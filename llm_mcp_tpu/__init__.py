@@ -1,0 +1,21 @@
+"""llm_mcp_tpu — a TPU-native distributed LLM inference router & execution plane.
+
+A brand-new framework with the capabilities of plagness/LLM-MCP (see SURVEY.md):
+an OpenAI-compatible API (`/v1/chat/completions` SSE, `/v1/embeddings`), a
+durable job queue with lease/heartbeat worker protocol, smart quality-tier
+routing with circuit breakers and device limits, cluster discovery, cost
+accounting, benchmarks and observability — with the crucial difference that
+inference runs **in-process on TPU** via a JAX/XLA executor (pjit-sharded
+autoregressive decode, Pallas attention, HBM-resident embedding encoders)
+instead of being delegated to external Ollama/cloud endpoints.
+
+Layering (SURVEY.md §7):
+  L1 executor   — llm_mcp_tpu.{models,ops,parallel,executor}
+  L2 state      — llm_mcp_tpu.state (durable queue + catalog)
+  L3 policy     — llm_mcp_tpu.{routing,discovery}
+  L4 core API   — llm_mcp_tpu.api
+  L5 bridges    — llm_mcp_tpu.mcpsrv
+  L6 ops        — ops_deploy/, telemetry
+"""
+
+__version__ = "0.1.0"

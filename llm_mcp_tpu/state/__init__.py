@@ -1,0 +1,5 @@
+from .db import Database
+from .queue import JobQueue, Job, JobStatus
+from .catalog import Catalog, infer_model_meta
+
+__all__ = ["Database", "JobQueue", "Job", "JobStatus", "Catalog", "infer_model_meta"]

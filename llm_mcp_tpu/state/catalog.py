@@ -1,0 +1,412 @@
+"""Catalog operations: devices, models, device_models, benchmarks, costs, stats.
+
+Parity map (reference):
+  - device upsert:        `core/internal/discovery/discovery.go:200-246`
+  - model catalog sync:   `discovery.go:482-624` (tier/thinking/context_k/kind
+                          inference from model names)
+  - benchmark record:     `grpcserver/server.go:302-327` (ReportBenchmark)
+  - cost accounting:      `handlers.go:836-869` (RecordCost),
+                          `2608-2634` (recordChatCost)
+  - model stats:          `handlers.go:3147-3171` (updateModelStats)
+  - rankings:             `db/migrations/05_chat_rankings.sql`
+  - worker registry:      `grpcserver/server.go:98-124` (RegisterWorker)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from .db import Database
+
+# Model-name → metadata inference (parity: discovery.go:482-560). Families are
+# keyed on substrings of the canonical model name.
+_TIER_BY_PARAMS = (
+    (3.0, "turbo"),
+    (9.0, "economy"),
+    (35.0, "standard"),
+    (80.0, "premium"),
+    (200.0, "ultra"),
+)
+
+_EMBED_MARKERS = ("embed", "bge", "minilm", "e5-", "gte-")
+_THINKING_MARKERS = ("r1", "think", "qwq", "reason", "o1", "o3")
+
+_CONTEXT_K_BY_FAMILY = {
+    "llama": 128,
+    "qwen": 128,
+    "mistral": 32,
+    "gemma": 8,
+    "phi": 16,
+    "deepseek": 64,
+    "nomic": 8,
+}
+
+
+def infer_model_meta(name: str, params_b: float = 0.0) -> dict[str, Any]:
+    """Infer kind/tier/thinking/context_k from a model name.
+
+    Mirrors the reference's name-based catalog inference at discovery time
+    (`discovery.go:482-560`): tier from parameter count, thinking from
+    r1/qwq-style markers, context_k per family, kind=embed for encoder names.
+    """
+    low = name.lower()
+    kind = "embed" if any(m in low for m in _EMBED_MARKERS) else "llm"
+    thinking = any(m in low for m in _THINKING_MARKERS)
+    family = ""
+    for fam in _CONTEXT_K_BY_FAMILY:
+        if fam in low:
+            family = fam
+            break
+    context_k = _CONTEXT_K_BY_FAMILY.get(family, 8)
+    if params_b <= 0:
+        # try to parse "...-8b", "...:7b" style suffixes
+        import re
+
+        m = re.search(r"[-:_](\d+(?:\.\d+)?)b\b", low)
+        if m:
+            try:
+                params_b = float(m.group(1))
+            except ValueError:
+                params_b = 0.0
+    tier = "standard"
+    for cap, t in _TIER_BY_PARAMS:
+        if params_b and params_b <= cap:
+            tier = t
+            break
+    else:
+        if params_b:
+            tier = "max"
+    return {
+        "kind": kind,
+        "tier": tier,
+        "thinking": thinking,
+        "context_k": context_k,
+        "family": family,
+        "params_b": params_b,
+    }
+
+
+class Catalog:
+    def __init__(self, db: Database):
+        self.db = db
+
+    # -- devices -----------------------------------------------------------
+
+    def upsert_device(
+        self,
+        device_id: str,
+        *,
+        name: str = "",
+        addr: str = "",
+        online: bool = True,
+        tags: dict[str, Any] | None = None,
+    ) -> None:
+        now = time.time()
+        self.db.execute(
+            "INSERT INTO devices(id, name, addr, online, last_seen, tags, created_at)"
+            " VALUES(?,?,?,?,?,?,?)"
+            " ON CONFLICT(id) DO UPDATE SET name=excluded.name, addr=excluded.addr,"
+            " online=excluded.online, last_seen=excluded.last_seen, tags=excluded.tags",
+            (
+                device_id,
+                name or device_id,
+                addr,
+                1 if online else 0,
+                now if online else None,
+                Database.to_json(tags or {}),
+                now,
+            ),
+        )
+
+    def set_device_online(self, device_id: str, online: bool) -> None:
+        now = time.time()
+        if online:
+            self.db.execute(
+                "UPDATE devices SET online=1, last_seen=? WHERE id=?", (now, device_id)
+            )
+        else:
+            self.db.execute("UPDATE devices SET online=0 WHERE id=?", (device_id,))
+
+    def get_device(self, device_id: str) -> dict[str, Any] | None:
+        row = self.db.query_one("SELECT * FROM devices WHERE id=?", (device_id,))
+        if row:
+            row["tags"] = Database.from_json(row["tags"], {})
+        return row
+
+    def list_devices(self, online_only: bool = False) -> list[dict[str, Any]]:
+        sql = "SELECT * FROM devices"
+        if online_only:
+            sql += " WHERE online=1"
+        rows = self.db.query(sql + " ORDER BY id")
+        for r in rows:
+            r["tags"] = Database.from_json(r["tags"], {})
+        return rows
+
+    def record_device_metrics(self, device_id: str, metrics: dict[str, Any]) -> None:
+        self.db.execute(
+            "INSERT INTO device_metrics(device_id, ts, metrics) VALUES(?,?,?)",
+            (device_id, time.time(), Database.to_json(metrics)),
+        )
+
+    # -- models ------------------------------------------------------------
+
+    def upsert_model(
+        self,
+        model_id: str,
+        *,
+        kind: str | None = None,
+        params_b: float | None = None,
+        size_gb: float = 0.0,
+        tier: str | None = None,
+        thinking: bool | None = None,
+        context_k: int | None = None,
+        family: str | None = None,
+    ) -> None:
+        meta = infer_model_meta(model_id, params_b or 0.0)
+        now = time.time()
+        self.db.execute(
+            "INSERT INTO models(id, name, family, kind, params_b, size_gb, tier,"
+            " thinking, context_k, created_at) VALUES(?,?,?,?,?,?,?,?,?,?)"
+            " ON CONFLICT(id) DO UPDATE SET kind=excluded.kind,"
+            " params_b=excluded.params_b, size_gb=excluded.size_gb,"
+            " tier=excluded.tier, thinking=excluded.thinking,"
+            " context_k=excluded.context_k, family=excluded.family",
+            (
+                model_id,
+                model_id,
+                family if family is not None else meta["family"],
+                kind or meta["kind"],
+                params_b if params_b is not None else meta["params_b"],
+                size_gb,
+                tier or meta["tier"],
+                1 if (thinking if thinking is not None else meta["thinking"]) else 0,
+                context_k or meta["context_k"],
+                now,
+            ),
+        )
+
+    def get_model(self, model_id: str) -> dict[str, Any] | None:
+        return self.db.query_one("SELECT * FROM models WHERE id=?", (model_id,))
+
+    def list_models(self, kind: str | None = None) -> list[dict[str, Any]]:
+        if kind:
+            return self.db.query("SELECT * FROM models WHERE kind=? ORDER BY id", (kind,))
+        return self.db.query("SELECT * FROM models ORDER BY id")
+
+    def set_pricing(self, model_id: str, input_per_1m: float, output_per_1m: float) -> None:
+        self.db.execute(
+            "INSERT INTO model_pricing(model_id, input_per_1m, output_per_1m, updated_at)"
+            " VALUES(?,?,?,?) ON CONFLICT(model_id) DO UPDATE SET"
+            " input_per_1m=excluded.input_per_1m, output_per_1m=excluded.output_per_1m,"
+            " updated_at=excluded.updated_at",
+            (model_id, input_per_1m, output_per_1m, time.time()),
+        )
+
+    def get_pricing(self, model_id: str) -> dict[str, Any] | None:
+        return self.db.query_one("SELECT * FROM model_pricing WHERE model_id=?", (model_id,))
+
+    # -- device_models -----------------------------------------------------
+
+    def sync_device_models(self, device_id: str, model_ids: list[str]) -> None:
+        """Upsert the given models as available on the device and mark models
+        no longer present as unavailable (`discovery.go:562-624`)."""
+        now = time.time()
+        with self.db.transaction() as conn:
+            for mid in model_ids:
+                conn.execute(
+                    "INSERT INTO device_models(device_id, model_id, available, last_synced)"
+                    " VALUES(?,?,1,?) ON CONFLICT(device_id, model_id) DO UPDATE SET"
+                    " available=1, last_synced=excluded.last_synced",
+                    (device_id, mid, now),
+                )
+            if model_ids:
+                marks = ",".join("?" * len(model_ids))
+                conn.execute(
+                    f"UPDATE device_models SET available=0 WHERE device_id=?"
+                    f" AND model_id NOT IN ({marks})",
+                    [device_id, *model_ids],
+                )
+            else:
+                conn.execute(
+                    "UPDATE device_models SET available=0 WHERE device_id=?", (device_id,)
+                )
+
+    def device_models(self, device_id: str) -> list[str]:
+        rows = self.db.query(
+            "SELECT model_id FROM device_models WHERE device_id=? AND available=1",
+            (device_id,),
+        )
+        return [r["model_id"] for r in rows]
+
+    # -- benchmarks --------------------------------------------------------
+
+    def record_benchmark(
+        self,
+        device_id: str,
+        model_id: str,
+        task_type: str,
+        *,
+        tokens_in: int = 0,
+        tokens_out: int = 0,
+        latency_ms: float = 0.0,
+        tps: float = 0.0,
+    ) -> None:
+        self.db.execute(
+            "INSERT INTO benchmarks(device_id, model_id, task_type, tokens_in,"
+            " tokens_out, latency_ms, tps, created_at) VALUES(?,?,?,?,?,?,?,?)",
+            (device_id, model_id, task_type, tokens_in, tokens_out, latency_ms, tps, time.time()),
+        )
+
+    def latest_benchmark(
+        self, device_id: str, model_id: str, task_type: str
+    ) -> dict[str, Any] | None:
+        return self.db.query_one(
+            "SELECT * FROM benchmarks WHERE device_id=? AND model_id=? AND task_type=?"
+            " ORDER BY created_at DESC LIMIT 1",
+            (device_id, model_id, task_type),
+        )
+
+    def list_benchmarks(self, limit: int = 200) -> list[dict[str, Any]]:
+        return self.db.query(
+            "SELECT b.* FROM benchmarks b JOIN (SELECT device_id, model_id, task_type,"
+            " MAX(created_at) AS mc FROM benchmarks GROUP BY device_id, model_id, task_type) l"
+            " ON b.device_id=l.device_id AND b.model_id=l.model_id AND b.task_type=l.task_type"
+            " AND b.created_at=l.mc ORDER BY b.tps DESC LIMIT ?",
+            (limit,),
+        )
+
+    # -- costs & stats -----------------------------------------------------
+
+    def record_cost(
+        self,
+        model_id: str,
+        provider: str,
+        tokens_in: int,
+        tokens_out: int,
+        *,
+        job_id: str | None = None,
+        meta: dict[str, Any] | None = None,
+    ) -> float:
+        """Compute + persist USD cost from model_pricing (parity:
+        `calculate_job_cost()` 02_v2_improvements.sql:55, RecordCost
+        handlers.go:836-869). Returns the computed cost."""
+        pricing = self.get_pricing(model_id)
+        cost = 0.0
+        if pricing:
+            cost = (
+                tokens_in * pricing["input_per_1m"] / 1e6
+                + tokens_out * pricing["output_per_1m"] / 1e6
+            )
+        self.db.execute(
+            "INSERT INTO llm_costs(ts, model_id, provider, job_id, tokens_in,"
+            " tokens_out, cost_usd, meta) VALUES(?,?,?,?,?,?,?,?)",
+            (
+                time.time(),
+                model_id,
+                provider,
+                job_id,
+                tokens_in,
+                tokens_out,
+                cost,
+                Database.to_json(meta or {}),
+            ),
+        )
+        return cost
+
+    def costs_summary(self, since: float | None = None) -> list[dict[str, Any]]:
+        if since is None:
+            return self.db.query("SELECT * FROM v_cost_stats ORDER BY cost_usd DESC")
+        return self.db.query(
+            "SELECT model_id, provider, COUNT(*) AS requests, SUM(tokens_in) AS tokens_in,"
+            " SUM(tokens_out) AS tokens_out, SUM(cost_usd) AS cost_usd FROM llm_costs"
+            " WHERE ts >= ? GROUP BY model_id, provider ORDER BY cost_usd DESC",
+            (since,),
+        )
+
+    def update_model_stats(
+        self,
+        model_id: str,
+        *,
+        tokens_in: int = 0,
+        tokens_out: int = 0,
+        cost_usd: float = 0.0,
+        duration_ms: float = 0.0,
+        error: bool = False,
+    ) -> None:
+        now = time.time()
+        self.db.execute(
+            "INSERT INTO model_stats(model_id, requests, tokens_in, tokens_out, cost_usd,"
+            " total_duration_ms, errors, updated_at) VALUES(?,1,?,?,?,?,?,?)"
+            " ON CONFLICT(model_id) DO UPDATE SET requests=requests+1,"
+            " tokens_in=model_stats.tokens_in+excluded.tokens_in,"
+            " tokens_out=model_stats.tokens_out+excluded.tokens_out,"
+            " cost_usd=model_stats.cost_usd+excluded.cost_usd,"
+            " total_duration_ms=model_stats.total_duration_ms+excluded.total_duration_ms,"
+            " errors=model_stats.errors+excluded.errors, updated_at=excluded.updated_at",
+            (model_id, tokens_in, tokens_out, cost_usd, duration_ms, 1 if error else 0, now),
+        )
+
+    def record_feedback(self, model_id: str, up: bool) -> None:
+        now = time.time()
+        col = "feedback_up" if up else "feedback_down"
+        self.db.execute(
+            f"INSERT INTO model_stats(model_id, {col}, updated_at) VALUES(?,1,?)"
+            f" ON CONFLICT(model_id) DO UPDATE SET {col}={col}+1, updated_at=excluded.updated_at",
+            (model_id, now),
+        )
+
+    def model_stats(self) -> list[dict[str, Any]]:
+        """Per-model stats with computed success rate (generated columns in
+        the reference, 05_chat_rankings.sql:38-50)."""
+        rows = self.db.query("SELECT * FROM model_stats ORDER BY requests DESC")
+        for r in rows:
+            req = r["requests"] or 0
+            r["success_rate"] = (req - r["errors"]) / req if req else 0.0
+            fb = r["feedback_up"] + r["feedback_down"]
+            r["feedback_score"] = (r["feedback_up"] - r["feedback_down"]) / fb if fb else 0.0
+            r["avg_duration_ms"] = r["total_duration_ms"] / req if req else 0.0
+        return rows
+
+    # -- rankings ----------------------------------------------------------
+
+    def set_ranking(self, model_id: str, category: str, score: float) -> None:
+        self.db.execute(
+            "INSERT INTO model_rankings(model_id, category, score, updated_at)"
+            " VALUES(?,?,?,?) ON CONFLICT(model_id, category) DO UPDATE SET"
+            " score=excluded.score, updated_at=excluded.updated_at",
+            (model_id, category, score, time.time()),
+        )
+
+    def rankings(self, category: str | None = None) -> list[dict[str, Any]]:
+        if category:
+            return self.db.query(
+                "SELECT * FROM model_rankings WHERE category=? ORDER BY score DESC",
+                (category,),
+            )
+        return self.db.query("SELECT * FROM model_rankings ORDER BY category, score DESC")
+
+    # -- workers -----------------------------------------------------------
+
+    def register_worker(self, worker_id: str, name: str = "", kinds: list[str] | None = None) -> None:
+        now = time.time()
+        self.db.execute(
+            "INSERT INTO workers(id, name, kinds, last_heartbeat, started_at)"
+            " VALUES(?,?,?,?,?) ON CONFLICT(id) DO UPDATE SET name=excluded.name,"
+            " kinds=excluded.kinds, last_heartbeat=excluded.last_heartbeat",
+            (worker_id, name or worker_id, Database.to_json(kinds or []), now, now),
+        )
+
+    def worker_heartbeat(self, worker_id: str) -> None:
+        self.db.execute(
+            "UPDATE workers SET last_heartbeat=? WHERE id=?", (time.time(), worker_id)
+        )
+
+    def workers_online(self, within_seconds: float = 90.0) -> list[dict[str, Any]]:
+        rows = self.db.query(
+            "SELECT * FROM workers WHERE last_heartbeat >= ? ORDER BY id",
+            (time.time() - within_seconds,),
+        )
+        for r in rows:
+            r["kinds"] = Database.from_json(r["kinds"], [])
+        return rows

@@ -1,0 +1,146 @@
+"""Thread-safe SQLite database wrapper with an in-process notify bus.
+
+Role parity: the reference's pgx v5 pool over PostgreSQL 16 (`core/cmd/core/
+main.go:38-47`) plus the `pg_notify('job_update', id)` trigger
+(`db/migrations/03_notify_trigger.sql:4-18`). Postgres is external
+infrastructure in the reference; here the state layer is embedded (SQLite WAL)
+with identical queue semantics, and the notify trigger becomes an in-process
+listener registry fired by the queue layer on every status transition. SSE
+consumers in other processes fall back to polling, exactly like the
+reference's fallback path (`handlers.go:580-608`).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from collections.abc import Callable, Iterable
+from typing import Any
+
+from .schema import SCHEMA, SCHEMA_VERSION
+
+
+class Database:
+    """Serialized-writer SQLite handle, safe for many threads.
+
+    SQLite serializes writers at the file level; combined with the
+    single-connection lock here, any UPDATE claiming a job row is atomic —
+    which is exactly the guarantee the reference buys with
+    `FOR UPDATE SKIP LOCKED` (`handlers.go:247`, `grpcserver/server.go:150`).
+    """
+
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        self._lock = threading.RLock()
+        self._conn = sqlite3.connect(path, check_same_thread=False, timeout=30.0)
+        self._conn.row_factory = sqlite3.Row
+        self._conn.execute("PRAGMA foreign_keys=ON")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._listeners: list[Callable[[str, str], None]] = []
+        self._listeners_lock = threading.Lock()
+        self._init_schema()
+
+    def _init_schema(self) -> None:
+        with self._lock:
+            self._conn.executescript(SCHEMA)
+            self._conn.execute(
+                "INSERT INTO meta(key, value) VALUES('schema_version', ?) "
+                "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+                (str(SCHEMA_VERSION),),
+            )
+            self._conn.commit()
+
+    # -- query helpers -----------------------------------------------------
+
+    def execute(self, sql: str, params: Iterable[Any] = ()) -> sqlite3.Cursor:
+        with self._lock:
+            cur = self._conn.execute(sql, tuple(params))
+            self._conn.commit()
+            return cur
+
+    def executemany(self, sql: str, rows: Iterable[Iterable[Any]]) -> None:
+        with self._lock:
+            self._conn.executemany(sql, [tuple(r) for r in rows])
+            self._conn.commit()
+
+    def query(self, sql: str, params: Iterable[Any] = ()) -> list[dict[str, Any]]:
+        with self._lock:
+            cur = self._conn.execute(sql, tuple(params))
+            return [dict(r) for r in cur.fetchall()]
+
+    def query_one(self, sql: str, params: Iterable[Any] = ()) -> dict[str, Any] | None:
+        rows = self.query(sql, params)
+        return rows[0] if rows else None
+
+    def transaction(self) -> "_Txn":
+        """Exclusive write transaction (BEGIN IMMEDIATE)."""
+        return _Txn(self)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    # -- notify bus (03_notify_trigger.sql parity) -------------------------
+
+    def add_listener(self, fn: Callable[[str, str], None]) -> None:
+        """Register fn(channel, payload); fired on queue status transitions."""
+        with self._listeners_lock:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[str, str], None]) -> None:
+        with self._listeners_lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
+
+    def notify(self, channel: str, payload: str) -> None:
+        with self._listeners_lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(channel, payload)
+            except Exception:
+                pass
+
+    # -- small helpers used across layers ----------------------------------
+
+    @staticmethod
+    def now() -> float:
+        return time.time()
+
+    @staticmethod
+    def to_json(obj: Any) -> str:
+        return json.dumps(obj, ensure_ascii=False, separators=(",", ":"))
+
+    @staticmethod
+    def from_json(s: str | None, default: Any = None) -> Any:
+        if not s:
+            return default
+        try:
+            return json.loads(s)
+        except (ValueError, TypeError):
+            return default
+
+
+class _Txn:
+    """Context manager giving exclusive multi-statement write access."""
+
+    def __init__(self, db: Database):
+        self._db = db
+
+    def __enter__(self) -> sqlite3.Connection:
+        self._db._lock.acquire()
+        self._db._conn.execute("BEGIN IMMEDIATE")
+        return self._db._conn
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        try:
+            if exc_type is None:
+                self._db._conn.commit()
+            else:
+                self._db._conn.rollback()
+        finally:
+            self._db._lock.release()

@@ -1,0 +1,395 @@
+"""Durable job queue: submit / claim / complete / fail / heartbeat / lease.
+
+Semantics parity with the reference's Postgres-backed queue:
+  - submit:   `handlers.go:35-94` (INSERT RETURNING id, device-limit gate is
+              done by the routing layer before submit)
+  - claim:    `handlers.go:200-293` — single-job claim with a per-device
+              concurrency cap CTE; claim predicate includes expired leases so
+              crashed workers' jobs become re-claimable
+  - complete: `handlers.go:295-347`
+  - fail:     `handlers.go:349-411` — requeue while attempts < max_attempts,
+              else terminal error
+  - heartbeat:`handlers.go:413-445` — lease extension
+  - notify:   `db/migrations/03_notify_trigger.sql` — every status transition
+              fires `job_update` with the job id
+  - offline requeue: `core/internal/discovery/offline_handler.go:12-38` —
+              reset leases of running jobs on offline devices so they requeue
+              immediately
+
+Improvement over the reference (gap called out in SURVEY.md §5 item 6): jobs
+whose `deadline_at` has passed are marked terminal `error` at claim time
+instead of being executed late.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any
+
+from .db import Database
+
+JOB_UPDATE_CHANNEL = "job_update"
+
+
+class JobStatus:
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    ERROR = "error"
+    CANCELED = "canceled"
+
+    TERMINAL = (DONE, ERROR, CANCELED)
+
+
+@dataclass
+class Job:
+    id: str
+    kind: str
+    status: str
+    priority: int = 0
+    payload: dict[str, Any] = field(default_factory=dict)
+    result: dict[str, Any] | None = None
+    error: str | None = None
+    attempts: int = 0
+    max_attempts: int = 3
+    worker_id: str | None = None
+    device_id: str | None = None
+    lease_until: float | None = None
+    deadline_at: float | None = None
+    created_at: float = 0.0
+    updated_at: float = 0.0
+    started_at: float | None = None
+    finished_at: float | None = None
+
+    @classmethod
+    def from_row(cls, row: dict[str, Any]) -> "Job":
+        return cls(
+            id=row["id"],
+            kind=row["kind"],
+            status=row["status"],
+            priority=row["priority"],
+            payload=Database.from_json(row["payload"], {}),
+            result=Database.from_json(row["result"]),
+            error=row["error"],
+            attempts=row["attempts"],
+            max_attempts=row["max_attempts"],
+            worker_id=row["worker_id"],
+            device_id=row["device_id"],
+            lease_until=row["lease_until"],
+            deadline_at=row["deadline_at"],
+            created_at=row["created_at"],
+            updated_at=row["updated_at"],
+            started_at=row["started_at"],
+            finished_at=row["finished_at"],
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "kind": self.kind,
+            "status": self.status,
+            "priority": self.priority,
+            "payload": self.payload,
+            "result": self.result,
+            "error": self.error,
+            "attempts": self.attempts,
+            "max_attempts": self.max_attempts,
+            "worker_id": self.worker_id,
+            "device_id": self.device_id,
+            "lease_until": self.lease_until,
+            "deadline_at": self.deadline_at,
+            "created_at": self.created_at,
+            "updated_at": self.updated_at,
+            "started_at": self.started_at,
+            "finished_at": self.finished_at,
+        }
+
+
+class JobQueue:
+    def __init__(self, db: Database, default_max_attempts: int = 3):
+        self.db = db
+        self.default_max_attempts = default_max_attempts
+        # Condition used by in-process waiters (claim long-poll, SSE bridge).
+        self._cond = threading.Condition()
+
+    # -- notify ------------------------------------------------------------
+
+    def _notify(self, job_id: str) -> None:
+        self.db.notify(JOB_UPDATE_CHANNEL, job_id)
+        with self._cond:
+            self._cond.notify_all()
+
+    def wait_for_update(self, timeout: float) -> bool:
+        """Block until any job status changes (or timeout). In-process analog
+        of `LISTEN job_update` + WaitForNotification (`handlers.go:543-577`)."""
+        with self._cond:
+            return self._cond.wait(timeout)
+
+    # -- submit ------------------------------------------------------------
+
+    def submit(
+        self,
+        kind: str,
+        payload: dict[str, Any] | None = None,
+        *,
+        priority: int = 0,
+        max_attempts: int | None = None,
+        deadline_at: float | None = None,
+        job_id: str | None = None,
+    ) -> Job:
+        now = time.time()
+        payload = payload or {}
+        jid = job_id or uuid.uuid4().hex
+        device_id = payload.get("device_id") or None
+        self.db.execute(
+            "INSERT INTO jobs(id, kind, status, priority, payload, attempts,"
+            " max_attempts, device_id, deadline_at, created_at, updated_at)"
+            " VALUES(?,?,?,?,?,0,?,?,?,?,?)",
+            (
+                jid,
+                kind,
+                JobStatus.QUEUED,
+                priority,
+                Database.to_json(payload),
+                max_attempts or self.default_max_attempts,
+                device_id,
+                deadline_at,
+                now,
+                now,
+            ),
+        )
+        self._notify(jid)
+        return self.get(jid)  # type: ignore[return-value]
+
+    # -- read --------------------------------------------------------------
+
+    def get(self, job_id: str) -> Job | None:
+        row = self.db.query_one("SELECT * FROM jobs WHERE id=?", (job_id,))
+        return Job.from_row(row) if row else None
+
+    def list(
+        self,
+        status: str | None = None,
+        kind: str | None = None,
+        limit: int = 100,
+        offset: int = 0,
+    ) -> list[Job]:
+        sql = "SELECT * FROM jobs"
+        clauses, params = [], []
+        if status:
+            clauses.append("status=?")
+            params.append(status)
+        if kind:
+            clauses.append("kind=?")
+            params.append(kind)
+        if clauses:
+            sql += " WHERE " + " AND ".join(clauses)
+        sql += " ORDER BY created_at DESC LIMIT ? OFFSET ?"
+        params += [limit, offset]
+        return [Job.from_row(r) for r in self.db.query(sql, params)]
+
+    def counts_by_status(self) -> dict[str, int]:
+        rows = self.db.query("SELECT status, COUNT(*) AS n FROM jobs GROUP BY status")
+        return {r["status"]: r["n"] for r in rows}
+
+    # -- claim -------------------------------------------------------------
+
+    def claim(
+        self,
+        worker_id: str,
+        kinds: list[str] | None = None,
+        lease_seconds: float = 30.0,
+        device_max_concurrency: int = 0,
+    ) -> Job | None:
+        """Atomically claim one runnable job.
+
+        Claim predicate mirrors `handlers.go:200-293`: queued jobs, or running
+        jobs whose lease expired (crash recovery). Jobs are ordered by
+        priority DESC then created_at ASC. When `device_max_concurrency > 0`,
+        jobs pinned to a device that already has that many live running jobs
+        are skipped (the per-device concurrency cap CTE, `handlers.go:212-246`
+        — in the TPU build this cap models slots in the continuous batch).
+
+        Deadline enforcement (reference gap, SURVEY §5): expired-deadline jobs
+        are marked terminal instead of claimed.
+        """
+        now = time.time()
+        kinds = kinds or []
+        expired_ids: list[str] = []
+        claimed: dict[str, Any] | None = None
+
+        with self.db.transaction() as conn:
+            kind_clause = ""
+            params: list[Any] = [now]
+            if kinds:
+                kind_clause = " AND kind IN (%s)" % ",".join("?" * len(kinds))
+            sql = (
+                "SELECT * FROM jobs WHERE"
+                " (status='queued' OR (status='running' AND lease_until IS NOT NULL AND lease_until < ?))"
+                + kind_clause
+                + " ORDER BY priority DESC, created_at ASC LIMIT 50"
+            )
+            if kinds:
+                params += kinds
+            rows = [dict(r) for r in conn.execute(sql, params).fetchall()]
+
+            for row in rows:
+                if row["deadline_at"] is not None and row["deadline_at"] < now:
+                    expired_ids.append(row["id"])
+                    conn.execute(
+                        "UPDATE jobs SET status='error', error='deadline_exceeded',"
+                        " finished_at=?, updated_at=? WHERE id=? AND status IN ('queued','running')",
+                        (now, now, row["id"]),
+                    )
+                    continue
+                dev = row["device_id"]
+                if dev and device_max_concurrency > 0:
+                    cnt = conn.execute(
+                        "SELECT COUNT(*) FROM jobs WHERE device_id=? AND status='running'"
+                        " AND (lease_until IS NULL OR lease_until >= ?) AND id != ?",
+                        (dev, now, row["id"]),
+                    ).fetchone()[0]
+                    if cnt >= device_max_concurrency:
+                        continue
+                lease = now + lease_seconds
+                cur = conn.execute(
+                    "UPDATE jobs SET status='running', worker_id=?, lease_until=?,"
+                    " attempts=attempts+1, started_at=COALESCE(started_at, ?), updated_at=?"
+                    " WHERE id=? AND status IN ('queued','running')",
+                    (worker_id, lease, now, now, row["id"]),
+                )
+                if cur.rowcount == 1:
+                    conn.execute(
+                        "INSERT INTO job_attempts(job_id, attempt, worker_id, status, started_at)"
+                        " VALUES(?,?,?,?,?)",
+                        (row["id"], row["attempts"] + 1, worker_id, "running", now),
+                    )
+                    claimed = row
+                    break
+
+        for jid in expired_ids:
+            self._notify(jid)
+        if claimed is None:
+            return None
+        self._notify(claimed["id"])
+        return self.get(claimed["id"])
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def heartbeat(self, job_id: str, worker_id: str, lease_seconds: float = 30.0) -> bool:
+        now = time.time()
+        cur = self.db.execute(
+            "UPDATE jobs SET lease_until=?, updated_at=? WHERE id=? AND worker_id=? AND status='running'",
+            (now + lease_seconds, now, job_id, worker_id),
+        )
+        return cur.rowcount == 1
+
+    def complete(
+        self,
+        job_id: str,
+        worker_id: str,
+        result: dict[str, Any] | None = None,
+        metrics: dict[str, Any] | None = None,
+    ) -> bool:
+        now = time.time()
+        with self.db.transaction() as conn:
+            cur = conn.execute(
+                "UPDATE jobs SET status='done', result=?, finished_at=?, updated_at=?"
+                " WHERE id=? AND worker_id=? AND status='running'",
+                (Database.to_json(result or {}), now, now, job_id, worker_id),
+            )
+            ok = cur.rowcount == 1
+            if ok:
+                conn.execute(
+                    "UPDATE job_attempts SET status='done', finished_at=?"
+                    " WHERE job_id=? AND finished_at IS NULL",
+                    (now, job_id),
+                )
+                if metrics:
+                    row = conn.execute(
+                        "SELECT device_id FROM jobs WHERE id=?", (job_id,)
+                    ).fetchone()
+                    if row and row[0]:
+                        conn.execute(
+                            "INSERT INTO device_metrics(device_id, ts, metrics) VALUES(?,?,?)",
+                            (row[0], now, Database.to_json(metrics)),
+                        )
+        if ok:
+            self._notify(job_id)
+        return ok
+
+    def fail(self, job_id: str, worker_id: str, error: str) -> str | None:
+        """Fail an attempt: requeue while retry budget remains, else terminal.
+
+        Returns the resulting status ('queued' or 'error'), or None if the job
+        wasn't running under this worker. Mirrors `handlers.go:349-411`.
+        """
+        now = time.time()
+        status: str | None = None
+        with self.db.transaction() as conn:
+            row = conn.execute(
+                "SELECT attempts, max_attempts FROM jobs WHERE id=? AND worker_id=? AND status='running'",
+                (job_id, worker_id),
+            ).fetchone()
+            if row is None:
+                return None
+            attempts, max_attempts = row
+            if attempts < max_attempts:
+                status = JobStatus.QUEUED
+                conn.execute(
+                    "UPDATE jobs SET status='queued', worker_id=NULL, lease_until=NULL,"
+                    " error=?, updated_at=? WHERE id=?",
+                    (error, now, job_id),
+                )
+            else:
+                status = JobStatus.ERROR
+                conn.execute(
+                    "UPDATE jobs SET status='error', error=?, finished_at=?, updated_at=? WHERE id=?",
+                    (error, now, now, job_id),
+                )
+            conn.execute(
+                "UPDATE job_attempts SET status='error', error=?, finished_at=?"
+                " WHERE job_id=? AND finished_at IS NULL",
+                (error, now, job_id),
+            )
+        self._notify(job_id)
+        return status
+
+    def cancel(self, job_id: str) -> bool:
+        now = time.time()
+        cur = self.db.execute(
+            "UPDATE jobs SET status='canceled', finished_at=?, updated_at=?"
+            " WHERE id=? AND status IN ('queued','running')",
+            (now, now, job_id),
+        )
+        if cur.rowcount == 1:
+            self._notify(job_id)
+            return True
+        return False
+
+    def requeue_device_jobs(self, device_ids: list[str]) -> int:
+        """Reset leases of running jobs on offline devices so any worker can
+        reclaim them immediately (`offline_handler.go:12-38`)."""
+        if not device_ids:
+            return 0
+        now = time.time()
+        marks = ",".join("?" * len(device_ids))
+        cur = self.db.execute(
+            f"UPDATE jobs SET lease_until=?, updated_at=? WHERE device_id IN ({marks})"
+            " AND status='running'",
+            [now - 1.0, now, *device_ids],
+        )
+        return cur.rowcount
+
+    def purge_stale(self, older_than_days: float = 7.0) -> int:
+        """Delete terminal jobs older than N days (the documented-but-absent
+        planner cleanup, SURVEY §2 'Documented-but-absent')."""
+        cutoff = time.time() - older_than_days * 86400.0
+        cur = self.db.execute(
+            "DELETE FROM jobs WHERE status IN ('done','error','canceled') AND updated_at < ?",
+            (cutoff,),
+        )
+        return cur.rowcount

@@ -1,0 +1,219 @@
+"""State-layer schema: queue, device registry, model catalog, analytics.
+
+Parity: reference Postgres schema C13 —
+  `db/init/01_core.sql` (devices:4, device_metrics:19, models:36,
+  model_pricing:52, device_models:61, benchmarks:72, jobs:88, job_attempts:108,
+  device_limits:121), `db/migrations/02_v2_improvements.sql` (llm_costs,
+  v_cost_stats), `db/migrations/04_smart_routing.sql` (tier/thinking/context
+  columns, v_device_stats), `db/migrations/05_chat_rankings.sql`
+  (model_rankings, model_stats).
+
+Dialect: SQLite (WAL). The semantics the reference gets from Postgres
+(`FOR UPDATE SKIP LOCKED` claims, `pg_notify` on status change) are provided by
+the queue layer: SQLite's serialized writers make single-row claim updates
+atomic, and notifications are an in-process listener registry plus polling
+fallback for cross-process consumers (the reference also has a polling
+fallback, `handlers.go:580-608`).
+
+Timestamps are unix epoch seconds (REAL). JSON payloads are TEXT.
+"""
+
+SCHEMA_VERSION = 1
+
+SCHEMA = """
+PRAGMA journal_mode=WAL;
+
+CREATE TABLE IF NOT EXISTS meta (
+    key TEXT PRIMARY KEY,
+    value TEXT NOT NULL
+);
+
+-- Device registry (reference 01_core.sql:4). A "device" is a schedulable
+-- inference endpoint: a TPU slice served by an executor process, an extra
+-- HTTP endpoint, or a synthetic cloud device.
+CREATE TABLE IF NOT EXISTS devices (
+    id          TEXT PRIMARY KEY,
+    name        TEXT NOT NULL DEFAULT '',
+    addr        TEXT NOT NULL DEFAULT '',
+    online      INTEGER NOT NULL DEFAULT 0,
+    last_seen   REAL,
+    tags        TEXT NOT NULL DEFAULT '{}',   -- JSON: {tpu,chips,hbm_gb,mesh,base_device,...}
+    created_at  REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS device_metrics (
+    id          INTEGER PRIMARY KEY AUTOINCREMENT,
+    device_id   TEXT NOT NULL,
+    ts          REAL NOT NULL,
+    metrics     TEXT NOT NULL DEFAULT '{}'    -- JSON
+);
+CREATE INDEX IF NOT EXISTS idx_device_metrics_dev ON device_metrics(device_id, ts);
+
+-- Model catalog (01_core.sql:36 + 04_smart_routing.sql:5-6 tier/thinking/context).
+CREATE TABLE IF NOT EXISTS models (
+    id          TEXT PRIMARY KEY,             -- canonical model name
+    name        TEXT NOT NULL,
+    family      TEXT NOT NULL DEFAULT '',
+    kind        TEXT NOT NULL DEFAULT 'llm',  -- llm | embed
+    params_b    REAL NOT NULL DEFAULT 0,
+    size_gb     REAL NOT NULL DEFAULT 0,
+    tier        TEXT NOT NULL DEFAULT 'standard',
+    thinking    INTEGER NOT NULL DEFAULT 0,
+    context_k   INTEGER NOT NULL DEFAULT 8,
+    created_at  REAL NOT NULL
+);
+
+-- Per-1M-token pricing (01_core.sql:52; cloud seeds 04_smart_routing.sql:44-60).
+CREATE TABLE IF NOT EXISTS model_pricing (
+    model_id     TEXT PRIMARY KEY,
+    input_per_1m REAL NOT NULL DEFAULT 0,
+    output_per_1m REAL NOT NULL DEFAULT 0,
+    currency     TEXT NOT NULL DEFAULT 'USD',
+    updated_at   REAL NOT NULL
+);
+
+-- Which device has which model loaded/loadable (01_core.sql:61).
+CREATE TABLE IF NOT EXISTS device_models (
+    device_id   TEXT NOT NULL,
+    model_id    TEXT NOT NULL,
+    available   INTEGER NOT NULL DEFAULT 1,
+    last_synced REAL NOT NULL,
+    PRIMARY KEY (device_id, model_id)
+);
+
+-- Throughput/latency records driving routing (01_core.sql:72-84).
+CREATE TABLE IF NOT EXISTS benchmarks (
+    id          INTEGER PRIMARY KEY AUTOINCREMENT,
+    device_id   TEXT NOT NULL,
+    model_id    TEXT NOT NULL,
+    task_type   TEXT NOT NULL DEFAULT 'generate',  -- generate | embed | chat
+    tokens_in   INTEGER NOT NULL DEFAULT 0,
+    tokens_out  INTEGER NOT NULL DEFAULT 0,
+    latency_ms  REAL NOT NULL DEFAULT 0,
+    tps         REAL NOT NULL DEFAULT 0,
+    created_at  REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_benchmarks_key ON benchmarks(device_id, model_id, task_type, created_at);
+
+-- THE queue (01_core.sql:88). device_id is extracted from payload at write
+-- time (the reference uses payload->>'device_id' expression indexes,
+-- 02_v2_improvements.sql:7-9; SQLite gets a real column + index instead).
+CREATE TABLE IF NOT EXISTS jobs (
+    id           TEXT PRIMARY KEY,
+    kind         TEXT NOT NULL,
+    status       TEXT NOT NULL DEFAULT 'queued',  -- queued|running|done|error|canceled
+    priority     INTEGER NOT NULL DEFAULT 0,
+    payload      TEXT NOT NULL DEFAULT '{}',
+    result       TEXT,
+    error        TEXT,
+    attempts     INTEGER NOT NULL DEFAULT 0,
+    max_attempts INTEGER NOT NULL DEFAULT 3,
+    worker_id    TEXT,
+    device_id    TEXT,
+    lease_until  REAL,
+    deadline_at  REAL,
+    created_at   REAL NOT NULL,
+    updated_at   REAL NOT NULL,
+    started_at   REAL,
+    finished_at  REAL
+);
+CREATE INDEX IF NOT EXISTS idx_jobs_claim ON jobs(status, priority, created_at);
+CREATE INDEX IF NOT EXISTS idx_jobs_device ON jobs(device_id, status);
+CREATE INDEX IF NOT EXISTS idx_jobs_kind ON jobs(kind, status);
+
+-- Per-attempt audit trail (01_core.sql:108).
+CREATE TABLE IF NOT EXISTS job_attempts (
+    id          INTEGER PRIMARY KEY AUTOINCREMENT,
+    job_id      TEXT NOT NULL,
+    attempt     INTEGER NOT NULL,
+    worker_id   TEXT,
+    status      TEXT NOT NULL,
+    error       TEXT,
+    started_at  REAL,
+    finished_at REAL
+);
+CREATE INDEX IF NOT EXISTS idx_job_attempts_job ON job_attempts(job_id);
+
+-- Per-device capability caps (01_core.sql:121; derivation limits.go:124-160).
+CREATE TABLE IF NOT EXISTS device_limits (
+    device_id     TEXT PRIMARY KEY,
+    max_params_b  REAL NOT NULL DEFAULT 0,
+    max_size_gb   REAL NOT NULL DEFAULT 0,
+    max_context_k INTEGER NOT NULL DEFAULT 0,
+    allow_models  TEXT NOT NULL DEFAULT '[]',  -- JSON list
+    deny_models   TEXT NOT NULL DEFAULT '[]',  -- JSON list
+    source        TEXT NOT NULL DEFAULT 'derived',  -- derived | preset
+    updated_at    REAL NOT NULL
+);
+
+-- Cost accounting (02_v2_improvements.sql:12).
+CREATE TABLE IF NOT EXISTS llm_costs (
+    id         INTEGER PRIMARY KEY AUTOINCREMENT,
+    ts         REAL NOT NULL,
+    model_id   TEXT NOT NULL,
+    provider   TEXT NOT NULL DEFAULT '',
+    job_id     TEXT,
+    tokens_in  INTEGER NOT NULL DEFAULT 0,
+    tokens_out INTEGER NOT NULL DEFAULT 0,
+    cost_usd   REAL NOT NULL DEFAULT 0,
+    meta       TEXT NOT NULL DEFAULT '{}'
+);
+CREATE INDEX IF NOT EXISTS idx_llm_costs_ts ON llm_costs(ts);
+CREATE INDEX IF NOT EXISTS idx_llm_costs_model ON llm_costs(model_id, ts);
+
+-- Category scoring for smart chat model selection (05_chat_rankings.sql:9).
+CREATE TABLE IF NOT EXISTS model_rankings (
+    model_id  TEXT NOT NULL,
+    category  TEXT NOT NULL,        -- code | reasoning | chat | summarize | ...
+    score     REAL NOT NULL DEFAULT 0,
+    updated_at REAL NOT NULL,
+    PRIMARY KEY (model_id, category)
+);
+
+-- Rolling per-model stats (05_chat_rankings.sql:26-55; success_rate is computed
+-- in queries rather than a generated column).
+CREATE TABLE IF NOT EXISTS model_stats (
+    model_id          TEXT PRIMARY KEY,
+    requests          INTEGER NOT NULL DEFAULT 0,
+    tokens_in         INTEGER NOT NULL DEFAULT 0,
+    tokens_out        INTEGER NOT NULL DEFAULT 0,
+    cost_usd          REAL NOT NULL DEFAULT 0,
+    total_duration_ms REAL NOT NULL DEFAULT 0,
+    errors            INTEGER NOT NULL DEFAULT 0,
+    feedback_up       INTEGER NOT NULL DEFAULT 0,
+    feedback_down     INTEGER NOT NULL DEFAULT 0,
+    updated_at        REAL NOT NULL
+);
+
+-- Worker registry (reference RegisterWorker, grpcserver/server.go:98-124;
+-- dashboard "workers online" handlers.go:948-1092).
+CREATE TABLE IF NOT EXISTS workers (
+    id             TEXT PRIMARY KEY,
+    name           TEXT NOT NULL DEFAULT '',
+    kinds          TEXT NOT NULL DEFAULT '[]',  -- JSON list; empty = all kinds
+    last_heartbeat REAL,
+    started_at     REAL NOT NULL
+);
+
+-- Views: v_cost_stats (02_v2_improvements.sql:41), v_device_stats
+-- (04_smart_routing.sql:71).
+CREATE VIEW IF NOT EXISTS v_cost_stats AS
+    SELECT model_id,
+           provider,
+           COUNT(*)        AS requests,
+           SUM(tokens_in)  AS tokens_in,
+           SUM(tokens_out) AS tokens_out,
+           SUM(cost_usd)   AS cost_usd
+    FROM llm_costs GROUP BY model_id, provider;
+
+CREATE VIEW IF NOT EXISTS v_device_stats AS
+    SELECT d.id AS device_id,
+           d.name,
+           d.online,
+           COUNT(DISTINCT dm.model_id) AS models,
+           (SELECT COUNT(*) FROM jobs j WHERE j.device_id = d.id AND j.status = 'running') AS running_jobs,
+           (SELECT COUNT(*) FROM jobs j WHERE j.device_id = d.id AND j.status = 'queued') AS queued_jobs
+    FROM devices d
+    LEFT JOIN device_models dm ON dm.device_id = d.id AND dm.available = 1
+    GROUP BY d.id;
+"""

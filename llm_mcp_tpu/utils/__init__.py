@@ -1,0 +1,13 @@
+from .config import Config, getenv, getenv_int, getenv_float, getenv_bool
+from .tokens import estimate_tokens, messages_to_prompt, split_think
+
+__all__ = [
+    "Config",
+    "getenv",
+    "getenv_int",
+    "getenv_float",
+    "getenv_bool",
+    "estimate_tokens",
+    "messages_to_prompt",
+    "split_think",
+]

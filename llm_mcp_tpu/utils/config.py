@@ -1,0 +1,110 @@
+"""Env-var configuration helpers.
+
+Parity: reference `core/internal/config/config.go:9-34` (Getenv/GetenvInt and
+provider key presence checks). The reference uses pure env-var config with no
+flag library; we keep that model and add typed helpers plus a `Config` snapshot
+object so services can be constructed hermetically in tests.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+def getenv(key: str, default: str = "") -> str:
+    v = os.environ.get(key, "")
+    return v if v != "" else default
+
+
+def getenv_int(key: str, default: int) -> int:
+    v = os.environ.get(key, "")
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return default
+
+
+def getenv_float(key: str, default: float) -> float:
+    v = os.environ.get(key, "")
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return default
+
+
+def getenv_bool(key: str, default: bool = False) -> bool:
+    v = os.environ.get(key, "").strip().lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    return default
+
+
+@dataclass
+class Config:
+    """Snapshot of all service configuration.
+
+    Mirrors the env catalog of the reference (`compose.yml:26-42`,
+    `doc/README.md` env section) with TPU-specific additions.
+    """
+
+    # Core service
+    http_addr: str = field(default_factory=lambda: getenv("CORE_HTTP_ADDR", ":8080"))
+    grpc_addr: str = field(default_factory=lambda: getenv("CORE_GRPC_ADDR", ":9090"))
+    db_path: str = field(default_factory=lambda: getenv("DB_PATH", "llmmcp.sqlite3"))
+    db_dsn: str = field(default_factory=lambda: getenv("DB_DSN", ""))
+
+    # Discovery
+    discovery_interval_s: int = field(default_factory=lambda: getenv_int("DISCOVERY_INTERVAL", 60))
+    tpu_extra_endpoints: str = field(default_factory=lambda: getenv("TPU_EXTRA_ENDPOINTS", ""))
+    discovery_scan_subnets: bool = field(default_factory=lambda: getenv_bool("DISCOVERY_SCAN_SUBNETS"))
+    discovery_subnets: str = field(default_factory=lambda: getenv("DISCOVERY_SUBNETS", ""))
+
+    # Scheduling / limits
+    device_max_concurrency: int = field(default_factory=lambda: getenv_int("DEVICE_MAX_CONCURRENCY", 2))
+    strict_model_limits: bool = field(default_factory=lambda: getenv_bool("STRICT_MODEL_LIMITS"))
+    device_limits_json: str = field(default_factory=lambda: getenv("DEVICE_LIMITS_JSON", ""))
+    device_limits_file: str = field(default_factory=lambda: getenv("DEVICE_LIMITS_FILE", ""))
+    device_limits_interval_s: int = field(default_factory=lambda: getenv_int("DEVICE_LIMITS_INTERVAL", 300))
+
+    # Worker
+    worker_id: str = field(default_factory=lambda: getenv("WORKER_ID", ""))
+    worker_name: str = field(default_factory=lambda: getenv("WORKER_NAME", ""))
+    worker_kinds: str = field(default_factory=lambda: getenv("WORKER_KINDS", ""))
+    worker_lease_seconds: int = field(default_factory=lambda: getenv_int("WORKER_LEASE_SECONDS", 30))
+
+    # Cloud providers
+    openai_api_key: str = field(default_factory=lambda: getenv("OPENAI_API_KEY", ""))
+    openai_base_url: str = field(default_factory=lambda: getenv("OPENAI_BASE_URL", "https://api.openai.com/v1"))
+    openrouter_api_key: str = field(default_factory=lambda: getenv("OPENROUTER_API_KEY", ""))
+    openrouter_base_url: str = field(
+        default_factory=lambda: getenv("OPENROUTER_BASE_URL", "https://openrouter.ai/api/v1")
+    )
+    cloud_embed_dimensions: int = field(default_factory=lambda: getenv_int("CLOUD_EMBED_DIMENSIONS", 0))
+
+    # Knowledge services
+    lightrag_url: str = field(default_factory=lambda: getenv("LIGHTRAG_URL", ""))
+    lightrag_api_key: str = field(default_factory=lambda: getenv("LIGHTRAG_API_KEY", ""))
+    mem0_url: str = field(default_factory=lambda: getenv("MEM0_URL", ""))
+
+    # Telemetry
+    telegram_bot_token: str = field(default_factory=lambda: getenv("TELEGRAM_BOT_TOKEN", ""))
+    telegram_chat_id: str = field(default_factory=lambda: getenv("TELEGRAM_CHAT_ID", ""))
+    telemetry_interval_s: int = field(default_factory=lambda: getenv_int("TELEMETRY_INTERVAL", 30))
+    alert_fail_threshold: int = field(default_factory=lambda: getenv_int("ALERT_FAIL_THRESHOLD", 5))
+
+    # TPU executor
+    tpu_model: str = field(default_factory=lambda: getenv("TPU_MODEL", "llama-3.1-8b"))
+    tpu_embed_model: str = field(default_factory=lambda: getenv("TPU_EMBED_MODEL", "nomic-embed-text"))
+    tpu_weights_dir: str = field(default_factory=lambda: getenv("TPU_WEIGHTS_DIR", ""))
+    tpu_max_slots: int = field(default_factory=lambda: getenv_int("TPU_MAX_SLOTS", 32))
+    tpu_max_seq_len: int = field(default_factory=lambda: getenv_int("TPU_MAX_SEQ_LEN", 2048))
+    tpu_mesh_shape: str = field(default_factory=lambda: getenv("TPU_MESH_SHAPE", ""))  # e.g. "dp=1,tp=8"
+
+    def has_openai(self) -> bool:
+        return bool(self.openai_api_key)
+
+    def has_openrouter(self) -> bool:
+        return bool(self.openrouter_api_key)

@@ -1,0 +1,46 @@
+"""Structured JSON logging.
+
+Parity: the reference installs a `slog` JSON handler at process start
+(`core/cmd/core/main.go:27`) and logs method/path and routing decisions with
+correlated ids (`handlers.go:31`, `router.go:272,526`). Same idea here:
+one-line JSON records with a stable key set, on stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Any
+
+
+class JSONFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out: dict[str, Any] = {
+            "ts": round(time.time(), 3),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        extra = getattr(record, "kv", None)
+        if isinstance(extra, dict):
+            out.update(extra)
+        if record.exc_info and record.exc_info[0] is not None:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, ensure_ascii=False, default=str)
+
+
+def setup_logging(level: int = logging.INFO) -> None:
+    root = logging.getLogger()
+    root.setLevel(level)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(JSONFormatter())
+    root.addHandler(handler)
+
+
+def kv(logger: logging.Logger, level: int, msg: str, **fields: Any) -> None:
+    """Log `msg` with structured key/value fields."""
+    logger.log(level, msg, extra={"kv": fields})

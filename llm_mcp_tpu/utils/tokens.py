@@ -1,0 +1,59 @@
+"""Token estimation and prompt/message helpers.
+
+Parity targets in the reference:
+  - EstimateTokens: len/4 chars, floor 256 (`core/internal/routing/router.go:113-123`)
+  - MessagesToPrompt (`router.go`, tested at `router_test.go:68-97`)
+  - `<think>` tag splitting in worker results (`worker/llm_worker/main.py:207-219`)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+MIN_ESTIMATED_TOKENS = 256
+
+
+def estimate_tokens(text: str) -> int:
+    """Cheap context-size estimate: one token per 4 chars, floor 256.
+
+    Mirrors reference `router.go:113-123`; used for context-bucket routing
+    before any tokenizer runs.
+    """
+    if not text:
+        return MIN_ESTIMATED_TOKENS
+    return max(MIN_ESTIMATED_TOKENS, len(text) // 4)
+
+
+def messages_to_prompt(messages: list[dict[str, Any]]) -> str:
+    """Flatten chat messages to a single prompt string ("role: content" lines)."""
+    parts: list[str] = []
+    for m in messages or []:
+        role = str(m.get("role", "user"))
+        content = m.get("content", "")
+        if isinstance(content, list):  # OpenAI content-parts form
+            content = " ".join(
+                str(p.get("text", "")) for p in content if isinstance(p, dict)
+            )
+        parts.append(f"{role}: {content}")
+    return "\n".join(parts)
+
+
+def split_think(text: str) -> tuple[str, str]:
+    """Split `<think>...</think>` reasoning from the visible answer.
+
+    Returns (thinking, answer). Mirrors reference worker behavior
+    (`worker/llm_worker/main.py:207-219`): if the text starts with a think
+    block, extract it; otherwise thinking is empty.
+    """
+    if not text:
+        return "", text
+    stripped = text.lstrip()
+    if not stripped.startswith("<think>"):
+        return "", text
+    end = stripped.find("</think>")
+    if end < 0:
+        # Unterminated think block: everything is thinking.
+        return stripped[len("<think>"):].strip(), ""
+    thinking = stripped[len("<think>"):end].strip()
+    answer = stripped[end + len("</think>"):].lstrip("\n")
+    return thinking, answer

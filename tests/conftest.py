@@ -1,0 +1,39 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip sharding is tested without TPU hardware by running JAX's CPU
+backend with 8 virtual host devices (the pattern recommended in SURVEY.md §4:
+`XLA_FLAGS=--xla_force_host_platform_device_count=8`). Must run before the
+first `import jax` anywhere in the test session.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def db():
+    from llm_mcp_tpu.state import Database
+
+    d = Database(":memory:")
+    yield d
+    d.close()
+
+
+@pytest.fixture()
+def queue(db):
+    from llm_mcp_tpu.state import JobQueue
+
+    return JobQueue(db)
+
+
+@pytest.fixture()
+def catalog(db):
+    from llm_mcp_tpu.state import Catalog
+
+    return Catalog(db)

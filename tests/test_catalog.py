@@ -1,0 +1,93 @@
+"""Catalog tests: devices, models, benchmarks, costs, stats, rankings."""
+
+import time
+
+from llm_mcp_tpu.state.catalog import infer_model_meta
+
+
+def test_infer_model_meta_llm():
+    m = infer_model_meta("llama-3.1-8b")
+    assert m["kind"] == "llm"
+    assert m["family"] == "llama"
+    assert m["params_b"] == 8.0
+    assert m["tier"] == "economy"
+    assert m["context_k"] == 128
+    assert not m["thinking"]
+
+
+def test_infer_model_meta_embed_and_thinking():
+    assert infer_model_meta("nomic-embed-text")["kind"] == "embed"
+    assert infer_model_meta("qwen3-embedding-8b")["kind"] == "embed"
+    assert infer_model_meta("deepseek-r1-32b")["thinking"]
+    assert infer_model_meta("qwq-32b")["thinking"]
+
+
+def test_infer_tiers():
+    assert infer_model_meta("x-1b")["tier"] == "turbo"
+    assert infer_model_meta("x-30b")["tier"] == "standard"
+    assert infer_model_meta("x-70b")["tier"] == "premium"
+    assert infer_model_meta("x-120b")["tier"] == "ultra"
+    assert infer_model_meta("x-400b")["tier"] == "max"
+
+
+def test_device_upsert_and_online(catalog):
+    catalog.upsert_device("tpu0", addr="localhost:8090", tags={"tpu": True, "chips": 8})
+    d = catalog.get_device("tpu0")
+    assert d["online"] == 1 and d["tags"]["chips"] == 8
+    catalog.set_device_online("tpu0", False)
+    assert catalog.get_device("tpu0")["online"] == 0
+    assert catalog.list_devices(online_only=True) == []
+
+
+def test_model_sync_and_unavailable(catalog):
+    catalog.upsert_device("tpu0")
+    catalog.upsert_model("llama-3.1-8b")
+    catalog.upsert_model("nomic-embed-text")
+    catalog.sync_device_models("tpu0", ["llama-3.1-8b", "nomic-embed-text"])
+    assert sorted(catalog.device_models("tpu0")) == ["llama-3.1-8b", "nomic-embed-text"]
+    catalog.sync_device_models("tpu0", ["llama-3.1-8b"])
+    assert catalog.device_models("tpu0") == ["llama-3.1-8b"]
+
+
+def test_benchmarks_latest(catalog):
+    catalog.record_benchmark("tpu0", "m", "generate", tps=100.0, latency_ms=10)
+    time.sleep(0.01)
+    catalog.record_benchmark("tpu0", "m", "generate", tps=200.0, latency_ms=9)
+    latest = catalog.latest_benchmark("tpu0", "m", "generate")
+    assert latest["tps"] == 200.0
+    assert len(catalog.list_benchmarks()) == 1  # latest per key
+
+
+def test_cost_accounting(catalog):
+    catalog.upsert_model("gpt-x")
+    catalog.set_pricing("gpt-x", input_per_1m=1.0, output_per_1m=2.0)
+    cost = catalog.record_cost("gpt-x", "openrouter", tokens_in=1_000_000, tokens_out=500_000)
+    assert abs(cost - 2.0) < 1e-9
+    summary = catalog.costs_summary()
+    assert summary[0]["cost_usd"] == cost
+    assert summary[0]["requests"] == 1
+
+
+def test_model_stats_success_rate(catalog):
+    catalog.update_model_stats("m", tokens_in=10, tokens_out=20, duration_ms=100)
+    catalog.update_model_stats("m", tokens_in=10, tokens_out=20, duration_ms=300, error=True)
+    catalog.record_feedback("m", up=True)
+    stats = catalog.model_stats()[0]
+    assert stats["requests"] == 2
+    assert stats["errors"] == 1
+    assert stats["success_rate"] == 0.5
+    assert stats["avg_duration_ms"] == 200
+    assert stats["feedback_score"] == 1.0
+
+
+def test_rankings(catalog):
+    catalog.set_ranking("a", "code", 9.0)
+    catalog.set_ranking("b", "code", 7.0)
+    ranked = catalog.rankings("code")
+    assert [r["model_id"] for r in ranked] == ["a", "b"]
+
+
+def test_workers(catalog):
+    catalog.register_worker("w1", kinds=["generate"])
+    online = catalog.workers_online()
+    assert len(online) == 1 and online[0]["kinds"] == ["generate"]

@@ -1,0 +1,193 @@
+"""Queue semantics tests.
+
+Covers the invariants the reference enforces in SQL (SURVEY.md §4):
+SKIP-LOCKED-style exclusive claim, per-device concurrency caps, lease expiry
+reclaim, retry budget, heartbeat extension, deadline enforcement (our
+improvement), offline-device requeue, and notify on status change.
+"""
+
+import threading
+import time
+
+from llm_mcp_tpu.state import JobStatus
+
+
+def test_submit_and_get(queue):
+    job = queue.submit("echo", {"msg": "hi"}, priority=5)
+    assert job.id and job.status == JobStatus.QUEUED
+    got = queue.get(job.id)
+    assert got.payload == {"msg": "hi"}
+    assert got.priority == 5
+    assert got.max_attempts == 3
+
+
+def test_claim_order_priority_then_fifo(queue):
+    a = queue.submit("echo", {}, priority=0)
+    b = queue.submit("echo", {}, priority=10)
+    c = queue.submit("echo", {}, priority=0)
+    ids = [queue.claim("w1").id, queue.claim("w1").id, queue.claim("w1").id]
+    assert ids == [b.id, a.id, c.id]
+    assert queue.claim("w1") is None
+
+
+def test_claim_is_exclusive(queue):
+    queue.submit("echo", {})
+    results = []
+    barrier = threading.Barrier(8)
+
+    def worker(i):
+        barrier.wait()
+        results.append(queue.claim(f"w{i}"))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    claimed = [r for r in results if r is not None]
+    assert len(claimed) == 1
+
+
+def test_kind_filter(queue):
+    queue.submit("embed", {})
+    gen = queue.submit("generate", {})
+    job = queue.claim("w1", kinds=["generate"])
+    assert job.id == gen.id
+
+
+def test_device_concurrency_cap(queue):
+    for _ in range(3):
+        queue.submit("generate", {"device_id": "tpu0"})
+    j1 = queue.claim("w1", device_max_concurrency=2)
+    j2 = queue.claim("w2", device_max_concurrency=2)
+    assert j1 and j2
+    assert queue.claim("w3", device_max_concurrency=2) is None
+    queue.complete(j1.id, "w1", {"ok": True})
+    assert queue.claim("w3", device_max_concurrency=2) is not None
+
+
+def test_lease_expiry_reclaim(queue):
+    queue.submit("echo", {})
+    j = queue.claim("w1", lease_seconds=0.05)
+    assert j.status == JobStatus.RUNNING
+    assert queue.claim("w2") is None  # lease still held
+    time.sleep(0.1)
+    j2 = queue.claim("w2")
+    assert j2 is not None and j2.id == j.id
+    assert j2.attempts == 2
+
+
+def test_heartbeat_extends_lease(queue):
+    queue.submit("echo", {})
+    j = queue.claim("w1", lease_seconds=0.1)
+    time.sleep(0.06)
+    assert queue.heartbeat(j.id, "w1", lease_seconds=5.0)
+    time.sleep(0.06)
+    assert queue.claim("w2") is None  # extended lease still held
+    # wrong worker can't heartbeat
+    assert not queue.heartbeat(j.id, "w2")
+
+
+def test_complete(queue):
+    queue.submit("echo", {})
+    j = queue.claim("w1")
+    assert queue.complete(j.id, "w1", {"answer": 42})
+    got = queue.get(j.id)
+    assert got.status == JobStatus.DONE
+    assert got.result == {"answer": 42}
+    assert got.finished_at is not None
+    # completing again is a no-op
+    assert not queue.complete(j.id, "w1", {})
+
+
+def test_fail_requeue_then_terminal(queue):
+    queue.submit("echo", {}, max_attempts=2)
+    j = queue.claim("w1")
+    assert queue.fail(j.id, "w1", "boom") == JobStatus.QUEUED
+    j = queue.claim("w1")
+    assert j.attempts == 2
+    assert queue.fail(j.id, "w1", "boom2") == JobStatus.ERROR
+    got = queue.get(j.id)
+    assert got.status == JobStatus.ERROR
+    assert got.error == "boom2"
+
+
+def test_job_attempts_audit_trail(queue, db):
+    queue.submit("echo", {}, max_attempts=2)
+    j = queue.claim("w1")
+    queue.fail(j.id, "w1", "x")
+    j = queue.claim("w2")
+    queue.complete(j.id, "w2", {})
+    rows = db.query("SELECT * FROM job_attempts WHERE job_id=? ORDER BY attempt", (j.id,))
+    assert [r["status"] for r in rows] == ["error", "done"]
+    assert rows[0]["worker_id"] == "w1" and rows[1]["worker_id"] == "w2"
+
+
+def test_deadline_enforced_at_claim(queue):
+    queue.submit("echo", {}, deadline_at=time.time() - 1)
+    live = queue.submit("echo", {})
+    j = queue.claim("w1")
+    assert j.id == live.id  # expired job skipped
+    dead = [x for x in queue.list(status=JobStatus.ERROR)]
+    assert len(dead) == 1 and dead[0].error == "deadline_exceeded"
+
+
+def test_cancel(queue):
+    j = queue.submit("echo", {})
+    assert queue.cancel(j.id)
+    assert queue.get(j.id).status == JobStatus.CANCELED
+    assert not queue.cancel(j.id)
+    assert queue.claim("w1") is None
+
+
+def test_requeue_offline_device_jobs(queue):
+    queue.submit("generate", {"device_id": "tpu0"})
+    j = queue.claim("w1", lease_seconds=300)
+    assert queue.claim("w2") is None
+    n = queue.requeue_device_jobs(["tpu0"])
+    assert n == 1
+    j2 = queue.claim("w2")
+    assert j2 is not None and j2.id == j.id
+
+
+def test_notify_on_transitions(queue, db):
+    events = []
+    db.add_listener(lambda ch, payload: events.append((ch, payload)))
+    j = queue.submit("echo", {})
+    c = queue.claim("w1")
+    queue.complete(c.id, "w1", {})
+    assert [e[1] for e in events] == [j.id, j.id, j.id]
+    assert all(e[0] == "job_update" for e in events)
+
+
+def test_wait_for_update(queue):
+    got = []
+
+    def waiter():
+        got.append(queue.wait_for_update(timeout=5.0))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    queue.submit("echo", {})
+    t.join(timeout=2.0)
+    assert got == [True]
+
+
+def test_purge_stale(queue, db):
+    j = queue.submit("echo", {})
+    c = queue.claim("w1")
+    queue.complete(c.id, "w1", {})
+    db.execute("UPDATE jobs SET updated_at=? WHERE id=?", (time.time() - 8 * 86400, j.id))
+    assert queue.purge_stale(7.0) == 1
+    assert queue.get(j.id) is None
+
+
+def test_counts_by_status(queue):
+    queue.submit("echo", {})
+    queue.submit("echo", {})
+    j = queue.claim("w1")
+    queue.complete(j.id, "w1", {})
+    counts = queue.counts_by_status()
+    assert counts.get("queued") == 1
+    assert counts.get("done") == 1

@@ -1,0 +1,63 @@
+"""Utils tests: token estimation, message flattening, think-splitting, config.
+
+Parity targets: reference `router_test.go:11-97` (EstimateTokens,
+MessagesToPrompt) and think-tag handling (`worker/llm_worker/main.py:207-219`).
+"""
+
+from llm_mcp_tpu.utils import (
+    estimate_tokens,
+    messages_to_prompt,
+    split_think,
+    getenv_int,
+    getenv_bool,
+    Config,
+)
+
+
+def test_estimate_tokens_floor():
+    assert estimate_tokens("") == 256
+    assert estimate_tokens("abc") == 256
+    assert estimate_tokens("x" * 1024) == 256
+    assert estimate_tokens("x" * 4096) == 1024
+
+
+def test_messages_to_prompt():
+    msgs = [
+        {"role": "system", "content": "be nice"},
+        {"role": "user", "content": "hi"},
+    ]
+    assert messages_to_prompt(msgs) == "system: be nice\nuser: hi"
+    # content-parts form
+    msgs = [{"role": "user", "content": [{"type": "text", "text": "a"}, {"type": "text", "text": "b"}]}]
+    assert messages_to_prompt(msgs) == "user: a b"
+    assert messages_to_prompt([]) == ""
+
+
+def test_split_think():
+    t, a = split_think("<think>hmm</think>hello")
+    assert t == "hmm" and a == "hello"
+    t, a = split_think("no think here")
+    assert t == "" and a == "no think here"
+    t, a = split_think("<think>unterminated")
+    assert t == "unterminated" and a == ""
+    t, a = split_think("")
+    assert t == "" and a == ""
+
+
+def test_env_helpers(monkeypatch):
+    monkeypatch.setenv("X_INT", "42")
+    monkeypatch.setenv("X_BAD", "nope")
+    monkeypatch.setenv("X_BOOL", "true")
+    assert getenv_int("X_INT", 1) == 42
+    assert getenv_int("X_BAD", 7) == 7
+    assert getenv_int("X_MISSING", 9) == 9
+    assert getenv_bool("X_BOOL")
+    assert not getenv_bool("X_MISSING")
+
+
+def test_config_snapshot(monkeypatch):
+    monkeypatch.setenv("DEVICE_MAX_CONCURRENCY", "5")
+    monkeypatch.setenv("OPENROUTER_API_KEY", "sk-test")
+    cfg = Config()
+    assert cfg.device_max_concurrency == 5
+    assert cfg.has_openrouter() and not cfg.has_openai()
