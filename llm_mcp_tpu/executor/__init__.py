@@ -1,0 +1,11 @@
+from .tokenizer import ByteTokenizer, load_tokenizer
+from .engine import GenerationEngine, GenRequest
+from .embedding import EmbeddingEngine
+
+__all__ = [
+    "ByteTokenizer",
+    "load_tokenizer",
+    "GenerationEngine",
+    "GenRequest",
+    "EmbeddingEngine",
+]

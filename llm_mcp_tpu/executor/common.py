@@ -1,0 +1,15 @@
+"""Shared executor helpers."""
+
+from __future__ import annotations
+
+
+def pow2_bucket(n: int, cap: int, floor: int = 32) -> int:
+    """Smallest power-of-two ≥ n (min `floor`), capped at `cap`.
+
+    Prompt/batch padding buckets: each bucket shape compiles once under jit,
+    so a handful of power-of-two sizes covers all input lengths.
+    """
+    b = floor
+    while b < n:
+        b *= 2
+    return min(b, cap)

@@ -1,0 +1,95 @@
+"""HBM-resident embedding engine serving `/v1/embeddings`.
+
+Replaces the reference's Ollama `/api/embed` proxy path
+(`core/internal/api/handlers.go:1942-2015`): batch inputs run as one jitted
+encoder forward per length bucket, entirely on TPU. Matryoshka `dimensions`
+support is exact (truncate + renormalize) rather than the reference's
+client-side truncation fallback (`handlers.go:2063-2078`).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.configs import ModelConfig, get_config
+from ..models.embedder import init_embedder_params, embed_forward
+from ..parallel.sharding import embedder_param_specs, shard_pytree
+from .common import pow2_bucket
+from .tokenizer import Tokenizer, load_tokenizer
+
+
+class EmbeddingEngine:
+    def __init__(
+        self,
+        model: str | ModelConfig = "tiny-embed",
+        *,
+        mesh=None,
+        params: Any = None,
+        tokenizer: Tokenizer | None = None,
+        max_batch: int = 64,
+        max_seq_len: int = 512,
+        dtype: Any = jnp.bfloat16,
+        seed: int = 0,
+        weights_dir: str = "",
+    ):
+        self.cfg = get_config(model) if isinstance(model, str) else model
+        self.mesh = mesh
+        self.max_batch = max_batch
+        self.max_seq_len = max_seq_len
+        self.tokenizer: Tokenizer = tokenizer or load_tokenizer(weights_dir)
+
+        if params is None:
+            params = init_embedder_params(self.cfg, jax.random.PRNGKey(seed), dtype=dtype)
+        if mesh is not None:
+            params = shard_pytree(params, embedder_param_specs(self.cfg), mesh)
+        self.params = params
+
+        cfg = self.cfg
+
+        @jax.jit
+        def fwd(params, tokens, lengths):
+            return embed_forward(cfg, params, tokens, lengths)
+
+        self._fwd = fwd
+        self._lock = threading.Lock()
+        self.total_inputs = 0
+        self.total_tokens = 0
+
+    def _bucket(self, n: int) -> int:
+        return pow2_bucket(n, self.max_seq_len)
+
+    def embed(
+        self, texts: list[str], dimensions: int | None = None
+    ) -> tuple[list[list[float]], int]:
+        """Encode texts → (vectors, total_tokens). Batches of up to
+        `max_batch`, padded per-batch to the longest bucket."""
+        if not texts:
+            return [], 0
+        all_ids = [self.tokenizer.encode(t)[: self.max_seq_len] for t in texts]
+        total_tokens = sum(len(i) for i in all_ids)
+        vectors: list[list[float]] = []
+
+        with self._lock:
+            for i in range(0, len(all_ids), self.max_batch):
+                chunk = all_ids[i : i + self.max_batch]
+                B = len(chunk)
+                bucket = self._bucket(max(len(c) for c in chunk))
+                tokens = np.zeros((B, bucket), dtype=np.int32)
+                lengths = np.zeros(B, dtype=np.int32)
+                for j, ids in enumerate(chunk):
+                    tokens[j, : len(ids)] = ids
+                    lengths[j] = len(ids)
+                out = np.asarray(self._fwd(self.params, tokens, lengths), dtype=np.float32)
+                if dimensions and 0 < dimensions < out.shape[1]:
+                    out = out[:, :dimensions]
+                    norms = np.maximum(np.linalg.norm(out, axis=1, keepdims=True), 1e-9)
+                    out = out / norms
+                vectors.extend(out.tolist())
+            self.total_inputs += len(texts)
+            self.total_tokens += total_tokens
+        return vectors, total_tokens

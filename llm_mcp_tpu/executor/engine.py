@@ -1,0 +1,525 @@
+"""Continuous-batching TPU generation engine.
+
+This is the subsystem the reference *does not have*: it streams someone else's
+tokens over HTTP (Ollama `/api/chat` NDJSON → SSE transform,
+`core/internal/api/handlers.go:2427-2587`). Here the decode hot loop runs
+in-process on TPU and the API layer streams tokens straight out of it.
+
+Design (SURVEY.md §7 "hard parts"):
+
+  - **Slots**: the engine owns a static-shape KV cache of `max_slots`
+    sequences. The reference's per-device concurrency cap
+    (`handlers.go:212-246`) maps to free slots in this batch.
+  - **Continuous batching**: requests join/leave the running batch at chunk
+    boundaries; one jitted decode step serves all active slots.
+  - **Chunked dispatch**: decode runs `decode_chunk` steps per device call via
+    `lax.scan`, so the [K, B] token block is the only per-chunk host sync —
+    dispatch overhead is amortized K×, while SSE streaming granularity stays
+    at K tokens.
+  - **Bucketed prefill**: prompts pad to power-of-two buckets; each bucket
+    compiles once. Prompt KV inserts into the slot via a donated
+    dynamic-update — no cache copies.
+  - **On-device sampling**: logits never leave HBM (ops/sampling.py).
+  - **Sharding**: with a mesh, params/cache shard per parallel/sharding.py
+    (TP over ICI); the engine code is identical on 1 chip and N chips.
+
+Threading: one engine thread owns the device loop; requests arrive on a
+queue; each request streams tokens out through its own `queue.Queue`, which
+the aiohttp layer bridges to SSE without head-of-line blocking.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.configs import ModelConfig, get_config
+from ..models.llama import (
+    init_llama_params,
+    init_kv_cache,
+    llama_prefill,
+    llama_decode_step,
+)
+from ..ops.sampling import sample_tokens
+from ..parallel.sharding import llama_param_specs, kv_cache_specs, shard_pytree
+from .common import pow2_bucket
+from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
+
+log = logging.getLogger("engine")
+
+_DONE = object()
+
+
+@dataclass
+class GenRequest:
+    prompt_ids: list[int]
+    max_tokens: int = 256
+    temperature: float = 0.7
+    top_k: int = 0
+    top_p: float = 1.0
+    stop: list[str] = field(default_factory=list)
+    request_id: str = field(default_factory=lambda: uuid.uuid4().hex)
+    # filled by the engine
+    out: "queue.Queue[Any]" = field(default_factory=queue.Queue)
+    created_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class _Slot:
+    req: GenRequest
+    generated: int = 0
+    text: str = ""
+    pending: bytes = b""
+    prompt_len: int = 0
+    first_token_at: float = 0.0
+
+
+class GenerationEngine:
+    def __init__(
+        self,
+        model: str | ModelConfig = "tiny-llm",
+        *,
+        mesh=None,
+        params: Any = None,
+        tokenizer: Tokenizer | None = None,
+        max_slots: int = 8,
+        max_seq_len: int = 512,
+        dtype: Any = jnp.bfloat16,
+        seed: int = 0,
+        decode_chunk: int = 4,
+        weights_dir: str = "",
+    ):
+        self.cfg = get_config(model) if isinstance(model, str) else model
+        self.mesh = mesh
+        self.dtype = dtype
+        self.max_slots = max_slots
+        self.max_seq_len = max_seq_len
+        self.decode_chunk = decode_chunk
+        self.tokenizer: Tokenizer = tokenizer or load_tokenizer(weights_dir)
+
+        if params is None:
+            params = init_llama_params(self.cfg, jax.random.PRNGKey(seed), dtype=dtype)
+        if mesh is not None:
+            params = shard_pytree(params, llama_param_specs(self.cfg), mesh)
+        self.params = params
+
+        cache = init_kv_cache(self.cfg, max_slots, max_seq_len, dtype=dtype)
+        if mesh is not None:
+            cache = shard_pytree(cache, kv_cache_specs(), mesh)
+        self._ck = cache["k"]
+        self._cv = cache["v"]
+
+        # Host-side mirrors of per-slot device state.
+        self._lengths = np.zeros(max_slots, dtype=np.int32)
+        self._last_tok = np.zeros(max_slots, dtype=np.int32)
+        self._temp = np.zeros(max_slots, dtype=np.float32)
+        self._topk = np.zeros(max_slots, dtype=np.int32)
+        self._topp = np.ones(max_slots, dtype=np.float32)
+        self._slots: list[_Slot | None] = [None] * max_slots
+
+        self._rng_counter = 0
+        self._base_key = jax.random.PRNGKey(seed + 1)
+
+        # Sampling mask: model vocab may be padded beyond the tokenizer's
+        # (MXU-friendly shapes) and control ids (pad/bos) must never be
+        # sampled — only real text ids and eos are allowed.
+        allowed = np.ones(self.cfg.vocab_size, dtype=bool)
+        allowed[self.tokenizer.vocab_size :] = False
+        for bad in (self.tokenizer.pad_id, self.tokenizer.bos_id):
+            if bad != self.tokenizer.eos_id and 0 <= bad < self.cfg.vocab_size:
+                allowed[bad] = False
+        self._allowed_mask = jnp.asarray(allowed) if not allowed.all() else None
+
+        self._decode_fn = self._build_decode()
+        mask = self._allowed_mask
+        cfg_ = self.cfg
+
+        @jax.jit
+        def sample1(logits, key, temp, topk, topp):
+            if mask is not None:
+                logits = jnp.where(mask, logits, -jnp.inf)
+            return sample_tokens(logits, key, temp, topk, topp)
+
+        self._sample1 = sample1
+
+        # jax.jit caches one executable per input shape, so prompt buckets
+        # (power-of-two padded) each compile once without any manual cache.
+        @jax.jit
+        def prefill_fn(params, tokens, lengths):
+            return llama_prefill(cfg_, params, tokens, lengths)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def insert_fn(ck, cv, ks, vs, slot):
+            # ks/vs: [L, 1, bucket, Hkv, hd] → write at [:, slot, :bucket];
+            # `slot` is a traced scalar, so one executable serves all slots.
+            ck = jax.lax.dynamic_update_slice(ck, ks.astype(ck.dtype), (0, slot, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, vs.astype(cv.dtype), (0, slot, 0, 0, 0))
+            return ck, cv
+
+        self._prefill_fn = prefill_fn
+        self._insert_fn = insert_fn
+
+        self._admit: "queue.Queue[GenRequest]" = queue.Queue()
+        self._stop_evt = threading.Event()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        # rolling stats for dashboard/benchmarks
+        self.stats_lock = threading.Lock()
+        self.total_tokens = 0
+        self.total_requests = 0
+        self._window: list[tuple[float, int]] = []  # (ts, tokens) for tps
+
+    # -- jit builders ------------------------------------------------------
+
+    def _build_decode(self):
+        cfg = self.cfg
+        K = self.decode_chunk
+        mask = self._allowed_mask
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def decode_chunk_fn(params, ck, cv, tokens, lengths, rng, temp, topk, topp):
+            def step(carry, _):
+                ck, cv, toks, lens, rng = carry
+                logits, ck, cv = llama_decode_step(cfg, params, ck, cv, toks, lens)
+                if mask is not None:
+                    logits = jnp.where(mask, logits, -jnp.inf)
+                rng, sub = jax.random.split(rng)
+                new = sample_tokens(logits, sub, temp, topk, topp)
+                return (ck, cv, new, lens + 1, rng), new
+
+            (ck, cv, _, _, _), out = jax.lax.scan(
+                step, (ck, cv, tokens, lengths, rng), None, length=K
+            )
+            return out, ck, cv  # out: [K, B]
+
+        return decode_chunk_fn
+
+    def _next_key(self):
+        self._rng_counter += 1
+        return jax.random.fold_in(self._base_key, self._rng_counter)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "GenerationEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, name="gen-engine", daemon=True)
+            self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._stop_evt.set()
+        self._wake.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+            self._thread = None
+        # Drain every waiter — callers blocked in req.out.get() must not
+        # deadlock when the engine stops mid-request.
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                s.req.out.put({"type": "error", "error": "engine shutdown"})
+                s.req.out.put(_DONE)
+                self._slots[i] = None
+        while True:
+            try:
+                req = self._admit.get_nowait()
+            except queue.Empty:
+                break
+            req.out.put({"type": "error", "error": "engine shutdown"})
+            req.out.put(_DONE)
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, req: GenRequest) -> GenRequest:
+        if self._stop_evt.is_set():
+            req.out.put({"type": "error", "error": "engine shutdown"})
+            req.out.put(_DONE)
+            return req
+        self._admit.put(req)
+        self._wake.set()
+        return req
+
+    def generate_stream(
+        self,
+        prompt: str,
+        *,
+        max_tokens: int = 256,
+        temperature: float = 0.7,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        stop: list[str] | None = None,
+    ) -> Iterator[dict[str, Any]]:
+        """Yield {"type":"token","text":...} events then a final
+        {"type":"done", "usage":..., "finish_reason":...}."""
+        ids = self.tokenizer.encode(prompt)
+        req = GenRequest(
+            prompt_ids=ids,
+            max_tokens=max_tokens,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            stop=stop or [],
+        )
+        self.submit(req)
+        while True:
+            evt = req.out.get()
+            if evt is _DONE:
+                return
+            yield evt
+            if evt.get("type") == "done":
+                return
+
+    def generate(self, prompt: str, **kw: Any) -> dict[str, Any]:
+        """Non-streaming: returns {"text", "usage", "finish_reason"}."""
+        text_parts: list[str] = []
+        final: dict[str, Any] = {}
+        for evt in self.generate_stream(prompt, **kw):
+            if evt["type"] == "token":
+                text_parts.append(evt["text"])
+            elif evt["type"] == "done":
+                final = evt
+            elif evt["type"] == "error":
+                raise RuntimeError(evt.get("error", "generation failed"))
+        return {
+            "text": "".join(text_parts),
+            "usage": final.get("usage", {}),
+            "finish_reason": final.get("finish_reason", "stop"),
+        }
+
+    def current_tps(self, window_s: float = 10.0) -> float:
+        now = time.time()
+        with self.stats_lock:
+            self._window = [(t, n) for t, n in self._window if now - t <= window_s]
+            toks = sum(n for _, n in self._window)
+        return toks / window_s
+
+    def slots_in_use(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    # -- engine loop -------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        return pow2_bucket(n, self.max_seq_len)
+
+    def _recover_cache(self) -> None:
+        """Re-allocate the KV cache if a failed dispatch consumed the donated
+        buffers (donate_argnums invalidates inputs even when execution
+        raises); without this every later round would see a deleted Array."""
+        try:
+            deleted = self._ck.is_deleted() or self._cv.is_deleted()
+        except AttributeError:
+            deleted = False
+        if deleted:
+            log.warning("KV cache buffers were donated into a failed dispatch; re-allocating")
+            cache = init_kv_cache(self.cfg, self.max_slots, self.max_seq_len, dtype=self.dtype)
+            if self.mesh is not None:
+                cache = shard_pytree(cache, kv_cache_specs(), self.mesh)
+            self._ck = cache["k"]
+            self._cv = cache["v"]
+
+    def _free_slot(self) -> int | None:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _run(self) -> None:
+        while not self._stop_evt.is_set():
+            admitted = self._admit_pending()
+            active = [i for i, s in enumerate(self._slots) if s is not None]
+            if not active:
+                if not admitted:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                continue
+            try:
+                self._decode_round(active)
+            except Exception as e:  # a poisoned round must not kill the loop
+                log.exception("decode round failed; failing %d active slots", len(active))
+                for b in active:
+                    s = self._slots[b]
+                    if s is not None:
+                        s.req.out.put({"type": "error", "error": str(e)})
+                        s.req.out.put(_DONE)
+                        self._slots[b] = None
+                self._recover_cache()
+
+    def _admit_pending(self) -> bool:
+        admitted = False
+        while True:
+            slot = self._free_slot()
+            if slot is None:
+                break
+            try:
+                req = self._admit.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                self._start_request(slot, req)
+                admitted = True
+            except Exception as e:  # malformed request must not kill the loop
+                log.exception("prefill failed")
+                req.out.put({"type": "error", "error": str(e)})
+                req.out.put(_DONE)
+        return admitted
+
+    def _start_request(self, slot: int, req: GenRequest) -> None:
+        ids = req.prompt_ids
+        # Leave room for at least one decode chunk after the prompt.
+        max_prompt = self.max_seq_len - self.decode_chunk
+        if len(ids) > max_prompt:  # keep the tail (standard left-truncation)
+            ids = ids[-max_prompt:]
+        P = len(ids)
+
+        if req.max_tokens <= 0:
+            req.out.put(
+                {
+                    "type": "done",
+                    "finish_reason": "length",
+                    "usage": {"prompt_tokens": P, "completion_tokens": 0, "total_tokens": P},
+                    "ttft_ms": 0.0,
+                }
+            )
+            req.out.put(_DONE)
+            return
+
+        bucket = self._bucket(P)
+        tokens = np.zeros((1, bucket), dtype=np.int32)
+        tokens[0, :P] = ids
+        lengths = np.array([P], dtype=np.int32)
+
+        logits, ks, vs = self._prefill_fn(self.params, tokens, lengths)
+        self._ck, self._cv = self._insert_fn(
+            self._ck, self._cv, ks, vs, np.int32(slot)
+        )
+
+        tok0 = self._sample1(
+            logits,
+            self._next_key(),
+            jnp.array([req.temperature], dtype=jnp.float32),
+            jnp.array([req.top_k], dtype=jnp.int32),
+            jnp.array([req.top_p], dtype=jnp.float32),
+        )
+        tok0 = int(np.asarray(tok0)[0])
+
+        s = _Slot(req=req, prompt_len=P, first_token_at=time.time())
+        self._slots[slot] = s
+        self._lengths[slot] = P
+        self._last_tok[slot] = tok0
+        self._temp[slot] = req.temperature
+        self._topk[slot] = req.top_k
+        self._topp[slot] = req.top_p
+        with self.stats_lock:
+            self.total_requests += 1
+        # tok0's KV will be written at position P in the first decode round.
+        self._emit_token(slot, tok0, pos=P - 1)
+
+    def _decode_round(self, active: list[int]) -> None:
+        out, self._ck, self._cv = self._decode_fn(
+            self.params,
+            self._ck,
+            self._cv,
+            jnp.asarray(self._last_tok),
+            jnp.asarray(self._lengths),
+            self._next_key(),
+            jnp.asarray(self._temp),
+            jnp.asarray(self._topk),
+            jnp.asarray(self._topp),
+        )
+        out = np.asarray(out)  # [K, B] — the only host sync per chunk
+        K = out.shape[0]
+        # Device advanced every slot K steps; mirror that, then process
+        # tokens against their true per-token cache positions.
+        base = self._lengths.copy()
+        self._lengths += K
+        self._last_tok = out[-1].copy()
+        n_emitted = 0
+        for b in active:
+            s = self._slots[b]
+            if s is None:
+                continue
+            for k in range(K):
+                if not self._emit_token(b, int(out[k, b]), pos=int(base[b]) + k):
+                    break
+                n_emitted += 1
+        with self.stats_lock:
+            self.total_tokens += n_emitted
+            self._window.append((time.time(), n_emitted))
+
+    def _emit_token(self, slot_idx: int, tok: int, pos: int) -> bool:
+        """Append one token to a slot; returns False when the slot finished.
+
+        `pos` is the cache position this token's KV occupies (or will occupy,
+        for the prefill's first sample). The slot must finish while the next
+        decode chunk's K writes still fit: pos+1+K ≤ max_seq_len.
+        """
+        s = self._slots[slot_idx]
+        if s is None:
+            return False
+        req = s.req
+        finish = None
+        emit = ""
+        cut = -1
+        if tok == self.tokenizer.eos_id:
+            finish = "stop"
+        else:
+            s.generated += 1
+            text, s.pending = self.tokenizer.decode_stream(s.pending, [tok])
+            # Stop sequences trim BEFORE emission (OpenAI/Ollama semantics:
+            # the stop string itself is never delivered). Scan the window
+            # where a stop could straddle the old/new text boundary.
+            prev_len = len(s.text)
+            total = s.text + text
+            cut = -1
+            for stop_s in req.stop:
+                if not stop_s:
+                    continue
+                i = total.find(stop_s, max(0, prev_len - len(stop_s) + 1))
+                if i != -1 and (cut == -1 or i < cut):
+                    cut = i
+            if cut != -1:
+                emit = total[prev_len:cut]
+                s.text = total[:cut]
+                finish = "stop"
+            else:
+                emit = text
+                s.text = total
+            if finish is None and s.generated >= req.max_tokens:
+                finish = "length"
+            if finish is None and pos + 1 + self.decode_chunk > self.max_seq_len:
+                finish = "length"
+        if finish is not None and s.pending:
+            # End of stream: flush any buffered partial decode (unless we cut
+            # at a stop sequence — the buffered tail is post-stop text).
+            if cut == -1:
+                emit += self.tokenizer.decode_flush(s.pending)
+            s.pending = b""
+        if emit:
+            req.out.put({"type": "token", "text": emit})
+        if finish is not None:
+            req.out.put(
+                {
+                    "type": "done",
+                    "finish_reason": finish,
+                    "usage": {
+                        "prompt_tokens": s.prompt_len,
+                        "completion_tokens": s.generated,
+                        "total_tokens": s.prompt_len + s.generated,
+                    },
+                    "ttft_ms": (s.first_token_at - req.created_at) * 1000.0,
+                }
+            )
+            req.out.put(_DONE)
+            self._slots[slot_idx] = None
+            return False
+        return True

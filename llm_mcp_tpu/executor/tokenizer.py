@@ -1,0 +1,151 @@
+"""Tokenizers for the TPU executor.
+
+Two implementations behind one minimal interface (encode / decode /
+streaming-decode / special ids):
+
+  - `HFTokenizer`: wraps a `tokenizer.json` (HuggingFace `tokenizers` Rust
+    lib) when a real checkpoint directory is configured — the production path
+    for Llama-3.1 / nomic / qwen vocabularies.
+  - `ByteTokenizer`: dependency-free UTF-8 byte fallback (259 ids) so every
+    model — including randomly-initialized dev/bench models — can serve the
+    full API without vocabulary files. Streaming decode buffers partial UTF-8
+    sequences so multi-byte characters never split across SSE chunks.
+
+The reference has no tokenizer at all (token counts arrive from Ollama's
+response fields, `worker/llm_worker/main.py:471-479`); here token accounting
+is exact and local.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Protocol
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    bos_id: int
+    eos_id: int
+    pad_id: int
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+    def decode_stream(self, pending: bytes, new_ids: list[int]) -> tuple[str, bytes]: ...
+    def decode_flush(self, pending: bytes) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte-level tokenizer: 0=pad, 1=bos, 2=eos, byte b → 3+b."""
+
+    PAD, BOS, EOS = 0, 1, 2
+    OFFSET = 3
+
+    def __init__(self) -> None:
+        self.vocab_size = 259
+        self.pad_id = self.PAD
+        self.bos_id = self.BOS
+        self.eos_id = self.EOS
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = [self.OFFSET + b for b in text.encode("utf-8")]
+        return ([self.BOS] + ids) if add_bos else ids
+
+    def _bytes(self, ids: list[int]) -> bytes:
+        # Ids outside [OFFSET, OFFSET+256) are ignored: models may have a
+        # vocab larger than 259 (padded for MXU-friendly shapes), so sampled
+        # ids beyond the byte range decode to nothing rather than crashing.
+        return bytes(i - self.OFFSET for i in ids if self.OFFSET <= i < self.OFFSET + 256)
+
+    def decode(self, ids: list[int]) -> str:
+        return self._bytes(ids).decode("utf-8", errors="replace")
+
+    def decode_stream(self, pending: bytes, new_ids: list[int]) -> tuple[str, bytes]:
+        """Incremental decode: returns (complete_text, leftover_bytes).
+
+        Leftover bytes are the tail of an incomplete UTF-8 multi-byte
+        sequence, to be prepended on the next call.
+        """
+        data = pending + self._bytes(new_ids)
+        # Hold back only a genuinely incomplete trailing multi-byte sequence
+        # (≤3 continuation-pending bytes); everything before it decodes now,
+        # with invalid bytes becoming U+FFFD — a model emitting garbage bytes
+        # must not stall the stream by buffering forever.
+        hold = 0
+        for i in range(1, min(3, len(data)) + 1):
+            b = data[-i]
+            if b < 0x80:  # ASCII — sequence complete
+                break
+            if b >= 0xC0:  # lead byte of a 2-4 byte sequence
+                need = 2 if b < 0xE0 else 3 if b < 0xF0 else 4
+                if i < need:
+                    hold = i
+                break
+            # else continuation byte — keep scanning backwards
+        if hold:
+            return data[:-hold].decode("utf-8", errors="replace"), data[-hold:]
+        return data.decode("utf-8", errors="replace"), b""
+
+    def decode_flush(self, pending: bytes) -> str:
+        """Decode whatever is still buffered at end of stream."""
+        return pending.decode("utf-8", errors="replace") if pending else ""
+
+
+class HFTokenizer:
+    """Wrapper over a HuggingFace `tokenizer.json` file."""
+
+    def __init__(self, path: str):
+        from tokenizers import Tokenizer as _Tok
+
+        self._tok = _Tok.from_file(path)
+        self.vocab_size = self._tok.get_vocab_size()
+        self.pad_id = self._special("<|finetune_right_pad_id|>", "<pad>", "[PAD]") or 0
+        self.bos_id = self._special("<|begin_of_text|>", "<s>", "[CLS]") or 0
+        self.eos_id = self._special("<|end_of_text|>", "<|eot_id|>", "</s>", "[SEP]") or 0
+
+    def _special(self, *names: str) -> int | None:
+        for n in names:
+            i = self._tok.token_to_id(n)
+            if i is not None:
+                return i
+        return None
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = self._tok.encode(text, add_special_tokens=False).ids
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def decode_stream(self, pending: bytes, new_ids: list[int]) -> tuple[str, bytes]:
+        # HF decode is stateless per call; pending carries undecoded ids as
+        # a packed bytes blob of little-endian int32s.
+        import struct
+
+        prev = list(struct.unpack(f"<{len(pending) // 4}i", pending)) if pending else []
+        ids = prev + new_ids
+        text = self.decode(ids)
+        # Hold back ids while the text ends with a replacement char (a
+        # byte-fallback token mid-sequence) — but only up to 8 ids: a UTF-8
+        # char spans ≤4 byte tokens, so a longer replacement-ending run means
+        # the model really emitted U+FFFD-producing ids; flush them rather
+        # than stalling the stream forever.
+        if text.endswith("�") and len(ids) < 8:
+            return "", struct.pack(f"<{len(ids)}i", *ids)
+        return text, b""
+
+    def decode_flush(self, pending: bytes) -> str:
+        import struct
+
+        if not pending:
+            return ""
+        ids = list(struct.unpack(f"<{len(pending) // 4}i", pending))
+        return self.decode(ids)
+
+
+def load_tokenizer(weights_dir: str = "") -> Tokenizer:
+    """HF tokenizer if `tokenizer.json` exists in the weights dir, else bytes."""
+    if weights_dir:
+        path = os.path.join(weights_dir, "tokenizer.json")
+        if os.path.exists(path):
+            return HFTokenizer(path)
+    return ByteTokenizer()

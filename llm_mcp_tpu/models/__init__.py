@@ -1,0 +1,15 @@
+from .configs import ModelConfig, MODEL_CONFIGS, get_config
+from .llama import init_llama_params, llama_prefill, llama_decode_step, init_kv_cache
+from .embedder import init_embedder_params, embed_forward
+
+__all__ = [
+    "ModelConfig",
+    "MODEL_CONFIGS",
+    "get_config",
+    "init_llama_params",
+    "llama_prefill",
+    "llama_decode_step",
+    "init_kv_cache",
+    "init_embedder_params",
+    "embed_forward",
+]

@@ -1,0 +1,171 @@
+"""Model architecture configs for the TPU executor.
+
+The reference delegates all model execution to Ollama's catalog (models are
+just names + inferred metadata, `discovery.go:482-560`). Here models are real
+in-process architectures. Flagship targets per BASELINE.json configs:
+Llama-3.1-8B (decoder, chat), nomic-embed-text and qwen3-embedding-8b
+(encoders, embeddings with Matryoshka truncation).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    arch: str = "llama"  # llama (causal decoder) | encoder (bidirectional embedder)
+    vocab_size: int = 128_256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_hidden: int = 14_336
+    head_dim: int = 0  # 0 → dim // n_heads
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 131_072
+    # encoder-only fields
+    pooling: str = "mean"  # mean | cls
+    embed_dim: int = 0  # output embedding dim (0 → dim)
+    # serving metadata
+    params_b: float = 0.0
+    tie_embeddings: bool = False
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.dim // self.n_heads
+
+    def param_count(self) -> int:
+        """Approximate parameter count (embedding + layers + head)."""
+        hd = self.resolved_head_dim
+        per_layer = (
+            self.dim * self.n_heads * hd  # wq
+            + 2 * self.dim * self.n_kv_heads * hd  # wk, wv
+            + self.n_heads * hd * self.dim  # wo
+            + 3 * self.dim * self.ffn_hidden  # w1, w2, w3
+            + 2 * self.dim  # norms
+        )
+        embed = self.vocab_size * self.dim
+        head = 0 if self.tie_embeddings or self.arch == "encoder" else self.vocab_size * self.dim
+        return embed + self.n_layers * per_layer + head + self.dim
+
+
+# Canonical architectures. Llama-3.1-8B per the published architecture
+# (32 layers, 4096 dim, 32 heads / 8 KV heads GQA, 14336 FFN, 128k vocab,
+# rope theta 5e5). The reference's catalog rows for these names carry only
+# inferred metadata (tier/context_k, `04_smart_routing.sql:18-31`).
+MODEL_CONFIGS: dict[str, ModelConfig] = {
+    "llama-3.1-8b": ModelConfig(
+        name="llama-3.1-8b",
+        vocab_size=128_256,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_hidden=14_336,
+        rope_theta=500_000.0,
+        max_seq_len=131_072,
+        params_b=8.0,
+    ),
+    "llama-3.2-1b": ModelConfig(
+        name="llama-3.2-1b",
+        vocab_size=128_256,
+        dim=2048,
+        n_layers=16,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_hidden=8192,
+        rope_theta=500_000.0,
+        max_seq_len=131_072,
+        params_b=1.24,
+        tie_embeddings=True,
+    ),
+    # Tiny config for tests / CPU dev — same code paths, toy sizes.
+    "tiny-llm": ModelConfig(
+        name="tiny-llm",
+        vocab_size=512,
+        dim=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_hidden=256,
+        rope_theta=10_000.0,
+        max_seq_len=512,
+        params_b=0.001,
+        tie_embeddings=True,
+    ),
+    "nomic-embed-text": ModelConfig(
+        name="nomic-embed-text",
+        arch="encoder",
+        vocab_size=30_528,
+        dim=768,
+        n_layers=12,
+        n_heads=12,
+        n_kv_heads=12,
+        ffn_hidden=3072,
+        rope_theta=10_000.0,
+        max_seq_len=8192,
+        pooling="mean",
+        embed_dim=768,
+        params_b=0.137,
+    ),
+    "qwen3-embedding-8b": ModelConfig(
+        name="qwen3-embedding-8b",
+        arch="encoder",
+        vocab_size=151_936,
+        dim=4096,
+        n_layers=36,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_hidden=12_288,
+        rope_theta=1_000_000.0,
+        max_seq_len=32_768,
+        pooling="mean",
+        embed_dim=4096,
+        params_b=7.57,
+    ),
+    "tiny-embed": ModelConfig(
+        name="tiny-embed",
+        arch="encoder",
+        vocab_size=512,
+        dim=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=4,
+        ffn_hidden=128,
+        rope_theta=10_000.0,
+        max_seq_len=512,
+        pooling="mean",
+        embed_dim=64,
+        params_b=0.0005,
+    ),
+}
+
+
+def _compact(s: str) -> str:
+    """Strip separators so "llama3.1:8b", "Llama-3.1-8B" and "llama_3.1_8b"
+    all compare equal."""
+    return re.sub(r"[-_.:\s]", "", s.lower())
+
+
+def get_config(name: str) -> ModelConfig:
+    key = name.lower().strip()
+    if key in MODEL_CONFIGS:
+        return MODEL_CONFIGS[key]
+    # Accept common aliases ("llama3.1:8b", "meta-llama/Llama-3.1-8B-Instruct")
+    # by comparing separator-stripped forms of the last path segment.
+    ck = _compact(key.split("/")[-1])
+    for cname, cfg in MODEL_CONFIGS.items():
+        cc = _compact(cname)
+        if cc == ck or cc in ck:
+            return cfg
+    if "llama" in key and "1b" in key:
+        return MODEL_CONFIGS["llama-3.2-1b"]
+    if "llama" in key:
+        return MODEL_CONFIGS["llama-3.1-8b"]
+    if "embed" in key:
+        return MODEL_CONFIGS["nomic-embed-text"]
+    raise KeyError(f"unknown model config: {name}")

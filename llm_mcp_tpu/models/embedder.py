@@ -1,0 +1,104 @@
+"""Bidirectional transformer encoder for embeddings, HBM-resident.
+
+Replaces the reference's delegated Ollama `/api/embed` batch path
+(`core/internal/api/handlers.go:1942-2015`) and `ollama.embed` jobs
+(`worker/llm_worker/main.py:246-261`) with an in-process encoder serving
+`POST /v1/embeddings` directly from TPU. Same TPU-first conventions as
+models/llama.py: scan over layers, static shapes, bf16 with f32 reductions.
+
+Matryoshka `dimensions` truncation (reference `handlers.go:2063-2078` does
+client-side truncation as a fallback) is exact here: truncate then
+re-normalize — done in the engine so one forward pass serves any requested
+dimension.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.norms import rms_norm as _rms_norm
+from ..ops.rope import rope_frequencies, apply_rope
+from .configs import ModelConfig
+
+Params = dict[str, Any]
+
+
+def init_embedder_params(
+    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    hd = cfg.resolved_head_dim
+    L, D, H, F, V = cfg.n_layers, cfg.dim, cfg.n_heads, cfg.ffn_hidden, cfg.vocab_size
+    keys = jax.random.split(key, 8)
+
+    def w(k, shape, fan_in):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in**-0.5)).astype(dtype)
+
+    return {
+        "embed": w(keys[0], (V, D), D),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), dtype=dtype),
+            "wq": w(keys[1], (L, D, H * hd), D),
+            "wk": w(keys[2], (L, D, H * hd), D),
+            "wv": w(keys[3], (L, D, H * hd), D),
+            "wo": w(keys[4], (L, H * hd, D), H * hd),
+            "ffn_norm": jnp.ones((L, D), dtype=dtype),
+            "w1": w(keys[5], (L, D, F), D),
+            "w3": w(keys[6], (L, D, F), D),
+            "w2": w(keys[7], (L, F, D), F),
+        },
+        "final_norm": jnp.ones((D,), dtype=dtype),
+    }
+
+
+def embed_forward(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32 right-padded
+    lengths: jnp.ndarray,  # [B] int32
+) -> jnp.ndarray:
+    """Encode a batch → L2-normalized embeddings [B, D] float32."""
+    B, S = tokens.shape
+    hd = cfg.resolved_head_dim
+    H = cfg.n_heads
+
+    h = params["embed"][tokens]
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :]
+    cos, sin = rope_frequencies(hd, cfg.rope_theta, positions)
+
+    valid = jnp.arange(S)[None, :] < lengths[:, None]  # [B, S]
+    mask = valid[:, None, :]  # [B, 1(q), S(k)] — bidirectional, pad-masked
+    neg = jnp.float32(-1e30)
+
+    def layer(h, lp):
+        x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bsd,de->bse", x, lp["wq"]).reshape(B, S, H, hd)
+        k = jnp.einsum("bsd,de->bse", x, lp["wk"]).reshape(B, S, H, hd)
+        v = jnp.einsum("bsd,de->bse", x, lp["wv"]).reshape(B, S, H, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (hd**-0.5)
+        scores = jnp.where(mask[:, None, :, :], scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H * hd)
+        h = h + jnp.einsum("bse,ed->bsd", ctx, lp["wo"])
+
+        x = _rms_norm(h, lp["ffn_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", x, lp["w1"]))
+        up = jnp.einsum("bsd,df->bsf", x, lp["w3"])
+        h = h + jnp.einsum("bsf,fd->bsd", gate * up, lp["w2"])
+        return h, None
+
+    h, _ = jax.lax.scan(layer, h, params["layers"])
+    h = _rms_norm(h, params["final_norm"], cfg.norm_eps).astype(jnp.float32)
+
+    if cfg.pooling == "cls":
+        pooled = h[:, 0]
+    else:  # masked mean
+        w = valid.astype(jnp.float32)[:, :, None]
+        pooled = (h * w).sum(axis=1) / jnp.maximum(w.sum(axis=1), 1.0)
+
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)

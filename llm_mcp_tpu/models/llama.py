@@ -1,0 +1,203 @@
+"""Llama-family causal decoder, pure-JAX functional, designed for XLA/TPU.
+
+Replaces the reference's delegated Ollama `/api/generate`/`/api/chat` execution
+(`worker/llm_worker/main.py:222-243`, `core/internal/api/handlers.go:2427-2587`)
+with an in-process model. TPU-first choices:
+
+  - **Scan over layers** with stacked per-layer weights (leading dim L): one
+    layer's XLA program compiled once, not L times — fast compiles and a small
+    executable even at 32+ layers.
+  - **Static shapes everywhere**: batch = engine slots, sequence = cache
+    capacity; per-slot progress is carried in `lengths` (int32) and masking,
+    never in array shapes — so jit compiles once per (batch, bucket).
+  - **GQA attention as einsum** over the KV cache with length masking; XLA maps
+    the contractions onto the MXU and fuses the mask/softmax elementwise work.
+  - **bfloat16 weights/activations, float32 softmax and logits.**
+  - Sampling is fused into the decode step (see ops/sampling.py) so only [B]
+    token ids leave the device per step.
+
+Layout conventions:
+  params["layers"][name]: [L, ...] stacked weights
+  KV cache: k, v: [L, B, S, H_kv, Dh]
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.norms import rms_norm as _rms_norm
+from ..ops.rope import rope_frequencies, apply_rope
+from .configs import ModelConfig
+
+Params = dict[str, Any]
+
+
+def init_llama_params(
+    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    """Random-init weights with fan-in scaling (used when no checkpoint is
+    supplied; real weights load via models/weights.py)."""
+    hd = cfg.resolved_head_dim
+    L, D, H, Hkv, F, V = (
+        cfg.n_layers,
+        cfg.dim,
+        cfg.n_heads,
+        cfg.n_kv_heads,
+        cfg.ffn_hidden,
+        cfg.vocab_size,
+    )
+    keys = jax.random.split(key, 8)
+
+    def w(k, shape, fan_in):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in**-0.5)).astype(dtype)
+
+    params: Params = {
+        "embed": w(keys[0], (V, D), D),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), dtype=dtype),
+            "wq": w(keys[1], (L, D, H * hd), D),
+            "wk": w(keys[2], (L, D, Hkv * hd), D),
+            "wv": w(keys[3], (L, D, Hkv * hd), D),
+            "wo": w(keys[4], (L, H * hd, D), H * hd),
+            "ffn_norm": jnp.ones((L, D), dtype=dtype),
+            "w1": w(keys[5], (L, D, F), D),
+            "w3": w(keys[6], (L, D, F), D),
+            "w2": w(keys[7], (L, F, D), F),
+        },
+        "final_norm": jnp.ones((D,), dtype=dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = w(jax.random.fold_in(key, 99), (D, V), D)
+    return params
+
+
+def init_kv_cache(
+    cfg: ModelConfig, batch: int, max_seq: int, dtype: jnp.dtype = jnp.bfloat16
+) -> dict[str, jnp.ndarray]:
+    hd = cfg.resolved_head_dim
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, hd)
+    return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
+
+
+def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
+    h = _rms_norm(h, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return jnp.einsum("...d,dv->...v", h, head).astype(jnp.float32)
+
+
+def llama_prefill(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32 (right-padded prompts)
+    lengths: jnp.ndarray,  # [B] int32 true prompt lengths
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Causal self-attention over fresh prompts (no past KV).
+
+    Returns (last_logits [B, V] f32, k [L, B, S, Hkv, Dh], v [...]) — the
+    prompt KV to be inserted into the engine cache at the request's slot.
+    """
+    B, S = tokens.shape
+    hd = cfg.resolved_head_dim
+    H, Hkv = cfg.n_heads, cfg.n_kv_heads
+    G = H // Hkv
+
+    h = params["embed"][tokens]  # [B, S, D]
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :]  # [1, S]
+    cos, sin = rope_frequencies(hd, cfg.rope_theta, positions)  # [1, S, hd/2]
+
+    # Causal + padding mask, computed once: [B, S, S] would be big at long S,
+    # so use [1, S, S] causal and fold padding via key-validity [B, 1, S].
+    causal = jnp.tril(jnp.ones((S, S), dtype=bool))[None]  # [1, S, S]
+    valid_k = (jnp.arange(S)[None, :] < lengths[:, None])[:, None, :]  # [B, 1, S]
+    mask = causal & valid_k  # [B, S, S]
+    neg = jnp.float32(-1e30)
+
+    def layer(h, xs):
+        lp = xs
+        x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bsd,de->bse", x, lp["wq"]).reshape(B, S, H, hd)
+        k = jnp.einsum("bsd,de->bse", x, lp["wk"]).reshape(B, S, Hkv, hd)
+        v = jnp.einsum("bsd,de->bse", x, lp["wv"]).reshape(B, S, Hkv, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        qg = q.reshape(B, S, Hkv, G, hd)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32)
+        scores = scores * (hd**-0.5)
+        scores = jnp.where(mask[:, None, None, :, :], scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+        ctx = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(B, S, H * hd)
+        h = h + jnp.einsum("bse,ed->bsd", ctx, lp["wo"])
+
+        x = _rms_norm(h, lp["ffn_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", x, lp["w1"]))
+        up = jnp.einsum("bsd,df->bsf", x, lp["w3"])
+        h = h + jnp.einsum("bsf,fd->bsd", gate * up, lp["w2"])
+        return h, (k, v)
+
+    h, (ks, vs) = jax.lax.scan(layer, h, params["layers"])
+
+    last = jnp.take_along_axis(
+        h, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]  # [B, D]
+    return _logits(cfg, params, last), ks, vs
+
+
+def llama_decode_step(
+    cfg: ModelConfig,
+    params: Params,
+    cache_k: jnp.ndarray,  # [L, B, S, Hkv, Dh]
+    cache_v: jnp.ndarray,
+    tokens: jnp.ndarray,  # [B] int32 — last emitted token per slot
+    lengths: jnp.ndarray,  # [B] int32 — position to write (tokens already in cache)
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One batched autoregressive step for all slots.
+
+    Writes this step's K/V at `lengths[b]`, attends over positions
+    ≤ lengths[b], returns (logits [B, V] f32, new_cache_k, new_cache_v).
+    Inactive slots simply produce garbage logits that the engine ignores —
+    keeping the step shape-static (no data-dependent control flow under jit).
+    """
+    L, B, S, Hkv, hd = cache_k.shape
+    H = cfg.n_heads
+    G = H // Hkv
+
+    h = params["embed"][tokens]  # [B, D]
+    cos, sin = rope_frequencies(hd, cfg.rope_theta, lengths)  # [B, hd/2]
+
+    batch_idx = jnp.arange(B)
+    key_pos = jnp.arange(S)[None, :]  # [1, S]
+    attn_mask = key_pos <= lengths[:, None]  # [B, S]
+    neg = jnp.float32(-1e30)
+
+    def layer(h, xs):
+        lp, ck, cv = xs  # ck, cv: [B, S, Hkv, hd]
+        x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
+        q = (x @ lp["wq"]).reshape(B, H, hd)
+        k = (x @ lp["wk"]).reshape(B, Hkv, hd)
+        v = (x @ lp["wv"]).reshape(B, Hkv, hd)
+        q = apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]  # [B, H, hd]
+        k = apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
+
+        ck = ck.at[batch_idx, lengths].set(k.astype(ck.dtype))
+        cv = cv.at[batch_idx, lengths].set(v.astype(cv.dtype))
+
+        qg = q.reshape(B, Hkv, G, hd)
+        scores = jnp.einsum("bhgd,bshd->bhgs", qg, ck).astype(jnp.float32)
+        scores = scores * (hd**-0.5)
+        scores = jnp.where(attn_mask[:, None, None, :], scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+        ctx = jnp.einsum("bhgs,bshd->bhgd", probs, cv).reshape(B, H * hd)
+        h = h + ctx @ lp["wo"]
+
+        x = _rms_norm(h, lp["ffn_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(x @ lp["w1"])
+        up = x @ lp["w3"]
+        h = h + (gate * up) @ lp["w2"]
+        return h, (ck, cv)
+
+    h, (new_k, new_v) = jax.lax.scan(layer, h, (params["layers"], cache_k, cache_v))
+    return _logits(cfg, params, h), new_k, new_v

@@ -1,0 +1,4 @@
+from .rope import rope_frequencies, apply_rope
+from .sampling import sample_tokens
+
+__all__ = ["rope_frequencies", "apply_rope", "sample_tokens"]

@@ -1,0 +1,18 @@
+"""Normalization ops shared by the decoder (models/llama.py) and the
+embedding encoder (models/embedder.py).
+
+TPU note: the reduction runs in float32 (rsqrt of a bf16 sum loses too much
+precision at dim≥4096) and the result is cast back to the activation dtype so
+the surrounding matmuls stay bf16 on the MXU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (norm * weight.astype(jnp.float32)).astype(x.dtype)

@@ -1,0 +1,33 @@
+"""Rotary position embeddings (RoPE).
+
+TPU-first notes: frequencies are computed inside the jitted graph from static
+config (no host round-trips); rotation is pure elementwise VPU work that XLA
+fuses into the surrounding matmuls. Split-half convention (as in Llama).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float, positions: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for given integer positions.
+
+    positions: [...,] int32 → returns cos, sin of shape [..., head_dim//2].
+    """
+    half = head_dim // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., half]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate pairs (split-half layout). x: [..., n_heads, head_dim];
+    cos/sin: [..., head_dim//2] broadcast over the heads axis."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    cos = cos[..., None, :]  # broadcast over heads
+    sin = sin[..., None, :]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)

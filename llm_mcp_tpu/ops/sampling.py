@@ -1,0 +1,52 @@
+"""On-device token sampling: temperature / top-k / top-p, per-row parameters.
+
+TPU-first design: sampling runs inside the jitted decode step so only the
+sampled token ids ([B] int32) ever leave the device — the [B, vocab] logits
+never cross HBM→host. A full-vocab sort per step would be wasteful on a 128k
+vocab, so top-p operates within a fixed 64-candidate top-k window (standard
+serving-engine approximation; exact when top_k ≤ 64, which covers practical
+sampling settings).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_CANDIDATES = 64
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] float32
+    rng: jax.Array,
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B] int32 (0 = disabled)
+    top_p: jnp.ndarray,  # [B] float32 (1.0 = disabled)
+) -> jnp.ndarray:
+    """Sample one token per row. temperature<=0 → greedy argmax."""
+    B, V = logits.shape
+    n_cand = min(_CANDIDATES, V)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # Top-K candidate window (per-row k applied by masking within the window).
+    cand_logits, cand_idx = jax.lax.top_k(logits, n_cand)  # [B, C] desc
+    k = jnp.where(top_k <= 0, n_cand, jnp.minimum(top_k, n_cand))
+    pos = jnp.arange(n_cand)[None, :]
+    k_mask = pos < k[:, None]
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = jnp.where(k_mask, cand_logits / temp, -jnp.inf)
+
+    # Top-p within the window: keep the smallest prefix with cumprob >= p
+    # (always keep the first candidate).
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    p_mask = (cum - probs) < top_p[:, None]  # prefix-exclusive cumsum < p
+    p_mask = p_mask.at[:, 0].set(True)
+    final = jnp.where(p_mask & k_mask, scaled, -jnp.inf)
+
+    gumbel = jax.random.gumbel(rng, (B, n_cand), dtype=jnp.float32)
+    choice = jnp.argmax(final + gumbel, axis=-1)  # [B]
+    sampled = jnp.take_along_axis(cand_idx, choice[:, None], axis=1)[:, 0].astype(jnp.int32)
+
+    return jnp.where(temperature <= 0.0, greedy, sampled)

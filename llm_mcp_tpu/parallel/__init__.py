@@ -1,0 +1,11 @@
+from .mesh import make_mesh, mesh_axis_sizes
+from .sharding import llama_param_specs, kv_cache_specs, embedder_param_specs, shard_pytree
+
+__all__ = [
+    "make_mesh",
+    "mesh_axis_sizes",
+    "llama_param_specs",
+    "kv_cache_specs",
+    "embedder_param_specs",
+    "shard_pytree",
+]

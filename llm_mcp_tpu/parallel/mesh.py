@@ -1,0 +1,50 @@
+"""Device mesh construction.
+
+The reference's "parallelism" is cluster-level (N workers × devices ×
+concurrency caps, SURVEY.md §2.2); intra-model parallelism did not exist.
+Here it does: a `jax.sharding.Mesh` with axes
+
+  dp — data parallel (independent batch slots)
+  tp — tensor parallel (attention heads / FFN hidden sharded over ICI)
+  sp — sequence parallel (long-context prefill; ring attention)
+
+XLA inserts the collectives (all-gather / reduce-scatter / psum) implied by
+the shardings; they ride ICI within a slice. Multi-host extends the same mesh
+over DCN via `jax.distributed.initialize` (see parallel/distributed.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def mesh_axis_sizes(spec: str, n_devices: int) -> dict[str, int]:
+    """Parse "dp=2,tp=4" → {'dp': 2, 'tp': 4, 'sp': 1}; default all-TP.
+
+    TP is the default because decode is HBM-bandwidth-bound: sharding the
+    weights over all chips divides bytes-per-step per chip, which is what
+    lifts tokens/sec/chip (scaling-book recipe).
+    """
+    sizes = {"dp": 1, "tp": 1, "sp": 1}
+    spec = (spec or "").strip()
+    if spec:
+        for part in spec.split(","):
+            k, _, v = part.partition("=")
+            k = k.strip()
+            if k in sizes and v.strip():
+                sizes[k] = int(v)
+        got = sizes["dp"] * sizes["tp"] * sizes["sp"]
+        if got != n_devices:
+            raise ValueError(f"mesh spec {spec!r} = {got} devices, have {n_devices}")
+    else:
+        sizes["tp"] = n_devices
+    return sizes
+
+
+def make_mesh(spec: str = "", devices: list | None = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    sizes = mesh_axis_sizes(spec, len(devices))
+    arr = np.asarray(devices).reshape(sizes["dp"], sizes["tp"], sizes["sp"])
+    return Mesh(arr, axis_names=("dp", "tp", "sp"))

@@ -1,0 +1,75 @@
+"""Parameter and KV-cache sharding specs (tensor parallelism).
+
+Megatron-style TP mapping expressed as PartitionSpecs; XLA GSPMD inserts the
+collectives:
+
+  wq/wk/wv [L, D, H·hd]: shard output (head) dim on tp → per-chip heads
+  wo       [L, H·hd, D]: shard input dim on tp → psum after projection
+  w1/w3    [L, D, F]:    shard F on tp
+  w2       [L, F, D]:    shard F on tp → psum after down-projection
+  embed    [V, D]:       shard vocab on tp (vocab-parallel logits; top-k/argmax
+                         over the sharded vocab axis gathers only [B, k])
+  KV cache [L, B, S, Hkv, hd]: heads on tp, batch slots on dp
+
+GQA note: Llama-3.1-8B has 8 KV heads — exactly one per chip on a v5e-8 TP
+mesh; Q heads (32) shard 4-per-chip. No KV replication needed up to tp=8.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.configs import ModelConfig
+
+
+def llama_param_specs(cfg: ModelConfig) -> dict[str, Any]:
+    specs: dict[str, Any] = {
+        "embed": P("tp", None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "ffn_norm": P(None, None),
+            "w1": P(None, None, "tp"),
+            "w3": P(None, None, "tp"),
+            "w2": P(None, "tp", None),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def embedder_param_specs(cfg: ModelConfig) -> dict[str, Any]:
+    return {
+        "embed": P("tp", None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "ffn_norm": P(None, None),
+            "w1": P(None, None, "tp"),
+            "w3": P(None, None, "tp"),
+            "w2": P(None, "tp", None),
+        },
+        "final_norm": P(None),
+    }
+
+
+def kv_cache_specs() -> dict[str, P]:
+    return {"k": P(None, "dp", None, "tp", None), "v": P(None, "dp", None, "tp", None)}
+
+
+def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """Place a pytree on the mesh according to matching PartitionSpecs."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )

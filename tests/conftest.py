@@ -8,10 +8,19 @@ first `import jax` anywhere in the test session.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the session env preselects a TPU platform (e.g.
+# JAX_PLATFORMS=axon): unit tests target the virtual mesh; bench.py and the
+# serving entrypoints use the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Plugins (jaxtyping) may import jax before this conftest, freezing config
+# defaults from the original env — override via jax.config as well.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,176 @@
+"""Engine tests: continuous batching, streaming, stop conditions, embeddings.
+
+These exercise the decode hot loop end-to-end on the CPU backend with the
+tiny model config — same code paths as TPU serving (SURVEY.md §4 notes the
+reference has no such in-process tests; we exceed it).
+"""
+
+import concurrent.futures as cf
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_mcp_tpu.executor import GenerationEngine, EmbeddingEngine
+from llm_mcp_tpu.executor.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = GenerationEngine(
+        "tiny-llm", max_slots=4, max_seq_len=128, dtype=jnp.float32, decode_chunk=4
+    ).start()
+    yield eng
+    eng.shutdown()
+
+
+def test_generate_basic(engine):
+    out = engine.generate("hello", max_tokens=8, temperature=0.0)
+    assert out["usage"]["completion_tokens"] <= 8
+    assert out["usage"]["prompt_tokens"] == len(engine.tokenizer.encode("hello"))
+    assert out["finish_reason"] in ("stop", "length")
+
+
+def test_generate_deterministic_greedy(engine):
+    a = engine.generate("same prompt", max_tokens=12, temperature=0.0)
+    b = engine.generate("same prompt", max_tokens=12, temperature=0.0)
+    assert a["text"] == b["text"]
+
+
+def test_streaming_events(engine):
+    events = list(engine.generate_stream("stream me", max_tokens=6, temperature=0.0))
+    assert events[-1]["type"] == "done"
+    tokens = [e for e in events if e["type"] == "token"]
+    assert len(tokens) >= 1
+    assert "usage" in events[-1]
+    assert events[-1]["ttft_ms"] >= 0
+
+
+def test_max_tokens_respected(engine):
+    out = engine.generate("count", max_tokens=3, temperature=0.0)
+    assert out["usage"]["completion_tokens"] <= 3
+
+
+def test_concurrent_requests_continuous_batching(engine):
+    def gen(i):
+        return engine.generate(f"prompt number {i}", max_tokens=10, temperature=0.0)
+
+    with cf.ThreadPoolExecutor(max_workers=6) as ex:
+        results = list(ex.map(gen, range(6)))
+    assert len(results) == 6
+    for r in results:
+        assert r["usage"]["completion_tokens"] >= 1
+    # batching stats recorded
+    assert engine.total_requests >= 6
+    assert engine.total_tokens > 0
+
+
+def test_concurrent_matches_sequential(engine):
+    """Continuous batching must not change greedy outputs (slot isolation)."""
+    seq = [engine.generate(f"isolation {i}", max_tokens=8, temperature=0.0)["text"] for i in range(3)]
+    with cf.ThreadPoolExecutor(max_workers=3) as ex:
+        conc = list(ex.map(lambda i: engine.generate(f"isolation {i}", max_tokens=8, temperature=0.0)["text"], range(3)))
+    assert seq == conc
+
+
+def test_long_prompt_truncation(engine):
+    long_prompt = "x" * 5000  # way beyond max_seq_len=128
+    out = engine.generate(long_prompt, max_tokens=4, temperature=0.0)
+    assert out["usage"]["prompt_tokens"] <= 126
+
+
+def test_stop_sequences():
+    eng = GenerationEngine(
+        "tiny-llm", max_slots=2, max_seq_len=64, dtype=jnp.float32, decode_chunk=2
+    ).start()
+    try:
+        out = eng.generate("q", max_tokens=50, temperature=1.0, stop=["zzz-never"])
+        assert out["finish_reason"] in ("stop", "length")
+    finally:
+        eng.shutdown()
+
+
+def test_stop_sequence_trimmed_from_output(engine):
+    """The stop string must never be delivered (OpenAI/Ollama semantics):
+    generate without stop, pick a substring of the output as the stop, rerun
+    greedy and check the output ends right before it."""
+    full = engine.generate("trim test", max_tokens=24, temperature=0.0)["text"]
+    if len(full) < 4:
+        pytest.skip("model emitted too little text to derive a stop string")
+    stop = full[len(full) // 2 : len(full) // 2 + 2]
+    out = engine.generate("trim test", max_tokens=24, temperature=0.0, stop=[stop])
+    assert stop not in out["text"]
+    assert full.startswith(out["text"])
+
+
+def test_max_tokens_zero(engine):
+    out = engine.generate("zero", max_tokens=0, temperature=0.0)
+    assert out["usage"]["completion_tokens"] == 0
+    assert out["text"] == ""
+
+
+def test_shutdown_unblocks_waiters():
+    eng = GenerationEngine(
+        "tiny-llm", max_slots=1, max_seq_len=64, dtype=jnp.float32, decode_chunk=2
+    ).start()
+    import threading
+
+    results = []
+
+    def gen():
+        try:
+            results.append(eng.generate("x" * 40, max_tokens=1000, temperature=0.5))
+        except RuntimeError as e:
+            results.append(e)
+
+    threads = [threading.Thread(target=gen) for _ in range(3)]
+    for t in threads:
+        t.start()
+    eng.shutdown()
+    for t in threads:
+        t.join(timeout=15)
+    assert all(not t.is_alive() for t in threads), "waiters must not deadlock on shutdown"
+    assert len(results) == 3
+
+
+def test_byte_tokenizer_stream_utf8():
+    tok = ByteTokenizer()
+    ids = tok.encode("héllo ⚡", add_bos=False)
+    # feed one id at a time; concatenation must reproduce the string
+    pending, text = b"", ""
+    for i in ids:
+        t, pending = tok.decode_stream(pending, [i])
+        text += t
+    assert text == "héllo ⚡"
+    assert pending == b""
+
+
+def test_embedding_engine_basic():
+    eng = EmbeddingEngine("tiny-embed", max_batch=4, max_seq_len=64, dtype=jnp.float32)
+    vecs, tokens = eng.embed(["hello world", "second text", "third"])
+    assert len(vecs) == 3
+    assert len(vecs[0]) == eng.cfg.dim
+    assert tokens > 0
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=1), 1.0, rtol=1e-4)
+
+
+def test_embedding_matryoshka_dimensions():
+    eng = EmbeddingEngine("tiny-embed", max_batch=4, max_seq_len=64, dtype=jnp.float32)
+    full, _ = eng.embed(["same input"])
+    trunc, _ = eng.embed(["same input"], dimensions=16)
+    assert len(trunc[0]) == 16
+    np.testing.assert_allclose(np.linalg.norm(trunc, axis=1), 1.0, rtol=1e-4)
+    # direction preserved: truncated+renormalized equals manual computation
+    manual = np.array(full[0][:16])
+    manual /= np.linalg.norm(manual)
+    np.testing.assert_allclose(trunc[0], manual, rtol=1e-4)
+
+
+def test_embedding_batch_exceeds_max_batch():
+    eng = EmbeddingEngine("tiny-embed", max_batch=2, max_seq_len=64, dtype=jnp.float32)
+    vecs, _ = eng.embed([f"text {i}" for i in range(5)])
+    assert len(vecs) == 5
+    # same text embeds identically regardless of batch position
+    a, _ = eng.embed(["anchor", "other1", "other2"])
+    b, _ = eng.embed(["anchor"])
+    np.testing.assert_allclose(a[0], b[0], rtol=1e-4, atol=1e-5)

@@ -1,0 +1,125 @@
+"""Model correctness: prefill/decode consistency, masking, embedder, sampling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_mcp_tpu.models import (
+    get_config,
+    init_llama_params,
+    llama_prefill,
+    llama_decode_step,
+    init_kv_cache,
+    init_embedder_params,
+    embed_forward,
+)
+from llm_mcp_tpu.ops.sampling import sample_tokens
+
+CFG = get_config("tiny-llm")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_llama_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def test_decode_matches_prefill(params):
+    """Logits from incremental decode == logits from one-shot prefill."""
+    key = jax.random.PRNGKey(1)
+    prompt = jax.random.randint(key, (1, 7), 3, CFG.vocab_size)
+    lengths = jnp.array([7], dtype=jnp.int32)
+
+    # One-shot: prefill the 7-token prompt, take last logits.
+    full_logits, ks, vs = llama_prefill(CFG, params, prompt, lengths)
+
+    # Incremental: prefill first 6 tokens, then decode token 7.
+    l6 = jnp.array([6], dtype=jnp.int32)
+    _, ks6, vs6 = llama_prefill(CFG, params, prompt[:, :6], l6)
+    cache = init_kv_cache(CFG, batch=2, max_seq=16, dtype=jnp.float32)
+    # insert prompt KV into slot 1
+    ck = cache["k"].at[:, 1:2, :6].set(ks6)
+    cv = cache["v"].at[:, 1:2, :6].set(vs6)
+    tok = jnp.array([0, int(prompt[0, 6])], dtype=jnp.int32)
+    lens = jnp.array([0, 6], dtype=jnp.int32)
+    step_logits, _, _ = llama_decode_step(CFG, params, ck, cv, tok, lens)
+
+    np.testing.assert_allclose(
+        np.asarray(step_logits[1]), np.asarray(full_logits[0]), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_prefill_padding_invariance(params):
+    """Right-padding must not change the real tokens' logits."""
+    key = jax.random.PRNGKey(2)
+    prompt = jax.random.randint(key, (1, 5), 3, CFG.vocab_size)
+    lengths = jnp.array([5], dtype=jnp.int32)
+    logits_a, _, _ = llama_prefill(CFG, params, prompt, lengths)
+    padded = jnp.concatenate([prompt, jnp.zeros((1, 3), dtype=prompt.dtype)], axis=1)
+    logits_b, _, _ = llama_prefill(CFG, params, padded, lengths)
+    np.testing.assert_allclose(np.asarray(logits_a), np.asarray(logits_b), rtol=2e-4, atol=2e-4)
+
+
+def test_decode_step_is_batch_independent(params):
+    """One slot's output must not depend on other slots' contents."""
+    cache = init_kv_cache(CFG, batch=2, max_seq=8, dtype=jnp.float32)
+    tok = jnp.array([5, 9], dtype=jnp.int32)
+    lens = jnp.array([0, 0], dtype=jnp.int32)
+    logits, _, _ = llama_decode_step(CFG, params, cache["k"], cache["v"], tok, lens)
+    tok2 = jnp.array([5, 123], dtype=jnp.int32)
+    logits2, _, _ = llama_decode_step(CFG, params, cache["k"], cache["v"], tok2, lens)
+    np.testing.assert_allclose(np.asarray(logits[0]), np.asarray(logits2[0]), rtol=1e-5)
+
+
+def test_embedder_normalized_and_pad_invariant():
+    cfg = get_config("tiny-embed")
+    p = init_embedder_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    toks = jax.random.randint(jax.random.PRNGKey(3), (2, 6), 3, cfg.vocab_size)
+    lens = jnp.array([6, 4], dtype=jnp.int32)
+    out = embed_forward(cfg, p, toks, lens)
+    assert out.shape == (2, cfg.dim)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(out), axis=-1), 1.0, rtol=1e-5)
+    # row 1 with junk in its padded tail must be unchanged
+    toks2 = toks.at[1, 4:].set(7)
+    out2 = embed_forward(cfg, p, toks2, lens)
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(out2[1]), rtol=1e-4, atol=1e-5)
+
+
+def test_sampling_greedy_and_topk():
+    logits = jnp.array([[0.0, 5.0, 1.0, 2.0], [9.0, 0.0, 0.0, 0.0]], dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    greedy = sample_tokens(
+        logits, rng,
+        temperature=jnp.array([0.0, 0.0]),
+        top_k=jnp.array([0, 0], dtype=jnp.int32),
+        top_p=jnp.array([1.0, 1.0]),
+    )
+    assert list(np.asarray(greedy)) == [1, 0]
+    # top_k=1 is greedy regardless of temperature
+    tk1 = sample_tokens(
+        logits, rng,
+        temperature=jnp.array([1.5, 1.5]),
+        top_k=jnp.array([1, 1], dtype=jnp.int32),
+        top_p=jnp.array([1.0, 1.0]),
+    )
+    assert list(np.asarray(tk1)) == [1, 0]
+
+
+def test_sampling_distribution_respects_temperature():
+    logits = jnp.array([[2.0, 1.0, 0.0, -1.0]], dtype=jnp.float32).repeat(1, axis=0)
+    counts = np.zeros(4)
+    for i in range(200):
+        t = sample_tokens(
+            logits, jax.random.PRNGKey(i),
+            temperature=jnp.array([1.0]),
+            top_k=jnp.array([0], dtype=jnp.int32),
+            top_p=jnp.array([1.0]),
+        )
+        counts[int(np.asarray(t)[0])] += 1
+    assert counts[0] > counts[2] > 0  # roughly monotone in logit
+
+
+def test_param_count_llama8b():
+    cfg = get_config("llama-3.1-8b")
+    n = cfg.param_count()
+    assert 7.5e9 < n < 8.5e9
