@@ -1,0 +1,16 @@
+from .circuit import CircuitBreaker, CircuitStatus
+from .limits import DeviceLimitSpec, LimitsEngine, derive_device_limits
+from .router import Router, RouteDecision, estimate_tokens, context_bucket, quality_deadline_s
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitStatus",
+    "DeviceLimitSpec",
+    "LimitsEngine",
+    "derive_device_limits",
+    "Router",
+    "RouteDecision",
+    "estimate_tokens",
+    "context_bucket",
+    "quality_deadline_s",
+]

@@ -1,0 +1,108 @@
+"""Per-device circuit breaker.
+
+Parity with the reference's in-memory breaker (`core/internal/routing/
+router.go:22-89`): 3 consecutive failures degrade a device for 5 minutes;
+after the window one probe request is allowed through; any success resets.
+Status surfaces as ok / degraded / probe on the dashboard (`router.go:78-89`).
+
+TPU adaptation: "device failure" here includes executor-reported conditions
+(XLA OOM, mesh member loss) reported via `record(device, ok=False)` by the
+serving layer, not just HTTP connection errors.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+DEGRADE_AFTER_FAILURES = 3
+DEGRADE_WINDOW_S = 300.0
+
+
+class CircuitStatus:
+    OK = "ok"
+    DEGRADED = "degraded"
+    PROBE = "probe"
+
+
+@dataclass
+class _State:
+    failures: int = 0
+    degraded_at: float = 0.0
+    probe_inflight: bool = False
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        threshold: int = DEGRADE_AFTER_FAILURES,
+        window_s: float = DEGRADE_WINDOW_S,
+    ):
+        self.threshold = threshold
+        self.window_s = window_s
+        self._lock = threading.Lock()
+        self._by_device: dict[str, _State] = {}
+
+    def record(self, device_id: str, ok: bool) -> None:
+        """Record a request outcome for a device."""
+        if not device_id:
+            return
+        with self._lock:
+            st = self._by_device.setdefault(device_id, _State())
+            if ok:
+                st.failures = 0
+                st.degraded_at = 0.0
+                st.probe_inflight = False
+            else:
+                st.failures += 1
+                st.probe_inflight = False
+                if st.failures >= self.threshold and st.degraded_at == 0.0:
+                    st.degraded_at = time.time()
+                elif st.degraded_at != 0.0:
+                    # failed probe → restart the degrade window
+                    st.degraded_at = time.time()
+
+    def allow(self, device_id: str) -> bool:
+        """True if a request may be routed to the device. After the degrade
+        window expires, exactly one probe is let through until its outcome
+        is recorded."""
+        if not device_id:
+            return True
+        with self._lock:
+            st = self._by_device.get(device_id)
+            if st is None or st.degraded_at == 0.0:
+                return True
+            if time.time() - st.degraded_at < self.window_s:
+                return False
+            if st.probe_inflight:
+                return False
+            st.probe_inflight = True
+            return True
+
+    def status(self, device_id: str) -> str:
+        with self._lock:
+            st = self._by_device.get(device_id)
+            if st is None or st.degraded_at == 0.0:
+                return CircuitStatus.OK
+            if time.time() - st.degraded_at < self.window_s:
+                return CircuitStatus.DEGRADED
+            return CircuitStatus.PROBE
+
+    def snapshot(self) -> dict[str, dict]:
+        """Dashboard view: device → {failures, status}."""
+        out = {}
+        with self._lock:
+            items = list(self._by_device.items())
+        for dev, st in items:
+            out[dev] = {"failures": st.failures, "status": self.status(dev)}
+        return out
+
+    # test hook mirroring the reference's direct DegradedAt rewind
+    # (`router_test.go:195-212`)
+    def _rewind_degraded_at(self, device_id: str, seconds: float) -> None:
+        with self._lock:
+            st = self._by_device.get(device_id)
+            if st and st.degraded_at:
+                st.degraded_at -= seconds

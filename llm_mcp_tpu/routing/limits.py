@@ -1,0 +1,244 @@
+"""Device capability limits: HBM-aware derivation + allow/deny policy.
+
+Parity with the reference's RAM→params derivation and ModelAllowed gate
+(`core/internal/limits/limits.go:84-247`), re-derived for TPU devices:
+
+  - The reference sizes Ollama boxes by host RAM/VRAM
+    (≤8GB→5B params, ≤16GB→12B, else 0.75·mem as GB of weights).
+  - TPU devices are sized by per-chip HBM × chip count: bf16 weights take
+    2 bytes/param, and serving needs headroom for the KV cache, activations
+    and XLA workspace, so usable weight budget ≈ 50% of total HBM. A v5e
+    chip (16 GB HBM) thus carries ≤4B params solo and Llama-3.1-8B needs
+    tp≥2; a v5e-8 slice (128 GB) carries ≤32B.
+  - `max_context_k` derives from the HBM left after weights at the device's
+    largest resident model, assuming GQA KV of ~128 KB/token (8B-class).
+
+Spec sources mirror the reference: `DEVICE_LIMITS_JSON` / `DEVICE_LIMITS_FILE`
+env (a JSON object keyed by device id, `"*"` for the default), preset entries
+are never overwritten by derivation (`limits.go:83-102` semantics), and
+STRICT mode denies models with unknown size.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..state.catalog import Catalog
+from ..state.db import Database
+
+KV_BYTES_PER_TOKEN_8B = 128 * 1024  # GQA 8 KV heads × 128 dim × 2 × bf16 × 32 layers
+
+
+@dataclass
+class DeviceLimitSpec:
+    max_params_b: float = 0.0
+    max_size_gb: float = 0.0
+    max_context_k: int = 0
+    allow_models: list[str] = field(default_factory=list)
+    deny_models: list[str] = field(default_factory=list)
+    source: str = "derived"  # derived | preset
+
+    def to_row(self) -> dict[str, Any]:
+        return {
+            "max_params_b": self.max_params_b,
+            "max_size_gb": self.max_size_gb,
+            "max_context_k": self.max_context_k,
+            "allow_models": self.allow_models,
+            "deny_models": self.deny_models,
+            "source": self.source,
+        }
+
+
+def derive_device_limits(hbm_gb: float, chips: int = 1) -> DeviceLimitSpec:
+    """HBM budget → capability caps for a TPU device (slice).
+
+    Usable weight budget = 50% of total HBM (bf16 weights; rest is KV cache,
+    activations, XLA workspace). Context cap assumes the largest co-resident
+    model leaves ~25% of HBM for KV at ~128KB/token (8B-class GQA).
+    """
+    total = max(hbm_gb, 0.0) * max(chips, 1)
+    weight_budget_gb = total * 0.5
+    max_params_b = weight_budget_gb / 2.0  # bf16: 2 GB per B params
+    kv_budget_bytes = total * 0.25 * (1 << 30)
+    max_context = int(kv_budget_bytes / KV_BYTES_PER_TOKEN_8B)
+    # round context down to a power-of-two-ish K bucket
+    max_context_k = 1
+    while max_context_k * 2 * 1024 <= max_context:
+        max_context_k *= 2
+    if total <= 0:
+        return DeviceLimitSpec()
+    return DeviceLimitSpec(
+        max_params_b=round(max_params_b, 2),
+        max_size_gb=round(weight_budget_gb, 2),
+        max_context_k=max_context_k,
+        source="derived",
+    )
+
+
+def parse_limit_specs(
+    limits_json: str | None = None, limits_file: str | None = None
+) -> dict[str, DeviceLimitSpec]:
+    """Parse `DEVICE_LIMITS_JSON` / `DEVICE_LIMITS_FILE` into specs keyed by
+    device id ("*" = default applied to devices without their own entry)."""
+    raw = ""
+    if limits_json is None:
+        limits_json = os.environ.get("DEVICE_LIMITS_JSON", "")
+    if limits_file is None:
+        limits_file = os.environ.get("DEVICE_LIMITS_FILE", "")
+    if limits_json.strip():
+        raw = limits_json
+    elif limits_file.strip():
+        try:
+            with open(limits_file) as f:
+                raw = f.read()
+        except OSError:
+            return {}
+    if not raw.strip():
+        return {}
+    try:
+        data = json.loads(raw)
+    except json.JSONDecodeError:
+        return {}
+    specs: dict[str, DeviceLimitSpec] = {}
+    if not isinstance(data, dict):
+        return specs
+    for dev, entry in data.items():
+        if not isinstance(entry, dict):
+            continue
+        specs[dev] = DeviceLimitSpec(
+            max_params_b=float(entry.get("max_params_b", 0) or 0),
+            max_size_gb=float(entry.get("max_size_gb", 0) or 0),
+            max_context_k=int(entry.get("max_context_k", 0) or 0),
+            allow_models=[str(m) for m in entry.get("allow_models", []) or []],
+            deny_models=[str(m) for m in entry.get("deny_models", []) or []],
+            source="preset",
+        )
+    return specs
+
+
+def _name_matches(model_id: str, patterns: list[str]) -> bool:
+    low = model_id.lower()
+    for p in patterns:
+        p = p.lower().strip()
+        if not p:
+            continue
+        if p == low or p in low:
+            return True
+    return False
+
+
+class LimitsEngine:
+    """Applies limit specs to the device_limits table and gates models.
+
+    Mirrors the reference's apply-at-interval + ModelAllowed flow
+    (`limits.go:163-247`, re-applied by the `main.go:56-67` ticker).
+    """
+
+    def __init__(self, db: Database, strict: bool | None = None):
+        self.db = db
+        self.catalog = Catalog(db)
+        if strict is None:
+            strict = os.environ.get("STRICT_MODEL_LIMITS", "") in ("1", "true", "yes")
+        self.strict = strict
+
+    # -- apply -------------------------------------------------------------
+
+    def apply_specs(self, specs: dict[str, DeviceLimitSpec] | None = None) -> int:
+        """Upsert presets for known devices; derive limits for TPU devices
+        without a preset (using tags.hbm_gb/chips). Preset rows are never
+        overwritten by derivation. Returns rows written."""
+        if specs is None:
+            specs = parse_limit_specs()
+        default = specs.get("*")
+        written = 0
+        for dev in self.catalog.list_devices():
+            dev_id = dev["id"]
+            spec = specs.get(dev_id)
+            if spec is None:
+                existing = self.get(dev_id)
+                if existing is not None and existing.source == "preset":
+                    continue  # presets win over derivation
+                tags = dev.get("tags") or {}
+                hbm = float(tags.get("hbm_gb", 0) or 0)
+                chips = int(tags.get("chips", 1) or 1)
+                if hbm > 0:
+                    spec = derive_device_limits(hbm, chips)
+                elif default is not None:
+                    spec = default
+                else:
+                    continue
+            self._upsert(dev_id, spec)
+            written += 1
+        return written
+
+    def _upsert(self, device_id: str, spec: DeviceLimitSpec) -> None:
+        import time as _time
+
+        self.db.execute(
+            "INSERT INTO device_limits(device_id, max_params_b, max_size_gb,"
+            " max_context_k, allow_models, deny_models, source, updated_at)"
+            " VALUES(?,?,?,?,?,?,?,?) ON CONFLICT(device_id) DO UPDATE SET"
+            " max_params_b=excluded.max_params_b, max_size_gb=excluded.max_size_gb,"
+            " max_context_k=excluded.max_context_k, allow_models=excluded.allow_models,"
+            " deny_models=excluded.deny_models, source=excluded.source,"
+            " updated_at=excluded.updated_at",
+            (
+                device_id,
+                spec.max_params_b,
+                spec.max_size_gb,
+                spec.max_context_k,
+                Database.to_json(spec.allow_models),
+                Database.to_json(spec.deny_models),
+                spec.source,
+                _time.time(),
+            ),
+        )
+
+    def get(self, device_id: str) -> DeviceLimitSpec | None:
+        row = self.db.query_one(
+            "SELECT * FROM device_limits WHERE device_id=?", (device_id,)
+        )
+        if not row:
+            return None
+        return DeviceLimitSpec(
+            max_params_b=row["max_params_b"],
+            max_size_gb=row["max_size_gb"],
+            max_context_k=row["max_context_k"],
+            allow_models=Database.from_json(row["allow_models"], []),
+            deny_models=Database.from_json(row["deny_models"], []),
+            source=row["source"],
+        )
+
+    # -- gate --------------------------------------------------------------
+
+    def model_allowed(
+        self, device_id: str, model_id: str, context_k: int = 0
+    ) -> tuple[bool, str]:
+        """Gate a (device, model) pair. Returns (allowed, reason).
+
+        Order mirrors `limits.go:163-247`: deny list → allow list → size/
+        params caps (STRICT denies unknown sizes) → context cap.
+        """
+        spec = self.get(device_id)
+        if spec is None:
+            return True, "no limits"
+        if _name_matches(model_id, spec.deny_models):
+            return False, "denied by deny_models"
+        if spec.allow_models and not _name_matches(model_id, spec.allow_models):
+            return False, "not in allow_models"
+        model = self.catalog.get_model(model_id)
+        params_b = float(model["params_b"]) if model else 0.0
+        size_gb = float(model["size_gb"]) if model else 0.0
+        if params_b <= 0 and size_gb <= 0:
+            if self.strict:
+                return False, "unknown model size (strict)"
+        if spec.max_params_b > 0 and params_b > spec.max_params_b:
+            return False, f"params {params_b}B > cap {spec.max_params_b}B"
+        if spec.max_size_gb > 0 and size_gb > spec.max_size_gb:
+            return False, f"size {size_gb}GB > cap {spec.max_size_gb}GB"
+        if spec.max_context_k > 0 and context_k > spec.max_context_k:
+            return False, f"context {context_k}K > cap {spec.max_context_k}K"
+        return True, "ok"

@@ -1,0 +1,419 @@
+"""Provider selection: cascade, smart quality routing, device ranking.
+
+Parity map (reference `core/internal/routing/router.go`):
+  - RouteLLM cascade (embed→local; force_cloud; prefer_local;
+    cloud→local fallback): router.go:126-274
+  - SelectOllamaDevice ranking SQL (online ⋈ has-model ⋈ benchmarks ⋈
+    limits, ORDER BY tps DESC, latency ASC, last_seen): router.go:277-331
+  - routeSmartLLM quality×context-bucket tier mapping: router.go:92-110,407-528
+  - token estimation len/4 min 256: router.go:113-123
+  - quality deadlines 15..180 s: handlers.go:640-643
+  - pricing injection _price_in_1m/_price_out_1m: router.go:513-516
+
+TPU adaptation: the local provider is "tpu" (an in-process or remote TPU
+executor device registered in the catalog) instead of an Ollama endpoint;
+cloud fallbacks (openrouter/openai) remain HTTP providers.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..state.catalog import Catalog
+from ..state.db import Database
+from ..utils.config import getenv
+from .circuit import CircuitBreaker
+from .limits import LimitsEngine
+
+log = logging.getLogger("router")
+
+PROVIDER_TPU = "tpu"
+PROVIDER_OPENROUTER = "openrouter"
+PROVIDER_OPENAI = "openai"
+
+TIER_ORDER = ("turbo", "economy", "standard", "premium", "ultra", "max")
+
+# quality × context-bucket → acceptable local tier lists (best first).
+# Mirrors the reference's qualityTiers table (router.go:92-110): bigger
+# contexts push toward bigger tiers; low qualities accept smaller models.
+QUALITY_TIERS: dict[str, list[list[str]]] = {
+    # bucket:      ≤4K                    4-32K                  >32K
+    "turbo":    [["turbo", "economy"], ["economy", "standard"], ["standard", "premium"]],
+    "economy":  [["economy", "turbo"], ["economy", "standard"], ["standard", "premium"]],
+    "standard": [["standard", "economy"], ["standard", "premium"], ["premium", "ultra"]],
+    "premium":  [["premium", "standard"], ["premium", "ultra"], ["ultra", "max"]],
+    "ultra":    [["ultra", "premium"], ["ultra", "max"], ["max", "ultra"]],
+    "max":      [["max", "ultra"], ["max", "ultra"], ["max", "ultra"]],
+}
+
+# cloud fallback tiers per quality (router.go cloudFallbackTiers analog)
+CLOUD_FALLBACK_TIERS: dict[str, list[str]] = {
+    "turbo": ["turbo", "economy", "standard"],
+    "economy": ["economy", "standard"],
+    "standard": ["standard", "premium"],
+    "premium": ["premium", "ultra"],
+    "ultra": ["ultra", "max"],
+    "max": ["max", "ultra"],
+}
+
+# quality → auto job deadline seconds (handlers.go:640-643)
+QUALITY_DEADLINES_S: dict[str, float] = {
+    "turbo": 15,
+    "economy": 30,
+    "standard": 60,
+    "premium": 90,
+    "ultra": 120,
+    "max": 180,
+}
+
+
+def estimate_tokens(text: str) -> int:
+    """len/4 chars heuristic, floor 256 (router.go:113-123)."""
+    return max(len(text) // 4, 256)
+
+
+def context_bucket(tokens: int) -> int:
+    """0: ≤4K, 1: 4-32K, 2: >32K (router.go:420-426)."""
+    if tokens <= 4096:
+        return 0
+    if tokens <= 32_768:
+        return 1
+    return 2
+
+
+def quality_deadline_s(quality: str) -> float:
+    return QUALITY_DEADLINES_S.get(quality, 60.0)
+
+
+@dataclass
+class RouteDecision:
+    provider: str
+    kind: str
+    model: str = ""
+    device_id: str = ""
+    device_addr: str = ""
+    tier: str = ""
+    thinking: bool = False
+    reason: str = ""
+    extras: dict[str, Any] = field(default_factory=dict)  # merged into job payload
+
+    def payload_overlay(self) -> dict[str, Any]:
+        out = dict(self.extras)
+        out["provider"] = self.provider
+        if self.model:
+            out["model"] = self.model
+        if self.device_id:
+            out["device_id"] = self.device_id
+        if self.device_addr:
+            out["device_addr"] = self.device_addr
+        if self.tier:
+            out["_tier"] = self.tier
+        if self.thinking:
+            out["thinking"] = True
+        return out
+
+
+class Router:
+    def __init__(
+        self,
+        db: Database | None,
+        *,
+        circuit: CircuitBreaker | None = None,
+        limits: LimitsEngine | None = None,
+        has_openrouter: bool | None = None,
+        has_openai: bool | None = None,
+    ):
+        # nil-DB construction is legal (the reference does `New(nil)` in
+        # tests) — the circuit breaker is memory-only.
+        self.db = db
+        self.catalog = Catalog(db) if db is not None else None
+        self.circuit = circuit or CircuitBreaker()
+        self.limits = limits or (LimitsEngine(db) if db is not None else None)
+        self.has_openrouter = (
+            has_openrouter
+            if has_openrouter is not None
+            else bool(getenv("OPENROUTER_API_KEY", ""))
+        )
+        self.has_openai = (
+            has_openai if has_openai is not None else bool(getenv("OPENAI_API_KEY", ""))
+        )
+
+    # -- device selection --------------------------------------------------
+
+    def select_device(
+        self,
+        model: str,
+        task_type: str = "generate",
+        *,
+        max_latency_ms: float = 0.0,
+    ) -> dict[str, Any] | None:
+        """Best online device that has the model, passes limits and circuit,
+        ranked by latest benchmark tps DESC, latency ASC, then freshness.
+
+        The one-big-SQL-ranking-query design of the reference
+        (router.go:286-322), against the SQLite catalog.
+        """
+        if self.db is None:
+            return None
+        rows = self.db.query(
+            """
+            SELECT d.id, d.name, d.addr, d.tags, d.last_seen,
+                   b.tps AS bench_tps, b.latency_ms AS bench_latency_ms
+            FROM devices d
+            JOIN device_models dm ON dm.device_id = d.id AND dm.available = 1
+            LEFT JOIN (
+                SELECT device_id, model_id, task_type, tps, latency_ms,
+                       MAX(created_at)
+                FROM benchmarks GROUP BY device_id, model_id, task_type
+            ) b ON b.device_id = d.id AND b.model_id = dm.model_id
+                AND b.task_type = ?
+            WHERE d.online = 1 AND dm.model_id = ?
+            ORDER BY COALESCE(b.tps, 0) DESC,
+                     COALESCE(b.latency_ms, 1e12) ASC,
+                     d.last_seen DESC
+            """,
+            (task_type, model),
+        )
+        model_row = self.catalog.get_model(model) if self.catalog else None
+        ctx_k = int(model_row["context_k"]) if model_row else 0
+        for r in rows:
+            dev_id = r["id"]
+            if not self.circuit.allow(dev_id):
+                continue
+            if max_latency_ms > 0 and (r["bench_latency_ms"] or 0) > max_latency_ms:
+                continue
+            if self.limits is not None:
+                ok, why = self.limits.model_allowed(dev_id, model, ctx_k)
+                if not ok:
+                    log.debug("device %s rejected for %s: %s", dev_id, model, why)
+                    continue
+            r["tags"] = Database.from_json(r["tags"], {})
+            return r
+        return None
+
+    # -- main entry --------------------------------------------------------
+
+    def route(
+        self,
+        *,
+        kind: str = "generate",
+        model: str = "",
+        prompt: str = "",
+        provider: str = "auto",
+        quality: str = "",
+        thinking: bool | None = None,
+        max_latency_ms: float = 0.0,
+        force_cloud: bool = False,
+        prefer_local: bool = True,
+    ) -> RouteDecision:
+        """Route one LLM request. The cascade mirrors RouteLLM
+        (router.go:126-274); a `quality` value engages smart routing
+        (router.go:407-528)."""
+        if quality:
+            return self._route_smart(
+                kind=kind,
+                prompt=prompt,
+                quality=quality,
+                thinking=thinking,
+                force_cloud=force_cloud,
+            )
+
+        # explicit provider
+        if provider in (PROVIDER_OPENROUTER, PROVIDER_OPENAI):
+            return self._cloud_decision(provider, model, kind, reason="explicit provider")
+        if provider == PROVIDER_TPU:
+            local = self._local_decision(model, kind, max_latency_ms)
+            if local:
+                return local
+            return RouteDecision(
+                provider=PROVIDER_TPU, kind=kind, model=model,
+                reason="explicit tpu provider; no device available",
+            )
+
+        # auto cascade
+        if kind == "embed" and not force_cloud:
+            local = self._local_decision(model, kind, max_latency_ms)
+            if local:
+                return local
+        if force_cloud:
+            cloud = self._first_cloud(model, kind, reason="force_cloud")
+            if cloud:
+                return cloud
+        if prefer_local and not force_cloud:
+            local = self._local_decision(model, kind, max_latency_ms)
+            if local:
+                return local
+        cloud = self._first_cloud(model, kind, reason="cloud fallback")
+        if cloud:
+            return cloud
+        local = self._local_decision(model, kind, max_latency_ms)
+        if local:
+            return local
+        return RouteDecision(
+            provider=PROVIDER_TPU, kind=kind, model=model, reason="no provider available"
+        )
+
+    def _local_decision(
+        self, model: str, kind: str, max_latency_ms: float
+    ) -> RouteDecision | None:
+        if not model:
+            return None
+        task = "embed" if kind == "embed" else "generate"
+        dev = self.select_device(model, task, max_latency_ms=max_latency_ms)
+        if dev is None:
+            return None
+        return RouteDecision(
+            provider=PROVIDER_TPU,
+            kind=kind,
+            model=model,
+            device_id=dev["id"],
+            device_addr=dev["addr"],
+            reason=f"local device {dev['id']} (tps={dev['bench_tps'] or 0})",
+        )
+
+    def _first_cloud(self, model: str, kind: str, reason: str) -> RouteDecision | None:
+        if self.has_openrouter:
+            return self._cloud_decision(PROVIDER_OPENROUTER, model, kind, reason)
+        if self.has_openai:
+            return self._cloud_decision(PROVIDER_OPENAI, model, kind, reason)
+        return None
+
+    def _cloud_decision(
+        self, provider: str, model: str, kind: str, reason: str
+    ) -> RouteDecision:
+        d = RouteDecision(provider=provider, kind=kind, model=model, reason=reason)
+        if self.catalog and model:
+            pricing = self.catalog.get_pricing(model)
+            if pricing:
+                d.extras["_price_in_1m"] = pricing["input_per_1m"]
+                d.extras["_price_out_1m"] = pricing["output_per_1m"]
+        return d
+
+    # -- smart quality routing --------------------------------------------
+
+    def _route_smart(
+        self,
+        *,
+        kind: str,
+        prompt: str,
+        quality: str,
+        thinking: bool | None,
+        force_cloud: bool,
+    ) -> RouteDecision:
+        quality = quality if quality in QUALITY_TIERS else "standard"
+        tokens = estimate_tokens(prompt)
+        bucket = context_bucket(tokens)
+        tiers = QUALITY_TIERS[quality][bucket]
+
+        if not force_cloud:
+            local = self._find_local_model(tiers, kind, thinking)
+            if local:
+                local.tier = local.tier or tiers[0]
+                local.reason += f" (quality={quality} bucket={bucket})"
+                return local
+
+        cloud = self._find_cloud_model(CLOUD_FALLBACK_TIERS[quality], kind, thinking)
+        if cloud:
+            cloud.reason += f" (quality={quality} bucket={bucket})"
+            return cloud
+
+        # last resort: any local model of any tier
+        local = self._find_local_model(list(TIER_ORDER), kind, thinking)
+        if local:
+            local.reason += f" (quality={quality} bucket={bucket}, degraded)"
+            return local
+        return RouteDecision(
+            provider=PROVIDER_TPU, kind=kind,
+            reason=f"no model for quality={quality} bucket={bucket}",
+        )
+
+    def _find_local_model(
+        self, tiers: list[str], kind: str, thinking: bool | None
+    ) -> RouteDecision | None:
+        """Local (model, device) in the given tiers, thinking-preferring,
+        load-balanced by live running+queued jobs per device
+        (router.go:531-579)."""
+        if self.db is None:
+            return None
+        marks = ",".join("?" * len(tiers))
+        mkind = "embed" if kind == "embed" else "llm"
+        rows = self.db.query(
+            f"""
+            SELECT m.id AS model_id, m.tier, m.thinking, m.context_k,
+                   d.id AS device_id, d.addr,
+                   (SELECT COUNT(*) FROM jobs j WHERE j.device_id = d.id
+                    AND j.status IN ('queued','running')) AS live_jobs
+            FROM models m
+            JOIN device_models dm ON dm.model_id = m.id AND dm.available = 1
+            JOIN devices d ON d.id = dm.device_id AND d.online = 1
+            WHERE m.kind = ? AND m.tier IN ({marks})
+            ORDER BY live_jobs ASC, m.params_b DESC
+            """,
+            [mkind, *tiers],
+        )
+        if not rows:
+            return None
+        # thinking preference: stable partition, preferred first
+        if thinking is not None:
+            rows.sort(key=lambda r: 0 if bool(r["thinking"]) == thinking else 1)
+        for r in rows:
+            dev_id = r["device_id"]
+            if not self.circuit.allow(dev_id):
+                continue
+            if self.limits is not None:
+                ok, _ = self.limits.model_allowed(dev_id, r["model_id"], r["context_k"])
+                if not ok:
+                    continue
+            d = RouteDecision(
+                provider=PROVIDER_TPU,
+                kind=kind,
+                model=r["model_id"],
+                device_id=dev_id,
+                device_addr=r["addr"],
+                tier=r["tier"],
+                thinking=bool(r["thinking"]),
+                reason=f"local {r['model_id']} on {dev_id} load={r['live_jobs']}",
+            )
+            return d
+        return None
+
+    def _find_cloud_model(
+        self, tiers: list[str], kind: str, thinking: bool | None
+    ) -> RouteDecision | None:
+        """Cloud model from the catalog in the given tiers, widest context
+        first (router.go:582-616), with pricing injected into the payload."""
+        if self.db is None or not (self.has_openrouter or self.has_openai):
+            return None
+        marks = ",".join("?" * len(tiers))
+        mkind = "embed" if kind == "embed" else "llm"
+        rows = self.db.query(
+            f"""
+            SELECT m.id AS model_id, m.tier, m.thinking, m.context_k,
+                   p.input_per_1m, p.output_per_1m
+            FROM models m
+            JOIN model_pricing p ON p.model_id = m.id
+            WHERE m.kind = ? AND m.tier IN ({marks}) AND m.id LIKE '%/%'
+            ORDER BY m.context_k DESC, p.output_per_1m ASC
+            """,
+            [mkind, *tiers],
+        )
+        if not rows:
+            return None
+        if thinking is not None:
+            rows.sort(key=lambda r: 0 if bool(r["thinking"]) == thinking else 1)
+        r = rows[0]
+        provider = PROVIDER_OPENROUTER if self.has_openrouter else PROVIDER_OPENAI
+        return RouteDecision(
+            provider=provider,
+            kind=kind,
+            model=r["model_id"],
+            tier=r["tier"],
+            thinking=bool(r["thinking"]),
+            reason=f"cloud {r['model_id']}",
+            extras={
+                "_price_in_1m": r["input_per_1m"],
+                "_price_out_1m": r["output_per_1m"],
+            },
+        )

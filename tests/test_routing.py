@@ -1,0 +1,256 @@
+"""Routing layer tests.
+
+Models the reference's pure-logic router/limits unit suites
+(`core/internal/routing/router_test.go:11-256`,
+`core/internal/limits/limits_test.go:14-160`) and exceeds them with
+catalog-backed device-selection tests (the reference never tests its SQL)."""
+
+import time
+
+import pytest
+
+from llm_mcp_tpu.routing import (
+    CircuitBreaker,
+    Router,
+    derive_device_limits,
+    estimate_tokens,
+    context_bucket,
+    quality_deadline_s,
+)
+from llm_mcp_tpu.routing.limits import LimitsEngine, parse_limit_specs
+from llm_mcp_tpu.routing.router import QUALITY_TIERS, CLOUD_FALLBACK_TIERS, TIER_ORDER
+
+
+# -- pure logic (router_test.go parity) -------------------------------------
+
+
+def test_estimate_tokens_floor_and_scale():
+    assert estimate_tokens("") == 256
+    assert estimate_tokens("x" * 100) == 256
+    assert estimate_tokens("x" * 4096) == 1024
+    assert estimate_tokens("x" * 400_000) == 100_000
+
+
+def test_context_buckets():
+    assert context_bucket(256) == 0
+    assert context_bucket(4096) == 0
+    assert context_bucket(4097) == 1
+    assert context_bucket(32_768) == 1
+    assert context_bucket(32_769) == 2
+
+
+def test_quality_tier_tables_complete():
+    for q, rows in QUALITY_TIERS.items():
+        assert len(rows) == 3, q  # one tier list per context bucket
+        for tiers in rows:
+            assert tiers, q
+            for t in tiers:
+                assert t in TIER_ORDER
+        assert q in CLOUD_FALLBACK_TIERS
+    assert quality_deadline_s("turbo") == 15
+    assert quality_deadline_s("max") == 180
+    assert quality_deadline_s("nonsense") == 60
+
+
+def test_circuit_breaker_state_machine():
+    cb = CircuitBreaker()
+    assert cb.allow("d1")
+    cb.record("d1", ok=False)
+    assert cb.status("d1") == "ok"  # 1 failure: still ok
+    assert cb.allow("d1")
+    cb.record("d1", ok=False)
+    cb.record("d1", ok=False)
+    assert cb.status("d1") == "degraded"  # 3 consecutive → degraded
+    assert not cb.allow("d1")
+    cb.record("d1", ok=True)
+    assert cb.status("d1") == "ok"  # success resets
+
+
+def test_circuit_breaker_probe_after_window():
+    cb = CircuitBreaker()
+    for _ in range(3):
+        cb.record("d1", ok=False)
+    assert not cb.allow("d1")
+    cb._rewind_degraded_at("d1", 301.0)  # the reference's DegradedAt rewind
+    assert cb.status("d1") == "probe"
+    assert cb.allow("d1")  # exactly one probe
+    assert not cb.allow("d1")  # second concurrent request blocked
+    cb.record("d1", ok=False)  # failed probe → degraded again
+    assert cb.status("d1") == "degraded"
+
+
+def test_circuit_breaker_empty_id_and_isolation():
+    cb = CircuitBreaker()
+    cb.record("", ok=False)
+    assert cb.allow("")
+    for _ in range(3):
+        cb.record("a", ok=False)
+    assert not cb.allow("a")
+    assert cb.allow("b")  # devices are independent
+
+
+def test_router_constructs_with_nil_db():
+    r = Router(None, has_openrouter=False, has_openai=False)
+    assert r.select_device("m") is None
+    d = r.route(kind="generate", model="m")
+    assert d.reason == "no provider available"
+
+
+# -- limits ------------------------------------------------------------------
+
+
+def test_derive_limits_hbm_tiers():
+    v5e_chip = derive_device_limits(16.0, chips=1)
+    assert v5e_chip.max_params_b == 4.0  # 16GB: 8GB weights bf16
+    v5e_8 = derive_device_limits(16.0, chips=8)
+    assert v5e_8.max_params_b == 32.0
+    assert v5e_8.max_context_k >= 128
+    assert derive_device_limits(0.0).max_params_b == 0.0
+
+
+def test_parse_limit_specs_json_and_default():
+    specs = parse_limit_specs(
+        limits_json='{"*": {"max_params_b": 7}, "dev1": {"max_params_b": 70, "deny_models": ["bad"]}}'
+    )
+    assert specs["*"].max_params_b == 7
+    assert specs["dev1"].deny_models == ["bad"]
+    assert specs["dev1"].source == "preset"
+    assert parse_limit_specs(limits_json="not json") == {}
+    assert parse_limit_specs(limits_json="") == {}
+
+
+def test_limits_engine_apply_and_gate(db, catalog):
+    catalog.upsert_device("tpu-0", tags={"hbm_gb": 16, "chips": 1})
+    catalog.upsert_device("tpu-big", tags={"hbm_gb": 16, "chips": 8})
+    catalog.upsert_model("llama-3.1-8b", params_b=8.0, kind="llm")
+    catalog.upsert_model("llama-3.2-1b", params_b=1.24, kind="llm")
+    eng = LimitsEngine(db)
+    assert eng.apply_specs({}) == 2  # derived for both
+
+    ok, why = eng.model_allowed("tpu-0", "llama-3.1-8b")
+    assert not ok and "cap" in why  # 8B > 4B single-chip cap
+    ok, _ = eng.model_allowed("tpu-0", "llama-3.2-1b")
+    assert ok
+    ok, _ = eng.model_allowed("tpu-big", "llama-3.1-8b")
+    assert ok
+
+
+def test_limits_preset_not_overwritten_by_derivation(db, catalog):
+    catalog.upsert_device("tpu-0", tags={"hbm_gb": 16})
+    eng = LimitsEngine(db)
+    specs = parse_limit_specs(limits_json='{"tpu-0": {"max_params_b": 70}}')
+    eng.apply_specs(specs)
+    eng.apply_specs({})  # re-derivation pass must not clobber the preset
+    assert eng.get("tpu-0").max_params_b == 70
+    assert eng.get("tpu-0").source == "preset"
+
+
+def test_limits_allow_deny_and_strict(db, catalog):
+    catalog.upsert_device("d", tags={})
+    eng = LimitsEngine(db, strict=True)
+    specs = parse_limit_specs(
+        limits_json='{"d": {"allow_models": ["llama"], "deny_models": ["llama-bad"]}}'
+    )
+    eng.apply_specs(specs)
+    ok, why = eng.model_allowed("d", "llama-bad-1b")
+    assert not ok and "deny" in why
+    ok, why = eng.model_allowed("d", "qwen-7b")
+    assert not ok and "allow" in why
+    # allowed by name but unknown size under strict
+    ok, why = eng.model_allowed("d", "llama-mystery")
+    assert not ok and "strict" in why
+
+
+# -- catalog-backed routing --------------------------------------------------
+
+
+@pytest.fixture()
+def routed(db, catalog):
+    """Two online TPU devices with benchmarks, one offline, one cloud model."""
+    catalog.upsert_device("tpu-fast", addr="10.0.0.1:8080", tags={"hbm_gb": 16, "chips": 8})
+    catalog.upsert_device("tpu-slow", addr="10.0.0.2:8080", tags={"hbm_gb": 16, "chips": 8})
+    catalog.upsert_device("tpu-off", addr="10.0.0.3:8080", online=False)
+    catalog.upsert_model("llama-3.1-8b", params_b=8.0, kind="llm", tier="economy")
+    catalog.upsert_model("nomic-embed-text", params_b=0.137, kind="embed", tier="turbo")
+    catalog.upsert_model("big/cloud-model", params_b=300, kind="llm", tier="premium", context_k=200)
+    catalog.set_pricing("big/cloud-model", 1.0, 3.0)
+    for dev in ("tpu-fast", "tpu-slow", "tpu-off"):
+        catalog.sync_device_models(dev, ["llama-3.1-8b", "nomic-embed-text"])
+    catalog.record_benchmark("tpu-fast", "llama-3.1-8b", "generate", tps=2400, latency_ms=40)
+    catalog.record_benchmark("tpu-slow", "llama-3.1-8b", "generate", tps=900, latency_ms=80)
+    return Router(db, has_openrouter=True, has_openai=False)
+
+
+def test_select_device_ranks_by_tps(routed):
+    dev = routed.select_device("llama-3.1-8b", "generate")
+    assert dev["id"] == "tpu-fast"
+
+
+def test_select_device_skips_degraded(routed):
+    for _ in range(3):
+        routed.circuit.record("tpu-fast", ok=False)
+    dev = routed.select_device("llama-3.1-8b", "generate")
+    assert dev["id"] == "tpu-slow"
+
+
+def test_select_device_latency_constraint(routed):
+    dev = routed.select_device("llama-3.1-8b", "generate", max_latency_ms=50)
+    assert dev["id"] == "tpu-fast"
+    dev = routed.select_device("llama-3.1-8b", "generate", max_latency_ms=10)
+    assert dev is None  # both devices exceed 10ms
+
+
+def test_select_device_ignores_offline(routed):
+    routed.circuit.record("tpu-fast", ok=False)
+    assert routed.select_device("llama-3.1-8b").get("id") != "tpu-off"
+
+
+def test_route_auto_prefers_local(routed):
+    d = routed.route(kind="generate", model="llama-3.1-8b", prompt="hi")
+    assert d.provider == "tpu"
+    assert d.device_id == "tpu-fast"
+    overlay = d.payload_overlay()
+    assert overlay["device_id"] == "tpu-fast"
+    assert overlay["model"] == "llama-3.1-8b"
+
+
+def test_route_force_cloud(routed):
+    d = routed.route(kind="generate", model="big/cloud-model", force_cloud=True)
+    assert d.provider == "openrouter"
+    assert d.extras.get("_price_in_1m") == 1.0
+
+
+def test_route_embed_goes_local(routed):
+    d = routed.route(kind="embed", model="nomic-embed-text")
+    assert d.provider == "tpu"
+
+
+def test_smart_routing_local_then_cloud(routed):
+    # economy quality, small context → local llama (tier economy)
+    d = routed.route(kind="generate", prompt="short", quality="economy")
+    assert d.provider == "tpu"
+    assert d.model == "llama-3.1-8b"
+    assert d.tier == "economy"
+    # premium quality → no local premium model → cloud fallback with pricing
+    d = routed.route(kind="generate", prompt="short", quality="premium")
+    assert d.provider == "openrouter"
+    assert d.model == "big/cloud-model"
+    assert d.extras["_price_in_1m"] == 1.0
+
+
+def test_smart_routing_huge_context_prefers_bigger_tiers(routed):
+    prompt = "x" * 400_000  # ~100K tokens → bucket 2
+    d = routed.route(kind="generate", prompt=prompt, quality="standard")
+    # bucket 2 standard → [premium, ultra]: only the cloud model qualifies
+    assert d.provider == "openrouter"
+
+
+def test_smart_routing_degrades_to_any_local_when_no_cloud(db, catalog):
+    catalog.upsert_device("t0", tags={})
+    catalog.upsert_model("tiny-llm", params_b=0.001, kind="llm", tier="turbo")
+    catalog.sync_device_models("t0", ["tiny-llm"])
+    r = Router(db, has_openrouter=False, has_openai=False)
+    d = r.route(kind="generate", prompt="x", quality="max")
+    assert d.provider == "tpu"
+    assert d.model == "tiny-llm"
+    assert "degraded" in d.reason
