@@ -1,0 +1,4 @@
+from .http import HTTPApi, Request, Response
+from .server import CoreServer
+
+__all__ = ["HTTPApi", "Request", "Response", "CoreServer"]

@@ -1,0 +1,70 @@
+"""`python -m llm_mcp_tpu.api` — boot the core server with local engines.
+
+The process-level analog of the reference's `core/cmd/core/main.go`:
+construct state, policy, API; load the configured models into TPU engines;
+serve until SIGTERM.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import sys
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=os.environ.get("LOG_LEVEL", "INFO"),
+        format='{"ts":"%(asctime)s","level":"%(levelname)s","logger":"%(name)s","msg":"%(message)s"}',
+    )
+    log = logging.getLogger("main")
+
+    from ..utils.config import Config
+
+    cfg = Config()
+
+    import jax.numpy as jnp
+
+    from ..executor import EmbeddingEngine, GenerationEngine
+    from .server import CoreServer
+
+    gen_engines = {}
+    embed_engines = {}
+    if os.environ.get("TPU_DISABLE_ENGINES", "") not in ("1", "true"):
+        model = cfg.tpu_model
+        log.info("loading generation engine: %s", model)
+        gen_engines[model] = GenerationEngine(
+            model,
+            max_slots=cfg.tpu_max_slots,
+            max_seq_len=cfg.tpu_max_seq_len,
+            dtype=jnp.bfloat16,
+            weights_dir=cfg.tpu_weights_dir,
+        ).start()
+        emodel = cfg.tpu_embed_model
+        log.info("loading embedding engine: %s", emodel)
+        embed_engines[emodel] = EmbeddingEngine(
+            emodel,
+            max_seq_len=min(cfg.tpu_max_seq_len, 8192),
+            dtype=jnp.bfloat16,
+            weights_dir=cfg.tpu_weights_dir,
+        )
+
+    host, _, port = cfg.http_addr.rpartition(":")
+    server = CoreServer(
+        cfg, gen_engines=gen_engines, embed_engines=embed_engines
+    ).start(host or "0.0.0.0", int(port or 8080))
+
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *_: stop.append(1))
+    try:
+        while not stop:
+            signal.pause()
+    finally:
+        log.info("shutting down")
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

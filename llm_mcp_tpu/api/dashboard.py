@@ -1,0 +1,253 @@
+"""Cluster dashboard, debug diagnostics, capacity, smoke test.
+
+Parity map (reference `core/internal/api/handlers.go`):
+  - GET /v1/dashboard single-JSON snapshot: 948-1092
+  - Host→Node hierarchy builder: 1095-1264 (multi-port Ollama devices per
+    host → here: multi-slice TPU devices per host via tags.base_device)
+  - role inference: 1267-1292   issues[] generator: 1295-1339
+  - GET /v1/debug/health deep health: 1372-1519
+  - GET /v1/debug/actions catalog: 1522-1567
+  - GET /v1/debug/capacity slots: 1570-1694 (slots = continuous-batch slots)
+  - POST /v1/debug/test live smoke: 1697-1814
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from ..routing import Router
+from ..state.catalog import Catalog
+from ..state.db import Database
+from ..state.queue import JobQueue
+from ..utils.config import Config
+from .http import Request, Response
+
+
+class DashboardAPI:
+    def __init__(
+        self,
+        *,
+        db: Database,
+        queue: JobQueue,
+        catalog: Catalog,
+        router: Router,
+        cfg: Config,
+        engines_info=None,  # callable -> dict with local engine stats
+    ):
+        self.db = db
+        self.queue = queue
+        self.catalog = catalog
+        self.router = router
+        self.cfg = cfg
+        self.engines_info = engines_info or (lambda: {})
+        self.started_at = time.time()
+
+    # -- dashboard ---------------------------------------------------------
+
+    def handle_dashboard(self, req: Request, resp: Response) -> None:
+        counts = self.queue.counts_by_status()
+        running = self.queue.list(status="running", limit=50)
+        devices = self.catalog.list_devices()
+        workers = self.catalog.workers_online()
+        costs = self.catalog.costs_summary(since=time.time() - 86400)
+        circuit = self.router.circuit.snapshot()
+        hosts = self._host_tree(devices, circuit)
+        issues = self._issues(counts, devices, workers, circuit)
+        resp.write_json(
+            {
+                "ts": time.time(),
+                "uptime_s": round(time.time() - self.started_at, 1),
+                "jobs": counts,
+                "running_jobs": [j.to_dict() for j in running],
+                "devices_online": sum(1 for d in devices if d["online"]),
+                "devices_total": len(devices),
+                "hosts": hosts,
+                "workers_online": len(workers),
+                "workers": workers,
+                "costs_24h": costs,
+                "circuit": circuit,
+                "engines": self.engines_info(),
+                "issues": issues,
+            }
+        )
+
+    def _host_tree(self, devices: list[dict], circuit: dict) -> list[dict]:
+        """Group slice/port child devices under their base host
+        (`handlers.go:1095-1264`). A TPU child device carries
+        tags.base_device, like the reference's per-port Ollama children."""
+        hosts: dict[str, dict] = {}
+        for d in devices:
+            tags = d.get("tags") or {}
+            base = str(tags.get("base_device") or d["id"])
+            host = hosts.setdefault(
+                base, {"host": base, "online": False, "nodes": [], "role": ""}
+            )
+            node = {
+                "id": d["id"],
+                "name": d["name"],
+                "addr": d["addr"],
+                "online": bool(d["online"]),
+                "last_seen": d["last_seen"],
+                "models": self.catalog.device_models(d["id"]),
+                "circuit": circuit.get(d["id"], {}).get("status", "ok"),
+                "tags": tags,
+            }
+            host["nodes"].append(node)
+            host["online"] = host["online"] or node["online"]
+        for h in hosts.values():
+            h["role"] = self._infer_role(h)
+        return sorted(hosts.values(), key=lambda h: h["host"])
+
+    @staticmethod
+    def _infer_role(host: dict) -> str:
+        """Role inference (`handlers.go:1267-1292`), TPU flavored."""
+        tags_union: dict[str, Any] = {}
+        models: list[str] = []
+        for n in host["nodes"]:
+            tags_union.update(n.get("tags") or {})
+            models += n.get("models") or []
+        if tags_union.get("tpu") or tags_union.get("chips"):
+            return "tpu-executor"
+        if tags_union.get("cloud"):
+            return "cloud-gateway"
+        if any("embed" in m for m in models):
+            return "embedder"
+        if models:
+            return "inference"
+        return "node"
+
+    def _issues(self, counts, devices, workers, circuit) -> list[str]:
+        """Plain-language cluster problems (`handlers.go:1295-1339`)."""
+        issues: list[str] = []
+        online = [d for d in devices if d["online"]]
+        if not online:
+            issues.append("No devices online — nothing can serve inference.")
+        if not workers:
+            issues.append("No workers have heartbeated in 90s — async jobs will not run.")
+        queued = counts.get("queued", 0)
+        if queued > 50:
+            issues.append(f"{queued} jobs queued — queue may be stuck or underprovisioned.")
+        errors = counts.get("error", 0)
+        if errors > 10:
+            issues.append(f"{errors} jobs in error state.")
+        degraded = [d for d, st in circuit.items() if st.get("status") == "degraded"]
+        if degraded:
+            issues.append(f"Devices degraded by circuit breaker: {', '.join(sorted(degraded))}.")
+        stale = [
+            d["id"]
+            for d in online
+            if d["last_seen"] and time.time() - d["last_seen"] > 600
+        ]
+        if stale:
+            issues.append(f"Online devices not seen for >10min: {', '.join(sorted(stale))}.")
+        return issues
+
+    # -- debug -------------------------------------------------------------
+
+    def handle_health(self, req: Request, resp: Response) -> None:
+        t0 = time.time()
+        db_ok, db_err = True, ""
+        try:
+            self.db.query_one("SELECT 1 AS ok")
+        except Exception as e:
+            db_ok, db_err = False, str(e)
+        db_ms = (time.time() - t0) * 1000
+        devices = self.catalog.list_devices(online_only=True)
+        checks = {
+            "db": {"ok": db_ok, "latency_ms": round(db_ms, 2), "error": db_err},
+            "devices_online": len(devices),
+            "workers_online": len(self.catalog.workers_online()),
+            "engines": self.engines_info(),
+        }
+        status = "ok" if db_ok else "error"
+        resp.write_json({"status": status, "checks": checks}, 200 if db_ok else 503)
+
+    def handle_actions(self, req: Request, resp: Response) -> None:
+        """Action catalog (`handlers.go:1522-1567`)."""
+        resp.write_json(
+            {
+                "actions": [
+                    {"method": "POST", "path": "/v1/discovery/run", "desc": "trigger device discovery"},
+                    {"method": "POST", "path": "/v1/debug/test", "desc": "run live smoke test"},
+                    {"method": "POST", "path": "/v1/jobs", "desc": "submit a job"},
+                    {"method": "POST", "path": "/v1/llm/request", "desc": "smart-routed LLM request"},
+                    {"method": "POST", "path": "/v1/chat/completions", "desc": "OpenAI-compatible chat"},
+                    {"method": "POST", "path": "/v1/embeddings", "desc": "OpenAI-compatible embeddings"},
+                    {"method": "GET", "path": "/v1/dashboard", "desc": "cluster snapshot"},
+                    {"method": "GET", "path": "/v1/debug/capacity", "desc": "slot capacity"},
+                    {"method": "POST", "path": "/v1/models/sync", "desc": "sync model catalog"},
+                ]
+            }
+        )
+
+    def handle_capacity(self, req: Request, resp: Response) -> None:
+        """Slots = engine batch slots for TPU devices (the reference's
+        nodes × DEVICE_MAX_CONCURRENCY, `handlers.go:1570-1694`; here the
+        per-device continuous-batch slot count from tags)."""
+        devices = self.catalog.list_devices(online_only=True)
+        total_slots = 0
+        per_device = []
+        running_by_dev = {
+            r["device_id"]: r["n"]
+            for r in self.db.query(
+                "SELECT device_id, COUNT(*) AS n FROM jobs WHERE status='running'"
+                " AND device_id IS NOT NULL GROUP BY device_id"
+            )
+        }
+        for d in devices:
+            tags = d.get("tags") or {}
+            slots = int(tags.get("slots", 0) or 0) or self.cfg.device_max_concurrency
+            used = running_by_dev.get(d["id"], 0)
+            total_slots += slots
+            per_device.append(
+                {"device_id": d["id"], "slots": slots, "running": used, "free": max(slots - used, 0)}
+            )
+        resp.write_json(
+            {
+                "total_slots": total_slots,
+                "running": sum(p["running"] for p in per_device),
+                "devices": per_device,
+            }
+        )
+
+    def handle_smoke_test(self, req: Request, resp: Response) -> None:
+        """Live smoke (`handlers.go:1697-1814`): db ping/read, per-device
+        reachability, queue round-trip with cleanup."""
+        results: dict[str, Any] = {}
+        t0 = time.time()
+        try:
+            self.db.query_one("SELECT 1 AS ok")
+            results["db_ping"] = {"ok": True, "ms": round((time.time() - t0) * 1000, 2)}
+        except Exception as e:
+            results["db_ping"] = {"ok": False, "error": str(e)}
+        try:
+            results["db_read"] = {
+                "ok": True,
+                "jobs": self.queue.counts_by_status(),
+                "devices": len(self.catalog.list_devices()),
+            }
+        except Exception as e:
+            results["db_read"] = {"ok": False, "error": str(e)}
+        # queue round-trip with a unique kind so a real user's queued job can
+        # never be claimed by the smoke test; leftovers are canceled
+        try:
+            import uuid
+
+            kind = f"smoke.{uuid.uuid4().hex[:8]}"
+            job = self.queue.submit(kind, {"payload": "smoke"})
+            claimed = self.queue.claim("smoke-test", kinds=[kind])
+            ok = claimed is not None and claimed.id == job.id
+            if ok:
+                self.queue.complete(job.id, "smoke-test", result={"echo": "smoke"})
+            final = self.queue.get(job.id)
+            if final is not None and final.status not in ("done",):
+                self.queue.cancel(job.id)
+            results["queue_roundtrip"] = {
+                "ok": bool(ok and final and final.status == "done"),
+                "job_id": job.id,
+            }
+        except Exception as e:
+            results["queue_roundtrip"] = {"ok": False, "error": str(e)}
+        all_ok = all(v.get("ok") for v in results.values())
+        resp.write_json({"status": "ok" if all_ok else "failed", "results": results})

@@ -1,0 +1,474 @@
+"""OpenAI-compatible sync inference surface: chat completions + embeddings,
+and the smart-routed async `/v1/llm/request`.
+
+Parity map (reference):
+  - POST /v1/chat/completions: `core/internal/api/handlers.go:2087-2587` —
+    but where the reference proxies Ollama NDJSON and re-chunks it into SSE
+    (the token loop lives outside the repo), here the SSE frames come
+    straight out of the in-process TPU decode loop.
+  - POST /v1/embeddings: `handlers.go:1821-2078` — in-process encoder with
+    exact Matryoshka `dimensions` truncation instead of the client-side
+    fallback (`handlers.go:2063-2078`).
+  - smart model selection when model=="" via model_rankings scoring:
+    `handlers.go:2121-2159,3040-3144`.
+  - POST /v1/llm/request: `handlers.go:645-697` — route, quality deadline,
+    enqueue, 202.
+  - `<think>` splitting into a reasoning field: `worker/llm_worker/main.py:207-219`.
+  - cost + stats recording: `handlers.go:2608-2634,3147-3171`.
+
+Remote TPU devices (another executor process found by discovery) are served
+by proxying the same OpenAI-shaped request to the device's own HTTP address
+— the analog of the reference's Ollama proxy hop, with circuit-breaker
+bookkeeping on failures (`handlers.go:1899-1931`).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import uuid
+from typing import Any
+
+from ..executor import EmbeddingEngine, GenerationEngine
+from ..routing import Router, quality_deadline_s
+from ..state.catalog import Catalog
+from ..state.queue import JobQueue
+from ..telemetry import Metrics
+from ..utils.tokens import messages_to_prompt, split_think
+from .http import Request, Response
+
+log = logging.getLogger("inference")
+
+CHAT_PROXY_TIMEOUT_S = 120.0
+EMBED_PROXY_TIMEOUT_S = 120.0
+EMBED_RETRIES = 3
+
+
+class InferenceAPI:
+    def __init__(
+        self,
+        *,
+        catalog: Catalog,
+        queue: JobQueue,
+        router: Router,
+        metrics: Metrics,
+        device_id: str = "tpu-local",
+        gen_engines: dict[str, GenerationEngine] | None = None,
+        embed_engines: dict[str, EmbeddingEngine] | None = None,
+        cloud: Any = None,  # providers.CloudClient | None
+    ):
+        self.catalog = catalog
+        self.queue = queue
+        self.router = router
+        self.metrics = metrics
+        self.device_id = device_id
+        self.gen_engines = gen_engines or {}
+        self.embed_engines = embed_engines or {}
+        self.cloud = cloud
+
+    # -- helpers -----------------------------------------------------------
+
+    def _local_gen(self, model: str) -> GenerationEngine | None:
+        if model in self.gen_engines:
+            return self.gen_engines[model]
+        return None
+
+    def _local_embed(self, model: str) -> EmbeddingEngine | None:
+        return self.embed_engines.get(model)
+
+    def _select_model_smart(self, category: str = "chat") -> str:
+        """model=="" → best model by rankings score × success rate − cost
+        factor (`handlers.go:3040-3144`, simplified to the same shape)."""
+        rows = self.catalog.db.query(
+            """
+            SELECT r.model_id, r.score,
+                   COALESCE(s.requests, 0) AS requests,
+                   COALESCE(s.errors, 0) AS errors,
+                   COALESCE(p.output_per_1m, 0) AS out_price
+            FROM model_rankings r
+            LEFT JOIN model_stats s ON s.model_id = r.model_id
+            LEFT JOIN model_pricing p ON p.model_id = r.model_id
+            WHERE r.category = ?
+            ORDER BY r.score DESC
+            """,
+            (category,),
+        )
+        best, best_score = "", -1e9
+        import math
+
+        for r in rows:
+            req = r["requests"] or 0
+            success = (req - (r["errors"] or 0)) / req if req else 1.0
+            cost_factor = math.log1p(r["out_price"] or 0.0) * 0.1
+            score = r["score"] * success - cost_factor
+            if score > best_score:
+                best, best_score = r["model_id"], score
+        if best:
+            return best
+        # no rankings: any local llm from the catalog
+        models = self.catalog.list_models(kind="llm")
+        for m in models:
+            if self._local_gen(m["id"]) is not None:
+                return m["id"]
+        return models[0]["id"] if models else ""
+
+    # -- chat completions --------------------------------------------------
+
+    def handle_chat_completions(self, req: Request, resp: Response) -> None:
+        try:
+            body = req.json()
+        except json.JSONDecodeError:
+            resp.write_error("invalid JSON body", 400)
+            return
+        model = str(body.get("model") or "")
+        messages = body.get("messages") or []
+        if not isinstance(messages, list) or not messages:
+            resp.write_error("messages required", 400)
+            return
+        stream = bool(body.get("stream", False))
+        try:
+            raw_max = body.get("max_tokens", body.get("max_completion_tokens"))
+            max_tokens = int(raw_max) if raw_max is not None else 512
+            temperature = float(body.get("temperature", 0.7))
+            top_p = float(body.get("top_p", 1.0))
+        except (TypeError, ValueError) as e:
+            resp.write_error(f"invalid numeric parameter: {e}", 400)
+            return
+        if max_tokens < 1:
+            resp.write_error("max_tokens must be >= 1", 400)
+            return
+        stop = body.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+
+        if not model:
+            model = self._select_model_smart("chat")
+            if not model:
+                resp.write_error("no model available", 503)
+                return
+
+        if "/" in model:  # cloud namespace, e.g. "meta-llama/..." via OpenRouter
+            self._chat_cloud(req, resp, body, model, stream)
+            return
+
+        t0 = time.time()
+        prompt = messages_to_prompt(messages)
+        engine = self._local_gen(model)
+        if engine is None:
+            dev = self.router.select_device(model, "generate")
+            if dev is not None and dev["id"] != self.device_id and dev["addr"]:
+                self._chat_proxy(resp, dev, body, model, stream)
+                return
+            resp.write_error(f"model {model!r} not available on any device", 503)
+            self.metrics.chat_requests.labels(model=model, provider="tpu", status="error").inc()
+            return
+
+        gen_kwargs = dict(
+            max_tokens=max_tokens, temperature=temperature, top_p=top_p, stop=stop
+        )
+        created = int(t0)
+        cmpl_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+
+        if stream:
+            self._chat_stream_local(resp, engine, model, prompt, gen_kwargs, cmpl_id, created, t0)
+        else:
+            self._chat_sync_local(resp, engine, model, prompt, gen_kwargs, cmpl_id, created, t0)
+
+    def _chat_sync_local(self, resp, engine, model, prompt, gen_kwargs, cmpl_id, created, t0):
+        try:
+            out = engine.generate(prompt, **gen_kwargs)
+        except RuntimeError as e:
+            resp.write_error(str(e), 500)
+            self.metrics.chat_requests.labels(model=model, provider="tpu", status="error").inc()
+            self.router.circuit.record(self.device_id, ok=False)
+            return
+        self.router.circuit.record(self.device_id, ok=True)
+        usage = out["usage"]
+        thinking, answer = split_think(out["text"])
+        message: dict[str, Any] = {"role": "assistant", "content": answer}
+        if thinking:
+            message["reasoning"] = thinking
+        resp.write_json(
+            {
+                "id": cmpl_id,
+                "object": "chat.completion",
+                "created": created,
+                "model": model,
+                "choices": [
+                    {"index": 0, "message": message, "finish_reason": out["finish_reason"]}
+                ],
+                "usage": usage,
+            }
+        )
+        self._record_chat(model, "tpu", usage, time.time() - t0, ok=True)
+
+    def _chat_stream_local(self, resp, engine, model, prompt, gen_kwargs, cmpl_id, created, t0):
+        resp.start_sse()
+        base = {"id": cmpl_id, "object": "chat.completion.chunk", "created": created, "model": model}
+        first = dict(base, choices=[{"index": 0, "delta": {"role": "assistant"}, "finish_reason": None}])
+        if not resp.sse_data(first):
+            return
+        usage: dict[str, Any] = {}
+        finish = "stop"
+        ok = True
+        ttft: float | None = None
+        for evt in engine.generate_stream(prompt, **gen_kwargs):
+            if evt["type"] == "token":
+                if ttft is None:
+                    ttft = time.time() - t0
+                    self.metrics.chat_ttft.labels(model=model).observe(ttft)
+                chunk = dict(
+                    base,
+                    choices=[{"index": 0, "delta": {"content": evt["text"]}, "finish_reason": None}],
+                )
+                if not resp.sse_data(chunk):
+                    return  # client went away; engine keeps finishing the slot
+            elif evt["type"] == "done":
+                usage = evt.get("usage", {})
+                finish = evt.get("finish_reason", "stop")
+            elif evt["type"] == "error":
+                ok = False
+                resp.sse_data(dict(base, error={"message": evt.get("error", "")}))
+                break
+        final = dict(
+            base, choices=[{"index": 0, "delta": {}, "finish_reason": finish}], usage=usage
+        )
+        resp.sse_data(final)
+        resp.sse_data("[DONE]")
+        self.router.circuit.record(self.device_id, ok=ok)
+        self._record_chat(model, "tpu", usage, time.time() - t0, ok=ok)
+
+    def _chat_proxy(self, resp: Response, dev: dict, body: dict, model: str, stream: bool) -> None:
+        """Forward to a remote TPU device's own /v1/chat/completions —
+        the reference's Ollama-device hop (`handlers.go:2427-2470`)."""
+        import httpx
+
+        url = f"http://{dev['addr']}/v1/chat/completions"
+        try:
+            if stream:
+                with httpx.stream(
+                    "POST", url, json=body, timeout=CHAT_PROXY_TIMEOUT_S
+                ) as r:
+                    if r.status_code >= 400:
+                        # surface the remote error as an error, not a 200 SSE
+                        r.read()
+                        self.router.circuit.record(dev["id"], ok=r.status_code < 500)
+                        resp.write_bytes(r.content, "application/json", r.status_code)
+                        return
+                    resp.start_sse()
+                    for line in r.iter_lines():
+                        if line.startswith("data: "):
+                            if not resp.sse_data(line[len("data: "):]):
+                                break
+                self.router.circuit.record(dev["id"], ok=True)
+            else:
+                r = httpx.post(url, json=body, timeout=CHAT_PROXY_TIMEOUT_S)
+                resp.write_bytes(r.content, "application/json", r.status_code)
+                self.router.circuit.record(dev["id"], ok=r.status_code < 500)
+        except Exception as e:  # connection-class failure → breaker
+            self.router.circuit.record(dev["id"], ok=False)
+            self.metrics.chat_requests.labels(model=model, provider="tpu", status="error").inc()
+            if not resp.started:
+                resp.write_error(f"device {dev['id']} unreachable: {e}", 502)
+
+    def _chat_cloud(self, req: Request, resp: Response, body: dict, model: str, stream: bool) -> None:
+        if self.cloud is None:
+            resp.write_error("no cloud provider configured", 503)
+            return
+        t0 = time.time()
+        try:
+            if stream:
+                resp.start_sse()
+                usage = {}
+                for frame in self.cloud.chat_stream(body):
+                    if isinstance(frame, dict):
+                        usage = frame.get("usage") or usage
+                    if not resp.sse_data(frame):
+                        break
+                resp.sse_data("[DONE]")
+                self._record_chat(model, "cloud", usage, time.time() - t0, ok=True)
+            else:
+                out = self.cloud.chat(body)
+                resp.write_json(out)
+                self._record_chat(model, "cloud", out.get("usage", {}), time.time() - t0, ok=True)
+        except Exception as e:
+            self.metrics.chat_requests.labels(model=model, provider="cloud", status="error").inc()
+            if not resp.started:
+                resp.write_error(f"cloud provider error: {e}", 502)
+
+    def _record_chat(self, model: str, provider: str, usage: dict, dt: float, ok: bool) -> None:
+        status = "ok" if ok else "error"
+        self.metrics.chat_requests.labels(model=model, provider=provider, status=status).inc()
+        self.metrics.chat_duration.labels(model=model, provider=provider).observe(dt)
+        tin = int(usage.get("prompt_tokens") or 0)
+        tout = int(usage.get("completion_tokens") or 0)
+        if tin:
+            self.metrics.chat_tokens.labels(model=model, provider=provider, direction="in").inc(tin)
+        if tout:
+            self.metrics.chat_tokens.labels(model=model, provider=provider, direction="out").inc(tout)
+        cost = self.catalog.record_cost(model, provider, tin, tout)
+        if cost:
+            self.metrics.chat_cost_usd.labels(model=model, provider=provider).inc(cost)
+        self.catalog.update_model_stats(
+            model, tokens_in=tin, tokens_out=tout, cost_usd=cost,
+            duration_ms=dt * 1000.0, error=not ok,
+        )
+
+    # -- embeddings --------------------------------------------------------
+
+    def handle_embeddings(self, req: Request, resp: Response) -> None:
+        try:
+            body = req.json()
+        except json.JSONDecodeError:
+            resp.write_error("invalid JSON body", 400)
+            return
+        model = str(body.get("model") or "")
+        raw_input = body.get("input")
+        if isinstance(raw_input, str):
+            texts = [raw_input]
+        elif isinstance(raw_input, list) and all(isinstance(t, str) for t in raw_input):
+            texts = raw_input
+        else:
+            resp.write_error("input must be a string or list of strings", 400)
+            return
+        if not texts:
+            resp.write_error("input must not be empty", 400)
+            return
+        try:
+            dimensions = body.get("dimensions")
+            dimensions = int(dimensions) if dimensions else None
+        except (TypeError, ValueError):
+            resp.write_error("dimensions must be an integer", 400)
+            return
+
+        if not model:
+            embeds = self.catalog.list_models(kind="embed")
+            local = [m["id"] for m in embeds if m["id"] in self.embed_engines]
+            model = local[0] if local else (embeds[0]["id"] if embeds else "")
+        if not model:
+            resp.write_error("no embedding model available", 503)
+            return
+
+        if "/" in model:
+            self._embed_cloud(resp, model, texts, dimensions)
+            return
+
+        t0 = time.time()
+        engine = self._local_embed(model)
+        if engine is not None:
+            vectors, ntok = engine.embed(texts, dimensions=dimensions)
+            self._write_embeddings(resp, model, vectors, ntok)
+            self.metrics.embedding_requests.labels(
+                model=model, device=self.device_id, status="ok"
+            ).inc()
+            self.metrics.embedding_duration.labels(model=model).observe(time.time() - t0)
+            self.metrics.embedding_input_tokens.labels(model=model).inc(ntok)
+            return
+
+        # remote devices: ≤3 attempts across devices with breaker updates
+        # (`handlers.go:1899-1931`)
+        import httpx
+
+        last_err = "no device has the model"
+        for _ in range(EMBED_RETRIES):
+            dev = self.router.select_device(model, "embed")
+            if dev is None or dev["id"] == self.device_id or not dev["addr"]:
+                break
+            try:
+                r = httpx.post(
+                    f"http://{dev['addr']}/v1/embeddings",
+                    json={"model": model, "input": texts, "dimensions": dimensions},
+                    timeout=EMBED_PROXY_TIMEOUT_S,
+                )
+                r.raise_for_status()
+                self.router.circuit.record(dev["id"], ok=True)
+                resp.write_bytes(r.content, "application/json")
+                self.metrics.embedding_requests.labels(
+                    model=model, device=dev["id"], status="ok"
+                ).inc()
+                return
+            except Exception as e:
+                last_err = str(e)
+                self.router.circuit.record(dev["id"], ok=False)
+                self.metrics.embedding_requests.labels(
+                    model=model, device=dev["id"], status="error"
+                ).inc()
+        resp.write_error(f"embeddings unavailable for {model!r}: {last_err}", 503)
+
+    def _embed_cloud(self, resp: Response, model: str, texts: list[str], dimensions: int | None) -> None:
+        if self.cloud is None:
+            resp.write_error("no cloud provider configured", 503)
+            return
+        try:
+            out = self.cloud.embed(model, texts, dimensions)
+            # Matryoshka client-side truncation fallback (`handlers.go:2063-2078`)
+            if dimensions and out.get("data"):
+                for item in out["data"]:
+                    vec = item.get("embedding") or []
+                    if len(vec) > dimensions:
+                        import math
+
+                        vec = vec[:dimensions]
+                        norm = math.sqrt(sum(v * v for v in vec)) or 1.0
+                        item["embedding"] = [v / norm for v in vec]
+            resp.write_json(out)
+        except Exception as e:
+            resp.write_error(f"cloud embeddings error: {e}", 502)
+
+    @staticmethod
+    def _write_embeddings(resp: Response, model: str, vectors: list[list[float]], ntok: int) -> None:
+        resp.write_json(
+            {
+                "object": "list",
+                "data": [
+                    {"object": "embedding", "embedding": v, "index": i}
+                    for i, v in enumerate(vectors)
+                ],
+                "model": model,
+                "usage": {"prompt_tokens": ntok, "total_tokens": ntok},
+            }
+        )
+
+    # -- async smart-routed request ---------------------------------------
+
+    def handle_llm_request(self, req: Request, resp: Response) -> None:
+        try:
+            body = req.json()
+        except json.JSONDecodeError:
+            resp.write_error("invalid JSON body", 400)
+            return
+        kind = str(body.get("kind") or "generate")
+        prompt = str(body.get("prompt") or "")
+        if not prompt and body.get("messages"):
+            prompt = messages_to_prompt(body["messages"])
+        quality = str(body.get("quality") or "")
+        thinking = body.get("thinking")
+        decision = self.router.route(
+            kind=kind,
+            model=str(body.get("model") or ""),
+            prompt=prompt,
+            provider=str(body.get("provider") or "auto"),
+            quality=quality,
+            thinking=bool(thinking) if thinking is not None else None,
+            max_latency_ms=float(body.get("max_latency_ms") or 0),
+            force_cloud=bool(body.get("force_cloud", False)),
+        )
+        payload = dict(body)
+        payload.update(decision.payload_overlay())
+        deadline = None
+        if quality:
+            deadline = time.time() + quality_deadline_s(quality)
+        job = self.queue.submit(kind, payload, deadline_at=deadline)
+        self.metrics.jobs_created.labels(kind=kind).inc()
+        resp.write_json(
+            {
+                "job_id": job.id,
+                "provider": decision.provider,
+                "kind": kind,
+                "model": decision.model,
+                "device_id": decision.device_id,
+                "reason": decision.reason,
+            },
+            status=202,
+        )

@@ -1,0 +1,246 @@
+"""Job queue HTTP surface: submit/get/list/claim/complete/fail/heartbeat +
+SSE status streaming + worker registration + device offline reports.
+
+Parity map (reference):
+  - submit:    `core/internal/api/handlers.go:35-94`
+  - get/list:  `handlers.go:96-199`
+  - claim:     `handlers.go:200-293` (per-device concurrency cap)
+  - complete:  `handlers.go:295-347`   fail: `349-411`   heartbeat: `413-445`
+  - SSE job stream via LISTEN + 15s safety re-poll: `handlers.go:481-608`
+  - worker register: `grpcserver/server.go:98-124`
+  - devices offline → lease reset: `offline_handler.go:12-38`,
+    worker side-channel `worker/llm_worker/main.py:180-186`
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Any
+
+from ..routing import Router
+from ..state.catalog import Catalog
+from ..state.queue import JobQueue, JobStatus
+from ..telemetry import Metrics
+from ..utils.config import Config
+from .http import Request, Response
+
+log = logging.getLogger("jobs")
+
+SSE_REPOLL_S = 15.0  # safety re-poll interval, as the reference
+SSE_MAX_S = 600.0
+
+
+class JobsAPI:
+    def __init__(
+        self,
+        *,
+        queue: JobQueue,
+        catalog: Catalog,
+        router: Router,
+        metrics: Metrics,
+        cfg: Config,
+    ):
+        self.queue = queue
+        self.catalog = catalog
+        self.router = router
+        self.metrics = metrics
+        self.cfg = cfg
+
+    # -- submit / read -----------------------------------------------------
+
+    def handle_submit(self, req: Request, resp: Response) -> None:
+        body = req.json()
+        kind = str(body.get("kind") or "")
+        if not kind:
+            resp.write_error("kind required", 400)
+            return
+        payload = body.get("payload") or {}
+        if not isinstance(payload, dict):
+            resp.write_error("payload must be an object", 400)
+            return
+        # device-limit gate at submit (`handlers.go:70-78`)
+        device_id = str(payload.get("device_id") or "")
+        model = str(payload.get("model") or "")
+        if device_id and model and self.router.limits is not None:
+            ok, why = self.router.limits.model_allowed(device_id, model)
+            if not ok:
+                resp.write_error(f"model not allowed on device: {why}", 422)
+                return
+        job = self.queue.submit(
+            kind,
+            payload,
+            priority=int(body.get("priority") or 0),
+            max_attempts=int(body.get("max_attempts") or 0) or None,
+            deadline_at=body.get("deadline_at"),
+        )
+        self.metrics.jobs_created.labels(kind=kind).inc()
+        resp.write_json({"job_id": job.id, "status": job.status}, status=202)
+
+    def handle_get(self, req: Request, resp: Response) -> None:
+        job = self.queue.get(req.params["id"])
+        if job is None:
+            resp.write_error("job not found", 404)
+            return
+        resp.write_json(job.to_dict())
+
+    def handle_list(self, req: Request, resp: Response) -> None:
+        jobs = self.queue.list(
+            status=req.query.get("status"),
+            kind=req.query.get("kind"),
+            limit=int(req.query.get("limit") or 100),
+            offset=int(req.query.get("offset") or 0),
+        )
+        resp.write_json({"jobs": [j.to_dict() for j in jobs]})
+
+    def handle_cancel(self, req: Request, resp: Response) -> None:
+        if not self.queue.cancel(req.params["id"]):
+            resp.write_error("job not cancelable", 409)
+            return
+        resp.write_json({"status": "canceled"})
+
+    # -- worker protocol ---------------------------------------------------
+
+    def handle_claim(self, req: Request, resp: Response) -> None:
+        body = req.json()
+        worker_id = str(body.get("worker_id") or "")
+        if not worker_id:
+            resp.write_error("worker_id required", 400)
+            return
+        kinds = body.get("kinds") or []
+        job = self.queue.claim(
+            worker_id,
+            kinds=[str(k) for k in kinds],
+            lease_seconds=float(body.get("lease_seconds") or self.cfg.worker_lease_seconds),
+            device_max_concurrency=self.cfg.device_max_concurrency,
+        )
+        self.catalog.worker_heartbeat(worker_id)
+        if job is None:
+            resp.write_json({"job": None}, status=200)
+            return
+        resp.write_json({"job": job.to_dict()})
+
+    def handle_complete(self, req: Request, resp: Response) -> None:
+        body = req.json()
+        job_id = req.params["id"]
+        worker_id = str(body.get("worker_id") or "")
+        ok = self.queue.complete(
+            job_id, worker_id, result=body.get("result"), metrics=body.get("metrics")
+        )
+        if not ok:
+            resp.write_error("job not running under this worker", 409)
+            return
+        job = self.queue.get(job_id)
+        if job is not None:
+            dev = job.payload.get("device_id") or job.device_id
+            if dev:
+                self.router.circuit.record(dev, ok=True)
+            self._record_benchmark_result(job)
+        resp.write_json({"status": "done"})
+
+    def handle_fail(self, req: Request, resp: Response) -> None:
+        body = req.json()
+        worker_id = str(body.get("worker_id") or "")
+        error = str(body.get("error") or "unknown error")
+        status = self.queue.fail(req.params["id"], worker_id, error)
+        if status is None:
+            resp.write_error("job not running under this worker", 409)
+            return
+        job = self.queue.get(req.params["id"])
+        if job is not None:
+            dev = job.payload.get("device_id") or job.device_id
+            if dev:
+                self.router.circuit.record(dev, ok=False)
+        resp.write_json({"status": status})
+
+    def handle_heartbeat(self, req: Request, resp: Response) -> None:
+        body = req.json()
+        worker_id = str(body.get("worker_id") or "")
+        ok = self.queue.heartbeat(
+            req.params["id"],
+            worker_id,
+            lease_seconds=float(body.get("lease_seconds") or self.cfg.worker_lease_seconds),
+        )
+        self.catalog.worker_heartbeat(worker_id)
+        if not ok:
+            resp.write_error("job not running under this worker", 409)
+            return
+        resp.write_json({"status": "ok"})
+
+    def handle_worker_register(self, req: Request, resp: Response) -> None:
+        body = req.json()
+        worker_id = str(body.get("worker_id") or "")
+        if not worker_id:
+            resp.write_error("worker_id required", 400)
+            return
+        self.catalog.register_worker(
+            worker_id,
+            name=str(body.get("name") or ""),
+            kinds=[str(k) for k in body.get("kinds") or []],
+        )
+        resp.write_json({"status": "registered", "worker_id": worker_id})
+
+    def handle_devices_offline(self, req: Request, resp: Response) -> None:
+        body = req.json()
+        ids = body.get("device_ids") or ([body["device_id"]] if body.get("device_id") else [])
+        ids = [str(i) for i in ids if i]
+        if not ids:
+            resp.write_error("device_ids required", 400)
+            return
+        for dev in ids:
+            self.catalog.set_device_online(dev, False)
+            self.router.circuit.record(dev, ok=False)
+        requeued = self.queue.requeue_device_jobs(ids)
+        resp.write_json({"status": "ok", "requeued_jobs": requeued})
+
+    # -- SSE job stream ----------------------------------------------------
+
+    def handle_stream(self, req: Request, resp: Response) -> None:
+        """Push job status changes over SSE: initial snapshot, then an event
+        per transition (notify-driven with a safety re-poll), ending at a
+        terminal status. The reference's LISTEN-based stream
+        (`handlers.go:481-608`) with the in-process notify bus."""
+        job_id = req.params["id"]
+        job = self.queue.get(job_id)
+        if job is None:
+            resp.write_error("job not found", 404)
+            return
+        resp.start_sse()
+        if not resp.sse_event("status", job.to_dict()):
+            return
+        last_status = job.status
+        last_updated = job.updated_at
+        deadline = time.time() + SSE_MAX_S
+        while job.status not in JobStatus.TERMINAL and time.time() < deadline:
+            self.queue.wait_for_update(SSE_REPOLL_S)
+            job = self.queue.get(job_id)
+            if job is None:
+                break
+            if job.status != last_status or job.updated_at != last_updated:
+                last_status, last_updated = job.status, job.updated_at
+                if not resp.sse_event("status", job.to_dict()):
+                    return
+        resp.sse_event("end", {"id": job_id, "status": last_status})
+
+    # -- benchmark results -------------------------------------------------
+
+    def _record_benchmark_result(self, job) -> None:
+        """benchmark.* job results feed the benchmarks table that routing
+        ranks by (`grpcserver/server.go:302-327`, `main.py:471-518`)."""
+        if not job.kind.startswith("benchmark.") or not job.result:
+            return
+        r = job.result
+        dev = str(job.payload.get("device_id") or job.device_id or "")
+        model = str(job.payload.get("model") or r.get("model") or "")
+        if not dev or not model:
+            return
+        self.catalog.record_benchmark(
+            dev,
+            model,
+            str(r.get("task_type") or job.kind.removeprefix("benchmark.")),
+            tokens_in=int(r.get("tokens_in") or 0),
+            tokens_out=int(r.get("tokens_out") or 0),
+            latency_ms=float(r.get("latency_ms") or 0),
+            tps=float(r.get("tps") or 0),
+        )

@@ -1,0 +1,115 @@
+"""Cloud fallback providers: OpenRouter / OpenAI HTTP clients.
+
+Parity: the reference keeps cloud chat/embeddings as HTTP fallbacks
+(`worker/llm_worker/main.py:274-327`, sync proxy `handlers.go:2235-2305`)
+— same role here. The TPU executor is the primary provider; these engage on
+`force_cloud`, cloud-namespaced model ids ("vendor/model"), or when smart
+routing falls back (router.py `_find_cloud_model`).
+
+Also: live OpenRouter balance query (`handlers.go:2688-2776`) and model
+catalog sync by category (`handlers.go:3176-3287`).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Iterator
+
+import httpx
+
+from ..utils.config import Config
+
+log = logging.getLogger("providers")
+
+CLOUD_TIMEOUT_S = 120.0
+
+
+class CloudClient:
+    """Thin OpenAI-wire client for OpenRouter (preferred) or OpenAI."""
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+
+    def _base(self) -> tuple[str, str]:
+        if self.cfg.has_openrouter():
+            return self.cfg.openrouter_base_url.rstrip("/"), self.cfg.openrouter_api_key
+        if self.cfg.has_openai():
+            return self.cfg.openai_base_url.rstrip("/"), self.cfg.openai_api_key
+        raise RuntimeError("no cloud provider configured")
+
+    def _headers(self, key: str) -> dict[str, str]:
+        return {"Authorization": f"Bearer {key}", "Content-Type": "application/json"}
+
+    def chat(self, body: dict[str, Any]) -> dict[str, Any]:
+        base, key = self._base()
+        body = dict(body)
+        body.pop("stream", None)
+        r = httpx.post(
+            f"{base}/chat/completions", json=body, headers=self._headers(key),
+            timeout=CLOUD_TIMEOUT_S,
+        )
+        r.raise_for_status()
+        return r.json()
+
+    def chat_stream(self, body: dict[str, Any]) -> Iterator[Any]:
+        """Yield SSE payloads (str or dict). Usage is extracted from the
+        final chunk as in the reference (`handlers.go:2235-2305`)."""
+        base, key = self._base()
+        body = dict(body)
+        body["stream"] = True
+        with httpx.stream(
+            "POST", f"{base}/chat/completions", json=body,
+            headers=self._headers(key), timeout=CLOUD_TIMEOUT_S,
+        ) as r:
+            r.raise_for_status()
+            for line in r.iter_lines():
+                if not line.startswith("data: "):
+                    continue
+                data = line[len("data: "):]
+                if data.strip() == "[DONE]":
+                    return
+                try:
+                    yield json.loads(data)
+                except json.JSONDecodeError:
+                    yield data
+
+    def embed(self, model: str, texts: list[str], dimensions: int | None) -> dict[str, Any]:
+        base, key = self._base()
+        body: dict[str, Any] = {"model": model, "input": texts}
+        if dimensions:
+            body["dimensions"] = dimensions
+        r = httpx.post(
+            f"{base}/embeddings", json=body, headers=self._headers(key),
+            timeout=CLOUD_TIMEOUT_S,
+        )
+        r.raise_for_status()
+        return r.json()
+
+    def balance(self) -> dict[str, Any]:
+        """Live OpenRouter key/balance query (`handlers.go:2688-2776`)."""
+        if not self.cfg.has_openrouter():
+            raise RuntimeError("OpenRouter not configured")
+        base = self.cfg.openrouter_base_url.rstrip("/")
+        r = httpx.get(
+            f"{base}/auth/key",
+            headers=self._headers(self.cfg.openrouter_api_key),
+            timeout=30.0,
+        )
+        r.raise_for_status()
+        data = r.json().get("data", {})
+        limit = data.get("limit")
+        usage = data.get("usage") or 0.0
+        return {
+            "usage_usd": usage,
+            "limit_usd": limit,
+            "balance_usd": (limit - usage) if limit is not None else None,
+            "is_free_tier": data.get("is_free_tier"),
+        }
+
+    def list_models(self) -> list[dict[str, Any]]:
+        """OpenRouter /models catalog (sync source, `handlers.go:3176-3287`)."""
+        base, key = self._base()
+        r = httpx.get(f"{base}/models", headers=self._headers(key), timeout=60.0)
+        r.raise_for_status()
+        return r.json().get("data", [])

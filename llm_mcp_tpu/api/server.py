@@ -1,0 +1,408 @@
+"""Core API server: the single service wiring every surface together.
+
+Parity: the reference's llmcore process (`core/cmd/core/main.go:26-123` boot,
+`core/internal/api/server.go:32-62` route table — 27 HTTP routes). Layering
+is the same (API → routing policy → state), but L1 execution is in-process:
+the server can host TPU generation/embedding engines directly and registers
+itself as a device in the catalog, so the routing brain sees it exactly like
+any remote executor.
+
+Route inventory (reference server.go:32-62 ↔ here):
+  health, metrics, jobs CRUD + claim/complete/fail/heartbeat + SSE stream,
+  llm/request, chat/completions, embeddings, models (+sync, +stats),
+  devices (+offline), discovery/run, dashboard, costs (summary, balance),
+  feedback, benchmarks, workers/register, debug (health, actions, capacity,
+  test), knowledge/ingest.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any
+
+from ..executor import EmbeddingEngine, GenerationEngine
+from ..routing import CircuitBreaker, LimitsEngine, Router
+from ..state.catalog import Catalog
+from ..state.db import Database
+from ..state.queue import JobQueue
+from ..telemetry import Metrics
+from ..utils.config import Config
+from .dashboard import DashboardAPI
+from .http import HTTPApi, Request, Response
+from .inference import InferenceAPI
+from .jobs import JobsAPI
+from .providers import CloudClient
+
+log = logging.getLogger("server")
+
+
+class CoreServer:
+    def __init__(
+        self,
+        cfg: Config | None = None,
+        *,
+        db: Database | None = None,
+        gen_engines: dict[str, GenerationEngine] | None = None,
+        embed_engines: dict[str, EmbeddingEngine] | None = None,
+        device_id: str = "tpu-local",
+        advertise_addr: str = "",
+    ):
+        self.cfg = cfg or Config()
+        self.db = db or Database(self.cfg.db_path)
+        self.queue = JobQueue(self.db)
+        self.catalog = Catalog(self.db)
+        self.metrics = Metrics()
+        self.limits = LimitsEngine(self.db, strict=self.cfg.strict_model_limits)
+        self.circuit = CircuitBreaker()
+        self.router = Router(
+            self.db,
+            circuit=self.circuit,
+            limits=self.limits,
+            has_openrouter=self.cfg.has_openrouter(),
+            has_openai=self.cfg.has_openai(),
+        )
+        self.cloud = (
+            CloudClient(self.cfg)
+            if (self.cfg.has_openrouter() or self.cfg.has_openai())
+            else None
+        )
+        self.device_id = device_id
+        self.advertise_addr = advertise_addr
+        self.gen_engines = gen_engines or {}
+        self.embed_engines = embed_engines or {}
+
+        self.inference = InferenceAPI(
+            catalog=self.catalog,
+            queue=self.queue,
+            router=self.router,
+            metrics=self.metrics,
+            device_id=device_id,
+            gen_engines=self.gen_engines,
+            embed_engines=self.embed_engines,
+            cloud=self.cloud,
+        )
+        self.jobs = JobsAPI(
+            queue=self.queue,
+            catalog=self.catalog,
+            router=self.router,
+            metrics=self.metrics,
+            cfg=self.cfg,
+        )
+        self.dashboard = DashboardAPI(
+            db=self.db,
+            queue=self.queue,
+            catalog=self.catalog,
+            router=self.router,
+            cfg=self.cfg,
+            engines_info=self.engines_info,
+        )
+
+        self.api = HTTPApi()
+        self._register_routes()
+        self._bg_stop = threading.Event()
+        self._bg_threads: list[threading.Thread] = []
+        self.discovery = None  # attached by discovery.Runner when configured
+
+    # -- local engine device registration ----------------------------------
+
+    def register_local_device(self) -> None:
+        """Advertise this process's engines as a schedulable device, with
+        loaded models and slot capacity — the analog of discovery upserting
+        an Ollama endpoint (`discovery.go:200-280`), self-registered."""
+        models = list(self.gen_engines.keys()) + list(self.embed_engines.keys())
+        if not models:
+            return
+        slots = sum(e.max_slots for e in self.gen_engines.values()) or 1
+        import jax
+
+        try:
+            n_chips = len(jax.devices())
+            platform = jax.devices()[0].platform
+        except Exception:
+            n_chips, platform = 0, "unknown"
+        self.catalog.upsert_device(
+            self.device_id,
+            name=self.device_id,
+            addr=self.advertise_addr,
+            online=True,
+            tags={
+                "tpu": platform in ("tpu", "axon"),
+                "platform": platform,
+                "chips": n_chips,
+                "slots": slots,
+                "self": True,
+            },
+        )
+        for m in self.gen_engines:
+            self.catalog.upsert_model(m, kind="llm")
+        for m in self.embed_engines:
+            self.catalog.upsert_model(m, kind="embed")
+        self.catalog.sync_device_models(self.device_id, models)
+
+    def engines_info(self) -> dict[str, Any]:
+        info: dict[str, Any] = {}
+        for name, e in self.gen_engines.items():
+            info[name] = {
+                "kind": "generate",
+                "slots_in_use": e.slots_in_use(),
+                "max_slots": e.max_slots,
+                "total_tokens": e.total_tokens,
+                "total_requests": e.total_requests,
+                "tps_10s": round(e.current_tps(), 1),
+            }
+            self.metrics.engine_slots_in_use.set(e.slots_in_use())
+            self.metrics.engine_tps.set(e.current_tps())
+        for name, e in self.embed_engines.items():
+            info[name] = {
+                "kind": "embed",
+                "total_inputs": e.total_inputs,
+                "total_tokens": e.total_tokens,
+            }
+        return info
+
+    # -- routes ------------------------------------------------------------
+
+    def _register_routes(self) -> None:
+        r = self.api.route
+        r("GET", "/health", self.handle_health)
+        r("GET", "/metrics", self.handle_metrics)
+
+        # jobs + worker protocol
+        r("POST", "/v1/jobs", self.jobs.handle_submit)
+        r("GET", "/v1/jobs", self.jobs.handle_list)
+        r("GET", "/v1/jobs/{id}", self.jobs.handle_get)
+        r("DELETE", "/v1/jobs/{id}", self.jobs.handle_cancel)
+        r("GET", "/v1/jobs/{id}/stream", self.jobs.handle_stream)
+        r("POST", "/v1/jobs/claim", self.jobs.handle_claim)
+        r("POST", "/v1/jobs/{id}/complete", self.jobs.handle_complete)
+        r("POST", "/v1/jobs/{id}/fail", self.jobs.handle_fail)
+        r("POST", "/v1/jobs/{id}/heartbeat", self.jobs.handle_heartbeat)
+        r("POST", "/v1/workers/register", self.jobs.handle_worker_register)
+        r("POST", "/v1/devices/offline", self.jobs.handle_devices_offline)
+
+        # inference
+        r("POST", "/v1/llm/request", self.inference.handle_llm_request)
+        r("POST", "/v1/chat/completions", self.inference.handle_chat_completions)
+        r("POST", "/v1/embeddings", self.inference.handle_embeddings)
+
+        # catalog
+        r("GET", "/v1/models", self.handle_models)
+        r("POST", "/v1/models/sync", self.handle_models_sync)
+        r("GET", "/v1/models/stats", self.handle_model_stats)
+        r("GET", "/v1/devices", self.handle_devices)
+        r("GET", "/v1/benchmarks", self.handle_benchmarks)
+
+        # discovery
+        r("POST", "/v1/discovery/run", self.handle_discovery_run)
+
+        # observability / business
+        r("GET", "/v1/dashboard", self.dashboard.handle_dashboard)
+        r("GET", "/v1/costs/summary", self.handle_costs_summary)
+        r("GET", "/v1/costs/balance", self.handle_costs_balance)
+        r("POST", "/v1/feedback", self.handle_feedback)
+        r("GET", "/v1/debug/health", self.dashboard.handle_health)
+        r("GET", "/v1/debug/actions", self.dashboard.handle_actions)
+        r("GET", "/v1/debug/capacity", self.dashboard.handle_capacity)
+        r("POST", "/v1/debug/test", self.dashboard.handle_smoke_test)
+
+        # knowledge
+        r("POST", "/v1/knowledge/ingest", self.handle_knowledge_ingest)
+
+    # -- small handlers ------------------------------------------------------
+
+    def handle_health(self, req: Request, resp: Response) -> None:
+        resp.write_json({"status": "ok", "service": "llm-mcp-tpu"})
+
+    def handle_metrics(self, req: Request, resp: Response) -> None:
+        self.engines_info()  # refresh engine slot/tps gauges at scrape time
+        self.metrics.devices_online.set(
+            len(self.catalog.list_devices(online_only=True))
+        )
+        data, ctype = self.metrics.render()
+        resp.write_bytes(data, ctype)
+
+    def handle_models(self, req: Request, resp: Response) -> None:
+        models = self.catalog.list_models(kind=req.query.get("kind"))
+        resp.write_json({"models": models})
+
+    def handle_models_sync(self, req: Request, resp: Response) -> None:
+        """Sync cloud models into the catalog (`handlers.go:3176-3287`).
+        Without a cloud provider, re-registers local engine models."""
+        self.register_local_device()
+        synced = len(self.gen_engines) + len(self.embed_engines)
+        cloud_synced = 0
+        if self.cloud is not None:
+            try:
+                for m in self.cloud.list_models():
+                    mid = str(m.get("id") or "")
+                    if not mid:
+                        continue
+                    ctx = int(m.get("context_length") or 0)
+                    self.catalog.upsert_model(mid, context_k=ctx // 1024 if ctx else None)
+                    pricing = m.get("pricing") or {}
+                    try:
+                        p_in = float(pricing.get("prompt") or 0) * 1e6
+                        p_out = float(pricing.get("completion") or 0) * 1e6
+                        if p_in or p_out:
+                            self.catalog.set_pricing(mid, p_in, p_out)
+                    except (TypeError, ValueError):
+                        pass
+                    cloud_synced += 1
+            except Exception as e:
+                resp.write_json(
+                    {"status": "partial", "local": synced, "cloud_error": str(e)}, 502
+                )
+                return
+        resp.write_json({"status": "ok", "local": synced, "cloud": cloud_synced})
+
+    def handle_model_stats(self, req: Request, resp: Response) -> None:
+        resp.write_json({"stats": self.catalog.model_stats()})
+
+    def handle_devices(self, req: Request, resp: Response) -> None:
+        devices = self.catalog.list_devices()
+        for d in devices:
+            d["models"] = self.catalog.device_models(d["id"])
+            d["circuit"] = self.circuit.status(d["id"])
+        resp.write_json({"devices": devices})
+
+    def handle_benchmarks(self, req: Request, resp: Response) -> None:
+        resp.write_json({"benchmarks": self.catalog.list_benchmarks()})
+
+    def handle_discovery_run(self, req: Request, resp: Response) -> None:
+        if self.discovery is None:
+            self.register_local_device()
+            resp.write_json({"status": "ok", "note": "no discovery runner; local device re-registered"})
+            return
+        t0 = time.time()
+        try:
+            result = self.discovery.run()
+            self.metrics.discovery_runs.labels(status="ok").inc()
+            self.metrics.discovery_duration.observe(time.time() - t0)
+            self.metrics.devices_online.set(
+                len(self.catalog.list_devices(online_only=True))
+            )
+            resp.write_json({"status": "ok", **result})
+        except Exception as e:
+            self.metrics.discovery_runs.labels(status="error").inc()
+            resp.write_error(f"discovery failed: {e}", 500)
+
+    def handle_costs_summary(self, req: Request, resp: Response) -> None:
+        since = req.query.get("since")
+        try:
+            since_f = float(since) if since else None
+        except ValueError:
+            resp.write_error("since must be a unix timestamp", 400)
+            return
+        resp.write_json({"costs": self.catalog.costs_summary(since=since_f)})
+
+    def handle_costs_balance(self, req: Request, resp: Response) -> None:
+        if self.cloud is None:
+            resp.write_error("no cloud provider configured", 503)
+            return
+        try:
+            bal = self.cloud.balance()
+            if bal.get("balance_usd") is not None:
+                self.metrics.openrouter_balance.set(bal["balance_usd"])
+            resp.write_json(bal)
+        except Exception as e:
+            resp.write_error(f"balance query failed: {e}", 502)
+
+    def handle_feedback(self, req: Request, resp: Response) -> None:
+        body = req.json()
+        model = str(body.get("model") or "")
+        rating = body.get("rating")
+        if not model or rating not in ("up", "down", 1, -1, "+1", "-1"):
+            resp.write_error("model and rating (up|down) required", 400)
+            return
+        self.catalog.record_feedback(model, up=rating in ("up", 1, "+1"))
+        resp.write_json({"status": "ok"})
+
+    def handle_knowledge_ingest(self, req: Request, resp: Response) -> None:
+        """Proxy to LightRAG / mem0 (`handlers.go:2829-2946`)."""
+        body = req.json()
+        text = str(body.get("text") or "")
+        target = str(body.get("target") or "lightrag")
+        import httpx
+
+        if target == "mem0":
+            if not self.cfg.mem0_url:
+                resp.write_error("MEM0_URL not configured", 503)
+                return
+            if len(text) < 10:
+                resp.write_error("text too short for memory (min 10 chars)", 400)
+                return
+            try:
+                r = httpx.post(
+                    f"{self.cfg.mem0_url.rstrip('/')}/v1/memories/",
+                    json={"messages": [{"role": "user", "content": text}],
+                          "user_id": str(body.get("user_id") or "default")},
+                    timeout=30.0,
+                )
+                resp.write_bytes(r.content, "application/json", r.status_code)
+            except Exception as e:
+                resp.write_error(f"mem0 unreachable: {e}", 502)
+            return
+        if not self.cfg.lightrag_url:
+            resp.write_error("LIGHTRAG_URL not configured", 503)
+            return
+        if len(text) < 100:
+            resp.write_error("text too short for ingestion (min 100 chars)", 400)
+            return
+        meta = body.get("metadata") or {}
+        if meta:
+            header = " | ".join(f"{k}: {v}" for k, v in meta.items())
+            text = f"[{header}]\n\n{text}"
+        headers = {}
+        if self.cfg.lightrag_api_key:
+            headers["X-API-Key"] = self.cfg.lightrag_api_key
+        try:
+            r = httpx.post(
+                f"{self.cfg.lightrag_url.rstrip('/')}/documents/text",
+                json={"text": text}, headers=headers, timeout=60.0,
+            )
+            resp.write_bytes(r.content, "application/json", r.status_code)
+        except Exception as e:
+            resp.write_error(f"lightrag unreachable: {e}", 502)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, host: str = "0.0.0.0", port: int = 8080) -> "CoreServer":
+        self.api.serve(host, port)
+        if not self.advertise_addr:
+            self.advertise_addr = f"{host}:{self.api.port}"
+        # register AFTER the addr is known so peers can proxy to us
+        self.register_local_device()
+        self.limits.apply_specs()
+        # background tickers: limits re-apply + discovery (main.go:56-67,101-112)
+        t = threading.Thread(target=self._ticker, name="core-tickers", daemon=True)
+        t.start()
+        self._bg_threads.append(t)
+        log.info("core server on %s:%d", host, self.api.port)
+        return self
+
+    def _ticker(self) -> None:
+        last_limits = 0.0
+        last_disc = 0.0
+        while not self._bg_stop.wait(1.0):
+            now = time.time()
+            if now - last_limits >= self.cfg.device_limits_interval_s:
+                last_limits = now
+                try:
+                    self.limits.apply_specs()
+                except Exception:
+                    log.exception("limits re-apply failed")
+            if self.discovery is not None and now - last_disc >= self.cfg.discovery_interval_s:
+                last_disc = now
+                try:
+                    self.discovery.run()
+                except Exception:
+                    log.exception("periodic discovery failed")
+
+    def shutdown(self) -> None:
+        self._bg_stop.set()
+        self.api.shutdown()
+        for e in self.gen_engines.values():
+            e.shutdown()
+        self.db.close()

@@ -1,0 +1,109 @@
+"""Prometheus metrics registry.
+
+Parity: reference `core/internal/metrics/metrics.go:10-115` — same 11
+collector names/labels so existing dashboards keep working, plus TPU-native
+additions (engine slot occupancy, decode throughput, TTFT).
+
+The reference's `llmcore_jobs_created_total` was declared but never
+incremented (dead metric, SURVEY §5); here it is wired up at submit.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+    CONTENT_TYPE_LATEST,
+)
+
+
+class Metrics:
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        r = self.registry
+
+        # -- reference-parity collectors (metrics.go:10-115) --
+        self.embedding_requests = Counter(
+            "llmcore_embedding_requests_total",
+            "Embedding requests",
+            ["model", "device", "status"],
+            registry=r,
+        )
+        self.embedding_duration = Histogram(
+            "llmcore_embedding_duration_seconds",
+            "Embedding request duration",
+            ["model"],
+            buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60),
+            registry=r,
+        )
+        self.embedding_input_tokens = Counter(
+            "llmcore_embedding_input_tokens_total",
+            "Embedding input tokens",
+            ["model"],
+            registry=r,
+        )
+        self.jobs_created = Counter(
+            "llmcore_jobs_created_total", "Jobs created", ["kind"], registry=r
+        )
+        self.devices_online = Gauge(
+            "llmcore_devices_online", "Devices online", registry=r
+        )
+        self.discovery_runs = Counter(
+            "llmcore_discovery_runs_total", "Discovery runs", ["status"], registry=r
+        )
+        self.discovery_duration = Histogram(
+            "llmcore_discovery_duration_seconds",
+            "Discovery run duration",
+            registry=r,
+        )
+        self.chat_requests = Counter(
+            "llmcore_chat_requests_total",
+            "Chat requests",
+            ["model", "provider", "status"],
+            registry=r,
+        )
+        self.chat_duration = Histogram(
+            "llmcore_chat_duration_seconds",
+            "Chat request duration",
+            ["model", "provider"],
+            buckets=(0.5, 1, 2.5, 5, 10, 30, 60, 120),
+            registry=r,
+        )
+        self.chat_tokens = Counter(
+            "llmcore_chat_tokens_total",
+            "Chat tokens",
+            ["model", "provider", "direction"],
+            registry=r,
+        )
+        self.chat_cost_usd = Counter(
+            "llmcore_chat_cost_usd_total",
+            "Chat cost USD",
+            ["model", "provider"],
+            registry=r,
+        )
+        self.openrouter_balance = Gauge(
+            "llmcore_openrouter_balance_usd", "OpenRouter balance", registry=r
+        )
+
+        # -- TPU-native additions --
+        self.engine_slots_in_use = Gauge(
+            "llmtpu_engine_slots_in_use", "Generation engine slots occupied", registry=r
+        )
+        self.engine_tps = Gauge(
+            "llmtpu_engine_decode_tok_per_s",
+            "Decode tokens/sec over the last 10s window",
+            registry=r,
+        )
+        self.chat_ttft = Histogram(
+            "llmtpu_chat_ttft_seconds",
+            "Time to first token",
+            ["model"],
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10),
+            registry=r,
+        )
+
+    def render(self) -> tuple[bytes, str]:
+        return generate_latest(self.registry), CONTENT_TYPE_LATEST
