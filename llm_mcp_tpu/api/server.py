@@ -103,7 +103,17 @@ class CoreServer:
         self._register_routes()
         self._bg_stop = threading.Event()
         self._bg_threads: list[threading.Thread] = []
-        self.discovery = None  # attached by discovery.Runner when configured
+        self._identity_cache: dict[str, Any] | None = None
+        from ..discovery import Runner as DiscoveryRunner
+
+        self.discovery = DiscoveryRunner(
+            self.catalog,
+            self.queue,
+            limits=self.limits,
+            cfg=self.cfg,
+            register_local=self.register_local_device,
+            self_device_id=device_id,
+        )
 
     # -- local engine device registration ----------------------------------
 
@@ -213,7 +223,34 @@ class CoreServer:
     # -- small handlers ------------------------------------------------------
 
     def handle_health(self, req: Request, resp: Response) -> None:
-        resp.write_json({"status": "ok", "service": "llm-mcp-tpu"})
+        # Executor identity fields feed peer discovery: probes read platform/
+        # chips/hbm_gb to tag the device and derive its limits (the analog of
+        # the reference deriving limits from reported RAM, limits.go:124-160).
+        resp.write_json({"status": "ok", "service": "llm-mcp-tpu", **self._device_identity()})
+
+    def _device_identity(self) -> dict[str, Any]:
+        # Platform/chips/HBM are static for the life of the process, and
+        # /health is the hot probe target (peer discovery, subnet sweeps,
+        # LB checks) — compute once.
+        if self._identity_cache is not None:
+            return self._identity_cache
+        ident: dict[str, Any] = {"device_id": self.device_id}
+        try:
+            import jax
+
+            devs = jax.devices()
+            ident["platform"] = devs[0].platform
+            ident["chips"] = len(devs)
+            stats = getattr(devs[0], "memory_stats", lambda: None)()
+            if stats and "bytes_limit" in stats:
+                ident["hbm_gb"] = round(
+                    len(devs) * stats["bytes_limit"] / (1 << 30), 1
+                )
+        except Exception:
+            pass
+        ident["engines"] = sorted(list(self.gen_engines) + list(self.embed_engines))
+        self._identity_cache = ident
+        return ident
 
     def handle_metrics(self, req: Request, resp: Response) -> None:
         self.engines_info()  # refresh engine slot/tps gauges at scrape time
@@ -271,10 +308,6 @@ class CoreServer:
         resp.write_json({"benchmarks": self.catalog.list_benchmarks()})
 
     def handle_discovery_run(self, req: Request, resp: Response) -> None:
-        if self.discovery is None:
-            self.register_local_device()
-            resp.write_json({"status": "ok", "note": "no discovery runner; local device re-registered"})
-            return
         t0 = time.time()
         try:
             result = self.discovery.run()
@@ -372,6 +405,10 @@ class CoreServer:
         self.api.serve(host, port)
         if not self.advertise_addr:
             self.advertise_addr = f"{host}:{self.api.port}"
+        # Peers of this fleet serve on the same port we do: probe it, not
+        # the default (slice-metadata hosts, port-less static endpoints,
+        # subnet sweeps all derive their target port from this list).
+        self.discovery.ports = [self.api.port]
         # register AFTER the addr is known so peers can proxy to us
         self.register_local_device()
         self.limits.apply_specs()
@@ -393,7 +430,7 @@ class CoreServer:
                     self.limits.apply_specs()
                 except Exception:
                     log.exception("limits re-apply failed")
-            if self.discovery is not None and now - last_disc >= self.cfg.discovery_interval_s:
+            if now - last_disc >= self.cfg.discovery_interval_s:
                 last_disc = now
                 try:
                     self.discovery.run()

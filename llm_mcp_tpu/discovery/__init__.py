@@ -1,0 +1,23 @@
+"""Discovery subsystem: find and catalog every schedulable inference node.
+
+Parity: reference `core/internal/discovery/` (discovery.go 914 LoC +
+offline_handler.go). The reference shells out to `tailscale status --json`
+and probes Ollama `/api/tags` per port; here the mesh sources are
+TPU-native: GCE/TPU-VM metadata enumeration, static executor endpoints, and
+an optional LAN subnet sweep — all probed over the same HTTP surface our
+core/executor nodes serve (`/health`, `/v1/models`).
+"""
+
+from .probe import ProbeResult, probe_endpoint
+from .runner import Runner
+from .slices import enumerate_tpu_slice, parse_static_endpoints
+from .subnet import scan_subnets
+
+__all__ = [
+    "Runner",
+    "ProbeResult",
+    "probe_endpoint",
+    "enumerate_tpu_slice",
+    "parse_static_endpoints",
+    "scan_subnets",
+]

@@ -1,0 +1,158 @@
+"""HTTP probing of candidate inference endpoints.
+
+Parity: reference `discovery.go:283-384` (probeOllamaPort — per-addr probe
+with latency measurement, best-addr selection, Host-header retry for
+IP-based access to named vhosts) and `discovery.go:388-425`
+(probeExtraEndpoint). The probe target here is our own node surface:
+`GET /health` for liveness + identity, `GET /v1/models` for the loaded model
+list — the TPU-native analog of Ollama `GET /api/tags`.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+DEFAULT_TIMEOUT_S = 2.0  # reference probe timeout: discovery.go:284
+
+
+@dataclass
+class ProbeResult:
+    ok: bool = False
+    addr: str = ""  # the address that answered fastest
+    latency_ms: float = 0.0
+    models: list[str] = field(default_factory=list)
+    model_meta: list[dict[str, Any]] = field(default_factory=list)
+    info: dict[str, Any] = field(default_factory=dict)  # /health body
+    probes: list[dict[str, Any]] = field(default_factory=list)  # per-addr log
+    error: str = ""
+
+
+def _default_http_get(url: str, timeout: float, host_header: str = "") -> tuple[int, bytes]:
+    req = urllib.request.Request(url, method="GET")
+    if host_header:
+        req.add_header("Host", host_header)
+    with urllib.request.urlopen(req, timeout=timeout) as r:  # noqa: S310
+        return r.status, r.read()
+
+
+HttpGet = Callable[..., tuple[int, bytes]]
+
+
+def _try_addr(
+    addr: str,
+    port: int,
+    timeout: float,
+    http_get: HttpGet,
+    host_header: str = "",
+) -> tuple[dict[str, Any] | None, float, str]:
+    """One candidate address: hit /health, return (health_body, ms, err)."""
+    base = f"http://{_bracket(addr)}:{port}"
+    t0 = time.monotonic()
+    try:
+        status, body = http_get(f"{base}/health", timeout, host_header)
+        ms = (time.monotonic() - t0) * 1000.0
+        if status != 200:
+            return None, ms, f"status {status}"
+        try:
+            info = json.loads(body.decode("utf-8", "replace"))
+        except (ValueError, UnicodeDecodeError):
+            info = {}
+        if not isinstance(info, dict):
+            info = {}
+        return info, ms, ""
+    except (urllib.error.URLError, socket.timeout, OSError, ValueError) as e:
+        return None, (time.monotonic() - t0) * 1000.0, str(e)
+
+
+def _bracket(addr: str) -> str:
+    """IPv6 literals need brackets in URLs (reference main.py:141-160)."""
+    if ":" in addr and not addr.startswith("["):
+        return f"[{addr}]"
+    return addr
+
+
+def probe_endpoint(
+    addrs: list[str],
+    port: int,
+    *,
+    timeout: float = DEFAULT_TIMEOUT_S,
+    host_header: str = "",
+    http_get: HttpGet | None = None,
+    fetch_models: bool = True,
+) -> ProbeResult:
+    """Probe every candidate address of one endpoint, pick the fastest.
+
+    Mirrors the reference's best-addr-by-latency selection with per-addr
+    probe logging (`discovery.go:283-384`): all candidate addrs are tried,
+    each gets a {addr, ok, latency_ms, error} record, and the fastest
+    healthy one becomes the device's canonical address.
+    """
+    http_get = http_get or _default_http_get
+    res = ProbeResult()
+    best_ms = float("inf")
+    best_info: dict[str, Any] = {}
+    for addr in addrs:
+        if not addr:
+            continue
+        info, ms, err = _try_addr(addr, port, timeout, http_get)
+        if info is None and host_header:
+            # IP-based access to a named vhost: retry with Host header
+            # (reference discovery.go:460-479).
+            info, ms, err = _try_addr(addr, port, timeout, http_get, host_header)
+        res.probes.append(
+            {"addr": addr, "ok": info is not None, "latency_ms": round(ms, 1), "error": err}
+        )
+        if info is not None and ms < best_ms:
+            best_ms, best_info, res.addr = ms, info, addr
+    if not res.addr:
+        res.error = "; ".join(p["error"] for p in res.probes if p["error"]) or "no addrs"
+        return res
+    res.ok = True
+    res.latency_ms = round(best_ms, 1)
+    res.info = best_info
+
+    if fetch_models:
+        # The device's truly-loaded models are its /health `engines` list —
+        # the analog of Ollama /api/tags listing locally present models.
+        # /v1/models serves the peer's whole catalog (incl. cloud models and
+        # other devices' models), so it is only used to ENRICH metadata for
+        # engine ids, never to define what this device hosts.
+        engines = probe_info_engines(res.info)
+        meta_by_id: dict[str, dict[str, Any]] = {}
+        base = f"http://{_bracket(res.addr)}:{port}"
+        try:
+            status, body = http_get(f"{base}/v1/models", timeout, host_header)
+            if status == 200:
+                doc = json.loads(body.decode("utf-8", "replace"))
+                for m in doc.get("models", doc.get("data", [])) or []:
+                    if isinstance(m, str):
+                        meta_by_id[m] = {"id": m}
+                    elif isinstance(m, dict) and (m.get("id") or m.get("name")):
+                        mid = str(m.get("id") or m.get("name"))
+                        meta_by_id[mid] = m
+        except (urllib.error.URLError, socket.timeout, OSError, ValueError):
+            pass  # healthy node with unreadable catalog still counts as online
+        if engines is not None:
+            res.models = engines
+            res.model_meta = [meta_by_id.get(m, {"id": m}) for m in engines]
+        else:
+            # Pre-engines peer (or non-core endpoint): fall back to its
+            # model listing wholesale.
+            res.models = list(meta_by_id)
+            res.model_meta = list(meta_by_id.values())
+    return res
+
+
+def probe_info_engines(info: dict[str, Any]) -> list[str] | None:
+    """Extract the loaded-engine model list from a /health body, or None
+    when the peer doesn't report one."""
+    engines = info.get("engines")
+    if isinstance(engines, list):
+        return [str(e) for e in engines]
+    return None

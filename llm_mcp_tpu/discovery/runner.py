@@ -1,0 +1,296 @@
+"""Discovery runner: the periodic orchestration of all mesh sources.
+
+Parity: reference `discovery.go:79-170` (Runner.Run walking tailscale nodes,
+probing, syncing catalogs, collecting offline devices) and
+`offline_handler.go:12-38` (requeue running jobs of offline devices). Mesh
+sources here: TPU-slice metadata peers, static TPU_EXTRA_ENDPOINTS, optional
+subnet sweep, plus the in-process local device (self-registration hook).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..routing.limits import LimitsEngine
+from ..state.catalog import Catalog, infer_model_meta
+from ..state.queue import JobQueue
+from ..utils.config import Config
+from .probe import HttpGet, ProbeResult, probe_endpoint
+from .slices import (
+    StaticEndpoint,
+    enumerate_tpu_slice,
+    parse_static_endpoints,
+    slice_device_tags,
+)
+from .subnet import scan_subnets
+
+log = logging.getLogger("discovery")
+
+
+@dataclass
+class RunResult:
+    devices_seen: int = 0
+    devices_online: int = 0
+    devices_offline: int = 0
+    vanished: list[str] = field(default_factory=list)
+    models_synced: int = 0
+    jobs_requeued: int = 0
+    duration_ms: float = 0.0
+    sources: dict[str, int] = field(default_factory=dict)
+    errors: list[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "devices_seen": self.devices_seen,
+            "devices_online": self.devices_online,
+            "devices_offline": self.devices_offline,
+            "models_synced": self.models_synced,
+            "jobs_requeued": self.jobs_requeued,
+            "duration_ms": round(self.duration_ms, 1),
+            "sources": self.sources,
+            "errors": self.errors,
+        }
+
+
+class Runner:
+    """Walks every mesh source, upserts devices + model catalogs, marks
+    vanished devices offline and requeues their running jobs."""
+
+    def __init__(
+        self,
+        catalog: Catalog,
+        queue: JobQueue,
+        *,
+        limits: LimitsEngine | None = None,
+        cfg: Config | None = None,
+        http_get: HttpGet | None = None,
+        register_local: Callable[[], None] | None = None,
+        ports: list[int] | None = None,
+        self_device_id: str = "",
+    ):
+        self.catalog = catalog
+        self.queue = queue
+        self.limits = limits
+        self.cfg = cfg or Config()
+        self.http_get = http_get
+        self.register_local = register_local
+        # Probed peers reporting this id in /health are this very process —
+        # skip them so the local node isn't cataloged twice (once self-
+        # registered, once as a phantom probed device).
+        self.self_device_id = self_device_id
+        # Multi-port probing: one host can expose several executor processes,
+        # each becoming its own schedulable child device — the reference's
+        # OLLAMA_PORTS port-device pattern (discovery.go:249-280).
+        self.ports = ports or [8080]
+        self._lock = threading.Lock()
+        self.last_run: RunResult | None = None
+        self.last_run_at: float = 0.0
+
+    # -- public ------------------------------------------------------------
+
+    def run(self) -> dict[str, Any]:
+        t0 = time.monotonic()
+        res = RunResult()
+        seen: set[str] = set()
+
+        if self.register_local is not None:
+            try:
+                self.register_local()
+                res.sources["local"] = 1
+            except Exception as e:  # local registration is best-effort
+                res.errors.append(f"local: {e}")
+
+        self._run_tpu_slice(res, seen)
+        self._run_static_endpoints(res, seen)
+        if self.cfg.discovery_scan_subnets and self.cfg.discovery_subnets:
+            self._run_subnet_scan(res, seen)
+
+        res.jobs_requeued = self._handle_offline(res, seen)
+        if self.limits is not None:
+            try:
+                # Re-derive HBM-based limits from fresh device tags; operator
+                # presets always win inside apply_specs (limits.go:83-102).
+                self.limits.apply_specs()
+            except Exception as e:
+                res.errors.append(f"limits: {e}")
+        res.devices_online = len(self.catalog.list_devices(online_only=True))
+        res.duration_ms = (time.monotonic() - t0) * 1000.0
+        with self._lock:
+            self.last_run = res
+            self.last_run_at = time.time()
+        log.info(
+            "discovery run: %d seen, %d online, %d requeued, %.0fms",
+            res.devices_seen,
+            res.devices_online,
+            res.jobs_requeued,
+            res.duration_ms,
+        )
+        return res.to_dict()
+
+    # -- sources -----------------------------------------------------------
+
+    def _run_tpu_slice(self, res: RunResult, seen: set[str]) -> None:
+        info = enumerate_tpu_slice(self.http_get)
+        if info is None:
+            return
+        count = 0
+        for host in info.hostnames:
+            for port in self.ports:
+                did = self._probe_and_upsert(
+                    device_id=f"{host}:{port}",
+                    name=host,
+                    addrs=[host],
+                    port=port,
+                    base_tags={**slice_device_tags(info), "base_device": host},
+                    res=res,
+                )
+                if did:
+                    seen.add(did)
+                    count += 1
+        res.sources["tpu-slice"] = count
+
+    def _run_static_endpoints(self, res: RunResult, seen: set[str]) -> None:
+        eps: list[StaticEndpoint] = parse_static_endpoints(
+            self.cfg.tpu_extra_endpoints, default_port=self.ports[0]
+        )
+        count = 0
+        for ep in eps:
+            did = self._probe_and_upsert(
+                device_id=f"{ep.host}:{ep.port}",
+                name=ep.name,
+                addrs=[ep.host],
+                port=ep.port,
+                base_tags={"source": "static", "endpoint": ep.name},
+                res=res,
+            )
+            if did:
+                seen.add(did)
+                count += 1
+        res.sources["static"] = count
+
+    def _run_subnet_scan(self, res: RunResult, seen: set[str]) -> None:
+        subnets = [s for s in self.cfg.discovery_subnets.split(",") if s.strip()]
+        hits = scan_subnets(subnets, self.ports, http_get=self.http_get)
+        count = 0
+        for hit in hits:
+            did = self._probe_and_upsert(
+                device_id=f"{hit.addr}:{hit.port}",
+                name=hit.addr,
+                addrs=[hit.addr],
+                port=hit.port,
+                base_tags={"source": "subnet-scan"},
+                res=res,
+            )
+            if did:
+                seen.add(did)
+                count += 1
+        res.sources["subnet"] = count
+
+    # -- device + catalog upsert -------------------------------------------
+
+    def _probe_and_upsert(
+        self,
+        *,
+        device_id: str,
+        name: str,
+        addrs: list[str],
+        port: int,
+        base_tags: dict[str, Any],
+        res: RunResult,
+    ) -> str | None:
+        """Probe one endpoint; on success upsert the device, its models, and
+        HBM-derived limits. Returns the device id if it answered."""
+        probe: ProbeResult = probe_endpoint(
+            addrs, port, http_get=self.http_get, host_header=name
+        )
+        res.devices_seen += 1
+        if probe.ok and self.self_device_id and probe.info.get("device_id") == self.self_device_id:
+            return None  # that's us — the self-registered device is authoritative
+        if not probe.ok:
+            existing = self.catalog.get_device(device_id)
+            if existing is not None and existing.get("online"):
+                self.catalog.set_device_online(device_id, False)
+                res.devices_offline += 1
+                res.vanished.append(device_id)
+            return None
+        tags = {
+            **base_tags,
+            "addr": probe.addr,
+            "port": port,
+            "latency_ms": probe.latency_ms,
+            "probes": probe.probes,
+        }
+        # Surface executor identity from /health (chips, platform, hbm).
+        for key in ("platform", "chips", "hbm_gb", "service"):
+            if key in probe.info:
+                tags[key] = probe.info[key]
+        self.catalog.upsert_device(
+            device_id, name=name, addr=f"{probe.addr}:{port}", online=True, tags=tags
+        )
+        res.models_synced += self._sync_models(device_id, probe)
+        return device_id
+
+    def _sync_models(self, device_id: str, probe: ProbeResult) -> int:
+        """Upsert probed models with name-inferred metadata and bind them to
+        the device; parity with syncDeviceModels (discovery.go:482-624):
+        models missing from this probe become unavailable on the device."""
+        n = 0
+        for meta in probe.model_meta:
+            mid = str(meta.get("id") or meta.get("name") or "")
+            if not mid:
+                continue
+            inferred = infer_model_meta(mid, float(meta.get("params_b") or 0.0))
+            self.catalog.upsert_model(
+                mid,
+                kind=str(meta.get("kind") or inferred["kind"]),
+                tier=str(meta.get("tier") or inferred["tier"]),
+                thinking=bool(meta.get("thinking", inferred["thinking"])),
+                context_k=int(meta.get("context_k") or inferred["context_k"]),
+                params_b=float(meta.get("params_b") or inferred["params_b"]),
+            )
+            n += 1
+        self.catalog.sync_device_models(device_id, probe.models)
+        return n
+
+    # -- offline propagation ------------------------------------------------
+
+    def _handle_offline(self, res: RunResult, seen: set[str]) -> int:
+        """Mark discovered-before-but-not-seen devices offline and reset
+        leases of their running jobs so they requeue immediately
+        (offline_handler.go:12-38)."""
+        offline_ids: list[str] = list(res.vanished)
+        for dev in self.catalog.list_devices(online_only=True):
+            did = dev["id"]
+            tags = dev.get("tags") or {}
+            if tags.get("self"):
+                continue  # the in-process device is authoritative about itself
+            if tags.get("source") in (None, "local"):
+                continue
+            if did not in seen:
+                self.catalog.set_device_online(did, False)
+                offline_ids.append(did)
+                res.devices_offline += 1
+        if not offline_ids:
+            return 0
+        return self.queue.requeue_device_jobs(offline_ids)
+
+    # -- background loop ----------------------------------------------------
+
+    def start_background(self, stop: threading.Event) -> threading.Thread:
+        """Periodic runner thread (reference main.go:101-112 ticker)."""
+
+        def _loop() -> None:
+            while not stop.is_set():
+                try:
+                    self.run()
+                except Exception:
+                    log.exception("discovery run failed")
+                stop.wait(max(5, self.cfg.discovery_interval_s))
+
+        t = threading.Thread(target=_loop, name="discovery", daemon=True)
+        t.start()
+        return t

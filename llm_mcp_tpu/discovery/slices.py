@@ -1,0 +1,173 @@
+"""TPU-VM slice enumeration: metadata server + static endpoint config.
+
+The reference's mesh source is `tailscale status --json` (discovery.go:88)
+plus `OLLAMA_EXTRA_ENDPOINTS` static probes (discovery.go:388-425). The
+TPU-native mesh sources are:
+
+1. The GCE/TPU-VM metadata server: a multi-host TPU slice publishes its
+   worker hostnames under `instance/attributes/worker-network-endpoints`
+   (and `tpu-env` with ACCELERATOR_TYPE etc.), so every worker can
+   enumerate its peers without any external binary.
+2. `TPU_EXTRA_ENDPOINTS` — comma-separated `name=host:port` or `host:port`
+   entries for static peers (K8s services, fixed VMs) — direct parity with
+   OLLAMA_EXTRA_ENDPOINTS.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any
+
+METADATA_BASE = "http://metadata.google.internal/computeMetadata/v1"
+METADATA_TIMEOUT_S = 1.0
+
+
+@dataclass
+class SliceInfo:
+    """One TPU slice as seen from metadata: peer workers + topology."""
+
+    accelerator_type: str = ""  # e.g. "v5litepod-8"
+    worker_id: int = 0
+    hostnames: list[str] = field(default_factory=list)  # peer worker hosts
+    attributes: dict[str, Any] = field(default_factory=dict)
+
+
+def _metadata_get(path: str, http_get=None) -> str | None:
+    url = f"{METADATA_BASE}/{path}"
+    if http_get is not None:
+        try:
+            status, body = http_get(url, METADATA_TIMEOUT_S, "")
+            return body.decode("utf-8", "replace") if status == 200 else None
+        except Exception:
+            return None
+    req = urllib.request.Request(url, headers={"Metadata-Flavor": "Google"})
+    try:
+        with urllib.request.urlopen(req, timeout=METADATA_TIMEOUT_S) as r:  # noqa: S310
+            return r.read().decode("utf-8", "replace")
+    except (urllib.error.URLError, socket.timeout, OSError):
+        return None
+
+
+def _parse_tpu_env(text: str) -> dict[str, str]:
+    """tpu-env metadata is 'KEY: value' lines (YAML-ish flat map)."""
+    out: dict[str, str] = {}
+    for line in text.splitlines():
+        k, sep, v = line.partition(":")
+        if sep:
+            out[k.strip()] = v.strip().strip("'\"")
+    return out
+
+
+def enumerate_tpu_slice(http_get=None) -> SliceInfo | None:
+    """Enumerate this TPU slice's workers from the metadata server.
+
+    Returns None when not on a TPU VM (metadata unreachable) — callers fall
+    back to static endpoints, exactly like the reference degrades when the
+    tailscale binary is absent (discovery.go:88-97 error path).
+    """
+    env_text = _metadata_get("instance/attributes/tpu-env", http_get)
+    if env_text is None:
+        return None
+    env = _parse_tpu_env(env_text)
+    info = SliceInfo(
+        accelerator_type=env.get("ACCELERATOR_TYPE", ""),
+        attributes=dict(env),
+    )
+    try:
+        info.worker_id = int(env.get("WORKER_ID", "0") or 0)
+    except ValueError:
+        info.worker_id = 0
+    # worker-network-endpoints: "ip:port:hostname,..." or hostnames CSV
+    eps = _metadata_get("instance/attributes/worker-network-endpoints", http_get)
+    hosts: list[str] = []
+    if eps:
+        for entry in eps.replace("\n", ",").split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            # formats seen in the wild: "host", "ip", "ip:8470:host" — the
+            # probe target is always the leading addr; the trailing hostname
+            # (when present) only matters for vhost Host headers, which the
+            # runner derives from the device name.
+            hosts.append(entry.split(":")[0])
+    elif env.get("WORKER_HOSTNAMES"):
+        hosts = [h.strip() for h in env["WORKER_HOSTNAMES"].split(",") if h.strip()]
+    info.hostnames = hosts
+    return info
+
+
+@dataclass
+class StaticEndpoint:
+    name: str
+    host: str
+    port: int
+
+
+def parse_static_endpoints(spec: str, default_port: int = 8080) -> list[StaticEndpoint]:
+    """Parse TPU_EXTRA_ENDPOINTS: "name=host:port,host2:port2,host3".
+
+    Parity with OLLAMA_EXTRA_ENDPOINTS parsing (discovery.go:140-148): each
+    entry is an optional name, a host, and an optional port.
+    """
+    out: list[StaticEndpoint] = []
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, sep, rest = entry.partition("=")
+        if not sep:
+            name, rest = "", entry
+        rest = rest.strip()
+        host, port = rest, default_port
+        if rest.startswith("["):  # [v6]:port
+            closing = rest.find("]")
+            host = rest[1:closing] if closing > 0 else rest.strip("[]")
+            tail = rest[closing + 1 :] if closing > 0 else ""
+            if tail.startswith(":"):
+                try:
+                    port = int(tail[1:])
+                except ValueError:
+                    port = default_port
+        elif rest.count(":") == 1:
+            h, _, p = rest.partition(":")
+            host = h
+            try:
+                port = int(p)
+            except ValueError:
+                port = default_port
+        out.append(StaticEndpoint(name=name or host, host=host, port=port))
+    return out
+
+
+def slice_device_tags(info: SliceInfo) -> dict[str, Any]:
+    """Catalog tags for a slice-discovered device (cf. discovery.go:200-246
+    tagging mesh nodes with os/online/addresses metadata)."""
+    return {
+        "tpu": True,
+        "source": "tpu-metadata",
+        "accelerator_type": info.accelerator_type,
+        "worker_id": info.worker_id,
+        "workers": len(info.hostnames),
+    }
+
+
+def parse_worker_network_endpoints_json(text: str) -> list[str]:
+    """Some TPU runtimes publish endpoints as JSON; accept both shapes."""
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        return []
+    hosts: list[str] = []
+    if isinstance(doc, list):
+        for item in doc:
+            if isinstance(item, str):
+                hosts.append(item.split(":")[0])
+            elif isinstance(item, dict):
+                h = item.get("ipAddress") or item.get("host") or item.get("hostname")
+                if h:
+                    hosts.append(str(h))
+    return hosts

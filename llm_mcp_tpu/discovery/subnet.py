@@ -1,0 +1,100 @@
+"""Optional concurrent LAN subnet sweep for inference nodes.
+
+Parity: reference `discovery.go:669-814` — 24 concurrent scanners, 300 ms
+per-probe timeout, private-IPv4-only guard, ≤512 addresses per prefix. The
+sweep looks for our node surface (`/health`) instead of Ollama.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import queue as _queue
+import threading
+from dataclasses import dataclass
+from typing import Callable
+
+from .probe import HttpGet, probe_endpoint
+
+SCAN_WORKERS = 24  # discovery.go:688
+SCAN_TIMEOUT_S = 0.3  # discovery.go:691 (300 ms)
+MAX_ADDRS_PER_PREFIX = 512  # discovery.go:676
+
+
+@dataclass
+class ScanHit:
+    addr: str
+    port: int
+    latency_ms: float
+
+
+def iter_scan_addrs(subnets: list[str]) -> list[str]:
+    """Expand subnet specs to concrete host addresses with the reference's
+    guards: private IPv4 only, ≤512 hosts per prefix, skip net/bcast."""
+    out: list[str] = []
+    for spec in subnets:
+        spec = spec.strip()
+        if not spec:
+            continue
+        try:
+            net = ipaddress.ip_network(spec, strict=False)
+        except ValueError:
+            continue
+        if net.version != 4 or not net.is_private:
+            continue
+        count = 0
+        for host in net.hosts():
+            if count >= MAX_ADDRS_PER_PREFIX:
+                break
+            out.append(str(host))
+            count += 1
+    return out
+
+
+def scan_subnets(
+    subnets: list[str],
+    ports: list[int],
+    *,
+    timeout: float = SCAN_TIMEOUT_S,
+    workers: int = SCAN_WORKERS,
+    http_get: HttpGet | None = None,
+    on_hit: Callable[[ScanHit], None] | None = None,
+) -> list[ScanHit]:
+    """Sweep subnets × ports concurrently; return endpoints that answered.
+
+    WaitGroup-coordinated worker pool in the reference (discovery.go:688-758)
+    becomes a thread pool draining a work queue here.
+    """
+    addrs = iter_scan_addrs(subnets)
+    work: _queue.Queue[tuple[str, int]] = _queue.Queue()
+    for a in addrs:
+        for p in ports:
+            work.put((a, p))
+    hits: list[ScanHit] = []
+    lock = threading.Lock()
+
+    def _worker() -> None:
+        while True:
+            try:
+                addr, port = work.get_nowait()
+            except _queue.Empty:
+                return
+            res = probe_endpoint(
+                [addr], port, timeout=timeout, http_get=http_get, fetch_models=False
+            )
+            if res.ok:
+                hit = ScanHit(addr=addr, port=port, latency_ms=res.latency_ms)
+                with lock:
+                    hits.append(hit)
+                if on_hit:
+                    on_hit(hit)
+            work.task_done()
+
+    threads = [
+        threading.Thread(target=_worker, name=f"subnet-scan-{i}", daemon=True)
+        for i in range(min(workers, max(1, work.qsize())))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return hits

@@ -1,0 +1,217 @@
+"""Discovery tests: probing, static endpoints, slice metadata, subnet scan,
+and the full runner loop with a fake mesh (devices appearing and vanishing).
+
+Parity targets: discovery.go probe/best-addr/catalog-sync behaviors and
+offline_handler.go lease-reset-on-offline (SURVEY.md §3.3).
+"""
+
+import json
+
+import pytest
+
+from llm_mcp_tpu.discovery import (
+    Runner,
+    parse_static_endpoints,
+    probe_endpoint,
+)
+from llm_mcp_tpu.discovery.slices import _parse_tpu_env, enumerate_tpu_slice
+from llm_mcp_tpu.discovery.subnet import iter_scan_addrs, scan_subnets
+from llm_mcp_tpu.utils.config import Config
+
+
+class FakeMesh:
+    """In-memory HTTP mesh: {(host, port): {health: ..., models: [...]}}."""
+
+    def __init__(self):
+        self.nodes = {}
+        self.metadata = {}
+        self.calls = []
+
+    def http_get(self, url, timeout, host_header=""):
+        self.calls.append(url)
+        if url.startswith("http://metadata.google.internal"):
+            path = url.split("/computeMetadata/v1/", 1)[1]
+            if path in self.metadata:
+                return 200, self.metadata[path].encode()
+            raise OSError("no metadata")
+        # http://host:port/path
+        rest = url[len("http://") :]
+        hostport, _, path = rest.partition("/")
+        host, _, port = hostport.rpartition(":")
+        host = host.strip("[]")
+        node = self.nodes.get((host, int(port)))
+        if node is None:
+            raise OSError("connection refused")
+        if path == "health":
+            return 200, json.dumps(node["health"]).encode()
+        if path == "v1/models":
+            return 200, json.dumps({"models": node.get("models", [])}).encode()
+        return 404, b"{}"
+
+
+@pytest.fixture()
+def mesh():
+    m = FakeMesh()
+    m.nodes[("tpu-a", 8080)] = {
+        "health": {"status": "ok", "platform": "tpu", "chips": 8, "hbm_gb": 128.0},
+        "models": [
+            {"id": "llama-3.1-8b", "kind": "llm"},
+            {"id": "nomic-embed-text"},
+        ],
+    }
+    m.nodes[("tpu-b", 8080)] = {
+        "health": {"status": "ok", "platform": "tpu", "chips": 4, "hbm_gb": 64.0},
+        "models": ["llama-3.2-1b"],
+    }
+    return m
+
+
+def test_probe_endpoint_best_addr(mesh):
+    res = probe_endpoint(["missing-host", "tpu-a"], 8080, http_get=mesh.http_get)
+    assert res.ok and res.addr == "tpu-a"
+    assert res.models == ["llama-3.1-8b", "nomic-embed-text"]
+    assert res.info["chips"] == 8
+    # per-addr probe log includes the failed candidate (discovery.go:283-384)
+    assert [p["ok"] for p in res.probes] == [False, True]
+
+
+def test_probe_prefers_health_engines_over_catalog(mesh):
+    # A peer's /v1/models serves its WHOLE catalog (incl. cloud models); the
+    # device's truly-loaded models are its /health engines list.
+    mesh.nodes[("tpu-a", 8080)]["health"]["engines"] = ["llama-3.1-8b"]
+    mesh.nodes[("tpu-a", 8080)]["models"].append({"id": "openai/gpt-4o", "kind": "llm"})
+    res = probe_endpoint(["tpu-a"], 8080, http_get=mesh.http_get)
+    assert res.models == ["llama-3.1-8b"]
+    assert res.model_meta[0]["kind"] == "llm"  # metadata enriched from catalog
+
+
+def test_probe_skips_self_device(catalog, queue, mesh):
+    mesh.nodes[("tpu-a", 8080)]["health"]["device_id"] = "me"
+    r = Runner(
+        catalog,
+        queue,
+        cfg=Config(tpu_extra_endpoints="tpu-a:8080"),
+        http_get=mesh.http_get,
+        self_device_id="me",
+    )
+    r.run()
+    assert catalog.get_device("tpu-a:8080") is None
+
+
+def test_probe_endpoint_all_down(mesh):
+    res = probe_endpoint(["nope-1", "nope-2"], 8080, http_get=mesh.http_get)
+    assert not res.ok and res.error
+
+
+def test_parse_static_endpoints():
+    eps = parse_static_endpoints("gpu1=10.0.0.5:8081, 10.0.0.6:8082, plainhost", 8080)
+    assert [(e.name, e.host, e.port) for e in eps] == [
+        ("gpu1", "10.0.0.5", 8081),
+        ("10.0.0.6", "10.0.0.6", 8082),
+        ("plainhost", "plainhost", 8080),
+    ]
+    v6 = parse_static_endpoints("[fd7a::1]:9000")[0]
+    assert v6.host == "fd7a::1" and v6.port == 9000
+
+
+def test_parse_tpu_env():
+    env = _parse_tpu_env("ACCELERATOR_TYPE: 'v5litepod-8'\nWORKER_ID: 0\n")
+    assert env["ACCELERATOR_TYPE"] == "v5litepod-8"
+    assert env["WORKER_ID"] == "0"
+
+
+def test_enumerate_tpu_slice(mesh):
+    mesh.metadata["instance/attributes/tpu-env"] = (
+        "ACCELERATOR_TYPE: 'v5litepod-16'\nWORKER_ID: 1\n"
+    )
+    mesh.metadata["instance/attributes/worker-network-endpoints"] = (
+        "10.0.0.1:8470:tpu-a,10.0.0.2:8470:tpu-b"
+    )
+    info = enumerate_tpu_slice(mesh.http_get)
+    assert info.accelerator_type == "v5litepod-16"
+    assert info.worker_id == 1
+    assert info.hostnames == ["10.0.0.1", "10.0.0.2"]
+
+
+def test_enumerate_tpu_slice_absent(mesh):
+    assert enumerate_tpu_slice(mesh.http_get) is None
+
+
+def test_iter_scan_addrs_guards():
+    # public prefixes are refused; /23 capped at 510 hosts (≤512 guard)
+    assert iter_scan_addrs(["8.8.8.0/24"]) == []
+    addrs = iter_scan_addrs(["192.168.0.0/23"])
+    assert len(addrs) == 510
+    assert iter_scan_addrs(["not-a-subnet"]) == []
+
+
+def test_scan_subnets_finds_node():
+    m = FakeMesh()
+    m.nodes[("192.168.1.7", 8080)] = {"health": {"status": "ok"}}
+    hits = scan_subnets(["192.168.1.0/28"], [8080], http_get=m.http_get)
+    assert [(h.addr, h.port) for h in hits] == [("192.168.1.7", 8080)]
+
+
+def _runner(catalog, queue, mesh, **cfg_kw):
+    cfg = Config(**cfg_kw)
+    return Runner(catalog, queue, cfg=cfg, http_get=mesh.http_get, limits=None)
+
+
+def test_runner_static_endpoints_sync(catalog, queue, mesh):
+    r = _runner(catalog, queue, mesh, tpu_extra_endpoints="tpu-a:8080,tpu-b:8080")
+    out = r.run()
+    assert out["sources"]["static"] == 2
+    devs = {d["id"]: d for d in catalog.list_devices(online_only=True)}
+    assert set(devs) == {"tpu-a:8080", "tpu-b:8080"}
+    assert devs["tpu-a:8080"]["tags"]["chips"] == 8
+    # model catalog synced with inferred metadata (discovery.go:482-624)
+    assert catalog.device_models("tpu-a:8080") == sorted(
+        ["llama-3.1-8b", "nomic-embed-text"]
+    )
+    m = catalog.get_model("nomic-embed-text")
+    assert m["kind"] == "embed"
+
+
+def test_runner_offline_requeues_jobs(catalog, queue, mesh):
+    r = _runner(catalog, queue, mesh, tpu_extra_endpoints="tpu-a:8080,tpu-b:8080")
+    r.run()
+    # a job running on tpu-b, then tpu-b vanishes
+    job = queue.submit("tpu.generate", {"device_id": "tpu-b:8080", "prompt": "x"})
+    claimed = queue.claim(worker_id="w1", kinds=["tpu.generate"])
+    assert claimed is not None and claimed.id == job.id
+    del mesh.nodes[("tpu-b", 8080)]
+    out = r.run()
+    assert out["devices_offline"] == 1
+    assert out["jobs_requeued"] == 1
+    dev = catalog.get_device("tpu-b:8080")
+    assert not dev["online"]
+    # lease reset ⇒ immediately re-claimable (offline_handler.go:20-26)
+    re = queue.claim(worker_id="w2", kinds=["tpu.generate"])
+    assert re is not None and re.id == job.id
+
+
+def test_runner_tpu_slice_source(catalog, queue, mesh):
+    mesh.metadata["instance/attributes/tpu-env"] = "ACCELERATOR_TYPE: v5litepod-8\n"
+    mesh.metadata["instance/attributes/worker-network-endpoints"] = "tpu-a,tpu-b"
+    r = _runner(catalog, queue, mesh)
+    out = r.run()
+    assert out["sources"]["tpu-slice"] == 2
+    d = catalog.get_device("tpu-a:8080")
+    assert d["tags"]["source"] == "tpu-metadata"
+    assert d["tags"]["accelerator_type"] == "v5litepod-8"
+
+
+def test_runner_derives_limits_from_hbm(catalog, queue, mesh, db):
+    from llm_mcp_tpu.routing.limits import LimitsEngine
+
+    limits = LimitsEngine(db)
+    r = Runner(
+        catalog,
+        queue,
+        cfg=Config(tpu_extra_endpoints="tpu-a:8080"),
+        http_get=mesh.http_get,
+        limits=limits,
+    )
+    r.run()
+    spec = limits.get("tpu-a:8080")
+    assert spec is not None and spec.max_params_b > 0
