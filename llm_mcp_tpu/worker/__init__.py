@@ -1,0 +1,15 @@
+"""Pull-based execution workers.
+
+Parity: reference `worker/llm_worker/main.py` (603 LoC) — stateless workers
+that register with the core, claim jobs from the durable queue, heartbeat
+their leases, execute by kind, and report results. The TPU twist: a worker
+can EMBED the JAX engines in-process (the common case on a TPU VM — no HTTP
+hop for the hot path) or proxy to a routed executor node's OpenAI-compatible
+surface, the way the reference worker proxied to Ollama.
+"""
+
+from .client import CoreClient
+from .executors import Executors
+from .worker import Worker
+
+__all__ = ["CoreClient", "Executors", "Worker"]

@@ -1,0 +1,69 @@
+"""`python -m llm_mcp_tpu.worker` — boot a pull worker.
+
+Env-configured like the reference worker container (compose.yml llmworker
+service): CORE_URL points at the core; TPU engines load in-process when
+WORKER_LOAD_ENGINES=1 (the TPU-VM deployment shape), otherwise jobs proxy
+to routed device addrs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=os.environ.get("LOG_LEVEL", "INFO"),
+        format='{"ts":"%(asctime)s","level":"%(levelname)s","logger":"%(name)s","msg":"%(message)s"}',
+    )
+    from ..api.providers import CloudClient
+    from ..utils.config import Config
+    from .client import CoreClient
+    from .executors import Executors
+    from .worker import Worker
+
+    cfg = Config()
+    core_url = os.environ.get("CORE_URL", "http://localhost:8080")
+
+    gen_engines: dict = {}
+    embed_engines: dict = {}
+    if os.environ.get("WORKER_LOAD_ENGINES", "") in ("1", "true"):
+        import jax.numpy as jnp
+
+        from ..executor import EmbeddingEngine, GenerationEngine
+
+        model = cfg.tpu_model
+        gen_engines[model] = GenerationEngine(
+            model,
+            max_slots=cfg.tpu_max_slots,
+            max_seq_len=cfg.tpu_max_seq_len,
+            dtype=jnp.bfloat16,
+            weights_dir=cfg.tpu_weights_dir,
+        ).start()
+        embed_engines[cfg.tpu_embed_model] = EmbeddingEngine(
+            cfg.tpu_embed_model,
+            max_seq_len=min(cfg.tpu_max_seq_len, 8192),
+            dtype=jnp.bfloat16,
+            weights_dir=cfg.tpu_weights_dir,
+        )
+
+    cloud = CloudClient(cfg) if (cfg.has_openrouter() or cfg.has_openai()) else None
+    worker = Worker(
+        CoreClient(core_url),
+        Executors(gen_engines=gen_engines, embed_engines=embed_engines, cloud=cloud),
+        worker_id=cfg.worker_id,
+        name=cfg.worker_name,
+        kinds=[k.strip() for k in cfg.worker_kinds.split(",") if k.strip()],
+        lease_seconds=float(cfg.worker_lease_seconds),
+    )
+    signal.signal(signal.SIGTERM, lambda *_: worker.stop())
+    signal.signal(signal.SIGINT, lambda *_: worker.stop())
+    worker.run()
+    for e in gen_engines.values():
+        e.shutdown()
+
+
+if __name__ == "__main__":
+    main()

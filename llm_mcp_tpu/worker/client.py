@@ -1,0 +1,156 @@
+"""Worker→core protocol client (HTTP).
+
+Parity: the reference worker speaks gRPC to the core
+(`worker/llm_worker/main.py:536-599`) with one HTTP side-channel
+(`POST /v1/devices/offline`, main.py:180-186). Here the primary transport is
+the core's HTTP worker protocol (same routes the gRPC server mirrors); the
+gRPC transport is available via `llm_mcp_tpu.rpc`.
+
+Retry policy mirrors main.py:112-138: exponential backoff on connection
+errors and 5xx; 4xx are terminal except 429.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable
+
+log = logging.getLogger("worker.client")
+
+# post(path, body, timeout) -> (status_code, parsed_json_or_{})
+HttpPost = Callable[[str, dict[str, Any] | None, float], tuple[int, dict[str, Any]]]
+
+
+class TerminalHTTPError(RuntimeError):
+    def __init__(self, status: int, body: Any):
+        super().__init__(f"HTTP {status}: {body}")
+        self.status = status
+        self.body = body
+
+
+def post_json(url: str, body: dict[str, Any] | None, timeout: float) -> tuple[int, dict[str, Any]]:
+    """One JSON POST → (status, parsed body). HTTP error statuses are
+    RETURNED, not raised — only transport failures raise, so callers can
+    distinguish device-unreachable from device-said-no."""
+    data = json.dumps(body or {}).encode()
+    req = urllib.request.Request(
+        url, data=data, method="POST", headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:  # noqa: S310
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read() or b"{}")
+        except (ValueError, OSError):
+            payload = {}
+        return e.code, payload
+
+
+class CoreClient:
+    def __init__(
+        self,
+        base_url: str,
+        *,
+        http_post: HttpPost | None = None,
+        timeout_s: float = 30.0,
+        max_retries: int = 5,
+        backoff_s: float = 0.5,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self._post = http_post or self._default_post
+
+    def _default_post(
+        self, path: str, body: dict[str, Any] | None, timeout: float
+    ) -> tuple[int, dict[str, Any]]:
+        return post_json(f"{self.base_url}{path}", body, timeout)
+
+    def post(self, path: str, body: dict[str, Any] | None = None) -> dict[str, Any]:
+        """POST with backoff. Raises TerminalHTTPError on non-retryable 4xx,
+        ConnectionError after retries are exhausted."""
+        delay = self.backoff_s
+        last_err: Exception | None = None
+        for attempt in range(self.max_retries):
+            is_last = attempt == self.max_retries - 1
+            try:
+                status, payload = self._post(path, body, self.timeout_s)
+            except (urllib.error.URLError, socket.timeout, OSError, ValueError) as e:
+                last_err = e
+                log.warning("post %s failed (%s), retry %d", path, e, attempt + 1)
+                if not is_last:
+                    time.sleep(delay)
+                    delay = min(delay * 2, 10.0)
+                continue
+            if status < 400:
+                return payload
+            if 400 <= status < 500 and status != 429:
+                raise TerminalHTTPError(status, payload)
+            last_err = TerminalHTTPError(status, payload)
+            if not is_last:
+                time.sleep(delay)
+                delay = min(delay * 2, 10.0)
+        raise ConnectionError(f"post {path}: retries exhausted: {last_err}")
+
+    # -- worker protocol (mirrors grpcserver RPCs / HTTP routes) -----------
+
+    def register(self, worker_id: str, name: str = "", kinds: list[str] | None = None) -> None:
+        self.post(
+            "/v1/workers/register",
+            {"worker_id": worker_id, "name": name, "kinds": kinds or []},
+        )
+
+    def claim(
+        self, worker_id: str, kinds: list[str] | None = None, lease_seconds: float = 30.0
+    ) -> dict[str, Any] | None:
+        out = self.post(
+            "/v1/jobs/claim",
+            {"worker_id": worker_id, "kinds": kinds or [], "lease_seconds": lease_seconds},
+        )
+        return out.get("job")
+
+    def heartbeat(self, job_id: str, worker_id: str, lease_seconds: float = 30.0) -> bool:
+        """False = lease lost (the core answered 409: job no longer running
+        under this worker); transport failures still raise."""
+        try:
+            out = self.post(
+                f"/v1/jobs/{job_id}/heartbeat",
+                {"worker_id": worker_id, "lease_seconds": lease_seconds},
+            )
+        except TerminalHTTPError as e:
+            if e.status == 409:
+                return False
+            raise
+        return out.get("status") == "ok"
+
+    def complete(
+        self,
+        job_id: str,
+        worker_id: str,
+        result: dict[str, Any],
+        metrics: dict[str, Any] | None = None,
+    ) -> None:
+        self.post(
+            f"/v1/jobs/{job_id}/complete",
+            {"worker_id": worker_id, "result": result, "metrics": metrics or {}},
+        )
+
+    def fail(self, job_id: str, worker_id: str, error: str) -> str:
+        out = self.post(
+            f"/v1/jobs/{job_id}/fail", {"worker_id": worker_id, "error": error}
+        )
+        return str(out.get("status") or "")
+
+    def report_offline(self, device_id: str, reason: str = "") -> None:
+        """Connection-failure side channel (`main.py:180-196`)."""
+        try:
+            self.post("/v1/devices/offline", {"device_id": device_id, "reason": reason})
+        except (ConnectionError, TerminalHTTPError):
+            log.warning("offline report for %s failed", device_id)

@@ -1,0 +1,342 @@
+"""Job-kind executors: the worker's dispatch table.
+
+Parity: reference `worker/llm_worker/main.py:330-449` kind dispatch with
+executors for local inference (`_ollama_generate` 222-243, `_ollama_embed`
+246-261), cloud chat (`openai.chat` 274-299, `openrouter.chat` 302-327),
+benchmark kinds (471-518), and the `echo` pipeline probe (449). Cross-cutting
+behaviors kept: `<think>` splitting (207-219), cost calc from routed pricing
+(199-204), per-stage ms timing in results (240-243).
+
+Local execution is either in-process (engines loaded in this worker) or a
+proxy to the routed device's OpenAI-compatible surface via `device_addr` —
+the analog of `ollama_addr` resolution (main.py:163-177).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import time
+import urllib.error
+from typing import Any
+
+from ..utils.tokens import estimate_tokens, messages_to_prompt, split_think
+from .client import post_json
+
+log = logging.getLogger("worker.executors")
+
+PROXY_TIMEOUT_S = 120.0  # reference chat/embed proxy timeout (handlers.go:1816,2082)
+BENCH_PROMPT = "Write a short story about a lighthouse keeper who discovers a hidden door."
+
+
+class ExecutionError(RuntimeError):
+    """Job failed; `connection_failure` marks device-unreachable errors that
+    should additionally report the device offline (main.py:189-196)."""
+
+    def __init__(self, msg: str, connection_failure: bool = False):
+        super().__init__(msg)
+        self.connection_failure = connection_failure
+
+
+def _payload_cost(payload: dict[str, Any], tokens_in: int, tokens_out: int) -> float | None:
+    """USD cost from routing-injected pricing (`_price_in_1m`/`_price_out_1m`,
+    router.go:513-516; cost calc main.py:199-204)."""
+    pin = payload.get("_price_in_1m")
+    pout = payload.get("_price_out_1m")
+    if pin is None and pout is None:
+        return None
+    return (tokens_in * float(pin or 0.0) + tokens_out * float(pout or 0.0)) / 1e6
+
+
+class Executors:
+    def __init__(
+        self,
+        *,
+        gen_engines: dict[str, Any] | None = None,
+        embed_engines: dict[str, Any] | None = None,
+        cloud: Any = None,  # providers.CloudClient | None
+        http_post_json=None,  # injectable for tests
+    ):
+        self.gen_engines = gen_engines or {}
+        self.embed_engines = embed_engines or {}
+        self.cloud = cloud
+        self._post = http_post_json or self._default_post
+
+    # -- dispatch ----------------------------------------------------------
+
+    def dispatch(self, kind: str, payload: dict[str, Any]) -> dict[str, Any]:
+        provider = str(payload.get("provider") or "tpu")
+        if kind == "echo":
+            return {"echo": payload.get("data", payload), "ok": True}
+        if kind.startswith("benchmark."):
+            return self._benchmark(kind.removeprefix("benchmark."), payload)
+        if kind in ("generate", "chat"):
+            if provider in ("openai", "openrouter"):
+                return self._cloud_chat(payload)
+            return self._generate(payload)
+        if kind == "embed":
+            if provider in ("openai", "openrouter"):
+                return self._cloud_embed(payload)
+            return self._embed(payload)
+        raise ExecutionError(f"unknown job kind: {kind}")
+
+    # -- local generation --------------------------------------------------
+
+    def _gen_params(self, payload: dict[str, Any]) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        if payload.get("max_tokens") is not None:
+            out["max_tokens"] = int(payload["max_tokens"])
+        if payload.get("temperature") is not None:
+            out["temperature"] = float(payload["temperature"])
+        if payload.get("top_k") is not None:
+            out["top_k"] = int(payload["top_k"])
+        if payload.get("top_p") is not None:
+            out["top_p"] = float(payload["top_p"])
+        if payload.get("stop"):
+            out["stop"] = list(payload["stop"])
+        return out
+
+    def _prompt_of(self, payload: dict[str, Any]) -> str:
+        prompt = str(payload.get("prompt") or "")
+        if not prompt and payload.get("messages"):
+            prompt = messages_to_prompt(payload["messages"])
+        return prompt
+
+    def _generate(self, payload: dict[str, Any]) -> dict[str, Any]:
+        model = str(payload.get("model") or "")
+        prompt = self._prompt_of(payload)
+        t0 = time.monotonic()
+        engine = self.gen_engines.get(model)
+        if engine is not None:
+            out = engine.generate(prompt, **self._gen_params(payload))
+            usage = out.get("usage", {})
+            text = out["text"]
+            tokens_in = int(usage.get("prompt_tokens") or 0)
+            tokens_out = int(usage.get("completion_tokens") or 0)
+        else:
+            text, tokens_in, tokens_out = self._remote_generate(payload, prompt)
+        ms = (time.monotonic() - t0) * 1000.0
+        thinking, answer = split_think(text)
+        result: dict[str, Any] = {
+            "response": answer,
+            "model": model,
+            "tokens_in": tokens_in,
+            "tokens_out": tokens_out,
+            "ms": round(ms, 1),
+        }
+        if thinking:
+            result["thinking"] = thinking
+        cost = _payload_cost(payload, tokens_in, tokens_out)
+        if cost is not None:
+            result["cost_usd"] = round(cost, 8)
+        return result
+
+    def _remote_generate(
+        self, payload: dict[str, Any], prompt: str
+    ) -> tuple[str, int, int]:
+        """Proxy to the routed device's /v1/chat/completions (non-stream) —
+        the worker-side analog of POST {ollama_addr}/api/generate."""
+        addr = str(payload.get("device_addr") or "")
+        if not addr:
+            raise ExecutionError(
+                f"model {payload.get('model')!r} not loaded locally and no device_addr routed"
+            )
+        body = {
+            "model": payload.get("model"),
+            "messages": [{"role": "user", "content": prompt}],
+            "stream": False,
+            **{
+                k: payload[k]
+                for k in ("max_tokens", "temperature", "top_p", "stop")
+                if payload.get(k) is not None
+            },
+        }
+        doc = self._post_device(addr, "/v1/chat/completions", body)
+        try:
+            text = doc["choices"][0]["message"]["content"]
+        except (KeyError, IndexError, TypeError):
+            raise ExecutionError(f"malformed completion from {addr}: {doc}") from None
+        usage = doc.get("usage") or {}
+        return (
+            str(text),
+            int(usage.get("prompt_tokens") or estimate_tokens(prompt)),
+            int(usage.get("completion_tokens") or estimate_tokens(str(text))),
+        )
+
+    # -- local embeddings --------------------------------------------------
+
+    def _embed(self, payload: dict[str, Any]) -> dict[str, Any]:
+        model = str(payload.get("model") or "")
+        texts = payload.get("input") or payload.get("texts") or []
+        if isinstance(texts, str):
+            texts = [texts]
+        dims = payload.get("dimensions")
+        t0 = time.monotonic()
+        engine = self.embed_engines.get(model)
+        if engine is not None:
+            vectors, total_tokens = engine.embed(
+                [str(t) for t in texts], dimensions=int(dims) if dims else None
+            )
+        else:
+            vectors, total_tokens = self._remote_embed(payload, texts)
+        ms = (time.monotonic() - t0) * 1000.0
+        result = {
+            "embeddings": vectors,
+            "model": model,
+            "count": len(vectors),
+            "tokens_in": total_tokens,
+            "ms": round(ms, 1),
+        }
+        cost = _payload_cost(payload, total_tokens, 0)
+        if cost is not None:
+            result["cost_usd"] = round(cost, 8)
+        return result
+
+    def _remote_embed(
+        self, payload: dict[str, Any], texts: list[Any]
+    ) -> tuple[list[list[float]], int]:
+        addr = str(payload.get("device_addr") or "")
+        if not addr:
+            raise ExecutionError(
+                f"model {payload.get('model')!r} not loaded locally and no device_addr routed"
+            )
+        body: dict[str, Any] = {"model": payload.get("model"), "input": texts}
+        if payload.get("dimensions"):
+            body["dimensions"] = payload["dimensions"]
+        doc = self._post_device(addr, "/v1/embeddings", body)
+        vectors = [d.get("embedding", []) for d in doc.get("data", [])]
+        total = int((doc.get("usage") or {}).get("prompt_tokens") or 0)
+        return vectors, total
+
+    # -- cloud -------------------------------------------------------------
+
+    def _cloud_chat(self, payload: dict[str, Any]) -> dict[str, Any]:
+        if self.cloud is None:
+            raise ExecutionError("cloud provider not configured")
+        messages = payload.get("messages") or [
+            {"role": "user", "content": self._prompt_of(payload)}
+        ]
+        t0 = time.monotonic()
+        doc = self.cloud.chat(
+            {
+                "model": payload.get("model"),
+                "messages": messages,
+                **{
+                    k: payload[k]
+                    for k in ("max_tokens", "temperature", "top_p")
+                    if payload.get(k) is not None
+                },
+            }
+        )
+        ms = (time.monotonic() - t0) * 1000.0
+        text = ""
+        try:
+            text = doc["choices"][0]["message"]["content"] or ""
+        except (KeyError, IndexError, TypeError):
+            pass
+        usage = doc.get("usage") or {}
+        tokens_in = int(usage.get("prompt_tokens") or 0)
+        tokens_out = int(usage.get("completion_tokens") or 0)
+        thinking, answer = split_think(text)
+        result = {
+            "response": answer,
+            "model": doc.get("model") or payload.get("model"),
+            "tokens_in": tokens_in,
+            "tokens_out": tokens_out,
+            "ms": round(ms, 1),
+        }
+        if thinking:
+            result["thinking"] = thinking
+        cost = _payload_cost(payload, tokens_in, tokens_out)
+        if cost is not None:
+            result["cost_usd"] = round(cost, 8)
+        return result
+
+    def _cloud_embed(self, payload: dict[str, Any]) -> dict[str, Any]:
+        if self.cloud is None:
+            raise ExecutionError("cloud provider not configured")
+        texts = payload.get("input") or payload.get("texts") or []
+        if isinstance(texts, str):
+            texts = [texts]
+        dims = payload.get("dimensions")
+        t0 = time.monotonic()
+        doc = self.cloud.embed(
+            str(payload.get("model") or ""), [str(t) for t in texts],
+            int(dims) if dims else None,
+        )
+        ms = (time.monotonic() - t0) * 1000.0
+        vectors = [d.get("embedding", []) for d in doc.get("data", [])]
+        total = int((doc.get("usage") or {}).get("prompt_tokens") or 0)
+        result = {
+            "embeddings": vectors,
+            "model": payload.get("model"),
+            "count": len(vectors),
+            "tokens_in": total,
+            "ms": round(ms, 1),
+        }
+        cost = _payload_cost(payload, total, 0)
+        if cost is not None:
+            result["cost_usd"] = round(cost, 8)
+        return result
+
+    # -- benchmarks --------------------------------------------------------
+
+    def _benchmark(self, task: str, payload: dict[str, Any]) -> dict[str, Any]:
+        """Measured tps/latency for (device, model, task) — feeds the
+        `benchmarks` table that device ranking consults. Reference computed
+        tps from Ollama's eval_duration (main.py:471-518); here timing comes
+        from our own engine/proxy wall clock."""
+        payload = dict(payload)
+        payload.setdefault("prompt", BENCH_PROMPT)
+        payload.setdefault("max_tokens", int(payload.get("bench_tokens") or 64))
+        t0 = time.monotonic()
+        if task == "embed":
+            payload.setdefault("input", [BENCH_PROMPT] * int(payload.get("bench_batch") or 8))
+            r = self._embed(payload)
+            latency_ms = (time.monotonic() - t0) * 1000.0
+            tokens = int(r.get("tokens_in") or 0)
+            tps = tokens / (latency_ms / 1000.0) if latency_ms > 0 else 0.0
+            return {
+                "task_type": "embed",
+                "model": r.get("model"),
+                "tokens_in": tokens,
+                "tokens_out": 0,
+                "latency_ms": round(latency_ms, 1),
+                "tps": round(tps, 2),
+            }
+        r = self._generate(payload)
+        latency_ms = (time.monotonic() - t0) * 1000.0
+        tokens_out = int(r.get("tokens_out") or 0)
+        tps = tokens_out / (latency_ms / 1000.0) if latency_ms > 0 else 0.0
+        return {
+            "task_type": "generate",
+            "model": r.get("model"),
+            "tokens_in": int(r.get("tokens_in") or 0),
+            "tokens_out": tokens_out,
+            "latency_ms": round(latency_ms, 1),
+            "tps": round(tps, 2),
+        }
+
+    # -- device HTTP -------------------------------------------------------
+
+    def _default_post(self, url: str, body: dict[str, Any]) -> tuple[int, dict[str, Any]]:
+        # post_json RETURNS HTTP error statuses instead of raising, so the
+        # status>=400 branch below stays a policy error (no offline report)
+        # and only transport failures count as connection failures.
+        return post_json(url, body, PROXY_TIMEOUT_S)
+
+    def _post_device(self, addr: str, path: str, body: dict[str, Any]) -> dict[str, Any]:
+        if ":" in addr and not addr.startswith(("http://", "https://")):
+            host, _, port = addr.rpartition(":")
+            if ":" in host and not host.startswith("["):  # IPv6 (main.py:141-160)
+                host = f"[{host}]"
+            addr = f"http://{host}:{port}"
+        elif not addr.startswith(("http://", "https://")):
+            addr = f"http://{addr}"
+        try:
+            status, doc = self._post(f"{addr}{path}", body)
+        except (urllib.error.URLError, socket.timeout, OSError, ValueError) as e:
+            raise ExecutionError(f"device {addr} unreachable: {e}", connection_failure=True) from e
+        if status >= 400:
+            raise ExecutionError(f"device {addr} returned {status}: {doc}")
+        return doc

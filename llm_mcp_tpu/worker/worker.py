@@ -1,0 +1,156 @@
+"""The worker pull loop: register → claim → heartbeat → execute → report.
+
+Parity: reference `worker/llm_worker/main.py:536-599` — register with
+retry-forever (545-552), 1.5 s idle claim poll (563-566), per-job heartbeat
+daemon thread at lease/2 (521-533, 573-579), complete/fail reporting with
+requeue semantics, connection-failure → device offline side-channel
+(592-595). Workers are stateless; scale-out is just more processes
+(SURVEY.md §2.2 data-parallel scale-out).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+import uuid
+from typing import Any
+
+from .client import CoreClient, TerminalHTTPError
+from .executors import ExecutionError, Executors
+
+log = logging.getLogger("worker")
+
+IDLE_POLL_S = 1.5  # main.py:563-566
+REGISTER_RETRY_S = 3.0
+
+
+class Worker:
+    def __init__(
+        self,
+        client: CoreClient,
+        executors: Executors,
+        *,
+        worker_id: str = "",
+        name: str = "",
+        kinds: list[str] | None = None,
+        lease_seconds: float = 30.0,
+        idle_poll_s: float = IDLE_POLL_S,
+    ):
+        self.client = client
+        self.executors = executors
+        self.worker_id = worker_id or f"worker-{socket.gethostname()}-{uuid.uuid4().hex[:8]}"
+        self.name = name or self.worker_id
+        # WORKER_KINDS specialization (main.py:539-540): empty = all kinds
+        self.kinds = kinds or []
+        self.lease_seconds = lease_seconds
+        self.idle_poll_s = idle_poll_s
+        self.jobs_done = 0
+        self.jobs_failed = 0
+        self._stop = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def register_forever(self) -> None:
+        """Retry registration until the core answers (main.py:545-552)."""
+        while not self._stop.is_set():
+            try:
+                self.client.register(self.worker_id, self.name, self.kinds)
+                log.info("registered as %s kinds=%s", self.worker_id, self.kinds or "all")
+                return
+            except (ConnectionError, TerminalHTTPError) as e:
+                log.warning("register failed (%s), retrying", e)
+                self._stop.wait(REGISTER_RETRY_S)
+
+    def run(self) -> None:
+        self.register_forever()
+        while not self._stop.is_set():
+            if not self.run_once():
+                self._stop.wait(self.idle_poll_s)
+
+    # -- one claim cycle (test seam) ---------------------------------------
+
+    def run_once(self) -> bool:
+        """Claim and execute at most one job. Returns True if one ran."""
+        try:
+            job = self.client.claim(self.worker_id, self.kinds, self.lease_seconds)
+        except (ConnectionError, TerminalHTTPError) as e:
+            log.warning("claim failed: %s", e)
+            return False
+        if not job:
+            return False
+        self._execute(job)
+        return True
+
+    # -- execution ---------------------------------------------------------
+
+    def _execute(self, job: dict[str, Any]) -> None:
+        job_id = str(job["id"])
+        kind = str(job.get("kind") or "")
+        payload = job.get("payload") or {}
+        log.info("job %s kind=%s model=%s", job_id, kind, payload.get("model", ""))
+
+        hb_stop = threading.Event()
+        hb = threading.Thread(
+            target=self._heartbeat_loop, args=(job_id, hb_stop),
+            name=f"hb-{job_id[:8]}", daemon=True,
+        )
+        hb.start()
+        t0 = time.monotonic()
+        try:
+            result = self.executors.dispatch(kind, payload)
+        except ExecutionError as e:
+            hb_stop.set()
+            hb.join(timeout=2.0)
+            self._report_failure(job_id, payload, str(e), e.connection_failure)
+            return
+        except Exception as e:  # defensive: never leave a job leased
+            hb_stop.set()
+            hb.join(timeout=2.0)
+            self._report_failure(job_id, payload, f"{type(e).__name__}: {e}", False)
+            return
+        hb_stop.set()
+        hb.join(timeout=2.0)
+
+        metrics = {
+            "worker_id": self.worker_id,
+            "duration_ms": round((time.monotonic() - t0) * 1000.0, 1),
+        }
+        try:
+            self.client.complete(job_id, self.worker_id, result, metrics)
+            self.jobs_done += 1
+        except (ConnectionError, TerminalHTTPError) as e:
+            # Lease expiry will requeue the job; the attempt's work is lost
+            # but the queue stays consistent (crash-recovery semantics).
+            log.error("complete %s failed: %s", job_id, e)
+
+    def _report_failure(
+        self, job_id: str, payload: dict[str, Any], error: str, connection_failure: bool
+    ) -> None:
+        self.jobs_failed += 1
+        log.warning("job %s failed: %s", job_id, error)
+        try:
+            self.client.fail(job_id, self.worker_id, error)
+        except (ConnectionError, TerminalHTTPError) as e:
+            log.error("fail report for %s failed: %s", job_id, e)
+        if connection_failure and payload.get("device_id"):
+            # Device-unreachable class errors additionally push the device
+            # offline so routing stops selecting it (main.py:189-196,592-595).
+            self.client.report_offline(str(payload["device_id"]), error)
+
+    def _heartbeat_loop(self, job_id: str, stop: threading.Event) -> None:
+        """Extend the lease every lease/2 seconds while the job runs
+        (main.py:521-533); a dead worker simply stops heartbeating and the
+        lease expires."""
+        interval = max(1.0, self.lease_seconds / 2.0)
+        while not stop.wait(interval):
+            try:
+                if not self.client.heartbeat(job_id, self.worker_id, self.lease_seconds):
+                    log.warning("heartbeat rejected for %s (lease lost)", job_id)
+                    return
+            except (ConnectionError, TerminalHTTPError) as e:
+                log.warning("heartbeat failed for %s: %s", job_id, e)
