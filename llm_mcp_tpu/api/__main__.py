@@ -55,6 +55,20 @@ def main() -> None:
         cfg, gen_engines=gen_engines, embed_engines=embed_engines
     ).start(host or "0.0.0.0", int(port or 8080))
 
+    grpc_server = None
+    if cfg.grpc_addr:
+        from ..rpc import GrpcCoreServer
+
+        ghost, _, gport = cfg.grpc_addr.rpartition(":")
+        grpc_server = GrpcCoreServer(
+            server.queue,
+            server.catalog,
+            circuit=server.router.circuit,
+            device_max_concurrency=cfg.device_max_concurrency,
+            default_lease_s=float(cfg.worker_lease_seconds),
+        ).start(f"{ghost or '0.0.0.0'}:{gport or 9090}")
+        log.info("grpc worker protocol on %s", cfg.grpc_addr)
+
     stop = []
     signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
     signal.signal(signal.SIGINT, lambda *_: stop.append(1))
@@ -63,6 +77,8 @@ def main() -> None:
             signal.pause()
     finally:
         log.info("shutting down")
+        if grpc_server is not None:
+            grpc_server.stop()
         server.shutdown()
 
 

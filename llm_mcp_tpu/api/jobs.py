@@ -202,6 +202,9 @@ class JobsAPI:
         terminal status. The reference's LISTEN-based stream
         (`handlers.go:481-608`) with the in-process notify bus."""
         job_id = req.params["id"]
+        # version read BEFORE job state: an update racing the read makes the
+        # next wait return immediately (no lost wakeup / re-poll stall)
+        version = self.queue.update_version
         job = self.queue.get(job_id)
         if job is None:
             resp.write_error("job not found", 404)
@@ -213,7 +216,7 @@ class JobsAPI:
         last_updated = job.updated_at
         deadline = time.time() + SSE_MAX_S
         while job.status not in JobStatus.TERMINAL and time.time() < deadline:
-            self.queue.wait_for_update(SSE_REPOLL_S)
+            version = self.queue.wait_for_update(SSE_REPOLL_S, since=version)
             job = self.queue.get(job_id)
             if job is None:
                 break
@@ -226,21 +229,6 @@ class JobsAPI:
     # -- benchmark results -------------------------------------------------
 
     def _record_benchmark_result(self, job) -> None:
-        """benchmark.* job results feed the benchmarks table that routing
-        ranks by (`grpcserver/server.go:302-327`, `main.py:471-518`)."""
-        if not job.kind.startswith("benchmark.") or not job.result:
-            return
-        r = job.result
-        dev = str(job.payload.get("device_id") or job.device_id or "")
-        model = str(job.payload.get("model") or r.get("model") or "")
-        if not dev or not model:
-            return
-        self.catalog.record_benchmark(
-            dev,
-            model,
-            str(r.get("task_type") or job.kind.removeprefix("benchmark.")),
-            tokens_in=int(r.get("tokens_in") or 0),
-            tokens_out=int(r.get("tokens_out") or 0),
-            latency_ms=float(r.get("latency_ms") or 0),
-            tps=float(r.get("tps") or 0),
-        )
+        from ..state.catalog import record_benchmark_from_job
+
+        record_benchmark_from_job(self.catalog, job)

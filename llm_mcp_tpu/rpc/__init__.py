@@ -1,0 +1,13 @@
+"""gRPC control/worker protocol.
+
+Parity: reference `core/internal/grpcserver/server.go` (10 RPCs mirroring
+the HTTP worker protocol) and `proto/llm.proto` (C9/C14). Messages are
+protoc-generated (`pb/llm_mcp_tpu_pb2.py`); service wiring is hand-rolled
+with `grpc.method_handlers_generic_handler` because the grpc_tools codegen
+plugin is not in the build environment.
+"""
+
+from .client import GrpcCoreClient
+from .server import GrpcCoreServer
+
+__all__ = ["GrpcCoreServer", "GrpcCoreClient"]

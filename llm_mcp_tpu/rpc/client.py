@@ -1,0 +1,231 @@
+"""gRPC client for the core worker protocol.
+
+Duck-type compatible with `worker.client.CoreClient` (register/claim/
+heartbeat/complete/fail/report_offline), so a `Worker` can run over either
+transport — the reference worker was gRPC-only (`main.py:536-599`).
+Heartbeat lease-lost surfaces as `False` exactly like the HTTP client's 409
+mapping; FAILED_PRECONDITION on complete/fail maps to TerminalHTTPError so
+Worker's error handling is transport-agnostic.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Iterator
+
+import grpc
+
+from ..worker.client import TerminalHTTPError
+from .pb import llm_mcp_tpu_pb2 as pb
+from .server import SERVICE_NAME, TERMINAL
+
+log = logging.getLogger("rpc.client")
+
+
+def _method(channel: grpc.Channel, name: str, resp_cls, stream: bool = False):
+    path = f"/{SERVICE_NAME}/{name}"
+    kw = dict(
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=resp_cls.FromString,
+    )
+    return channel.unary_stream(path, **kw) if stream else channel.unary_unary(path, **kw)
+
+
+class GrpcCoreClient:
+    def __init__(self, target: str, *, timeout_s: float = 30.0):
+        self.channel = grpc.insecure_channel(target)
+        self.timeout_s = timeout_s
+        c = self.channel
+        self._submit = _method(c, "SubmitJob", pb.Job)
+        self._get = _method(c, "GetJob", pb.Job)
+        self._stream = _method(c, "StreamJob", pb.Job, stream=True)
+        self._register = _method(c, "RegisterWorker", pb.Ack)
+        self._claim = _method(c, "ClaimJob", pb.ClaimResponse)
+        self._heartbeat = _method(c, "Heartbeat", pb.Ack)
+        self._complete = _method(c, "CompleteJob", pb.Ack)
+        self._fail = _method(c, "FailJob", pb.FailResponse)
+        self._report_metrics = _method(c, "ReportMetrics", pb.Ack)
+        self._report_benchmark = _method(c, "ReportBenchmark", pb.Ack)
+        self._report_offline = _method(c, "ReportOffline", pb.Ack)
+
+    def close(self) -> None:
+        self.channel.close()
+
+    # -- conversions -------------------------------------------------------
+
+    @staticmethod
+    def job_to_dict(j: pb.Job) -> dict[str, Any]:
+        """Same shape as the HTTP API's job JSON (state.queue.Job.to_dict)."""
+        started = {"started_at": j.started_at or None, "finished_at": j.finished_at or None}
+        return {
+            **started,
+            "id": j.id,
+            "kind": j.kind,
+            "status": j.status,
+            "priority": j.priority,
+            "payload": json.loads(j.payload_json) if j.payload_json else {},
+            "result": json.loads(j.result_json) if j.result_json else None,
+            "error": j.error or None,
+            "attempts": j.attempts,
+            "max_attempts": j.max_attempts,
+            "worker_id": j.worker_id or None,
+            "device_id": j.device_id or None,
+            "lease_until": j.lease_until or None,
+            "deadline_at": j.deadline_at or None,
+            "created_at": j.created_at,
+            "updated_at": j.updated_at,
+        }
+
+    def _call(self, fn, req):
+        try:
+            return fn(req, timeout=self.timeout_s)
+        except grpc.RpcError as e:
+            code = e.code()
+            if code in (
+                grpc.StatusCode.FAILED_PRECONDITION,
+                grpc.StatusCode.INVALID_ARGUMENT,
+                grpc.StatusCode.NOT_FOUND,
+            ):
+                raise TerminalHTTPError(self._http_status(code), e.details()) from e
+            raise ConnectionError(f"grpc {code.name}: {e.details()}") from e
+
+    @staticmethod
+    def _http_status(code: grpc.StatusCode) -> int:
+        return {
+            grpc.StatusCode.FAILED_PRECONDITION: 409,
+            grpc.StatusCode.INVALID_ARGUMENT: 400,
+            grpc.StatusCode.NOT_FOUND: 404,
+        }.get(code, 500)
+
+    # -- worker protocol (CoreClient-compatible) ---------------------------
+
+    def register(self, worker_id: str, name: str = "", kinds: list[str] | None = None) -> None:
+        self._call(
+            self._register, pb.WorkerInfo(worker_id=worker_id, name=name, kinds=kinds or [])
+        )
+
+    def claim(
+        self, worker_id: str, kinds: list[str] | None = None, lease_seconds: float = 30.0
+    ) -> dict[str, Any] | None:
+        resp = self._call(
+            self._claim,
+            pb.ClaimRequest(worker_id=worker_id, kinds=kinds or [], lease_seconds=lease_seconds),
+        )
+        return self.job_to_dict(resp.job) if resp.found else None
+
+    def heartbeat(self, job_id: str, worker_id: str, lease_seconds: float = 30.0) -> bool:
+        try:
+            ack = self._call(
+                self._heartbeat,
+                pb.HeartbeatRequest(
+                    job_id=job_id, worker_id=worker_id, lease_seconds=lease_seconds
+                ),
+            )
+        except TerminalHTTPError as e:
+            if e.status == 409:
+                return False
+            raise
+        return ack.ok
+
+    def complete(
+        self,
+        job_id: str,
+        worker_id: str,
+        result: dict[str, Any],
+        metrics: dict[str, Any] | None = None,
+    ) -> None:
+        self._call(
+            self._complete,
+            pb.CompleteRequest(
+                job_id=job_id,
+                worker_id=worker_id,
+                result_json=json.dumps(result),
+                metrics_json=json.dumps(metrics or {}),
+            ),
+        )
+
+    def fail(self, job_id: str, worker_id: str, error: str) -> str:
+        resp = self._call(
+            self._fail, pb.FailRequest(job_id=job_id, worker_id=worker_id, error=error)
+        )
+        return resp.status
+
+    def report_offline(self, device_id: str, reason: str = "") -> None:
+        """Mark the device offline + requeue its jobs — same effect as the
+        HTTP POST /v1/devices/offline side-channel (main.py:180-186)."""
+        try:
+            self._call(
+                self._report_offline,
+                pb.OfflineReport(device_id=device_id, reason=reason or "unreachable"),
+            )
+        except (ConnectionError, TerminalHTTPError):
+            log.warning("offline report for %s failed", device_id)
+
+    # -- control surface ---------------------------------------------------
+
+    def submit(
+        self,
+        kind: str,
+        payload: dict[str, Any] | None = None,
+        *,
+        priority: int = 0,
+        max_attempts: int = 0,
+        deadline_at: float = 0.0,
+    ) -> dict[str, Any]:
+        job = self._call(
+            self._submit,
+            pb.SubmitJobRequest(
+                kind=kind,
+                payload_json=json.dumps(payload or {}),
+                priority=priority,
+                max_attempts=max_attempts,
+                deadline_at=deadline_at,
+            ),
+        )
+        return self.job_to_dict(job)
+
+    def get(self, job_id: str) -> dict[str, Any]:
+        return self.job_to_dict(self._call(self._get, pb.JobRef(id=job_id)))
+
+    def stream(self, job_id: str, timeout_s: float = 120.0) -> Iterator[dict[str, Any]]:
+        try:
+            for j in self._stream(pb.JobRef(id=job_id), timeout=timeout_s):
+                d = self.job_to_dict(j)
+                yield d
+                if d["status"] in TERMINAL:
+                    return
+        except grpc.RpcError as e:
+            # same error mapping as every unary method (_call)
+            code = e.code()
+            if code in (
+                grpc.StatusCode.FAILED_PRECONDITION,
+                grpc.StatusCode.INVALID_ARGUMENT,
+                grpc.StatusCode.NOT_FOUND,
+            ):
+                raise TerminalHTTPError(self._http_status(code), e.details()) from e
+            raise ConnectionError(f"grpc {code.name}: {e.details()}") from e
+
+    def report_benchmark(
+        self,
+        device_id: str,
+        model_id: str,
+        task_type: str,
+        *,
+        tokens_in: int = 0,
+        tokens_out: int = 0,
+        latency_ms: float = 0.0,
+        tps: float = 0.0,
+    ) -> None:
+        self._call(
+            self._report_benchmark,
+            pb.Benchmark(
+                device_id=device_id,
+                model_id=model_id,
+                task_type=task_type,
+                tokens_in=tokens_in,
+                tokens_out=tokens_out,
+                latency_ms=latency_ms,
+                tps=tps,
+            ),
+        )

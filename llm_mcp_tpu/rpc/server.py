@@ -1,0 +1,269 @@
+"""gRPC core server: the worker protocol over gRPC.
+
+Parity: reference `core/internal/grpcserver/server.go` — 10 RPCs operating
+directly on the queue/catalog (never through the HTTP layer): SubmitJob
+(26-55), GetJob (57-63), StreamJob (65-96), RegisterWorker (98-124),
+ClaimJob (126-198), Heartbeat (200-215), CompleteJob (217-240), FailJob
+(242-274), ReportMetrics (276-300), ReportBenchmark (302-327).
+
+Improvements over the reference: StreamJob waits on the queue's update
+notification instead of blind 1 s polling (the reference's gRPC stream
+lacked the LISTEN path its HTTP SSE twin had, server.go:65-96); ClaimJob
+enforces the per-device concurrency cap that the reference's gRPC claim
+dropped (SURVEY C9 note).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from concurrent import futures
+from typing import Any, Callable
+
+import grpc
+
+from ..state.catalog import Catalog, record_benchmark_from_job
+from ..state.queue import Job, JobQueue
+from .pb import llm_mcp_tpu_pb2 as pb
+
+log = logging.getLogger("rpc.server")
+
+SERVICE_NAME = "llmmcptpu.v1.Core"
+TERMINAL = ("done", "error", "canceled")
+STREAM_MAX_S = 600.0  # same bound as the HTTP SSE twin (api/jobs.py SSE_MAX_S)
+
+
+def job_to_pb(job: Job) -> pb.Job:
+    return pb.Job(
+        id=job.id,
+        kind=job.kind,
+        status=job.status,
+        payload_json=json.dumps(job.payload or {}),
+        result_json=json.dumps(job.result) if job.result is not None else "",
+        error=job.error or "",
+        attempts=int(job.attempts),
+        max_attempts=int(job.max_attempts),
+        worker_id=job.worker_id or "",
+        device_id=job.device_id or "",
+        priority=int(job.priority),
+        created_at=float(job.created_at or 0),
+        updated_at=float(job.updated_at or 0),
+        lease_until=float(job.lease_until or 0),
+        deadline_at=float(job.deadline_at or 0),
+        started_at=float(job.started_at or 0),
+        finished_at=float(job.finished_at or 0),
+    )
+
+
+class GrpcCoreServer:
+    def __init__(
+        self,
+        queue: JobQueue,
+        catalog: Catalog,
+        *,
+        circuit: Any = None,  # routing.CircuitBreaker | None — shared with the API process
+        device_max_concurrency: int = 0,
+        default_lease_s: float = 30.0,
+        max_workers: int = 16,
+    ):
+        self.queue = queue
+        self.catalog = catalog
+        self.circuit = circuit
+        self.device_max_concurrency = device_max_concurrency
+        self.default_lease_s = default_lease_s
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        self._server.add_generic_rpc_handlers((self._make_handler(),))
+        self.port = 0
+
+    # -- service wiring (hand-rolled: no grpc_tools plugin in the env) -----
+
+    def _make_handler(self) -> grpc.GenericRpcHandler:
+        def unary(fn: Callable, req_cls) -> grpc.RpcMethodHandler:
+            return grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+
+        def stream(fn: Callable, req_cls) -> grpc.RpcMethodHandler:
+            return grpc.unary_stream_rpc_method_handler(
+                fn,
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+
+        handlers = {
+            "SubmitJob": unary(self.SubmitJob, pb.SubmitJobRequest),
+            "GetJob": unary(self.GetJob, pb.JobRef),
+            "StreamJob": stream(self.StreamJob, pb.JobRef),
+            "RegisterWorker": unary(self.RegisterWorker, pb.WorkerInfo),
+            "ClaimJob": unary(self.ClaimJob, pb.ClaimRequest),
+            "Heartbeat": unary(self.Heartbeat, pb.HeartbeatRequest),
+            "CompleteJob": unary(self.CompleteJob, pb.CompleteRequest),
+            "FailJob": unary(self.FailJob, pb.FailRequest),
+            "ReportMetrics": unary(self.ReportMetrics, pb.MetricsReport),
+            "ReportBenchmark": unary(self.ReportBenchmark, pb.Benchmark),
+            "ReportOffline": unary(self.ReportOffline, pb.OfflineReport),
+        }
+        return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, addr: str = "127.0.0.1:0") -> "GrpcCoreServer":
+        self.port = self._server.add_insecure_port(addr)
+        if self.port == 0:
+            # grpc signals bind failure by returning port 0 instead of raising
+            raise RuntimeError(f"grpc bind failed for {addr!r} (port in use or bad address)")
+        self._server.start()
+        log.info("grpc server on port %d", self.port)
+        return self
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace)
+
+    # -- RPCs --------------------------------------------------------------
+
+    def SubmitJob(self, req: pb.SubmitJobRequest, ctx) -> pb.Job:
+        try:
+            payload = json.loads(req.payload_json) if req.payload_json else {}
+        except json.JSONDecodeError:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, "payload_json is not valid JSON")
+        job = self.queue.submit(
+            req.kind or "generate",
+            payload,
+            priority=req.priority,
+            max_attempts=req.max_attempts or None,
+            deadline_at=req.deadline_at or None,
+        )
+        return job_to_pb(job)
+
+    def GetJob(self, req: pb.JobRef, ctx) -> pb.Job:
+        job = self.queue.get(req.id)
+        if job is None:
+            ctx.abort(grpc.StatusCode.NOT_FOUND, f"job {req.id} not found")
+        return job_to_pb(job)
+
+    def StreamJob(self, req: pb.JobRef, ctx):
+        """Push the job on every status change until terminal. Wakes on the
+        queue's update notification with a 15 s safety re-poll (the behavior
+        of the HTTP SSE path, handlers.go:543-577, which the reference's
+        gRPC stream lacked)."""
+        # version is read BEFORE the job state so an update racing the read
+        # makes the next wait return immediately instead of stalling.
+        version = self.queue.update_version
+        job = self.queue.get(req.id)
+        if job is None:
+            ctx.abort(grpc.StatusCode.NOT_FOUND, f"job {req.id} not found")
+        last_status = None
+        deadline = time.monotonic() + STREAM_MAX_S
+        while ctx.is_active() and time.monotonic() < deadline:
+            if job is None:
+                return  # job purged mid-stream
+            if job.status != last_status:
+                last_status = job.status
+                yield job_to_pb(job)
+                if job.status in TERMINAL:
+                    return
+            version = self.queue.wait_for_update(15.0, since=version)
+            job = self.queue.get(req.id)
+
+    def RegisterWorker(self, req: pb.WorkerInfo, ctx) -> pb.Ack:
+        if not req.worker_id:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, "worker_id required")
+        self.catalog.register_worker(req.worker_id, req.name, list(req.kinds))
+        return pb.Ack(ok=True, message="registered")
+
+    def ClaimJob(self, req: pb.ClaimRequest, ctx) -> pb.ClaimResponse:
+        if not req.worker_id:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, "worker_id required")
+        job = self.queue.claim(
+            req.worker_id,
+            kinds=list(req.kinds),
+            lease_seconds=req.lease_seconds or self.default_lease_s,
+            device_max_concurrency=self.device_max_concurrency,
+        )
+        self.catalog.worker_heartbeat(req.worker_id)
+        if job is None:
+            return pb.ClaimResponse(found=False)
+        return pb.ClaimResponse(found=True, job=job_to_pb(job))
+
+    def Heartbeat(self, req: pb.HeartbeatRequest, ctx) -> pb.Ack:
+        ok = self.queue.heartbeat(
+            req.job_id, req.worker_id, lease_seconds=req.lease_seconds or self.default_lease_s
+        )
+        self.catalog.worker_heartbeat(req.worker_id)
+        if not ok:
+            ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, "job not running under this worker")
+        return pb.Ack(ok=True)
+
+    def CompleteJob(self, req: pb.CompleteRequest, ctx) -> pb.Ack:
+        result = self._parse_json(req.result_json, ctx, "result_json")
+        metrics = self._parse_json(req.metrics_json, ctx, "metrics_json")
+        ok = self.queue.complete(req.job_id, req.worker_id, result=result, metrics=metrics)
+        if not ok:
+            ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, "job not running under this worker")
+        self._post_complete(req.job_id, ok=True)
+        return pb.Ack(ok=True)
+
+    def FailJob(self, req: pb.FailRequest, ctx) -> pb.FailResponse:
+        status = self.queue.fail(req.job_id, req.worker_id, req.error or "unknown error")
+        if status is None:
+            ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, "job not running under this worker")
+        self._post_complete(req.job_id, ok=False)
+        return pb.FailResponse(status=status)
+
+    def ReportMetrics(self, req: pb.MetricsReport, ctx) -> pb.Ack:
+        metrics = self._parse_json(req.metrics_json, ctx, "metrics_json")
+        self.catalog.record_device_metrics(req.device_id, metrics or {})
+        return pb.Ack(ok=True)
+
+    def ReportOffline(self, req: pb.OfflineReport, ctx) -> pb.Ack:
+        """Mark a device offline, open its breaker, and requeue its running
+        jobs — the gRPC twin of POST /v1/devices/offline (api/jobs.py
+        handle_devices_offline), so the gRPC transport is self-sufficient."""
+        if not req.device_id:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, "device_id required")
+        self.catalog.set_device_online(req.device_id, False)
+        if self.circuit is not None:
+            self.circuit.record(req.device_id, ok=False)
+        requeued = self.queue.requeue_device_jobs([req.device_id])
+        return pb.Ack(ok=True, message=f"requeued {requeued}")
+
+    def ReportBenchmark(self, req: pb.Benchmark, ctx) -> pb.Ack:
+        if not req.device_id or not req.model_id:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, "device_id and model_id required")
+        self.catalog.record_benchmark(
+            req.device_id,
+            req.model_id,
+            req.task_type or "generate",
+            tokens_in=int(req.tokens_in),
+            tokens_out=int(req.tokens_out),
+            latency_ms=float(req.latency_ms),
+            tps=float(req.tps),
+        )
+        return pb.Ack(ok=True)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _parse_json(self, text: str, ctx, field: str) -> dict[str, Any] | None:
+        if not text:
+            return None
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError:
+            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, f"{field} is not valid JSON")
+        return doc if isinstance(doc, dict) else {"value": doc}
+
+    def _post_complete(self, job_id: str, ok: bool) -> None:
+        """Side effects shared with the HTTP complete/fail path (api/jobs.py):
+        circuit-breaker recording for the job's device and benchmark-table
+        feeding for benchmark.* kinds — identical across transports."""
+        job = self.queue.get(job_id)
+        if job is None:
+            return
+        dev = job.payload.get("device_id") or job.device_id
+        if dev and self.circuit is not None:
+            self.circuit.record(str(dev), ok=ok)
+        if ok:
+            record_benchmark_from_job(self.catalog, job)

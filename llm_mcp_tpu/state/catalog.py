@@ -87,6 +87,29 @@ def infer_model_meta(name: str, params_b: float = 0.0) -> dict[str, Any]:
     }
 
 
+def record_benchmark_from_job(catalog: "Catalog", job: Any) -> None:
+    """benchmark.* job results feed the benchmarks table that routing ranks
+    by (`grpcserver/server.go:302-327`, `main.py:471-518`). Shared by the
+    HTTP and gRPC complete paths so model/device precedence never diverges
+    between transports (payload model wins over result model)."""
+    if not job.kind.startswith("benchmark.") or not job.result:
+        return
+    r = job.result
+    dev = str(job.payload.get("device_id") or job.device_id or "")
+    model = str(job.payload.get("model") or r.get("model") or "")
+    if not dev or not model:
+        return
+    catalog.record_benchmark(
+        dev,
+        model,
+        str(r.get("task_type") or job.kind.removeprefix("benchmark.")),
+        tokens_in=int(r.get("tokens_in") or 0),
+        tokens_out=int(r.get("tokens_out") or 0),
+        latency_ms=float(r.get("latency_ms") or 0),
+        tps=float(r.get("tps") or 0),
+    )
+
+
 class Catalog:
     def __init__(self, db: Database):
         self.db = db

@@ -113,20 +113,36 @@ class JobQueue:
         self.db = db
         self.default_max_attempts = default_max_attempts
         # Condition used by in-process waiters (claim long-poll, SSE bridge).
+        # _version is a monotonically increasing update counter: waiters pass
+        # the version they last observed so an update landing between their
+        # re-poll and their wait is never lost (no 15 s stall).
         self._cond = threading.Condition()
+        self._version = 0
 
     # -- notify ------------------------------------------------------------
 
     def _notify(self, job_id: str) -> None:
         self.db.notify(JOB_UPDATE_CHANNEL, job_id)
         with self._cond:
+            self._version += 1
             self._cond.notify_all()
 
-    def wait_for_update(self, timeout: float) -> bool:
-        """Block until any job status changes (or timeout). In-process analog
-        of `LISTEN job_update` + WaitForNotification (`handlers.go:543-577`)."""
+    @property
+    def update_version(self) -> int:
         with self._cond:
-            return self._cond.wait(timeout)
+            return self._version
+
+    def wait_for_update(self, timeout: float, since: int | None = None) -> int:
+        """Block until any job status changes (or timeout); returns the
+        current update version. When `since` is given and an update already
+        happened after it, returns immediately — the lost-wakeup-free
+        pattern. In-process analog of `LISTEN job_update` +
+        WaitForNotification (`handlers.go:543-577`)."""
+        with self._cond:
+            if since is not None and self._version != since:
+                return self._version
+            self._cond.wait(timeout)
+            return self._version
 
     # -- submit ------------------------------------------------------------
 

@@ -1,0 +1,7 @@
+#!/bin/sh
+# Regenerate gRPC message stubs. Service wiring is hand-rolled (rpc/server.py,
+# rpc/client.py) — only message classes are generated.
+set -e
+cd "$(dirname "$0")/.."
+protoc --python_out=. proto/llm_mcp_tpu.proto
+mv proto/llm_mcp_tpu_pb2.py llm_mcp_tpu/rpc/pb/llm_mcp_tpu_pb2.py

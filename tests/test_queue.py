@@ -162,16 +162,27 @@ def test_notify_on_transitions(queue, db):
 
 def test_wait_for_update(queue):
     got = []
+    v0 = queue.update_version
 
     def waiter():
-        got.append(queue.wait_for_update(timeout=5.0))
+        got.append(queue.wait_for_update(timeout=5.0, since=v0))
 
     t = threading.Thread(target=waiter)
     t.start()
     time.sleep(0.05)
     queue.submit("echo", {})
     t.join(timeout=2.0)
-    assert got == [True]
+    assert got == [v0 + 1]
+
+
+def test_wait_for_update_no_lost_wakeup(queue):
+    # an update that lands BEFORE the wait returns immediately via `since`
+    v0 = queue.update_version
+    queue.submit("echo", {})
+    t0 = time.monotonic()
+    v1 = queue.wait_for_update(timeout=5.0, since=v0)
+    assert time.monotonic() - t0 < 1.0
+    assert v1 == v0 + 1
 
 
 def test_purge_stale(queue, db):
