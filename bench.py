@@ -41,11 +41,17 @@ def main() -> None:
 
     from functools import partial
 
+    from llm_mcp_tpu.kernels.attention import pallas_supported, resolve_attn_impl
+
+    impl = resolve_attn_impl() if pallas_supported(S, cfg.resolved_head_dim) else "xla"
+
     @partial(jax.jit, donate_argnums=(1, 2))
     def decode_chunk(params, ck, cv, tokens, lengths, rng):
         def step(carry, _):
             ck, cv, toks, lens, rng = carry
-            logits, ck, cv = llama_decode_step(cfg, params, ck, cv, toks, lens)
+            logits, ck, cv = llama_decode_step(
+                cfg, params, ck, cv, toks, lens, attn_impl=impl
+            )
             rng, sub = jax.random.split(rng)
             new = sample_tokens(
                 logits,

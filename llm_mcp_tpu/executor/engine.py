@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..kernels.attention import pallas_supported, resolve_attn_impl
 from ..models.configs import ModelConfig, get_config
 from ..models.llama import (
     init_llama_params,
@@ -107,6 +108,16 @@ class GenerationEngine:
         self.decode_chunk = decode_chunk
         self.tokenizer: Tokenizer = tokenizer or load_tokenizer(weights_dir)
 
+        # Fused Pallas attention on a single chip; sharded meshes keep the
+        # einsum path (GSPMD partitions it) until the shard_map kernel wrap
+        # lands alongside the ring-attention long-context path.
+        hd = self.cfg.resolved_head_dim
+        self.attn_impl = (
+            resolve_attn_impl()
+            if mesh is None and pallas_supported(max_seq_len, hd)
+            else "xla"
+        )
+
         if params is None:
             params = init_llama_params(self.cfg, jax.random.PRNGKey(seed), dtype=dtype)
         if mesh is not None:
@@ -152,15 +163,17 @@ class GenerationEngine:
 
         self._sample1 = sample1
 
+        impl = self.attn_impl
+
         # jax.jit caches one executable per input shape, so prompt buckets
         # (power-of-two padded) each compile once without any manual cache.
         @jax.jit
         def prefill_fn(params, tokens, lengths):
-            return llama_prefill(cfg_, params, tokens, lengths)
+            return llama_prefill(cfg_, params, tokens, lengths, attn_impl=impl)
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def insert_fn(ck, cv, ks, vs, slot):
-            # ks/vs: [L, 1, bucket, Hkv, hd] → write at [:, slot, :bucket];
+            # ks/vs: [L, 1, Hkv, bucket, hd] → write at [:, slot, :, :bucket];
             # `slot` is a traced scalar, so one executable serves all slots.
             ck = jax.lax.dynamic_update_slice(ck, ks.astype(ck.dtype), (0, slot, 0, 0, 0))
             cv = jax.lax.dynamic_update_slice(cv, vs.astype(cv.dtype), (0, slot, 0, 0, 0))
@@ -186,12 +199,15 @@ class GenerationEngine:
         cfg = self.cfg
         K = self.decode_chunk
         mask = self._allowed_mask
+        impl = self.attn_impl
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def decode_chunk_fn(params, ck, cv, tokens, lengths, rng, temp, topk, topp):
             def step(carry, _):
                 ck, cv, toks, lens, rng = carry
-                logits, ck, cv = llama_decode_step(cfg, params, ck, cv, toks, lens)
+                logits, ck, cv = llama_decode_step(
+                    cfg, params, ck, cv, toks, lens, attn_impl=impl
+                )
                 if mask is not None:
                     logits = jnp.where(mask, logits, -jnp.inf)
                 rng, sub = jax.random.split(rng)

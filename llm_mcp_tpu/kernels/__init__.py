@@ -1,0 +1,13 @@
+from .attention import (
+    flash_prefill_attention,
+    decode_attention,
+    pallas_supported,
+    resolve_attn_impl,
+)
+
+__all__ = [
+    "flash_prefill_attention",
+    "decode_attention",
+    "pallas_supported",
+    "resolve_attn_impl",
+]

@@ -1,0 +1,268 @@
+"""Pallas TPU attention kernels for the serving hot loop.
+
+The reference streams tokens computed by an external llama.cpp process
+(`core/internal/api/handlers.go:2427-2587` proxies Ollama); its hot loop is a
+line scanner. Here the hot loop is attention over the KV cache, so it gets
+hand-written TPU kernels:
+
+  - `flash_prefill_attention` — causal flash attention for prompt prefill.
+    Online-softmax over key blocks: scores never materialize in HBM, VMEM
+    holds one [BQ, BK] tile at a time, the two matmuls hit the MXU at
+    [128, 128] granularity.
+  - `decode_attention` — single-position GQA attention over the cache for
+    the continuous batch. Bandwidth-bound: the win is streaming K/V through
+    VMEM exactly once per step in their native [S, hd] tiling and fusing
+    mask + softmax + weighted sum, with the f32 score tile living only in
+    VMEM.
+
+Layout contract (chosen for TPU tiling — (sublane, lane) = trailing dims):
+
+  q (prefill)  [B, H,   S, hd]
+  k/v, cache   [B, Hkv, S, hd]     # S×hd trailing → native (8/16, 128) tiles
+  q (decode)   [B, Hkv, G, hd]     # G = H // Hkv query heads per KV head
+  lengths      [B] int32           # valid positions per slot/row
+
+This is why the engine cache is [L, B, Hkv, S, hd] (heads BEFORE sequence):
+a [.., S, 1, hd] block would tile as (1, 128) sublane-padded 8×, wasting
+most of the HBM bandwidth the decode step is bound by.
+
+Both kernels auto-fall back to interpret mode off-TPU so the full test suite
+exercises them on the CPU backend (tests/conftest.py forces JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fail on some CPU-only builds; interpret mode needs pl only
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+
+def _smem_spec() -> pl.BlockSpec:
+    """Whole-array spec for the [B] lengths input: SMEM on TPU (scalar reads
+    drive masking), memory-space-agnostic under interpret mode off-TPU."""
+    if _HAS_PLTPU:
+        return pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.BlockSpec(memory_space=pl.ANY)  # pragma: no cover
+
+NEG_INF = float(-1e30)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def pallas_supported(seq_len: int, head_dim: int) -> bool:
+    """Static (trace-time) eligibility for the Pallas path."""
+    if os.environ.get("LLM_MCP_TPU_ATTN", "auto") == "xla":
+        return False
+    if head_dim % 128 != 0 and head_dim not in (32, 64):
+        return False
+    if seq_len >= 128:
+        return seq_len % 128 == 0
+    return seq_len & (seq_len - 1) == 0  # pow2 buckets below one block
+
+
+def resolve_attn_impl(mesh=None) -> str:
+    """Pick the attention implementation at trace time.
+
+    env LLM_MCP_TPU_ATTN: auto (default) | pallas | xla.
+    auto → pallas on TPU, xla elsewhere (CPU tests exercise the kernels in
+    interpret mode by passing attn_impl="pallas" / LLM_MCP_TPU_ATTN=pallas
+    explicitly — see tests/test_kernels.py).
+    """
+    mode = os.environ.get("LLM_MCP_TPU_ATTN", "auto")
+    if mode in ("pallas", "xla"):
+        return mode
+    return "pallas" if _on_tpu() else "xla"
+
+
+def _interpret() -> bool:
+    return not _on_tpu()
+
+
+# ---------------------------------------------------------------------------
+# Prefill: causal flash attention
+# ---------------------------------------------------------------------------
+
+
+def _flash_prefill_kernel(
+    lengths_ref,  # [B] int32 (scalar prefetch, SMEM)
+    q_ref,  # [1, 1, BQ, hd]
+    k_ref,  # [1, 1, S, hd]
+    v_ref,  # [1, 1, S, hd]
+    o_ref,  # [1, 1, BQ, hd]
+    *,
+    scale: float,
+    block_k: int,
+    seq_len: int,
+):
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+    bq = q_ref.shape[2]
+    hd = q_ref.shape[3]
+    valid_len = lengths_ref[b]
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [BQ, hd]
+    q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)  # [BQ, 1]
+
+    acc = jnp.zeros((bq, hd), dtype=jnp.float32)
+    m = jnp.full((bq, 1), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((bq, 1), dtype=jnp.float32)
+
+    # Causal: key block kb only matters while kb*BK <= last q position.
+    n_kb = jnp.minimum((qi * bq + bq + block_k - 1) // block_k, seq_len // block_k)
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1
+        )  # [1, BK]
+        mask = (k_pos <= q_pos) & (k_pos < valid_len)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc, m_new, l
+
+    acc, m, l = jax.lax.fori_loop(0, n_kb, body, (acc, m, l))
+    # l == 0 only when a row saw no unmasked key (valid_len == 0, or a q
+    # block entirely before any valid key) — emit 0 instead of 0/0 NaN.
+    # Padding rows with valid_len > 0 still attend the valid prefix and
+    # produce harmless garbage the caller never reads (it slices by length).
+    out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+def flash_prefill_attention(
+    q: jnp.ndarray,  # [B, H, S, hd]
+    k: jnp.ndarray,  # [B, Hkv, S, hd]
+    v: jnp.ndarray,  # [B, Hkv, S, hd]
+    lengths: jnp.ndarray,  # [B] int32
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Causal + length-masked GQA flash attention. Returns [B, H, S, hd]."""
+    B, H, S, hd = q.shape
+    Hkv = k.shape[1]
+    G = H // Hkv
+    bq = min(block_q, S)
+    bk = min(block_k, S)
+    assert S % bq == 0 and S % bk == 0, (S, bq, bk)
+    interp = _interpret() if interpret is None else interpret
+
+    kernel = functools.partial(
+        _flash_prefill_kernel, scale=hd**-0.5, block_k=bk, seq_len=S
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(B, H, S // bq),
+        in_specs=[
+            _smem_spec(),  # lengths [B]
+            pl.BlockSpec((1, 1, bq, hd), lambda b, h, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, S, hd), lambda b, h, qi: (b, h // G, 0, 0)),
+            pl.BlockSpec((1, 1, S, hd), lambda b, h, qi: (b, h // G, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, hd), lambda b, h, qi: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
+        interpret=interp,
+    )(lengths.astype(jnp.int32), q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Decode: one-position GQA attention over the KV cache
+# ---------------------------------------------------------------------------
+
+
+def _decode_attn_kernel(
+    lengths_ref,  # [B] int32 (scalar prefetch)
+    q_ref,  # [1, 1, G, hd]
+    k_ref,  # [1, 1, S, hd]
+    v_ref,  # [1, 1, S, hd]
+    o_ref,  # [1, 1, G, hd]
+    *,
+    scale: float,
+):
+    b = pl.program_id(0)
+    valid_len = lengths_ref[b]  # attend to positions 0..valid_len inclusive
+    S = k_ref.shape[2]
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, hd]
+    k = k_ref[0, 0].astype(jnp.float32)  # [S, hd]
+    v = v_ref[0, 0].astype(jnp.float32)
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [G, S]
+    pos = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+    s = jnp.where(pos <= valid_len, s, NEG_INF)
+
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    ctx = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [G, hd]
+    o_ref[0, 0] = (ctx / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def decode_attention(
+    q: jnp.ndarray,  # [B, Hkv, G, hd]
+    cache_k: jnp.ndarray,  # [B, Hkv, S, hd]
+    cache_v: jnp.ndarray,  # [B, Hkv, S, hd]
+    lengths: jnp.ndarray,  # [B] int32 — current write position (inclusive)
+    *,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Batched single-step attention. Returns [B, Hkv, G, hd].
+
+    The caller has already written this step's K/V at `lengths[b]`; the
+    kernel attends over positions ≤ lengths[b]. Whole-S tiles stream through
+    VMEM once; for cache capacities beyond VMEM (≳16K positions at hd=128)
+    the sequence-parallel ring path (parallel/ring.py) shards S instead.
+    """
+    B, Hkv, G, hd = q.shape
+    S = cache_k.shape[2]
+    interp = _interpret() if interpret is None else interpret
+
+    kernel = functools.partial(_decode_attn_kernel, scale=hd**-0.5)
+    return pl.pallas_call(
+        kernel,
+        grid=(B, Hkv),
+        in_specs=[
+            _smem_spec(),  # lengths [B]
+            pl.BlockSpec((1, 1, G, hd), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S, hd), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S, hd), lambda b, h: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, hd), lambda b, h: (b, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype),
+        interpret=interp,
+    )(lengths.astype(jnp.int32), q, cache_k, cache_v)

@@ -10,15 +10,19 @@ with an in-process model. TPU-first choices:
   - **Static shapes everywhere**: batch = engine slots, sequence = cache
     capacity; per-slot progress is carried in `lengths` (int32) and masking,
     never in array shapes — so jit compiles once per (batch, bucket).
-  - **GQA attention as einsum** over the KV cache with length masking; XLA maps
-    the contractions onto the MXU and fuses the mask/softmax elementwise work.
+  - **KV cache layout [L, B, Hkv, S, hd]**: heads before sequence so the
+    trailing (S, hd) dims match native TPU (sublane, lane) tiling — the
+    Pallas kernels stream K/V at full HBM bandwidth (kernels/attention.py).
   - **bfloat16 weights/activations, float32 softmax and logits.**
   - Sampling is fused into the decode step (see ops/sampling.py) so only [B]
     token ids leave the device per step.
+  - `attn_impl="pallas"` routes attention through the fused flash kernels;
+    "xla" uses einsum contractions (GQA) that XLA maps onto the MXU. Both
+    paths share every other op, and tests assert they agree.
 
 Layout conventions:
   params["layers"][name]: [L, ...] stacked weights
-  KV cache: k, v: [L, B, S, H_kv, Dh]
+  KV cache: k, v: [L, B, Hkv, S, hd]
 """
 
 from __future__ import annotations
@@ -28,6 +32,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from ..kernels.attention import decode_attention, flash_prefill_attention
 from ..ops.norms import rms_norm as _rms_norm
 from ..ops.rope import rope_frequencies, apply_rope
 from .configs import ModelConfig
@@ -78,7 +83,7 @@ def init_kv_cache(
     cfg: ModelConfig, batch: int, max_seq: int, dtype: jnp.dtype = jnp.bfloat16
 ) -> dict[str, jnp.ndarray]:
     hd = cfg.resolved_head_dim
-    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, hd)
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_seq, hd)
     return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
 
 
@@ -93,10 +98,11 @@ def llama_prefill(
     params: Params,
     tokens: jnp.ndarray,  # [B, S] int32 (right-padded prompts)
     lengths: jnp.ndarray,  # [B] int32 true prompt lengths
+    attn_impl: str = "xla",
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Causal self-attention over fresh prompts (no past KV).
 
-    Returns (last_logits [B, V] f32, k [L, B, S, Hkv, Dh], v [...]) — the
+    Returns (last_logits [B, V] f32, k [L, B, Hkv, S, Dh], v [...]) — the
     prompt KV to be inserted into the engine cache at the request's slot.
     """
     B, S = tokens.shape
@@ -124,19 +130,28 @@ def llama_prefill(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
-        qg = q.reshape(B, S, Hkv, G, hd)
-        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32)
-        scores = scores * (hd**-0.5)
-        scores = jnp.where(mask[:, None, None, :, :], scores, neg)
-        probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
-        ctx = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(B, S, H * hd)
+        # Cache layout: heads before sequence (see module docstring).
+        kh = k.transpose(0, 2, 1, 3)  # [B, Hkv, S, hd]
+        vh = v.transpose(0, 2, 1, 3)
+
+        if attn_impl == "pallas":
+            qh = q.transpose(0, 2, 1, 3)  # [B, H, S, hd]
+            ctx = flash_prefill_attention(qh, kh, vh, lengths)
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, H * hd)
+        else:
+            qg = q.reshape(B, S, Hkv, G, hd)
+            scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32)
+            scores = scores * (hd**-0.5)
+            scores = jnp.where(mask[:, None, None, :, :], scores, neg)
+            probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+            ctx = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(B, S, H * hd)
         h = h + jnp.einsum("bse,ed->bsd", ctx, lp["wo"])
 
         x = _rms_norm(h, lp["ffn_norm"], cfg.norm_eps)
         gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", x, lp["w1"]))
         up = jnp.einsum("bsd,df->bsf", x, lp["w3"])
         h = h + jnp.einsum("bsf,fd->bsd", gate * up, lp["w2"])
-        return h, (k, v)
+        return h, (kh, vh)
 
     h, (ks, vs) = jax.lax.scan(layer, h, params["layers"])
 
@@ -149,10 +164,11 @@ def llama_prefill(
 def llama_decode_step(
     cfg: ModelConfig,
     params: Params,
-    cache_k: jnp.ndarray,  # [L, B, S, Hkv, Dh]
+    cache_k: jnp.ndarray,  # [L, B, Hkv, S, Dh]
     cache_v: jnp.ndarray,
     tokens: jnp.ndarray,  # [B] int32 — last emitted token per slot
     lengths: jnp.ndarray,  # [B] int32 — position to write (tokens already in cache)
+    attn_impl: str = "xla",
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One batched autoregressive step for all slots.
 
@@ -161,20 +177,22 @@ def llama_decode_step(
     Inactive slots simply produce garbage logits that the engine ignores —
     keeping the step shape-static (no data-dependent control flow under jit).
     """
-    L, B, S, Hkv, hd = cache_k.shape
+    L, B, Hkv, S, hd = cache_k.shape
     H = cfg.n_heads
     G = H // Hkv
 
     h = params["embed"][tokens]  # [B, D]
     cos, sin = rope_frequencies(hd, cfg.rope_theta, lengths)  # [B, hd/2]
 
-    batch_idx = jnp.arange(B)
+    b_idx = jnp.arange(B)[:, None]  # [B, 1]
+    h_idx = jnp.arange(Hkv)[None, :]  # [1, Hkv]
+    w_idx = lengths[:, None]  # [B, 1] — broadcast with h_idx to [B, Hkv]
     key_pos = jnp.arange(S)[None, :]  # [1, S]
     attn_mask = key_pos <= lengths[:, None]  # [B, S]
     neg = jnp.float32(-1e30)
 
     def layer(h, xs):
-        lp, ck, cv = xs  # ck, cv: [B, S, Hkv, hd]
+        lp, ck, cv = xs  # ck, cv: [B, Hkv, S, hd]
         x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
         q = (x @ lp["wq"]).reshape(B, H, hd)
         k = (x @ lp["wk"]).reshape(B, Hkv, hd)
@@ -182,15 +200,18 @@ def llama_decode_step(
         q = apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]  # [B, H, hd]
         k = apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
 
-        ck = ck.at[batch_idx, lengths].set(k.astype(ck.dtype))
-        cv = cv.at[batch_idx, lengths].set(v.astype(cv.dtype))
+        ck = ck.at[b_idx, h_idx, w_idx].set(k.astype(ck.dtype))
+        cv = cv.at[b_idx, h_idx, w_idx].set(v.astype(cv.dtype))
 
         qg = q.reshape(B, Hkv, G, hd)
-        scores = jnp.einsum("bhgd,bshd->bhgs", qg, ck).astype(jnp.float32)
-        scores = scores * (hd**-0.5)
-        scores = jnp.where(attn_mask[:, None, None, :], scores, neg)
-        probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
-        ctx = jnp.einsum("bhgs,bshd->bhgd", probs, cv).reshape(B, H * hd)
+        if attn_impl == "pallas":
+            ctx = decode_attention(qg, ck, cv, lengths).reshape(B, H * hd)
+        else:
+            scores = jnp.einsum("bhgd,bhsd->bhgs", qg, ck).astype(jnp.float32)
+            scores = scores * (hd**-0.5)
+            scores = jnp.where(attn_mask[:, None, None, :], scores, neg)
+            probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+            ctx = jnp.einsum("bhgs,bhsd->bhgd", probs, cv).reshape(B, H * hd)
         h = h + ctx @ lp["wo"]
 
         x = _rms_norm(h, lp["ffn_norm"], cfg.norm_eps)

@@ -9,7 +9,7 @@ collectives:
   w2       [L, F, D]:    shard F on tp → psum after down-projection
   embed    [V, D]:       shard vocab on tp (vocab-parallel logits; top-k/argmax
                          over the sharded vocab axis gathers only [B, k])
-  KV cache [L, B, S, Hkv, hd]: heads on tp, batch slots on dp
+  KV cache [L, B, Hkv, S, hd]: heads on tp, batch slots on dp
 
 GQA note: Llama-3.1-8B has 8 KV heads — exactly one per chip on a v5e-8 TP
 mesh; Q heads (32) shard 4-per-chip. No KV replication needed up to tp=8.
@@ -65,7 +65,8 @@ def embedder_param_specs(cfg: ModelConfig) -> dict[str, Any]:
 
 
 def kv_cache_specs() -> dict[str, P]:
-    return {"k": P(None, "dp", None, "tp", None), "v": P(None, "dp", None, "tp", None)}
+    # [L, B, Hkv, S, hd] — batch slots on dp, KV heads on tp.
+    return {"k": P(None, "dp", "tp", None, None), "v": P(None, "dp", "tp", None, None)}
 
 
 def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:

@@ -81,14 +81,20 @@ class GrpcCoreClient:
         try:
             return fn(req, timeout=self.timeout_s)
         except grpc.RpcError as e:
-            code = e.code()
-            if code in (
-                grpc.StatusCode.FAILED_PRECONDITION,
-                grpc.StatusCode.INVALID_ARGUMENT,
-                grpc.StatusCode.NOT_FOUND,
-            ):
-                raise TerminalHTTPError(self._http_status(code), e.details()) from e
-            raise ConnectionError(f"grpc {code.name}: {e.details()}") from e
+            raise self._map_error(e) from e
+
+    @staticmethod
+    def _map_error(e: grpc.RpcError) -> Exception:
+        """Terminal codes → TerminalHTTPError (worker must not retry);
+        everything else → ConnectionError (retryable transport failure)."""
+        code = e.code()
+        if code in (
+            grpc.StatusCode.FAILED_PRECONDITION,
+            grpc.StatusCode.INVALID_ARGUMENT,
+            grpc.StatusCode.NOT_FOUND,
+        ):
+            return TerminalHTTPError(GrpcCoreClient._http_status(code), e.details())
+        return ConnectionError(f"grpc {code.name}: {e.details()}")
 
     @staticmethod
     def _http_status(code: grpc.StatusCode) -> int:
@@ -196,15 +202,7 @@ class GrpcCoreClient:
                 if d["status"] in TERMINAL:
                     return
         except grpc.RpcError as e:
-            # same error mapping as every unary method (_call)
-            code = e.code()
-            if code in (
-                grpc.StatusCode.FAILED_PRECONDITION,
-                grpc.StatusCode.INVALID_ARGUMENT,
-                grpc.StatusCode.NOT_FOUND,
-            ):
-                raise TerminalHTTPError(self._http_status(code), e.details()) from e
-            raise ConnectionError(f"grpc {code.name}: {e.details()}") from e
+            raise self._map_error(e) from e
 
     def report_benchmark(
         self,

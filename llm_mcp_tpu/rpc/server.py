@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 import time
 from concurrent import futures
 from typing import Any, Callable
@@ -75,6 +76,10 @@ class GrpcCoreServer:
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
         self._server.add_generic_rpc_handlers((self._make_handler(),))
         self.port = 0
+        # Long-lived StreamJob handlers each pin an executor thread; cap them
+        # to half the pool so Claim/Heartbeat/Complete always have threads
+        # (16 parked streams would otherwise starve heartbeats → lease loss).
+        self._stream_slots = threading.BoundedSemaphore(max(1, max_workers // 2))
 
     # -- service wiring (hand-rolled: no grpc_tools plugin in the env) -----
 
@@ -155,18 +160,27 @@ class GrpcCoreServer:
         job = self.queue.get(req.id)
         if job is None:
             ctx.abort(grpc.StatusCode.NOT_FOUND, f"job {req.id} not found")
-        last_status = None
-        deadline = time.monotonic() + STREAM_MAX_S
-        while ctx.is_active() and time.monotonic() < deadline:
-            if job is None:
-                return  # job purged mid-stream
-            if job.status != last_status:
-                last_status = job.status
-                yield job_to_pb(job)
-                if job.status in TERMINAL:
-                    return
-            version = self.queue.wait_for_update(15.0, since=version)
-            job = self.queue.get(req.id)
+        if not self._stream_slots.acquire(blocking=False):
+            # Stream capacity exhausted: degrade to a one-shot status snapshot
+            # (clients re-poll GetJob / re-open the stream) instead of parking
+            # another executor thread.
+            yield job_to_pb(job)
+            return
+        try:
+            last_status = None
+            deadline = time.monotonic() + STREAM_MAX_S
+            while ctx.is_active() and time.monotonic() < deadline:
+                if job is None:
+                    return  # job purged mid-stream
+                if job.status != last_status:
+                    last_status = job.status
+                    yield job_to_pb(job)
+                    if job.status in TERMINAL:
+                        return
+                version = self.queue.wait_for_update(15.0, since=version)
+                job = self.queue.get(req.id)
+        finally:
+            self._stream_slots.release()
 
     def RegisterWorker(self, req: pb.WorkerInfo, ctx) -> pb.Ack:
         if not req.worker_id:

@@ -1,0 +1,112 @@
+"""Pallas kernel correctness: flash prefill and decode attention vs the XLA
+einsum reference path (interpret mode on the CPU test backend)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_mcp_tpu.kernels.attention import (
+    flash_prefill_attention,
+    decode_attention,
+    pallas_supported,
+)
+from llm_mcp_tpu.models import (
+    get_config,
+    init_llama_params,
+    init_kv_cache,
+    llama_prefill,
+    llama_decode_step,
+)
+
+CFG = get_config("tiny-llm")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_llama_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def _ref_attention(q, k, v, lengths, causal):
+    """[B, H, S, hd] x [B, Hkv, S, hd] dense-masked reference in f64-ish f32."""
+    B, H, S, hd = q.shape
+    Hkv = k.shape[1]
+    G = H // Hkv
+    qg = q.reshape(B, Hkv, G, S, hd).astype(jnp.float32)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k.astype(jnp.float32)) * (hd**-0.5)
+    kpos = jnp.arange(S)[None, None, None, None, :]
+    mask = kpos < lengths[:, None, None, None, None]
+    if causal:
+        qpos = jnp.arange(S)[None, None, None, :, None]
+        mask = mask & (kpos <= qpos)
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows → zero output (matches kernel's l==0 guard)
+    any_valid = mask.any(axis=-1, keepdims=True)
+    p = jnp.where(any_valid, p, 0.0)
+    out = jnp.einsum("bhgqk,bhkd->bhgqd", p, v.astype(jnp.float32))
+    return out.reshape(B, H, S, hd)
+
+
+def test_flash_prefill_matches_reference():
+    key = jax.random.PRNGKey(1)
+    B, H, Hkv, S, hd = 2, 4, 2, 64, 32
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, H, S, hd), dtype=jnp.float32)
+    k = jax.random.normal(kk, (B, Hkv, S, hd), dtype=jnp.float32)
+    v = jax.random.normal(kv, (B, Hkv, S, hd), dtype=jnp.float32)
+    lengths = jnp.array([64, 37], dtype=jnp.int32)
+
+    out = flash_prefill_attention(q, k, v, lengths, block_q=32, block_k=32)
+    ref = _ref_attention(q, k, v, lengths, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_decode_attention_matches_reference():
+    key = jax.random.PRNGKey(2)
+    B, Hkv, G, S, hd = 3, 2, 2, 32, 32
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, Hkv, G, hd), dtype=jnp.float32)
+    ck = jax.random.normal(kk, (B, Hkv, S, hd), dtype=jnp.float32)
+    cv = jax.random.normal(kv, (B, Hkv, S, hd), dtype=jnp.float32)
+    lengths = jnp.array([0, 7, 31], dtype=jnp.int32)
+
+    out = decode_attention(q, ck, cv, lengths)  # [B, Hkv, G, hd]
+
+    s = jnp.einsum("bhgd,bhsd->bhgs", q, ck) * (hd**-0.5)
+    mask = jnp.arange(S)[None, None, None, :] <= lengths[:, None, None, None]
+    s = jnp.where(mask, s, -1e30)
+    ref = jnp.einsum("bhgs,bhsd->bhgd", jax.nn.softmax(s, axis=-1), cv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_llama_prefill_pallas_matches_xla(params):
+    toks = jax.random.randint(jax.random.PRNGKey(3), (2, 32), 3, CFG.vocab_size)
+    lengths = jnp.array([32, 19], dtype=jnp.int32)
+    lx, kx, vx = llama_prefill(CFG, params, toks, lengths, attn_impl="xla")
+    lp, kp, vp = llama_prefill(CFG, params, toks, lengths, attn_impl="pallas")
+    np.testing.assert_allclose(np.asarray(lx), np.asarray(lp), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(kx), np.asarray(kp), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(vx), np.asarray(vp), rtol=1e-4, atol=1e-4)
+
+
+def test_llama_decode_pallas_matches_xla(params):
+    cache = init_kv_cache(CFG, batch=2, max_seq=16, dtype=jnp.float32)
+    toks = jnp.array([5, 9], dtype=jnp.int32)
+    # nonzero lengths: pre-populate via a tiny prefill into slot 0
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 4), 3, CFG.vocab_size)
+    _, ks, vs = llama_prefill(CFG, params, prompt, jnp.array([4], dtype=jnp.int32))
+    ck = cache["k"].at[:, 0:1, :, :4].set(ks)
+    cv = cache["v"].at[:, 0:1, :, :4].set(vs)
+    lens = jnp.array([4, 0], dtype=jnp.int32)
+
+    lx, ckx, cvx = llama_decode_step(CFG, params, ck, cv, toks, lens, attn_impl="xla")
+    lp, ckp, cvp = llama_decode_step(CFG, params, ck, cv, toks, lens, attn_impl="pallas")
+    np.testing.assert_allclose(np.asarray(lx), np.asarray(lp), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(ckx), np.asarray(ckp), rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_supported_gates():
+    assert pallas_supported(128, 64)
+    assert pallas_supported(64, 128)
+    assert not pallas_supported(100, 128)  # ragged seq len

@@ -38,8 +38,8 @@ def test_decode_matches_prefill(params):
     _, ks6, vs6 = llama_prefill(CFG, params, prompt[:, :6], l6)
     cache = init_kv_cache(CFG, batch=2, max_seq=16, dtype=jnp.float32)
     # insert prompt KV into slot 1
-    ck = cache["k"].at[:, 1:2, :6].set(ks6)
-    cv = cache["v"].at[:, 1:2, :6].set(vs6)
+    ck = cache["k"].at[:, 1:2, :, :6].set(ks6)
+    cv = cache["v"].at[:, 1:2, :, :6].set(vs6)
     tok = jnp.array([0, int(prompt[0, 6])], dtype=jnp.int32)
     lens = jnp.array([0, 6], dtype=jnp.int32)
     step_logits, _, _ = llama_decode_step(CFG, params, ck, cv, tok, lens)
