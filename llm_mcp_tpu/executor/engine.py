@@ -108,14 +108,9 @@ class GenerationEngine:
         self.decode_chunk = decode_chunk
         self.tokenizer: Tokenizer = tokenizer or load_tokenizer(weights_dir)
 
-        # Fused Pallas attention on a single chip; sharded meshes keep the
-        # einsum path (GSPMD partitions it) until the shard_map kernel wrap
-        # lands alongside the ring-attention long-context path.
         hd = self.cfg.resolved_head_dim
         self.attn_impl = (
-            resolve_attn_impl()
-            if mesh is None and pallas_supported(max_seq_len, hd)
-            else "xla"
+            resolve_attn_impl(mesh) if pallas_supported(max_seq_len, hd) else "xla"
         )
 
         if params is None:

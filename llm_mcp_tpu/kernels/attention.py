@@ -80,13 +80,17 @@ def resolve_attn_impl(mesh=None) -> str:
     """Pick the attention implementation at trace time.
 
     env LLM_MCP_TPU_ATTN: auto (default) | pallas | xla.
-    auto → pallas on TPU, xla elsewhere (CPU tests exercise the kernels in
-    interpret mode by passing attn_impl="pallas" / LLM_MCP_TPU_ATTN=pallas
-    explicitly — see tests/test_kernels.py).
+    auto → pallas on a single TPU chip, xla elsewhere: sharded meshes keep
+    the einsum path (GSPMD partitions it) until the shard_map kernel wrap
+    lands alongside the ring-attention long-context path. CPU tests exercise
+    the kernels in interpret mode by passing attn_impl="pallas" /
+    LLM_MCP_TPU_ATTN=pallas explicitly — see tests/test_kernels.py.
     """
     mode = os.environ.get("LLM_MCP_TPU_ATTN", "auto")
     if mode in ("pallas", "xla"):
         return mode
+    if mesh is not None and mesh.size > 1:
+        return "xla"
     return "pallas" if _on_tpu() else "xla"
 
 
@@ -140,7 +144,10 @@ def _flash_prefill_kernel(
         s = jnp.where(mask, s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # Mask p explicitly: a fully-masked row keeps m_new == NEG_INF, where
+        # exp(s - m_new) == 1 would silently average V; masked p keeps l == 0
+        # so the guard below emits 0 for such rows.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(
@@ -149,10 +156,9 @@ def _flash_prefill_kernel(
         return acc, m_new, l
 
     acc, m, l = jax.lax.fori_loop(0, n_kb, body, (acc, m, l))
-    # l == 0 only when a row saw no unmasked key (valid_len == 0, or a q
-    # block entirely before any valid key) — emit 0 instead of 0/0 NaN.
-    # Padding rows with valid_len > 0 still attend the valid prefix and
-    # produce harmless garbage the caller never reads (it slices by length).
+    # l == 0 when a row saw no unmasked key (valid_len == 0) — emit 0
+    # instead of 0/0 NaN. Padding rows with valid_len > 0 still attend the
+    # valid prefix and produce garbage the caller never reads.
     out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
     o_ref[0, 0] = out.astype(o_ref.dtype)
 

@@ -1,5 +1,11 @@
 from .mesh import make_mesh, mesh_axis_sizes
 from .sharding import llama_param_specs, kv_cache_specs, embedder_param_specs, shard_pytree
+from .ring import (
+    ring_attention_local,
+    ulysses_attention_local,
+    sp_prefill_attention,
+    llama_prefill_sp,
+)
 
 __all__ = [
     "make_mesh",
@@ -8,4 +14,8 @@ __all__ = [
     "kv_cache_specs",
     "embedder_param_specs",
     "shard_pytree",
+    "ring_attention_local",
+    "ulysses_attention_local",
+    "sp_prefill_attention",
+    "llama_prefill_sp",
 ]
