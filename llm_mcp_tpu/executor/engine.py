@@ -31,6 +31,7 @@ the aiohttp layer bridges to SSE without head-of-line blocking.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -45,6 +46,7 @@ import numpy as np
 
 from ..kernels.attention import pallas_supported, resolve_attn_impl
 from ..models.configs import ModelConfig, get_config
+from ..models.weights import load_llama_checkpoint
 from ..models.llama import (
     init_llama_params,
     init_kv_cache,
@@ -59,6 +61,12 @@ from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
 log = logging.getLogger("engine")
 
 _DONE = object()
+
+
+def _has_safetensors(weights_dir: str) -> bool:
+    return bool(weights_dir) and os.path.isdir(weights_dir) and any(
+        f.endswith(".safetensors") for f in os.listdir(weights_dir)
+    )
 
 
 @dataclass
@@ -113,10 +121,15 @@ class GenerationEngine:
             resolve_attn_impl(mesh) if pallas_supported(max_seq_len, hd) else "xla"
         )
 
-        if params is None:
-            params = init_llama_params(self.cfg, jax.random.PRNGKey(seed), dtype=dtype)
-        if mesh is not None:
-            params = shard_pytree(params, llama_param_specs(self.cfg), mesh)
+        if params is None and _has_safetensors(weights_dir):
+            # Real checkpoint: stream safetensors shards straight into
+            # (sharded) HBM — already placed, no re-shard needed.
+            params = load_llama_checkpoint(self.cfg, weights_dir, dtype=dtype, mesh=mesh)
+        else:
+            if params is None:
+                params = init_llama_params(self.cfg, jax.random.PRNGKey(seed), dtype=dtype)
+            if mesh is not None:
+                params = shard_pytree(params, llama_param_specs(self.cfg), mesh)
         self.params = params
 
         cache = init_kv_cache(self.cfg, max_slots, max_seq_len, dtype=dtype)

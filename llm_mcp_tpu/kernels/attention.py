@@ -86,11 +86,13 @@ def resolve_attn_impl(mesh=None) -> str:
     the kernels in interpret mode by passing attn_impl="pallas" /
     LLM_MCP_TPU_ATTN=pallas explicitly — see tests/test_kernels.py.
     """
+    if mesh is not None and mesh.size > 1:
+        # Sharded mesh: the unwrapped pallas_call must not trace over GSPMD
+        # inputs, even when LLM_MCP_TPU_ATTN=pallas is set.
+        return "xla"
     mode = os.environ.get("LLM_MCP_TPU_ATTN", "auto")
     if mode in ("pallas", "xla"):
         return mode
-    if mesh is not None and mesh.size > 1:
-        return "xla"
     return "pallas" if _on_tpu() else "xla"
 
 

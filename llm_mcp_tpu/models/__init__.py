@@ -1,8 +1,28 @@
 from .configs import ModelConfig, MODEL_CONFIGS, get_config
 from .llama import init_llama_params, llama_prefill, llama_decode_step, init_kv_cache
 from .embedder import init_embedder_params, embed_forward
+from .weights import (
+    read_safetensors,
+    write_safetensors,
+    read_checkpoint_dir,
+    hf_to_llama_params,
+    llama_to_hf_tensors,
+    load_llama_checkpoint,
+    place_params,
+    save_native,
+    load_native,
+)
 
 __all__ = [
+    "read_safetensors",
+    "write_safetensors",
+    "read_checkpoint_dir",
+    "hf_to_llama_params",
+    "llama_to_hf_tensors",
+    "load_llama_checkpoint",
+    "place_params",
+    "save_native",
+    "load_native",
     "ModelConfig",
     "MODEL_CONFIGS",
     "get_config",

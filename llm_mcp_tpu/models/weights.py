@@ -1,0 +1,319 @@
+"""Checkpoint loading: safetensors → sharded HBM, plus native save/restore.
+
+The reference never touches model weights — they live inside external Ollama
+servers and "loading a model" is an HTTP-side effect (`discovery.go:482-560`
+just catalogs names). In the TPU-native build, weight I/O is a real
+subsystem:
+
+  - **safetensors reader/writer** in pure numpy: the format is an 8-byte
+    little-endian header length + JSON header + raw tensor bytes, so a
+    dependency-free mmap reader is ~60 lines and never copies more than one
+    tensor at a time. BF16 is handled via `ml_dtypes` (ships with JAX).
+  - **HF name mapping**: `model.layers.{i}.self_attn.q_proj.weight`-style
+    checkpoints are re-laid-out into this framework's scan-friendly stacked
+    tree (`params["layers"]["wq"]: [L, D, H·hd]`, see models/llama.py). HF
+    linears are [out, in]; ours are [in, out] (activations are row vectors),
+    so every projection transposes on load.
+  - **Sharded placement**: with a mesh, each mapped leaf is `device_put` with
+    its `NamedSharding` from parallel/sharding.py — weights stream from host
+    RAM straight into sharded HBM; no chip ever materializes the full 8B
+    tree.
+  - **Native checkpoints** via orbax (`save_native`/`load_native`) for
+    engine-produced artifacts (quantized/re-laid-out weights), with an npz
+    fallback when orbax is unavailable.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import struct
+from typing import Any, Callable
+
+import numpy as np
+
+from .configs import ModelConfig
+
+try:  # ml_dtypes ships with jax; gives numpy a real bfloat16 dtype.
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+# safetensors dtype tag ↔ numpy dtype
+_ST_DTYPES: dict[str, np.dtype] = {
+    "F64": np.dtype("<f8"),
+    "F32": np.dtype("<f4"),
+    "F16": np.dtype("<f2"),
+    "I64": np.dtype("<i8"),
+    "I32": np.dtype("<i4"),
+    "I16": np.dtype("<i2"),
+    "I8": np.dtype("i1"),
+    "U8": np.dtype("u1"),
+    "BOOL": np.dtype("?"),
+}
+if _BF16 is not None:
+    _ST_DTYPES["BF16"] = _BF16
+
+
+def _np_to_st_dtype(dt: np.dtype) -> str:
+    for tag, nd in _ST_DTYPES.items():
+        if nd == dt:
+            return tag
+    raise ValueError(f"unsupported dtype for safetensors: {dt}")
+
+
+def read_safetensors(path: str) -> dict[str, np.ndarray]:
+    """Read every tensor from one .safetensors file (zero-copy mmap views)."""
+    with open(path, "rb") as f:
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen).decode("utf-8"))
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    base = 8 + hlen
+    out: dict[str, np.ndarray] = {}
+    for name, spec in header.items():
+        if name == "__metadata__":
+            continue
+        dt = _ST_DTYPES.get(spec["dtype"])
+        if dt is None:
+            raise ValueError(f"{path}: tensor {name} has unsupported dtype {spec['dtype']}")
+        b, e = spec["data_offsets"]
+        arr = np.frombuffer(mm, dtype=dt, count=(e - b) // dt.itemsize, offset=base + b)
+        out[name] = arr.reshape(spec["shape"])
+    return out
+
+
+def write_safetensors(path: str, tensors: dict[str, np.ndarray]) -> None:
+    """Write tensors to one .safetensors file (for tests and re-export)."""
+    header: dict[str, Any] = {}
+    offset = 0
+    blobs: list[bytes] = []
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        blob = arr.tobytes()
+        header[name] = {
+            "dtype": _np_to_st_dtype(arr.dtype),
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + len(blob)],
+        }
+        offset += len(blob)
+        blobs.append(blob)
+    hjson = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    # Pad header to 8-byte alignment (spec allows trailing spaces).
+    pad = (8 - (len(hjson) % 8)) % 8
+    hjson += b" " * pad
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hjson)))
+        f.write(hjson)
+        for blob in blobs:
+            f.write(blob)
+
+
+def read_checkpoint_dir(ckpt_dir: str) -> dict[str, np.ndarray]:
+    """Merge all *.safetensors shards in a directory (HF multi-shard layout)."""
+    files = sorted(
+        os.path.join(ckpt_dir, f)
+        for f in os.listdir(ckpt_dir)
+        if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {ckpt_dir}")
+    tensors: dict[str, np.ndarray] = {}
+    for f in files:
+        tensors.update(read_safetensors(f))
+    return tensors
+
+
+# ---------------------------------------------------------------------------
+# HF llama-family name mapping → stacked scan layout
+# ---------------------------------------------------------------------------
+
+# (our layer key, HF suffix, transpose?) — HF stores linears [out, in].
+_LLAMA_LAYER_MAP = [
+    ("attn_norm", "input_layernorm.weight", False),
+    ("wq", "self_attn.q_proj.weight", True),
+    ("wk", "self_attn.k_proj.weight", True),
+    ("wv", "self_attn.v_proj.weight", True),
+    ("wo", "self_attn.o_proj.weight", True),
+    ("ffn_norm", "post_attention_layernorm.weight", False),
+    ("w1", "mlp.gate_proj.weight", True),
+    ("w3", "mlp.up_proj.weight", True),
+    ("w2", "mlp.down_proj.weight", True),
+]
+
+
+def hf_to_llama_params(
+    cfg: ModelConfig,
+    tensors: dict[str, np.ndarray],
+    *,
+    prefix: str = "model.",
+) -> dict[str, Any]:
+    """Re-layout an HF llama/qwen-style checkpoint into the stacked tree.
+
+    Returns numpy arrays (host RAM); cast + placement happen in
+    `place_params`. Raises KeyError with the missing tensor name on an
+    incomplete checkpoint.
+    """
+
+    def get(name: str) -> np.ndarray:
+        if name not in tensors:
+            raise KeyError(f"checkpoint missing tensor {name!r}")
+        return tensors[name]
+
+    L = cfg.n_layers
+    layers: dict[str, np.ndarray] = {}
+    for ours, suffix, transpose in _LLAMA_LAYER_MAP:
+        per_layer = []
+        for i in range(L):
+            t = get(f"{prefix}layers.{i}.{suffix}")
+            per_layer.append(t.T if transpose else t)
+        layers[ours] = np.stack(per_layer, axis=0)
+
+    params: dict[str, Any] = {
+        "embed": get(f"{prefix}embed_tokens.weight"),
+        "layers": layers,
+        "final_norm": get(f"{prefix}norm.weight"),
+    }
+    if not cfg.tie_embeddings:
+        lm = tensors.get("lm_head.weight")
+        if lm is None:  # some exports tie silently — fall back to embed
+            lm = params["embed"]
+        params["lm_head"] = lm.T
+    return params
+
+
+def llama_to_hf_tensors(
+    cfg: ModelConfig, params: dict[str, Any], *, prefix: str = "model."
+) -> dict[str, np.ndarray]:
+    """Inverse of `hf_to_llama_params` (for re-export / roundtrip tests)."""
+    out: dict[str, np.ndarray] = {
+        f"{prefix}embed_tokens.weight": np.asarray(params["embed"]),
+        f"{prefix}norm.weight": np.asarray(params["final_norm"]),
+    }
+    for ours, suffix, transpose in _LLAMA_LAYER_MAP:
+        stacked = np.asarray(params["layers"][ours])
+        for i in range(cfg.n_layers):
+            t = stacked[i]
+            out[f"{prefix}layers.{i}.{suffix}"] = t.T if transpose else t
+    if not cfg.tie_embeddings and "lm_head" in params:
+        out["lm_head.weight"] = np.asarray(params["lm_head"]).T
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Device placement (optionally sharded)
+# ---------------------------------------------------------------------------
+
+
+def place_params(
+    params: Any,
+    *,
+    dtype: Any = None,
+    mesh: Any = None,
+    specs: Any = None,
+) -> Any:
+    """Cast host arrays and put them on device — sharded when a mesh is given.
+
+    Each leaf goes straight to its final `NamedSharding`; XLA transfers only
+    the owned shard bytes per device, so a v5e chip never needs host→HBM room
+    for the whole tree.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.tree_util import tree_map
+
+    if mesh is not None and specs is not None:
+        flat_specs = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: hasattr(x, "index") or x is None
+        )
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        placed = []
+        for leaf, spec in zip(flat, flat_specs):
+            arr = jnp.asarray(leaf, dtype=dtype) if dtype is not None else jnp.asarray(leaf)
+            placed.append(jax.device_put(arr, NamedSharding(mesh, spec)))
+        return jax.tree_util.tree_unflatten(treedef, placed)
+    cast: Callable[[Any], Any] = (
+        (lambda x: jnp.asarray(x, dtype=dtype)) if dtype is not None else jnp.asarray
+    )
+    return tree_map(cast, params)
+
+
+def load_llama_checkpoint(
+    cfg: ModelConfig,
+    ckpt_dir: str,
+    *,
+    dtype: Any = None,
+    mesh: Any = None,
+) -> Any:
+    """One-call load: HF safetensors dir → (sharded) device param tree."""
+    tensors = read_checkpoint_dir(ckpt_dir)
+    host = hf_to_llama_params(cfg, tensors)
+    specs = None
+    if mesh is not None:
+        from ..parallel.sharding import llama_param_specs
+
+        specs = llama_param_specs(cfg)
+    return place_params(host, dtype=dtype, mesh=mesh, specs=specs)
+
+
+# ---------------------------------------------------------------------------
+# Native checkpoints (orbax, npz fallback)
+# ---------------------------------------------------------------------------
+
+
+def save_native(path: str, params: Any) -> str:
+    """Persist a param tree. Orbax layout when available, else a flat npz.
+
+    Returns the path actually written (orbax writes a directory, npz a file
+    with `.npz` appended)."""
+    path = os.path.abspath(path)
+    try:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(path, params, force=True)
+        ckptr.wait_until_finished()
+        return path
+    except ModuleNotFoundError:  # pragma: no cover
+        flat = _flatten("", params)
+        np.savez(path, **{k: np.asarray(v) for k, v in flat.items()})
+        return path + ".npz"
+
+
+def load_native(
+    path: str, *, dtype: Any = None, mesh: Any = None, specs: Any = None
+) -> Any:
+    """Restore a tree written by `save_native`, optionally sharding it."""
+    path = os.path.abspath(path)
+    if os.path.isdir(path):
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.StandardCheckpointer()
+        params = ckptr.restore(path)
+    else:
+        npz = np.load(path if path.endswith(".npz") else path + ".npz")
+        params = _unflatten(dict(npz))
+    return place_params(params, dtype=dtype, mesh=mesh, specs=specs)
+
+
+def _flatten(prefix: str, tree: Any) -> dict[str, Any]:
+    if isinstance(tree, dict):
+        out: dict[str, Any] = {}
+        for k, v in tree.items():
+            out.update(_flatten(f"{prefix}{k}/", v))
+        return out
+    return {prefix[:-1]: tree}
+
+
+def _unflatten(flat: dict[str, Any]) -> Any:
+    tree: dict[str, Any] = {}
+    for key, val in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return tree
