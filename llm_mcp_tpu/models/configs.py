@@ -27,6 +27,10 @@ class ModelConfig:
     rope_theta: float = 500_000.0
     norm_eps: float = 1e-5
     max_seq_len: int = 131_072
+    # MoE fields (0 experts → dense FFN)
+    n_experts: int = 0
+    experts_per_tok: int = 2
+    capacity_factor: float = 1.25
     # encoder-only fields
     pooling: str = "mean"  # mean | cls
     embed_dim: int = 0  # output embedding dim (0 → dim)
@@ -41,11 +45,14 @@ class ModelConfig:
     def param_count(self) -> int:
         """Approximate parameter count (embedding + layers + head)."""
         hd = self.resolved_head_dim
+        ffn = 3 * self.dim * self.ffn_hidden
+        if self.n_experts:
+            ffn = self.n_experts * ffn + self.dim * self.n_experts  # experts + router
         per_layer = (
             self.dim * self.n_heads * hd  # wq
             + 2 * self.dim * self.n_kv_heads * hd  # wk, wv
             + self.n_heads * hd * self.dim  # wo
-            + 3 * self.dim * self.ffn_hidden  # w1, w2, w3
+            + ffn
             + 2 * self.dim  # norms
         )
         embed = self.vocab_size * self.dim
@@ -95,6 +102,41 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         rope_theta=10_000.0,
         max_seq_len=512,
         params_b=0.001,
+        tie_embeddings=True,
+    ),
+    # Mixtral 8x7B per the published architecture (32 layers, 4096 dim,
+    # 32/8 GQA heads, 14336 expert FFN, 8 experts top-2, 32k vocab).
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b",
+        vocab_size=32_000,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_hidden=14_336,
+        rope_theta=1_000_000.0,
+        max_seq_len=32_768,
+        n_experts=8,
+        experts_per_tok=2,
+        params_b=46.7,
+    ),
+    # Tiny MoE config for tests / CPU dev — same code paths, toy sizes.
+    "tiny-moe": ModelConfig(
+        name="tiny-moe",
+        vocab_size=512,
+        dim=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_hidden=256,
+        rope_theta=10_000.0,
+        max_seq_len=512,
+        n_experts=4,
+        experts_per_tok=2,
+        # E/k = 2.0 ⇒ capacity = T: dropless even at prefill, so tests can
+        # assert decode == prefill == pipelined prefill bit-for-bit.
+        capacity_factor=2.0,
+        params_b=0.002,
         tie_embeddings=True,
     ),
     "nomic-embed-text": ModelConfig(

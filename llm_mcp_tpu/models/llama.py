@@ -36,6 +36,7 @@ from ..kernels.attention import decode_attention, flash_prefill_attention
 from ..ops.norms import rms_norm as _rms_norm
 from ..ops.rope import rope_frequencies, apply_rope
 from .configs import ModelConfig
+from .moe import init_moe_layer_params, moe_ffn
 
 Params = dict[str, Any]
 
@@ -59,19 +60,27 @@ def init_llama_params(
     def w(k, shape, fan_in):
         return (jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in**-0.5)).astype(dtype)
 
+    layers: Params = {
+        "attn_norm": jnp.ones((L, D), dtype=dtype),
+        "wq": w(keys[1], (L, D, H * hd), D),
+        "wk": w(keys[2], (L, D, Hkv * hd), D),
+        "wv": w(keys[3], (L, D, Hkv * hd), D),
+        "wo": w(keys[4], (L, H * hd, D), H * hd),
+        "ffn_norm": jnp.ones((L, D), dtype=dtype),
+    }
+    if cfg.n_experts:
+        layers.update(init_moe_layer_params(cfg, keys[5], dtype))
+    else:
+        layers.update(
+            {
+                "w1": w(keys[5], (L, D, F), D),
+                "w3": w(keys[6], (L, D, F), D),
+                "w2": w(keys[7], (L, F, D), F),
+            }
+        )
     params: Params = {
         "embed": w(keys[0], (V, D), D),
-        "layers": {
-            "attn_norm": jnp.ones((L, D), dtype=dtype),
-            "wq": w(keys[1], (L, D, H * hd), D),
-            "wk": w(keys[2], (L, D, Hkv * hd), D),
-            "wv": w(keys[3], (L, D, Hkv * hd), D),
-            "wo": w(keys[4], (L, H * hd, D), H * hd),
-            "ffn_norm": jnp.ones((L, D), dtype=dtype),
-            "w1": w(keys[5], (L, D, F), D),
-            "w3": w(keys[6], (L, D, F), D),
-            "w2": w(keys[7], (L, F, D), F),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((D,), dtype=dtype),
     }
     if not cfg.tie_embeddings:
@@ -93,6 +102,71 @@ def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
     return jnp.einsum("...d,dv->...v", h, head).astype(jnp.float32)
 
 
+def prefill_masks(
+    cfg: ModelConfig, S: int, lengths: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(cos [1,S,hd/2], sin, mask [B,S,S]) shared by all prefill layers."""
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :]  # [1, S]
+    cos, sin = rope_frequencies(cfg.resolved_head_dim, cfg.rope_theta, positions)
+    # Causal + padding mask, computed once: [B, S, S] would be big at long S,
+    # so use [1, S, S] causal and fold padding via key-validity [B, 1, S].
+    causal = jnp.tril(jnp.ones((S, S), dtype=bool))[None]  # [1, S, S]
+    valid_k = (jnp.arange(S)[None, :] < lengths[:, None])[:, None, :]  # [B, 1, S]
+    return cos, sin, causal & valid_k
+
+
+def prefill_layer(
+    cfg: ModelConfig,
+    lp: Params,  # this layer's weights (un-stacked)
+    h: jnp.ndarray,  # [B, S, D]
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    mask: jnp.ndarray,  # [B, S, S]
+    lengths: jnp.ndarray,  # [B]
+    attn_impl: str = "xla",
+) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray]]:
+    """One decoder layer over a full prompt. Shared by the scan in
+    `llama_prefill` and the stage loop in parallel/pipeline.py."""
+    B, S, _ = h.shape
+    hd = cfg.resolved_head_dim
+    H, Hkv = cfg.n_heads, cfg.n_kv_heads
+    G = H // Hkv
+    neg = jnp.float32(-1e30)
+
+    x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,de->bse", x, lp["wq"]).reshape(B, S, H, hd)
+    k = jnp.einsum("bsd,de->bse", x, lp["wk"]).reshape(B, S, Hkv, hd)
+    v = jnp.einsum("bsd,de->bse", x, lp["wv"]).reshape(B, S, Hkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    # Cache layout: heads before sequence (see module docstring).
+    kh = k.transpose(0, 2, 1, 3)  # [B, Hkv, S, hd]
+    vh = v.transpose(0, 2, 1, 3)
+
+    if attn_impl == "pallas":
+        qh = q.transpose(0, 2, 1, 3)  # [B, H, S, hd]
+        ctx = flash_prefill_attention(qh, kh, vh, lengths)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, H * hd)
+    else:
+        qg = q.reshape(B, S, Hkv, G, hd)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32)
+        scores = scores * (hd**-0.5)
+        scores = jnp.where(mask[:, None, None, :, :], scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+        ctx = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(B, S, H * hd)
+    h = h + jnp.einsum("bse,ed->bsd", ctx, lp["wo"])
+
+    x = _rms_norm(h, lp["ffn_norm"], cfg.norm_eps)
+    if cfg.n_experts:
+        h = h + moe_ffn(cfg, lp, x.reshape(B * S, -1)).reshape(B, S, -1)
+    else:
+        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", x, lp["w1"]))
+        up = jnp.einsum("bsd,df->bsf", x, lp["w3"])
+        h = h + jnp.einsum("bsf,fd->bsd", gate * up, lp["w2"])
+    return h, (kh, vh)
+
+
 def llama_prefill(
     cfg: ModelConfig,
     params: Params,
@@ -106,52 +180,11 @@ def llama_prefill(
     prompt KV to be inserted into the engine cache at the request's slot.
     """
     B, S = tokens.shape
-    hd = cfg.resolved_head_dim
-    H, Hkv = cfg.n_heads, cfg.n_kv_heads
-    G = H // Hkv
-
     h = params["embed"][tokens]  # [B, S, D]
-    positions = jnp.arange(S, dtype=jnp.int32)[None, :]  # [1, S]
-    cos, sin = rope_frequencies(hd, cfg.rope_theta, positions)  # [1, S, hd/2]
+    cos, sin, mask = prefill_masks(cfg, S, lengths)
 
-    # Causal + padding mask, computed once: [B, S, S] would be big at long S,
-    # so use [1, S, S] causal and fold padding via key-validity [B, 1, S].
-    causal = jnp.tril(jnp.ones((S, S), dtype=bool))[None]  # [1, S, S]
-    valid_k = (jnp.arange(S)[None, :] < lengths[:, None])[:, None, :]  # [B, 1, S]
-    mask = causal & valid_k  # [B, S, S]
-    neg = jnp.float32(-1e30)
-
-    def layer(h, xs):
-        lp = xs
-        x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bsd,de->bse", x, lp["wq"]).reshape(B, S, H, hd)
-        k = jnp.einsum("bsd,de->bse", x, lp["wk"]).reshape(B, S, Hkv, hd)
-        v = jnp.einsum("bsd,de->bse", x, lp["wv"]).reshape(B, S, Hkv, hd)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-
-        # Cache layout: heads before sequence (see module docstring).
-        kh = k.transpose(0, 2, 1, 3)  # [B, Hkv, S, hd]
-        vh = v.transpose(0, 2, 1, 3)
-
-        if attn_impl == "pallas":
-            qh = q.transpose(0, 2, 1, 3)  # [B, H, S, hd]
-            ctx = flash_prefill_attention(qh, kh, vh, lengths)
-            ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, H * hd)
-        else:
-            qg = q.reshape(B, S, Hkv, G, hd)
-            scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32)
-            scores = scores * (hd**-0.5)
-            scores = jnp.where(mask[:, None, None, :, :], scores, neg)
-            probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
-            ctx = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(B, S, H * hd)
-        h = h + jnp.einsum("bse,ed->bsd", ctx, lp["wo"])
-
-        x = _rms_norm(h, lp["ffn_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", x, lp["w1"]))
-        up = jnp.einsum("bsd,df->bsf", x, lp["w3"])
-        h = h + jnp.einsum("bsf,fd->bsd", gate * up, lp["w2"])
-        return h, (kh, vh)
+    def layer(h, lp):
+        return prefill_layer(cfg, lp, h, cos, sin, mask, lengths, attn_impl)
 
     h, (ks, vs) = jax.lax.scan(layer, h, params["layers"])
 
@@ -215,9 +248,12 @@ def llama_decode_step(
         h = h + ctx @ lp["wo"]
 
         x = _rms_norm(h, lp["ffn_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(x @ lp["w1"])
-        up = x @ lp["w3"]
-        h = h + (gate * up) @ lp["w2"]
+        if cfg.n_experts:
+            h = h + moe_ffn(cfg, lp, x, capacity=B)  # dropless at decode
+        else:
+            gate = jax.nn.silu(x @ lp["w1"])
+            up = x @ lp["w3"]
+            h = h + (gate * up) @ lp["w2"]
         return h, (ck, cv)
 
     h, (new_k, new_v) = jax.lax.scan(layer, h, (params["layers"], cache_k, cache_v))

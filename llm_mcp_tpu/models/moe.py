@@ -1,0 +1,117 @@
+"""Mixture-of-Experts FFN with GShard-style capacity dispatch (TPU-first).
+
+The reference has no MoE (no model execution at all — Ollama serves Mixtral
+et al. as opaque names in the catalog, `discovery.go:526-551`). Here MoE is a
+real sharded subsystem so Mixtral-class models run in-process.
+
+TPU-first design choices:
+
+  - **Dense dispatch via one-hot matmuls** (Switch/GShard formulation): the
+    token→expert routing is expressed as two einsums against a [T, E, C]
+    dispatch tensor instead of gather/scatter — everything is static-shaped,
+    maps onto the MXU, and GSPMD turns the dispatch einsums into the
+    all-to-all when experts are sharded on the `ep` mesh axis.
+  - **Stacked expert weights** `[L, E, D, F]`: one batched matmul per layer
+    (`ecd,edf->ecf`) instead of E separate matmuls — large MXU tiles, and the
+    `E` dim shards cleanly with `P("ep")`.
+  - **Capacity-bounded**: each expert processes at most C tokens per step
+    (`C = ceil(T·k/E · capacity_factor)`); overflow tokens are dropped from
+    that expert (their gate mass is simply lost, residual carries them) —
+    the standard trade that keeps shapes static under jit.
+  - Router math in float32 (softmax over expert logits), expert FFN in the
+    model dtype.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .configs import ModelConfig
+
+
+def expert_capacity(cfg: ModelConfig, n_tokens: int) -> int:
+    """Static per-expert token capacity for a T-token step."""
+    c = math.ceil(n_tokens * cfg.experts_per_tok / cfg.n_experts * cfg.capacity_factor)
+    return max(1, min(c, n_tokens))
+
+
+def moe_dispatch(
+    cfg: ModelConfig, router_logits: jnp.ndarray, capacity: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Build (dispatch [T, E, C] model-dtype 0/1, combine [T, E, C] f32 gates).
+
+    Top-k routing with normalized gates; position-in-expert assigned by
+    cumulative count with slot-0 priority (GShard), tokens beyond capacity
+    dropped.
+    """
+    T, E = router_logits.shape
+    k = cfg.experts_per_tok
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)  # [T, E]
+    top_g, top_i = jax.lax.top_k(probs, k)  # [T, k]
+    top_g = top_g / jnp.sum(top_g, axis=-1, keepdims=True)  # renormalize gates
+
+    dispatch = jnp.zeros((T, E, capacity), dtype=jnp.float32)
+    combine = jnp.zeros((T, E, capacity), dtype=jnp.float32)
+    prev_count = jnp.zeros((E,), dtype=jnp.int32)
+    for j in range(k):  # k is tiny and static (1-2 typically)
+        mask_j = jax.nn.one_hot(top_i[:, j], E, dtype=jnp.int32)  # [T, E]
+        pos_j = jnp.cumsum(mask_j, axis=0) - 1 + prev_count[None, :]  # [T, E]
+        prev_count = prev_count + jnp.sum(mask_j, axis=0)
+        keep = (pos_j < capacity) & (mask_j > 0)  # [T, E]
+        slot = jax.nn.one_hot(jnp.clip(pos_j, 0, capacity - 1), capacity)  # [T,E,C]
+        sel = jnp.where(keep[..., None], slot, 0.0)
+        dispatch = dispatch + sel
+        combine = combine + sel * top_g[:, j][:, None, None]
+    return dispatch, combine
+
+
+def moe_ffn(
+    cfg: ModelConfig, lp: dict[str, Any], x: jnp.ndarray, capacity: int | None = None
+) -> jnp.ndarray:
+    """Sparse FFN over flattened tokens x: [T, D] → [T, D].
+
+    lp holds this layer's "router" [D, E], "w1e"/"w3e" [E, D, F],
+    "w2e" [E, F, D] (sliced from the stacked [L, ...] tree by the caller's
+    scan). With `P("ep")` on the E dim, GSPMD inserts the token all-to-all
+    around the batched expert matmuls.
+
+    `capacity=T` makes the layer dropless — decode passes this (a [B, E, B]
+    dispatch over engine slots is tiny, and dropping tokens at decode time
+    would silently degrade generations); prefill uses the capacity factor to
+    bound the batched expert matmul at large T.
+    """
+    T, D = x.shape
+    C = capacity if capacity is not None else expert_capacity(cfg, T)
+    logits = jnp.einsum("td,de->te", x, lp["router"])  # router in f32 below
+    dispatch, combine = moe_dispatch(cfg, logits, C)
+
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)  # [E, C, D]
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, lp["w1e"]))
+    up = jnp.einsum("ecd,edf->ecf", xe, lp["w3e"])
+    ye = jnp.einsum("ecf,efd->ecd", gate * up, lp["w2e"])  # [E, C, D]
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), ye)  # [T, D]
+    return y
+
+
+def init_moe_layer_params(
+    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype
+) -> dict[str, jnp.ndarray]:
+    """Stacked [L, ...] MoE weights for every layer (Mixtral-style all-MoE)."""
+    L, D, E, F = cfg.n_layers, cfg.dim, cfg.n_experts, cfg.ffn_hidden
+    keys = jax.random.split(key, 4)
+
+    def w(k, shape, fan_in):
+        return (
+            jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in**-0.5)
+        ).astype(dtype)
+
+    return {
+        "router": w(keys[0], (L, D, E), D),
+        "w1e": w(keys[1], (L, E, D, F), D),
+        "w3e": w(keys[2], (L, E, D, F), D),
+        "w2e": w(keys[3], (L, E, F, D), F),
+    }

@@ -144,13 +144,22 @@ _LLAMA_LAYER_MAP = [
 ]
 
 
+# Mixtral-style MoE layers: router + per-expert w1/w2/w3 (HF [out, in]).
+_MOE_GATE = "block_sparse_moe.gate.weight"
+
+
+def _moe_suffix(e: int, w: str) -> str:
+    return f"block_sparse_moe.experts.{e}.{w}.weight"
+
+
 def hf_to_llama_params(
     cfg: ModelConfig,
     tensors: dict[str, np.ndarray],
     *,
     prefix: str = "model.",
 ) -> dict[str, Any]:
-    """Re-layout an HF llama/qwen-style checkpoint into the stacked tree.
+    """Re-layout an HF llama/qwen/mixtral-style checkpoint into the stacked
+    tree.
 
     Returns numpy arrays (host RAM); cast + placement happen in
     `place_params`. Raises KeyError with the missing tensor name on an
@@ -163,13 +172,34 @@ def hf_to_llama_params(
         return tensors[name]
 
     L = cfg.n_layers
+    layer_map = _LLAMA_LAYER_MAP
+    if cfg.n_experts:
+        layer_map = [m for m in _LLAMA_LAYER_MAP if m[0] not in ("w1", "w3", "w2")]
     layers: dict[str, np.ndarray] = {}
-    for ours, suffix, transpose in _LLAMA_LAYER_MAP:
+    for ours, suffix, transpose in layer_map:
         per_layer = []
         for i in range(L):
             t = get(f"{prefix}layers.{i}.{suffix}")
             per_layer.append(t.T if transpose else t)
         layers[ours] = np.stack(per_layer, axis=0)
+    if cfg.n_experts:
+        layers["router"] = np.stack(
+            [get(f"{prefix}layers.{i}.{_MOE_GATE}").T for i in range(L)], axis=0
+        )  # [L, D, E]
+        for ours, hf_w in (("w1e", "w1"), ("w2e", "w2"), ("w3e", "w3")):
+            layers[ours] = np.stack(
+                [
+                    np.stack(
+                        [
+                            get(f"{prefix}layers.{i}.{_moe_suffix(e, hf_w)}").T
+                            for e in range(cfg.n_experts)
+                        ],
+                        axis=0,
+                    )
+                    for i in range(L)
+                ],
+                axis=0,
+            )  # [L, E, in, out]
 
     params: dict[str, Any] = {
         "embed": get(f"{prefix}embed_tokens.weight"),
@@ -192,11 +222,22 @@ def llama_to_hf_tensors(
         f"{prefix}embed_tokens.weight": np.asarray(params["embed"]),
         f"{prefix}norm.weight": np.asarray(params["final_norm"]),
     }
-    for ours, suffix, transpose in _LLAMA_LAYER_MAP:
+    layer_map = _LLAMA_LAYER_MAP
+    if cfg.n_experts:
+        layer_map = [m for m in _LLAMA_LAYER_MAP if m[0] not in ("w1", "w3", "w2")]
+    for ours, suffix, transpose in layer_map:
         stacked = np.asarray(params["layers"][ours])
         for i in range(cfg.n_layers):
             t = stacked[i]
             out[f"{prefix}layers.{i}.{suffix}"] = t.T if transpose else t
+    if cfg.n_experts:
+        router = np.asarray(params["layers"]["router"])  # [L, D, E]
+        for i in range(cfg.n_layers):
+            out[f"{prefix}layers.{i}.{_MOE_GATE}"] = router[i].T
+            for ours, hf_w in (("w1e", "w1"), ("w2e", "w2"), ("w3e", "w3")):
+                stacked = np.asarray(params["layers"][ours])  # [L, E, in, out]
+                for e in range(cfg.n_experts):
+                    out[f"{prefix}layers.{i}.{_moe_suffix(e, hf_w)}"] = stacked[i, e].T
     if not cfg.tie_embeddings and "lm_head" in params:
         out["lm_head.weight"] = np.asarray(params["lm_head"]).T
     return out

@@ -6,8 +6,11 @@ from .ring import (
     sp_prefill_attention,
     llama_prefill_sp,
 )
+from .pipeline import pipeline_prefill, stack_stages
 
 __all__ = [
+    "pipeline_prefill",
+    "stack_stages",
     "make_mesh",
     "mesh_axis_sizes",
     "llama_param_specs",

@@ -5,12 +5,16 @@ concurrency caps, SURVEY.md §2.2); intra-model parallelism did not exist.
 Here it does: a `jax.sharding.Mesh` with axes
 
   dp — data parallel (independent batch slots)
+  pp — pipeline parallel (layer stages, GPipe microbatching — parallel/pipeline.py)
+  ep — expert parallel (MoE expert shards — models/moe.py)
+  sp — sequence parallel (long-context prefill; ring attention — parallel/ring.py)
   tp — tensor parallel (attention heads / FFN hidden sharded over ICI)
-  sp — sequence parallel (long-context prefill; ring attention)
 
-XLA inserts the collectives (all-gather / reduce-scatter / psum) implied by
-the shardings; they ride ICI within a slice. Multi-host extends the same mesh
-over DCN via `jax.distributed.initialize` (see parallel/distributed.py).
+`tp` is the innermost (fastest-varying) axis so its collectives ride the
+shortest ICI hops; `sp` sits next for the ring permutes. XLA inserts the
+collectives (all-gather / reduce-scatter / psum / all-to-all) implied by the
+shardings. Multi-host extends the same mesh over DCN via
+`jax.distributed.initialize` (see parallel/distributed.py).
 """
 
 from __future__ import annotations
@@ -19,15 +23,18 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
+AXES = ("dp", "pp", "ep", "sp", "tp")
+
 
 def mesh_axis_sizes(spec: str, n_devices: int) -> dict[str, int]:
-    """Parse "dp=2,tp=4" → {'dp': 2, 'tp': 4, 'sp': 1}; default all-TP.
+    """Parse "dp=2,tp=4" → {'dp': 2, 'pp': 1, 'ep': 1, 'sp': 1, 'tp': 4};
+    default all-TP.
 
     TP is the default because decode is HBM-bandwidth-bound: sharding the
     weights over all chips divides bytes-per-step per chip, which is what
     lifts tokens/sec/chip (scaling-book recipe).
     """
-    sizes = {"dp": 1, "tp": 1, "sp": 1}
+    sizes = {a: 1 for a in AXES}
     spec = (spec or "").strip()
     if spec:
         for part in spec.split(","):
@@ -35,7 +42,9 @@ def mesh_axis_sizes(spec: str, n_devices: int) -> dict[str, int]:
             k = k.strip()
             if k in sizes and v.strip():
                 sizes[k] = int(v)
-        got = sizes["dp"] * sizes["tp"] * sizes["sp"]
+        got = 1
+        for a in AXES:
+            got *= sizes[a]
         if got != n_devices:
             raise ValueError(f"mesh spec {spec!r} = {got} devices, have {n_devices}")
     else:
@@ -46,5 +55,5 @@ def mesh_axis_sizes(spec: str, n_devices: int) -> dict[str, int]:
 def make_mesh(spec: str = "", devices: list | None = None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     sizes = mesh_axis_sizes(spec, len(devices))
-    arr = np.asarray(devices).reshape(sizes["dp"], sizes["tp"], sizes["sp"])
-    return Mesh(arr, axis_names=("dp", "tp", "sp"))
+    arr = np.asarray(devices).reshape(*(sizes[a] for a in AXES))
+    return Mesh(arr, axis_names=AXES)

@@ -1,0 +1,139 @@
+"""Pipeline parallelism: GPipe-style microbatched prefill over the `pp` axis.
+
+Absent from the reference (its only "pipeline" is the job queue). Here layer
+stages are a real mesh dimension: the stacked layer tree `[L, ...]` is
+reshaped to `[PP, L/PP, ...]` and sharded on `pp`, so each device holds only
+its stage's weights in HBM — the memory-capacity escape hatch for models too
+big for tensor parallelism alone (pp composes with tp for the biggest
+configs).
+
+Schedule: classic GPipe fill-drain. M microbatches flow through PP stages in
+M + PP - 1 steps; stage p processes microbatch `i - p` at step i and hands
+its activation to stage p+1 via `ppermute` (one ICI hop — stages are laid
+out contiguously on the mesh). Everything is static-shaped: invalid
+(bubble) steps compute on garbage and are masked out at the write, the
+jit-friendly alternative to data-dependent control flow.
+
+The per-layer math is `models.llama.prefill_layer` — the same function the
+single-stage scan uses, so pipeline equivalence is testable to the bit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.configs import ModelConfig
+from ..models.llama import prefill_masks, prefill_layer, _logits
+from .ring import _shard_map
+
+
+def stack_stages(layers: Any, pp: int) -> Any:
+    """[L, ...] stacked layer tree → [PP, L/PP, ...] stage-major tree."""
+    def split(x):
+        L = x.shape[0]
+        assert L % pp == 0, f"n_layers {L} not divisible by pp={pp}"
+        return x.reshape(pp, L // pp, *x.shape[1:])
+
+    return jax.tree.map(split, layers)
+
+
+def pipeline_prefill(
+    cfg: ModelConfig,
+    params: Any,
+    tokens: jnp.ndarray,  # [B, S] int32
+    lengths: jnp.ndarray,  # [B] int32
+    mesh: Mesh,
+    n_microbatches: int = 0,
+    attn_impl: str = "xla",
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Prefill with layers pipelined over the mesh's `pp` axis.
+
+    Same contract as `llama_prefill`: (last_logits [B, V] f32,
+    k [L, B, Hkv, S, hd], v [...]). B must divide into M microbatches.
+    """
+    PP = mesh.shape["pp"]
+    B, S = tokens.shape
+    M = n_microbatches or PP
+    assert B % M == 0, f"batch {B} not divisible by {M} microbatches"
+    mb = B // M
+    L = cfg.n_layers
+    Lp = L // PP
+    Hkv, hd = cfg.n_kv_heads, cfg.resolved_head_dim
+
+    h = params["embed"][tokens]  # [B, S, D] (embed replicated over pp)
+    D = h.shape[-1]
+    cos, sin, mask = prefill_masks(cfg, S, lengths)
+
+    hm = h.reshape(M, mb, S, D)
+    maskm = mask.reshape(M, mb, S, S)
+    lenm = lengths.reshape(M, mb)
+
+    stage_lp = stack_stages(params["layers"], PP)  # [PP, Lp, ...]
+
+    def run(stage_lp, hm, maskm, lenm, cos, sin):
+        # Local views: stage_lp leaves arrive as [1, Lp, ...].
+        lp = jax.tree.map(lambda x: x[0], stage_lp)
+        stage = jax.lax.axis_index("pp")
+        steps = M + PP - 1
+
+        def run_stage(x, mask_j, len_j):
+            def layer(h, one_lp):
+                return prefill_layer(
+                    cfg, one_lp, h, cos, sin, mask_j, len_j, attn_impl
+                )
+
+            return jax.lax.scan(layer, x, lp)
+
+        out0 = jnp.zeros((M, mb, S, D), dtype=h.dtype)
+        kv0 = jnp.zeros((M, Lp, mb, Hkv, S, hd), dtype=h.dtype)
+        x0 = jnp.zeros((mb, S, D), dtype=h.dtype)
+        fwd = [(p, (p + 1) % PP) for p in range(PP)]
+
+        def body(i, carry):
+            x_in, outbuf, kbuf, vbuf = carry
+            j = i - stage  # microbatch this stage handles at step i
+            cj = jnp.clip(j, 0, M - 1)
+            valid = (j >= 0) & (j < M)
+
+            x = jnp.where(stage == 0, hm[jnp.clip(i, 0, M - 1)], x_in)
+            y, (ks, vs) = run_stage(x, maskm[cj], lenm[cj])
+
+            # Masked writes keep bubble steps from clobbering real results.
+            kbuf = kbuf.at[cj].set(jnp.where(valid, ks, kbuf[cj]))
+            vbuf = vbuf.at[cj].set(jnp.where(valid, vs, vbuf[cj]))
+            w_out = valid & (stage == PP - 1)
+            outbuf = outbuf.at[cj].set(jnp.where(w_out, y, outbuf[cj]))
+
+            x_next = jax.lax.ppermute(y, "pp", fwd)
+            return x_next, outbuf, kbuf, vbuf
+
+        _, outbuf, kbuf, vbuf = jax.lax.fori_loop(
+            0, steps, body, (x0, out0, kv0, kv0)
+        )
+        # Only the last stage holds real outputs; make them replicated.
+        outbuf = jnp.where(stage == PP - 1, outbuf, 0.0)
+        outbuf = jax.lax.psum(outbuf, "pp")
+        return outbuf, kbuf, vbuf
+
+    shmap = _shard_map(
+        run,
+        mesh,
+        in_specs=(P("pp"), P(), P(), P(), P(), P()),
+        out_specs=(P(), P(None, "pp"), P(None, "pp")),
+    )
+    out, k, v = shmap(stage_lp, hm, maskm, lenm, cos, sin)
+
+    h = out.reshape(B, S, D)
+    # [M, L, mb, Hkv, S, hd] → [L, B, Hkv, S, hd]
+    k = jnp.moveaxis(k, 0, 1).reshape(L, B, Hkv, S, hd)
+    v = jnp.moveaxis(v, 0, 1).reshape(L, B, Hkv, S, hd)
+
+    last = jnp.take_along_axis(
+        h, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    return _logits(cfg, params, last), k, v

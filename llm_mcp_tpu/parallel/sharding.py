@@ -26,19 +26,36 @@ from ..models.configs import ModelConfig
 
 
 def llama_param_specs(cfg: ModelConfig) -> dict[str, Any]:
+    layers: dict[str, Any] = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "ffn_norm": P(None, None),
+    }
+    if cfg.n_experts:
+        # Experts on ep, expert FFN hidden on tp: the dispatch einsums in
+        # models/moe.py become the token all-to-all over ep under GSPMD.
+        layers.update(
+            {
+                "router": P(None, None, None),
+                "w1e": P(None, "ep", None, "tp"),
+                "w3e": P(None, "ep", None, "tp"),
+                "w2e": P(None, "ep", "tp", None),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w1": P(None, None, "tp"),
+                "w3": P(None, None, "tp"),
+                "w2": P(None, "tp", None),
+            }
+        )
     specs: dict[str, Any] = {
         "embed": P("tp", None),
-        "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, None, "tp"),
-            "wk": P(None, None, "tp"),
-            "wv": P(None, None, "tp"),
-            "wo": P(None, "tp", None),
-            "ffn_norm": P(None, None),
-            "w1": P(None, None, "tp"),
-            "w3": P(None, None, "tp"),
-            "w2": P(None, "tp", None),
-        },
+        "layers": layers,
         "final_norm": P(None),
     }
     if not cfg.tie_embeddings:
