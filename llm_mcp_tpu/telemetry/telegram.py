@@ -1,0 +1,124 @@
+"""Telegram alert gateway.
+
+Role parity: reference `telemetry/llm_telemetry/telegram_gateway.py:46-170,
+213-237` — a thin client over the Telegram Bot API with sendMessage /
+editMessageText, plus rate-limit (HTTP 429 `retry_after`) handling. The
+reference also supports a telegram-mcp sidecar route; here that generalizes to
+an injectable transport so tests (and alternative gateways) plug in without
+network access.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+from collections.abc import Callable
+from typing import Any
+
+log = logging.getLogger("telemetry.telegram")
+
+# transport(url, payload, timeout) -> (status_code, response_json)
+Transport = Callable[[str, dict[str, Any], float], tuple[int, dict[str, Any]]]
+
+
+def _urllib_transport(url: str, payload: dict[str, Any], timeout: float) -> tuple[int, dict[str, Any]]:
+    data = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"}, method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:  # noqa: S310
+            return r.status, json.loads(r.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read().decode() or "{}")
+        except Exception:
+            body = {}
+        return e.code, body
+
+
+class TelegramGateway:
+    """Bot-API client: send or edit alert messages, tolerate rate limits.
+
+    Reference behavior re-created (`telegram_gateway.py:104-170`):
+    - sendMessage with HTML parse mode and disabled link previews;
+    - editMessageText when a message_id is supplied (used for rolling
+      status messages);
+    - on 429, honor `parameters.retry_after` once, then give up quietly —
+      alerting must never take the monitor loop down.
+    """
+
+    def __init__(
+        self,
+        bot_token: str,
+        chat_id: str,
+        transport: Transport | None = None,
+        timeout: float = 10.0,
+    ):
+        self.bot_token = bot_token
+        self.chat_id = chat_id
+        self.transport = transport or _urllib_transport
+        self.timeout = timeout
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.bot_token and self.chat_id)
+
+    def _call(self, method: str, payload: dict[str, Any]) -> dict[str, Any] | None:
+        url = f"https://api.telegram.org/bot{self.bot_token}/{method}"
+        for attempt in (0, 1):
+            try:
+                status, body = self.transport(url, payload, self.timeout)
+            except Exception as e:  # network failure: log, never raise
+                log.warning("telegram %s failed: %s", method, e)
+                return None
+            if status == 429 and attempt == 0:
+                retry_after = 1.0
+                params = body.get("parameters")
+                if isinstance(params, dict):
+                    try:
+                        retry_after = float(params.get("retry_after", 1))
+                    except (TypeError, ValueError):
+                        pass
+                time.sleep(min(retry_after, 30.0))
+                continue
+            if status >= 400:
+                log.warning("telegram %s -> %s: %s", method, status, body.get("description"))
+                return None
+            return body
+        return None
+
+    def send(self, text: str) -> int | None:
+        """Send a message; returns message_id for later edits."""
+        if not self.enabled:
+            return None
+        body = self._call(
+            "sendMessage",
+            {
+                "chat_id": self.chat_id,
+                "text": text,
+                "parse_mode": "HTML",
+                "disable_web_page_preview": True,
+            },
+        )
+        if body and isinstance(body.get("result"), dict):
+            return body["result"].get("message_id")
+        return None
+
+    def edit(self, message_id: int, text: str) -> bool:
+        if not self.enabled:
+            return False
+        body = self._call(
+            "editMessageText",
+            {
+                "chat_id": self.chat_id,
+                "message_id": message_id,
+                "text": text,
+                "parse_mode": "HTML",
+                "disable_web_page_preview": True,
+            },
+        )
+        return body is not None

@@ -1,0 +1,193 @@
+"""Telemetry alerting tests — parity with reference telemetry behavior
+(`telemetry/llm_telemetry/main.py`): offline/recovery diffing, failed-job
+threshold with dedupe, queue-stuck detection, Telegram gateway rate limits.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from llm_mcp_tpu.state import Catalog, Database, JobQueue
+from llm_mcp_tpu.telemetry import AlertMonitor, TelegramGateway, snapshot_status
+
+
+@pytest.fixture()
+def stack():
+    db = Database(":memory:")
+    yield db, Catalog(db), JobQueue(db)
+    db.close()
+
+
+class FakeTransport:
+    def __init__(self, responses=None):
+        self.calls = []
+        self.responses = list(responses or [])
+
+    def __call__(self, url, payload, timeout):
+        self.calls.append((url, payload))
+        if self.responses:
+            return self.responses.pop(0)
+        return 200, {"ok": True, "result": {"message_id": len(self.calls)}}
+
+
+# -- device diffing --------------------------------------------------------
+
+
+def test_no_alert_on_first_scan(stack):
+    db, cat, _ = stack
+    cat.upsert_device("tpu-a", name="tpu-a", online=True)
+    mon = AlertMonitor(db)
+    assert mon.scan_once() == []
+
+
+def test_offline_and_recovery_alerts(stack):
+    db, cat, _ = stack
+    cat.upsert_device("tpu-a", name="slice-a", online=True, tags={"hbm_gb": 16})
+    mon = AlertMonitor(db)
+    mon.scan_once()  # snapshot
+    cat.set_device_online("tpu-a", False)
+    alerts = mon.scan_once()
+    assert len(alerts) == 1 and "offline" in alerts[0] and "slice" in alerts[0]
+    # no re-alert while still offline
+    assert mon.scan_once() == []
+    cat.set_device_online("tpu-a", True)
+    alerts = mon.scan_once()
+    assert len(alerts) == 1 and "recovered" in alerts[0]
+
+
+# -- failed jobs -----------------------------------------------------------
+
+
+def _fail_n(queue: JobQueue, n: int, kind="generate"):
+    for _ in range(n):
+        job = queue.submit(kind, {"model": "m"})
+        claimed = queue.claim(worker_id="w1")
+        assert claimed is not None
+        # burn all attempts so the job lands in terminal error state
+        for _ in range(10):
+            if queue.fail(claimed.id, "w1", "boom") == "error":
+                break
+            reclaimed = queue.claim(worker_id="w1")
+            if reclaimed is None:
+                break
+
+
+def test_failed_jobs_threshold_and_dedupe(stack):
+    db, _, queue = stack
+    mon = AlertMonitor(db, fail_threshold=3)
+    _fail_n(queue, 1)
+    assert mon.scan_once() == []  # below threshold; job marked seen
+    _fail_n(queue, 3)
+    alerts = mon.scan_once()
+    assert len(alerts) == 1 and "failed jobs" in alerts[0]
+    # all seen now -> no duplicate alert
+    assert mon.scan_once() == []
+
+
+def test_failed_jobs_outside_window_ignored(stack):
+    db, _, queue = stack
+    now = time.time()
+    mon = AlertMonitor(db, fail_threshold=1, now_fn=lambda: now + 7200)
+    _fail_n(queue, 2)
+    assert mon.scan_once() == []  # failures are 2h old from monitor's view
+
+
+# -- stuck queue -----------------------------------------------------------
+
+
+def test_stuck_queue_alert_and_drain(stack):
+    db, _, queue = stack
+    queue.submit("generate", {"model": "m"})
+    now = time.time()
+    mon = AlertMonitor(db, stuck_after_s=300, now_fn=lambda: now + 600)
+    alerts = mon.scan_once()
+    assert len(alerts) == 1 and "stuck" in alerts[0]
+    assert mon.scan_once() == []  # alert once
+    claimed = queue.claim(worker_id="w1")
+    queue.complete(claimed.id, "w1", {"ok": True})
+    alerts = mon.scan_once()
+    assert len(alerts) == 1 and "drained" in alerts[0]
+
+
+# -- gateway ---------------------------------------------------------------
+
+
+def test_gateway_send_and_edit():
+    t = FakeTransport()
+    gw = TelegramGateway("tok", "chat", transport=t)
+    mid = gw.send("hello")
+    assert mid == 1
+    assert gw.edit(mid, "updated")
+    urls = [u for u, _ in t.calls]
+    assert urls[0].endswith("/sendMessage") and urls[1].endswith("/editMessageText")
+    assert t.calls[0][1]["chat_id"] == "chat"
+
+
+def test_gateway_rate_limit_retry(monkeypatch):
+    slept = []
+    monkeypatch.setattr(time, "sleep", lambda s: slept.append(s))
+    t = FakeTransport(
+        responses=[
+            (429, {"parameters": {"retry_after": 2}}),
+            (200, {"ok": True, "result": {"message_id": 7}}),
+        ]
+    )
+    gw = TelegramGateway("tok", "chat", transport=t)
+    assert gw.send("x") == 7
+    assert slept == [2.0]
+
+
+def test_gateway_disabled_without_credentials():
+    t = FakeTransport()
+    gw = TelegramGateway("", "", transport=t)
+    assert gw.send("x") is None
+    assert t.calls == []
+
+
+def test_monitor_routes_alerts_through_gateway(stack):
+    db, cat, _ = stack
+    t = FakeTransport()
+    gw = TelegramGateway("tok", "chat", transport=t)
+    cat.upsert_device("d1", online=True)
+    mon = AlertMonitor(db, gateway=gw)
+    mon.scan_once()
+    cat.set_device_online("d1", False)
+    mon.scan_once()
+    assert len(t.calls) == 1 and "offline" in t.calls[0][1]["text"]
+
+
+def test_snapshot_status(stack):
+    db, cat, queue = stack
+    cat.upsert_device("d1", online=True)
+    cat.upsert_device("d2", online=False)
+    queue.submit("generate", {})
+    snap = snapshot_status(db)
+    assert snap["devices_online"] == 1 and snap["devices_total"] == 2
+    assert snap["jobs"].get("queued") == 1
+
+
+def test_html_escaping_in_alerts(stack):
+    db, cat, queue = stack
+    cat.upsert_device("d1", name="node<3>&co", online=True)
+    mon = AlertMonitor(db, fail_threshold=1)
+    mon.scan_once()
+    cat.set_device_online("d1", False)
+    alerts = mon.scan_once()
+    assert "node&lt;3&gt;&amp;co" in alerts[0] and "<3>" not in alerts[0]
+    job = queue.submit("generate", {"model": "m"}, max_attempts=1)
+    claimed = queue.claim(worker_id="w1")
+    queue.fail(claimed.id, "w1", "expected <pad> token")
+    alerts = mon.scan_once()
+    assert alerts and "&lt;pad&gt;" in alerts[0]
+
+
+def test_busy_queue_not_stuck(stack):
+    db, _, queue = stack
+    queue.submit("generate", {"model": "m"})
+    queue.submit("generate", {"model": "m"})
+    claimed = queue.claim(worker_id="w1")  # recent started_at => queue is moving
+    now = time.time()
+    mon = AlertMonitor(db, stuck_after_s=300, now_fn=lambda: now + 200)
+    assert mon.scan_once() == []
