@@ -68,11 +68,17 @@ class JobsAPI:
             if not ok:
                 resp.write_error(f"model not allowed on device: {why}", 422)
                 return
+        try:
+            priority = int(body.get("priority") or 0)
+            max_attempts = int(body.get("max_attempts") or 0) or None
+        except (TypeError, ValueError):
+            resp.write_error("priority/max_attempts must be integers", 400)
+            return
         job = self.queue.submit(
             kind,
             payload,
-            priority=int(body.get("priority") or 0),
-            max_attempts=int(body.get("max_attempts") or 0) or None,
+            priority=priority,
+            max_attempts=max_attempts,
             deadline_at=body.get("deadline_at"),
         )
         self.metrics.jobs_created.labels(kind=kind).inc()

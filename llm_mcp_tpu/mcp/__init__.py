@@ -1,0 +1,17 @@
+"""MCP layer: HTTP bridge + stdio MCP tool server.
+
+Role parity with the reference's L5:
+- `bridge.py`  ≈ `mcp/src/index.ts` — zero-framework HTTP bridge :3333 that
+  speaks gRPC to the core for submit/get/stream and reverse-proxies the
+  dashboard/cost/etc routes.
+- `stdio.py` + `tools.py` ≈ `fastmcp/server.py` — the 12-tool MCP server.
+  The reference uses the FastMCP framework; this environment has no MCP SDK,
+  so the (small) MCP stdio protocol is implemented directly: JSON-RPC 2.0
+  over stdin/stdout with `initialize`, `tools/list`, `tools/call`.
+"""
+
+from .bridge import BridgeServer
+from .tools import TOOLS, ToolContext
+from .stdio import MCPStdioServer
+
+__all__ = ["BridgeServer", "MCPStdioServer", "TOOLS", "ToolContext"]

@@ -1,0 +1,57 @@
+"""`python -m llm_mcp_tpu.mcp [bridge|stdio]` — run the MCP layer.
+
+- `bridge` (default): the HTTP bridge service on :3333, the process parity
+  of the reference's `llmmcp` compose service (`mcp/src/index.ts`).
+- `stdio`: the MCP tool server on stdin/stdout, the parity of
+  `fastmcp/server.py` — point an MCP host (Claude Desktop, etc.) at
+  `python -m llm_mcp_tpu.mcp stdio`.
+
+Env: CORE_URL (default http://localhost:8080), CORE_GRPC_TARGET (optional),
+BRIDGE_ADDR (default :3333), BRIDGE_URL (stdio mode; defaults to CORE-less
+bridge URL http://localhost:3333).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+def main() -> None:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "bridge"
+    core_url = os.environ.get("CORE_URL", "http://localhost:8080")
+
+    if mode == "stdio":
+        # protocol runs on stdout — logs MUST go to stderr
+        logging.basicConfig(stream=sys.stderr, level=os.environ.get("LOG_LEVEL", "INFO"))
+        from .stdio import MCPStdioServer
+        from .tools import ToolContext
+
+        bridge_url = os.environ.get("BRIDGE_URL", "http://localhost:3333")
+        MCPStdioServer(ToolContext(bridge_url)).run()
+        return
+
+    logging.basicConfig(
+        level=os.environ.get("LOG_LEVEL", "INFO"),
+        format='{"ts":"%(asctime)s","level":"%(levelname)s","logger":"%(name)s","msg":"%(message)s"}',
+    )
+    from .bridge import BridgeServer
+
+    addr = os.environ.get("BRIDGE_ADDR", ":3333")
+    host, _, port = addr.rpartition(":")
+    server = BridgeServer(
+        core_url, core_grpc_target=os.environ.get("CORE_GRPC_TARGET", "")
+    ).start(host or "0.0.0.0", int(port or 3333))
+    import signal
+    import threading
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,199 @@
+"""HTTP bridge: thin front door for MCP hosts and simple HTTP clients.
+
+Role parity: reference `mcp/src/index.ts` — a zero-framework `node:http`
+server on :3333 that (a) talks gRPC to the core for job submit/get/stream
+(`index.ts:90-161`), and (b) reverse-proxies nine plain-HTTP routes to the
+core's `/v1/*` surface (`index.ts:163-227`). Here the bridge is Python on the
+same stdlib HTTP layer the core uses; gRPC via `GrpcCoreClient` when a gRPC
+target is configured, with HTTP fallback so the bridge also works against a
+core that only exposes HTTP.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+from urllib.parse import urlencode
+
+from ..api.http import HTTPApi, Request, Response
+from ..state.queue import JobStatus
+from .tools import http_json
+
+log = logging.getLogger("mcp.bridge")
+
+# route-on-the-bridge -> (method, core path); mirrors index.ts:163-227
+PROXY_ROUTES: list[tuple[str, str, str]] = [
+    ("POST", "/llm/request", "/v1/llm/request"),
+    ("GET", "/dashboard", "/v1/dashboard"),
+    ("GET", "/costs/summary", "/v1/costs/summary"),
+    ("GET", "/costs/balance", "/v1/costs/balance"),
+    ("GET", "/benchmarks", "/v1/benchmarks"),
+    ("POST", "/discovery/run", "/v1/discovery/run"),
+    ("GET", "/models/stats", "/v1/models/stats"),
+    ("POST", "/models/sync", "/v1/models/sync"),
+    ("POST", "/feedback", "/v1/feedback"),
+    ("POST", "/knowledge/ingest", "/v1/knowledge/ingest"),
+]
+
+
+class BridgeServer:
+    def __init__(
+        self,
+        core_http_url: str,
+        core_grpc_target: str = "",
+        timeout_s: float = 120.0,
+    ):
+        self.core_http_url = core_http_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self._grpc = None
+        if core_grpc_target:
+            try:
+                from ..rpc.client import GrpcCoreClient
+
+                self._grpc = GrpcCoreClient(core_grpc_target)
+            except Exception as e:  # grpc unavailable: HTTP fallback only
+                log.warning("gRPC client unavailable (%s); HTTP-only bridge", e)
+        self.api = HTTPApi()
+        self._register()
+        self._server = None
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _core_request(
+        self, method: str, path: str, body: Any = None, timeout: float | None = None
+    ) -> tuple[int, Any]:
+        return http_json(method, self.core_http_url + path, body, timeout or self.timeout_s)
+
+    def _register(self) -> None:
+        r = self.api.route
+        r("GET", "/health", self.handle_health)
+        r("POST", "/submit", self.handle_submit)
+        r("GET", "/jobs/{id}", self.handle_get_job)
+        r("GET", "/jobs/{id}/stream", self.handle_stream_job)
+        for method, here, there in PROXY_ROUTES:
+            r(method, here, self._make_proxy(method, there))
+
+    def _make_proxy(self, method: str, core_path: str):
+        def proxy(req: Request, resp: Response) -> None:
+            body = None
+            if method in ("POST", "PUT"):
+                try:
+                    body = req.json()
+                except Exception:
+                    body = {}
+            path = core_path
+            if req.query:
+                path = f"{core_path}?{urlencode(req.query)}"
+            status, payload = self._core_request(method, path, body)
+            resp.write_json(payload, status=status)
+
+        return proxy
+
+    # -- handlers (index.ts:76-161 parity) ---------------------------------
+
+    def handle_health(self, req: Request, resp: Response) -> None:
+        resp.write_json(
+            {
+                "status": "ok",
+                "service": "llm-mcp-tpu-bridge",
+                "core": self.core_http_url,
+                "grpc": self._grpc is not None,
+            }
+        )
+
+    def handle_submit(self, req: Request, resp: Response) -> None:
+        try:
+            body = req.json()
+        except Exception:
+            resp.write_error("invalid JSON body", 400)
+            return
+        kind = body.get("kind", "")
+        if not kind:
+            resp.write_error("kind required", 400)
+            return
+        payload = body.get("payload", {})
+        if self._grpc is not None:
+            try:
+                job = self._grpc.submit(
+                    kind,
+                    payload,
+                    priority=int(body.get("priority") or 0),
+                    max_attempts=int(body.get("max_attempts") or 3),
+                    deadline_at=float(body.get("deadline_at") or 0.0),
+                )
+            except (TypeError, ValueError) as e:
+                resp.write_error(f"invalid field: {e}", 400)
+                return
+            except Exception as e:
+                status = getattr(e, "status", 502)
+                resp.write_error(str(e), status if isinstance(status, int) else 502)
+                return
+            resp.write_json({"job_id": job["id"], "status": job["status"]}, status=202)
+            return
+        status, out = self._core_request("POST", "/v1/jobs", body)
+        resp.write_json(out, status=status)
+
+    def handle_get_job(self, req: Request, resp: Response) -> None:
+        job_id = req.params["id"]
+        if self._grpc is not None:
+            try:
+                resp.write_json(self._grpc.get(job_id))
+            except Exception as e:
+                status = getattr(e, "status", 502)
+                resp.write_error(str(e), status if isinstance(status, int) else 502)
+            return
+        status, out = self._core_request("GET", f"/v1/jobs/{job_id}")
+        resp.write_json(out, status=status)
+
+    def handle_stream_job(self, req: Request, resp: Response) -> None:
+        """SSE re-exposure of the job status stream (index.ts:131-161)."""
+        job_id = req.params["id"]
+        resp.start_sse()
+        if self._grpc is not None:
+            try:
+                for update in self._grpc.stream(job_id, timeout_s=self.timeout_s):
+                    if not resp.sse_event("status", update):
+                        return
+                    if update.get("status") in JobStatus.TERMINAL:
+                        break
+            except Exception as e:
+                resp.sse_event("error", {"error": str(e)})
+            return
+        # HTTP fallback: poll the core like the reference's polling fallback
+        import time
+
+        last = None
+        deadline = time.time() + self.timeout_s
+        while time.time() < deadline:
+            try:
+                status, job = self._core_request("GET", f"/v1/jobs/{job_id}")
+            except OSError as e:  # core unreachable mid-poll: emit a frame, end
+                resp.sse_event("error", {"error": f"core unreachable: {e}"})
+                return
+            if status != 200:
+                resp.sse_event("error", {"error": "job not found", "status": status})
+                return
+            if job.get("status") != last:
+                last = job.get("status")
+                if not resp.sse_event("status", job):
+                    return
+            if last in JobStatus.TERMINAL:
+                return
+            time.sleep(1.0)
+        resp.sse_event("timeout", {"error": f"stream timeout after {self.timeout_s}s"})
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, host: str = "0.0.0.0", port: int = 3333) -> "BridgeServer":
+        self._server = self.api.serve(host, port)
+        log.info("bridge listening on %s:%s -> %s", host, self.api.port, self.core_http_url)
+        return self
+
+    @property
+    def port(self) -> int:
+        return self.api.port
+
+    def shutdown(self) -> None:
+        self.api.shutdown()
+        if self._grpc is not None:
+            self._grpc.close()

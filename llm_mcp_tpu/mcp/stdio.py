@@ -1,0 +1,130 @@
+"""MCP stdio server: JSON-RPC 2.0 over stdin/stdout, no SDK dependency.
+
+Role parity: reference `fastmcp/server.py` runs under the FastMCP framework,
+which handles the Model Context Protocol plumbing. This environment has no
+MCP SDK, so the protocol subset MCP hosts actually use for tool servers is
+implemented directly:
+
+- `initialize` / `notifications/initialized` handshake,
+- `tools/list` → the 12 tool specs,
+- `tools/call` → dispatch into `tools.py`, results wrapped as text content,
+- `ping`, graceful EOF shutdown.
+
+Wire format: one JSON-RPC message per line (newline-delimited JSON), the
+standard stdio transport framing of MCP.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from typing import Any, TextIO
+
+from .tools import TOOLS, TOOLS_BY_NAME, ToolContext
+
+log = logging.getLogger("mcp.stdio")
+
+PROTOCOL_VERSION = "2025-03-26"
+SERVER_INFO = {"name": "llm-mcp-tpu", "version": "0.1.0"}
+
+# JSON-RPC error codes
+PARSE_ERROR = -32700
+INVALID_REQUEST = -32600
+METHOD_NOT_FOUND = -32601
+INVALID_PARAMS = -32602
+INTERNAL_ERROR = -32603
+
+
+class MCPStdioServer:
+    def __init__(self, ctx: ToolContext, stdin: TextIO | None = None, stdout: TextIO | None = None):
+        self.ctx = ctx
+        self.stdin = stdin or sys.stdin
+        self.stdout = stdout or sys.stdout
+        self.initialized = False
+
+    # -- transport ---------------------------------------------------------
+
+    def _send(self, msg: dict[str, Any]) -> None:
+        self.stdout.write(json.dumps(msg, ensure_ascii=False) + "\n")
+        self.stdout.flush()
+
+    def _reply(self, req_id: Any, result: Any) -> None:
+        self._send({"jsonrpc": "2.0", "id": req_id, "result": result})
+
+    def _error(self, req_id: Any, code: int, message: str) -> None:
+        self._send({"jsonrpc": "2.0", "id": req_id, "error": {"code": code, "message": message}})
+
+    # -- dispatch ----------------------------------------------------------
+
+    def handle_message(self, msg: dict[str, Any]) -> None:
+        method = msg.get("method")
+        req_id = msg.get("id")
+        is_notification = "id" not in msg
+        try:
+            if method == "initialize":
+                self._reply(
+                    req_id,
+                    {
+                        "protocolVersion": PROTOCOL_VERSION,
+                        "capabilities": {"tools": {"listChanged": False}},
+                        "serverInfo": SERVER_INFO,
+                    },
+                )
+            elif method == "notifications/initialized":
+                self.initialized = True
+            elif method == "ping":
+                self._reply(req_id, {})
+            elif method == "tools/list":
+                self._reply(req_id, {"tools": [t.spec() for t in TOOLS]})
+            elif method == "tools/call":
+                self._handle_tool_call(req_id, msg.get("params") or {})
+            elif is_notification:
+                pass  # unknown notifications are ignored per JSON-RPC
+            else:
+                self._error(req_id, METHOD_NOT_FOUND, f"unknown method: {method}")
+        except Exception as e:  # noqa: BLE001 — protocol loop must survive
+            log.exception("error handling %s", method)
+            if not is_notification:
+                self._error(req_id, INTERNAL_ERROR, str(e))
+
+    def _handle_tool_call(self, req_id: Any, params: dict[str, Any]) -> None:
+        name = params.get("name", "")
+        tool = TOOLS_BY_NAME.get(name)
+        if tool is None:
+            self._error(req_id, INVALID_PARAMS, f"unknown tool: {name}")
+            return
+        args = params.get("arguments") or {}
+        missing = [k for k in tool.input_schema.get("required", []) if k not in args]
+        if missing:
+            self._error(req_id, INVALID_PARAMS, f"missing arguments: {', '.join(missing)}")
+            return
+        try:
+            result = tool.fn(self.ctx, args)
+            text = result if isinstance(result, str) else json.dumps(result, ensure_ascii=False)
+            self._reply(
+                req_id, {"content": [{"type": "text", "text": text}], "isError": False}
+            )
+        except Exception as e:  # tool failure is a RESULT, not a protocol error
+            self._reply(
+                req_id,
+                {"content": [{"type": "text", "text": f"tool error: {e}"}], "isError": True},
+            )
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self) -> None:
+        log.info("MCP stdio server up: %d tools -> %s", len(TOOLS), self.ctx.bridge_url)
+        for line in self.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                self._error(None, PARSE_ERROR, "parse error")
+                continue
+            if not isinstance(msg, dict) or msg.get("jsonrpc") != "2.0":
+                self._error(None, INVALID_REQUEST, "invalid request")
+                continue
+            self.handle_message(msg)

@@ -1,0 +1,233 @@
+"""The 12 MCP tools, mirroring the reference FastMCP server.
+
+Role parity: reference `fastmcp/server.py` — 12 `@mcp.tool()` wrappers over
+the bridge via httpx (`server.py:46-169`). Here each tool is a declarative
+spec (name, description, JSON Schema) plus a callable over a `ToolContext`
+that performs the HTTP call with stdlib urllib, so the stdio server can
+enumerate them for `tools/list` and dispatch `tools/call` without any MCP SDK.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable
+from urllib.parse import urlencode
+
+
+def http_json(method: str, url: str, body: Any = None, timeout: float = 60.0) -> tuple[int, Any]:
+    """One JSON request → (status, parsed body); HTTP error statuses are
+    returned, not raised (transport failures still raise)."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:  # noqa: S310
+            return r.status, json.loads(r.read().decode() or "{}")
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode() or "{}")
+        except Exception:
+            return e.code, {"error": f"HTTP {e.code}"}
+
+
+class ToolCallError(RuntimeError):
+    """A tool call that reached the bridge but got an HTTP error status."""
+
+    def __init__(self, status: int, body: Any):
+        detail = body.get("error") if isinstance(body, dict) else body
+        super().__init__(f"HTTP {status}: {json.dumps(detail) if detail else status}")
+        self.status = status
+        self.body = body
+
+
+@dataclass
+class ToolContext:
+    """HTTP access to the bridge (or directly to a core /v1 surface)."""
+
+    bridge_url: str
+    timeout_s: float = 60.0
+
+    def request(self, method: str, path: str, body: Any = None, query: dict | None = None) -> Any:
+        url = self.bridge_url.rstrip("/") + path
+        if query:
+            url += "?" + urlencode({k: v for k, v in query.items() if v is not None})
+        status, payload = http_json(method, url, body, self.timeout_s)
+        if status >= 400:
+            # surfaces to the MCP host as an isError=True tool result
+            raise ToolCallError(status, payload)
+        return payload
+
+
+@dataclass
+class Tool:
+    name: str
+    description: str
+    fn: Callable[[ToolContext, dict[str, Any]], Any]
+    input_schema: dict[str, Any] = field(
+        default_factory=lambda: {"type": "object", "properties": {}}
+    )
+
+    def spec(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "description": self.description,
+            "inputSchema": self.input_schema,
+        }
+
+
+def _obj(props: dict[str, Any], required: list[str] | None = None) -> dict[str, Any]:
+    schema: dict[str, Any] = {"type": "object", "properties": props}
+    if required:
+        schema["required"] = required
+    return schema
+
+
+# -- tool implementations (fastmcp/server.py:46-169 parity) -----------------
+
+
+def _dashboard(ctx: ToolContext, args: dict) -> Any:
+    return ctx.request("GET", "/dashboard")
+
+
+def _submit(ctx: ToolContext, args: dict) -> Any:
+    return ctx.request(
+        "POST",
+        "/submit",
+        {
+            "kind": args.get("kind", "generate"),
+            "payload": args.get("payload", {}),
+            "priority": args.get("priority", 0),
+            "max_attempts": args.get("max_attempts", 3),
+        },
+    )
+
+
+def _job_status(ctx: ToolContext, args: dict) -> Any:
+    return ctx.request("GET", f"/jobs/{args['job_id']}")
+
+
+def _request(ctx: ToolContext, args: dict) -> Any:
+    body = {k: v for k, v in args.items() if v is not None}
+    return ctx.request("POST", "/llm/request", body)
+
+
+def _costs(ctx: ToolContext, args: dict) -> Any:
+    return ctx.request("GET", "/costs/summary", query={"days": args.get("days")})
+
+
+def _benchmarks(ctx: ToolContext, args: dict) -> Any:
+    return ctx.request("GET", "/benchmarks", query={"limit": args.get("limit")})
+
+
+def _balance(ctx: ToolContext, args: dict) -> Any:
+    return ctx.request("GET", "/costs/balance")
+
+
+def _model_stats(ctx: ToolContext, args: dict) -> Any:
+    return ctx.request("GET", "/models/stats")
+
+
+def _feedback(ctx: ToolContext, args: dict) -> Any:
+    rating = "up" if args.get("positive", True) else "down"
+    return ctx.request("POST", "/feedback", {"model": args["model"], "rating": rating})
+
+
+def _learn(ctx: ToolContext, args: dict) -> Any:
+    return ctx.request(
+        "POST",
+        "/knowledge/ingest",
+        {"target": "lightrag", "text": args["text"], "metadata": args.get("metadata", {})},
+    )
+
+
+def _remember(ctx: ToolContext, args: dict) -> Any:
+    return ctx.request(
+        "POST",
+        "/knowledge/ingest",
+        {"target": "mem0", "text": args["text"], "user_id": args.get("user_id", "default")},
+    )
+
+
+def _sync_models(ctx: ToolContext, args: dict) -> Any:
+    return ctx.request("POST", "/models/sync", {})
+
+
+TOOLS: list[Tool] = [
+    Tool(
+        "llm_dashboard",
+        "Cluster snapshot: jobs by status, devices, TPU slices, workers, costs, issues.",
+        _dashboard,
+    ),
+    Tool(
+        "llm_submit",
+        "Submit an async job (generate/embed/benchmark.*/echo) to the durable queue.",
+        _submit,
+        _obj(
+            {
+                "kind": {"type": "string", "description": "job kind, e.g. generate"},
+                "payload": {"type": "object", "description": "kind-specific payload"},
+                "priority": {"type": "integer"},
+                "max_attempts": {"type": "integer"},
+            },
+            ["kind"],
+        ),
+    ),
+    Tool(
+        "llm_job_status",
+        "Fetch a job by id, including result or error once finished.",
+        _job_status,
+        _obj({"job_id": {"type": "string"}}, ["job_id"]),
+    ),
+    Tool(
+        "llm_request",
+        "Smart-routed LLM request: pick quality tier, route to TPU slice or cloud, enqueue.",
+        _request,
+        _obj(
+            {
+                "prompt": {"type": "string"},
+                "quality": {
+                    "type": "string",
+                    "enum": ["turbo", "economy", "standard", "premium", "ultra", "max"],
+                },
+                "kind": {"type": "string"},
+                "model": {"type": "string"},
+                "provider": {"type": "string"},
+                "thinking": {"type": "boolean"},
+            },
+            ["prompt"],
+        ),
+    ),
+    Tool("llm_costs", "Cost summary grouped by model/provider.", _costs,
+         _obj({"days": {"type": "integer"}})),
+    Tool("llm_benchmarks", "Recent benchmark rows (device, model, tps, latency).", _benchmarks,
+         _obj({"limit": {"type": "integer"}})),
+    Tool("llm_balance", "Live cloud provider credit balance.", _balance),
+    Tool("llm_model_stats", "Per-model rolling stats: requests, tokens, cost, success rate.",
+         _model_stats),
+    Tool(
+        "llm_feedback",
+        "Thumbs up/down feedback for a model's answer quality.",
+        _feedback,
+        _obj({"model": {"type": "string"}, "positive": {"type": "boolean"}}, ["model"]),
+    ),
+    Tool(
+        "llm_learn",
+        "Ingest text into the LightRAG knowledge base (min 100 chars).",
+        _learn,
+        _obj({"text": {"type": "string"}, "metadata": {"type": "object"}}, ["text"]),
+    ),
+    Tool(
+        "llm_remember",
+        "Store a memory in mem0 (min 10 chars).",
+        _remember,
+        _obj({"text": {"type": "string"}, "user_id": {"type": "string"}}, ["text"]),
+    ),
+    Tool("llm_sync_models", "Re-sync the model catalog from engines and cloud providers.",
+         _sync_models),
+]
+
+TOOLS_BY_NAME: dict[str, Tool] = {t.name: t for t in TOOLS}

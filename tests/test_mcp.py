@@ -1,0 +1,211 @@
+"""MCP layer tests: bridge against a live core (HTTP + gRPC modes) and the
+stdio MCP server's JSON-RPC protocol. Parity targets: `mcp/src/index.ts`
+(bridge) and `fastmcp/server.py` (12 tools)."""
+
+from __future__ import annotations
+
+import io
+import json
+
+import httpx
+import jax.numpy as jnp
+import pytest
+
+from llm_mcp_tpu.api.server import CoreServer
+from llm_mcp_tpu.executor import EmbeddingEngine, GenerationEngine
+from llm_mcp_tpu.mcp import BridgeServer, MCPStdioServer, TOOLS, ToolContext
+from llm_mcp_tpu.state.db import Database
+from llm_mcp_tpu.utils.config import Config
+
+
+@pytest.fixture(scope="module")
+def core():
+    cfg = Config()
+    cfg.db_path = ":memory:"
+    gen = GenerationEngine("tiny-llm", max_slots=4, max_seq_len=128, dtype=jnp.float32).start()
+    emb = EmbeddingEngine("tiny-embed", max_batch=4, max_seq_len=64, dtype=jnp.float32)
+    srv = CoreServer(
+        cfg,
+        db=Database(":memory:"),
+        gen_engines={"tiny-llm": gen},
+        embed_engines={"tiny-embed": emb},
+    ).start("127.0.0.1", 0)
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def bridge(core):
+    b = BridgeServer(f"http://127.0.0.1:{core.api.port}").start("127.0.0.1", 0)
+    yield b
+    b.shutdown()
+
+
+@pytest.fixture(scope="module")
+def burl(bridge):
+    return f"http://127.0.0.1:{bridge.port}"
+
+
+# -- bridge (index.ts parity) ----------------------------------------------
+
+
+def test_bridge_health(burl):
+    r = httpx.get(f"{burl}/health")
+    assert r.status_code == 200
+    body = r.json()
+    assert body["status"] == "ok" and body["service"] == "llm-mcp-tpu-bridge"
+
+
+def test_bridge_submit_get_stream(burl):
+    r = httpx.post(f"{burl}/submit", json={"kind": "echo", "payload": {"x": 1}})
+    assert r.status_code == 202
+    job_id = r.json()["job_id"]
+    r = httpx.get(f"{burl}/jobs/{job_id}")
+    assert r.status_code == 200
+    assert r.json()["id"] == job_id
+
+    with httpx.stream("GET", f"{burl}/jobs/{job_id}/stream", timeout=10.0) as s:
+        assert s.headers["content-type"].startswith("text/event-stream")
+        for line in s.iter_lines():
+            if line.startswith("data:"):
+                assert json.loads(line[5:])["id"] == job_id
+                break
+
+
+def test_bridge_submit_requires_kind(burl):
+    assert httpx.post(f"{burl}/submit", json={"payload": {}}).status_code == 400
+
+
+def test_bridge_proxies(burl):
+    dash = httpx.get(f"{burl}/dashboard")
+    assert dash.status_code == 200 and "jobs" in dash.json()
+    bench = httpx.get(f"{burl}/benchmarks", params={"limit": 5})
+    assert bench.status_code == 200
+    fb = httpx.post(f"{burl}/feedback", json={"model": "tiny-llm", "rating": "up"})
+    assert fb.status_code == 200
+    costs = httpx.get(f"{burl}/costs/summary")
+    assert costs.status_code == 200
+
+
+def test_bridge_grpc_mode(core):
+    grpc_mod = pytest.importorskip("grpc")
+    from llm_mcp_tpu.rpc import GrpcCoreServer
+
+    gsrv = GrpcCoreServer(core.queue, core.catalog).start("127.0.0.1:0")
+    b = BridgeServer(
+        f"http://127.0.0.1:{core.api.port}", core_grpc_target=f"127.0.0.1:{gsrv.port}"
+    ).start("127.0.0.1", 0)
+    try:
+        url = f"http://127.0.0.1:{b.port}"
+        r = httpx.post(f"{url}/submit", json={"kind": "echo", "payload": {"y": 2}})
+        assert r.status_code == 202
+        job_id = r.json()["job_id"]
+        assert httpx.get(f"{url}/jobs/{job_id}").json()["id"] == job_id
+        assert httpx.get(f"{url}/jobs/does-not-exist").status_code == 404
+    finally:
+        b.shutdown()
+        gsrv.stop()
+
+
+# -- stdio MCP server ------------------------------------------------------
+
+
+def rpc(server, method, params=None, req_id=1):
+    out = io.StringIO()
+    server.stdout = out
+    msg = {"jsonrpc": "2.0", "method": method, "id": req_id}
+    if params is not None:
+        msg["params"] = params
+    server.handle_message(msg)
+    lines = [json.loads(l) for l in out.getvalue().splitlines() if l.strip()]
+    return lines[0] if lines else None
+
+
+@pytest.fixture()
+def stdio(burl):
+    return MCPStdioServer(ToolContext(burl), stdin=io.StringIO(), stdout=io.StringIO())
+
+
+def test_stdio_initialize_handshake(stdio):
+    resp = rpc(stdio, "initialize", {"protocolVersion": "2025-03-26", "capabilities": {}})
+    assert resp["result"]["serverInfo"]["name"] == "llm-mcp-tpu"
+    assert "tools" in resp["result"]["capabilities"]
+    stdio.handle_message({"jsonrpc": "2.0", "method": "notifications/initialized"})
+    assert stdio.initialized
+
+
+def test_stdio_tools_list(stdio):
+    resp = rpc(stdio, "tools/list")
+    tools = resp["result"]["tools"]
+    assert len(tools) == 12
+    names = {t["name"] for t in tools}
+    assert names == {
+        "llm_dashboard", "llm_submit", "llm_job_status", "llm_request", "llm_costs",
+        "llm_benchmarks", "llm_balance", "llm_model_stats", "llm_feedback",
+        "llm_learn", "llm_remember", "llm_sync_models",
+    }
+    for t in tools:
+        assert t["description"] and t["inputSchema"]["type"] == "object"
+
+
+def test_stdio_tool_call_roundtrip(stdio):
+    resp = rpc(
+        stdio,
+        "tools/call",
+        {"name": "llm_submit", "arguments": {"kind": "echo", "payload": {"z": 3}}},
+    )
+    result = resp["result"]
+    assert result["isError"] is False
+    body = json.loads(result["content"][0]["text"])
+    job_id = body["job_id"]
+
+    resp = rpc(stdio, "tools/call", {"name": "llm_job_status", "arguments": {"job_id": job_id}})
+    assert json.loads(resp["result"]["content"][0]["text"])["id"] == job_id
+
+    resp = rpc(stdio, "tools/call", {"name": "llm_dashboard", "arguments": {}})
+    assert "jobs" in json.loads(resp["result"]["content"][0]["text"])
+
+
+def test_stdio_errors(stdio):
+    resp = rpc(stdio, "tools/call", {"name": "no_such_tool", "arguments": {}})
+    assert resp["error"]["code"] == -32602
+    resp = rpc(stdio, "tools/call", {"name": "llm_job_status", "arguments": {}})
+    assert "missing arguments" in resp["error"]["message"]
+    resp = rpc(stdio, "definitely/not/a/method")
+    assert resp["error"]["code"] == -32601
+
+
+def test_stdio_run_loop(burl):
+    lines = [
+        json.dumps({"jsonrpc": "2.0", "id": 1, "method": "initialize", "params": {}}),
+        "not json at all",
+        json.dumps({"jsonrpc": "2.0", "method": "notifications/initialized"}),
+        json.dumps({"jsonrpc": "2.0", "id": 2, "method": "tools/list"}),
+    ]
+    stdin, stdout = io.StringIO("\n".join(lines) + "\n"), io.StringIO()
+    MCPStdioServer(ToolContext(burl), stdin=stdin, stdout=stdout).run()
+    out = [json.loads(l) for l in stdout.getvalue().splitlines()]
+    assert out[0]["id"] == 1 and "result" in out[0]
+    assert out[1]["error"]["code"] == -32700
+    assert out[2]["id"] == 2 and len(out[2]["result"]["tools"]) == 12
+
+
+def test_tool_error_is_result_not_protocol_error():
+    # unreachable bridge -> tool-level error with isError=True
+    srv = MCPStdioServer(ToolContext("http://127.0.0.1:1", timeout_s=0.2))
+    resp = rpc(srv, "tools/call", {"name": "llm_dashboard", "arguments": {}})
+    assert resp["result"]["isError"] is True
+
+
+def test_http_error_surfaces_as_tool_error(stdio):
+    # 404 from the bridge must become isError=True, not a fake success
+    resp = rpc(stdio, "tools/call", {"name": "llm_job_status", "arguments": {"job_id": "nope"}})
+    assert resp["result"]["isError"] is True
+    assert "404" in resp["result"]["content"][0]["text"]
+
+
+def test_bridge_submit_rejects_bad_priority_types(burl):
+    r = httpx.post(f"{burl}/submit", json={"kind": "echo", "priority": None})
+    assert r.status_code == 202  # null coerces to default, like the core path
+    r = httpx.post(f"{burl}/submit", json={"kind": "echo", "priority": "high"})
+    assert r.status_code in (400, 202)  # gRPC mode: 400; HTTP passthrough: core decides
