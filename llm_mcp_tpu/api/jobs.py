@@ -71,15 +71,16 @@ class JobsAPI:
         try:
             priority = int(body.get("priority") or 0)
             max_attempts = int(body.get("max_attempts") or 0) or None
+            deadline_at = float(body["deadline_at"]) if body.get("deadline_at") else None
         except (TypeError, ValueError):
-            resp.write_error("priority/max_attempts must be integers", 400)
+            resp.write_error("priority/max_attempts/deadline_at must be numeric", 400)
             return
         job = self.queue.submit(
             kind,
             payload,
             priority=priority,
             max_attempts=max_attempts,
-            deadline_at=body.get("deadline_at"),
+            deadline_at=deadline_at,
         )
         self.metrics.jobs_created.labels(kind=kind).inc()
         resp.write_json({"job_id": job.id, "status": job.status}, status=202)

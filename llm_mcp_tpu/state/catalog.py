@@ -178,6 +178,7 @@ class Catalog:
         self,
         model_id: str,
         *,
+        name: str | None = None,
         kind: str | None = None,
         params_b: float | None = None,
         size_gb: float = 0.0,
@@ -191,13 +192,19 @@ class Catalog:
         self.db.execute(
             "INSERT INTO models(id, name, family, kind, params_b, size_gb, tier,"
             " thinking, context_k, created_at) VALUES(?,?,?,?,?,?,?,?,?,?)"
-            " ON CONFLICT(id) DO UPDATE SET kind=excluded.kind,"
+            # name updates only when an explicit display name was given —
+            # name-less upserts (engine registration, discovery) must not
+            # wipe a friendly name the catalog sync stored earlier
+            " ON CONFLICT(id) DO UPDATE SET"
+            " name=CASE WHEN excluded.name<>excluded.id THEN excluded.name"
+            "      ELSE models.name END,"
+            " kind=excluded.kind,"
             " params_b=excluded.params_b, size_gb=excluded.size_gb,"
             " tier=excluded.tier, thinking=excluded.thinking,"
             " context_k=excluded.context_k, family=excluded.family",
             (
                 model_id,
-                model_id,
+                name or model_id,
                 family if family is not None else meta["family"],
                 kind or meta["kind"],
                 params_b if params_b is not None else meta["params_b"],

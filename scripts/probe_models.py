@@ -1,0 +1,164 @@
+#!/usr/bin/env python3
+"""Synthetic benchmark probe: drive the REAL stack end-to-end.
+
+Role parity: reference `scripts/probe_openrouter_models.py:113-200,244-405` —
+submit chat jobs for each target model through the production queue, wait for
+workers to complete them, compute p50/p95 latency percentiles, and insert
+rows into `benchmarks` under a synthetic device id (reference:
+`cloud-openrouter`) so the routing brain can rank cloud models by measured
+latency exactly like local devices.
+
+This doubles as the closest thing to an E2E test the cluster has
+(SURVEY.md §4): it exercises submit → claim → execute → complete → result
+with no mocks.
+
+Usage:
+    python scripts/probe_models.py --core http://localhost:8080 \
+        --models tiny-llm --rounds 3 [--kind generate] [--db PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from llm_mcp_tpu.mcp.tools import http_json  # noqa: E402
+
+DEFAULT_PROMPT = "Reply with one short sentence: what is a systolic array?"
+
+
+def _http(method: str, url: str, body: Any = None, timeout: float = 30.0) -> tuple[int, Any]:
+    return http_json(method, url, body, timeout)
+
+
+def percentile(values: list[float], pct: float) -> float:
+    """Nearest-rank percentile (reference `probe_openrouter_models.py:113-124`)."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    k = max(0, min(len(ordered) - 1, int(round((pct / 100.0) * (len(ordered) - 1)))))
+    return ordered[k]
+
+
+def probe_model(
+    core: str,
+    model: str,
+    kind: str,
+    rounds: int,
+    prompt: str,
+    timeout_s: float,
+    max_tokens: int,
+) -> dict[str, Any]:
+    latencies_ms: list[float] = []
+    tps_values: list[float] = []
+    tokens_out_total = 0
+    errors: list[str] = []
+    for i in range(rounds):
+        payload = {"model": model, "prompt": prompt, "max_tokens": max_tokens}
+        try:
+            status, out = _http("POST", f"{core}/v1/jobs", {"kind": kind, "payload": payload})
+        except OSError as e:
+            errors.append(f"submit failed: {e}")
+            continue
+        if status != 202:
+            errors.append(f"submit HTTP {status}: {out}")
+            continue
+        job_id = out["job_id"]
+        t0 = time.time()
+        deadline = t0 + timeout_s
+        job = None
+        while time.time() < deadline:
+            try:
+                _, job = _http("GET", f"{core}/v1/jobs/{job_id}")
+            except OSError:
+                time.sleep(0.5)  # transient core hiccup: keep polling
+                continue
+            if job.get("status") in ("done", "error", "canceled"):
+                break
+            time.sleep(0.25)
+        elapsed_ms = (time.time() - t0) * 1000.0
+        if not job or job.get("status") != "done":
+            errors.append(f"round {i}: {job.get('status') if job else 'timeout'}: "
+                          f"{(job or {}).get('error') or ''}")
+            continue
+        latencies_ms.append(elapsed_ms)
+        result = job.get("result") or {}
+        n_out = int(result.get("tokens_out") or result.get("eval_count") or 0)
+        tokens_out_total += n_out
+        if result.get("tps"):
+            tps_values.append(float(result["tps"]))
+        elif n_out and elapsed_ms > 0:
+            tps_values.append(n_out / (elapsed_ms / 1000.0))
+    return {
+        "model": model,
+        "rounds": rounds,
+        "ok": len(latencies_ms),
+        "errors": errors,
+        "p50_ms": round(percentile(latencies_ms, 50), 1),
+        "p95_ms": round(percentile(latencies_ms, 95), 1),
+        "avg_tps": round(sum(tps_values) / len(tps_values), 2) if tps_values else 0.0,
+        "tokens_out": tokens_out_total,
+    }
+
+
+def record(db_path: str, device_id: str, task_type: str, results: list[dict[str, Any]]) -> int:
+    from llm_mcp_tpu.state import Catalog, Database
+
+    db = Database(db_path)
+    catalog = Catalog(db)
+    n = 0
+    try:
+        catalog.upsert_device(
+            device_id, name=device_id, online=True, tags={"synthetic": True, "probe": True}
+        )
+        for r in results:
+            if not r["ok"]:
+                continue
+            catalog.record_benchmark(
+                device_id,
+                r["model"],
+                task_type,
+                tokens_out=r["tokens_out"],
+                latency_ms=r["p50_ms"],
+                tps=r["avg_tps"],
+            )
+            n += 1
+    finally:
+        db.close()
+    return n
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--core", default=os.environ.get("CORE_URL", "http://localhost:8080"))
+    ap.add_argument("--models", required=True, help="comma-separated model ids")
+    ap.add_argument("--kind", default="generate", help="job kind to probe (generate|chat|embed)")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--prompt", default=DEFAULT_PROMPT)
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--device-id", default="cloud-probe")
+    ap.add_argument("--db", default="", help="record benchmarks into this state DB")
+    args = ap.parse_args()
+
+    results = [
+        probe_model(
+            args.core.rstrip("/"), m.strip(), args.kind, args.rounds,
+            args.prompt, args.timeout, args.max_tokens,
+        )
+        for m in args.models.split(",")
+        if m.strip()
+    ]
+    recorded = record(args.db, args.device_id, args.kind, results) if args.db else 0
+    print(json.dumps({"results": results, "recorded": recorded}, indent=2))
+    return 0 if all(r["ok"] for r in results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,164 @@
+#!/usr/bin/env python3
+"""Sync the curated cloud model list into the framework catalog.
+
+Role parity: reference `scripts/sync_openrouter_models.py:80-318` — read the
+curated YAML, enrich each id from the provider's live `/models` endpoint
+(OpenRouter wire format: per-TOKEN prices as decimal strings), convert prices
+to USD-per-1M, and upsert `models` + `model_pricing`. Differences by design:
+the state layer is the framework's embedded SQLite catalog (not Postgres), and
+the script degrades gracefully offline — the curated file carries fallback
+pricing so a zero-egress environment still seeds a useful catalog.
+
+Usage:
+    python scripts/sync_cloud_models.py [--db PATH] [--config PATH]
+        [--base-url URL] [--api-key KEY] [--dry-run]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+from typing import Any
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import yaml  # noqa: E402
+
+PER_TOKEN_TO_PER_1M = 1_000_000.0
+
+
+def load_curated(path: str) -> list[dict[str, Any]]:
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    models = doc.get("models") or []
+    out = []
+    for m in models:
+        if isinstance(m, str):
+            m = {"id": m}
+        if isinstance(m, dict) and m.get("id"):
+            out.append(m)
+    return out
+
+
+def fetch_provider_catalog(base_url: str, api_key: str, timeout: float = 30.0) -> dict[str, dict]:
+    """GET {base}/models → {model_id: entry}; empty dict on any failure."""
+    url = base_url.rstrip("/") + "/models"
+    headers = {"Accept": "application/json"}
+    if api_key:
+        headers["Authorization"] = f"Bearer {api_key}"
+    req = urllib.request.Request(url, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:  # noqa: S310
+            doc = json.loads(r.read().decode())
+    except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+        print(f"provider catalog unavailable ({e}); using curated pricing", file=sys.stderr)
+        return {}
+    return {m["id"]: m for m in doc.get("data", []) if isinstance(m, dict) and m.get("id")}
+
+
+def per_1m_pricing(entry: dict[str, Any]) -> tuple[float, float] | None:
+    """OpenRouter prices are USD per token as strings ('0.0000008')."""
+    pricing = entry.get("pricing") or {}
+    try:
+        p_in = float(pricing.get("prompt", "0")) * PER_TOKEN_TO_PER_1M
+        p_out = float(pricing.get("completion", "0")) * PER_TOKEN_TO_PER_1M
+    except (TypeError, ValueError):
+        return None
+    if p_in < 0 or p_out < 0:  # OpenRouter uses -1 for dynamic pricing
+        return None
+    if p_in == 0 and p_out == 0:  # missing/zeroed pricing: let curated win
+        return None
+    return p_in, p_out
+
+
+def sync(
+    db_path: str,
+    config_path: str,
+    base_url: str,
+    api_key: str,
+    dry_run: bool = False,
+    fetcher=fetch_provider_catalog,
+) -> dict[str, Any]:
+    from llm_mcp_tpu.state import Catalog, Database
+    from llm_mcp_tpu.state.catalog import infer_model_meta
+
+    curated = load_curated(config_path)
+    live = fetcher(base_url, api_key)
+
+    db = Database(db_path)
+    catalog = Catalog(db)
+    synced, priced, skipped = [], 0, []
+    try:
+        for spec in curated:
+            model_id = spec["id"]
+            entry = live.get(model_id, {})
+            meta = infer_model_meta(model_id)
+            kind = spec.get("kind") or meta.get("kind") or "llm"
+            context_k = 0
+            if entry.get("context_length"):
+                context_k = max(1, int(entry["context_length"]) // 1024)
+            pricing = per_1m_pricing(entry) if entry else None
+            if pricing is None and isinstance(spec.get("pricing"), dict):
+                p = spec["pricing"]
+                try:
+                    pricing = (float(p.get("input_per_1m", 0)), float(p.get("output_per_1m", 0)))
+                except (TypeError, ValueError):
+                    pricing = None
+            if dry_run:
+                synced.append(model_id)
+                if pricing:
+                    priced += 1
+                continue
+            catalog.upsert_model(
+                model_id,
+                name=str(entry.get("name") or model_id),
+                kind=kind,
+                tier=spec.get("tier") or meta.get("tier") or "standard",
+                thinking=bool(spec.get("thinking", meta.get("thinking", False))),
+                context_k=context_k or int(meta.get("context_k") or 8),
+            )
+            if pricing:
+                catalog.set_pricing(model_id, pricing[0], pricing[1])
+                priced += 1
+            else:
+                skipped.append(model_id)
+            if spec.get("category"):
+                catalog.set_ranking(model_id, str(spec["category"]), float(spec.get("score", 50.0)))
+            synced.append(model_id)
+    finally:
+        db.close()
+    return {
+        "synced": len(synced),
+        "priced": priced,
+        "unpriced": skipped,
+        "live_catalog": len(live),
+        "dry_run": dry_run,
+        "models": synced,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--db", default=os.environ.get("DB_PATH", "llmmcp.sqlite3"))
+    ap.add_argument(
+        "--config",
+        default=os.path.join(os.path.dirname(__file__), "..", "config", "curated_cloud_models.yaml"),
+    )
+    ap.add_argument(
+        "--base-url",
+        default=os.environ.get("OPENROUTER_BASE_URL", "https://openrouter.ai/api/v1"),
+    )
+    ap.add_argument("--api-key", default=os.environ.get("OPENROUTER_API_KEY", ""))
+    ap.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args()
+    result = sync(args.db, args.config, args.base_url, args.api_key, dry_run=args.dry_run)
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

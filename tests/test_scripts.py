@@ -1,0 +1,203 @@
+"""Ops-script tests (C22 parity): curated model sync with per-token→per-1M
+price conversion, and the synthetic benchmark probe driven through the real
+submit→claim→execute→complete stack."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import threading
+
+import jax.numpy as jnp
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(name, os.path.join(REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+sync_mod = _load("sync_cloud_models")
+probe_mod = _load("probe_models")
+
+CURATED = os.path.join(REPO, "config", "curated_cloud_models.yaml")
+
+
+# ------------------------------------------------------- sync_cloud_models --
+
+
+def test_load_curated_file():
+    models = sync_mod.load_curated(CURATED)
+    assert len(models) >= 5
+    assert all("id" in m for m in models)
+
+
+def test_per_1m_conversion():
+    entry = {"pricing": {"prompt": "0.0000008", "completion": "0.0000024"}}
+    p_in, p_out = sync_mod.per_1m_pricing(entry)
+    assert p_in == pytest.approx(0.8)
+    assert p_out == pytest.approx(2.4)
+    assert sync_mod.per_1m_pricing({"pricing": {"prompt": "-1", "completion": "0"}}) is None
+    assert sync_mod.per_1m_pricing({"pricing": {"prompt": "x"}}) is None
+
+
+def test_sync_with_live_fetcher(tmp_path):
+    db_path = str(tmp_path / "cat.sqlite3")
+
+    def fake_fetch(base_url, api_key, timeout=30.0):
+        return {
+            "moonshotai/kimi-k2.5": {
+                "id": "moonshotai/kimi-k2.5",
+                "name": "Kimi K2.5",
+                "context_length": 262144,
+                "pricing": {"prompt": "0.00000055", "completion": "0.0000022"},
+            }
+        }
+
+    result = sync_mod.sync(db_path, CURATED, "http://x", "", fetcher=fake_fetch)
+    assert result["synced"] >= 5
+    assert result["priced"] >= 5  # live for kimi, curated fallback for the rest
+
+    from llm_mcp_tpu.state import Catalog, Database
+
+    db = Database(db_path)
+    cat = Catalog(db)
+    kimi = cat.get_model("moonshotai/kimi-k2.5")
+    assert kimi is not None and kimi["name"] == "Kimi K2.5"
+    assert kimi["context_k"] == 256
+    pricing = cat.get_pricing("moonshotai/kimi-k2.5")
+    assert pricing["input_per_1m"] == pytest.approx(0.55)
+    # offline-fallback pricing for a model the live catalog didn't return
+    glm = cat.get_pricing("z-ai/glm-4.7")
+    assert glm is not None and glm["input_per_1m"] == pytest.approx(0.45)
+    # category rankings seeded
+    assert any(r["model_id"] == "x-ai/grok-code-fast-1" for r in cat.rankings("coding"))
+    # embed kind respected from curated spec
+    assert cat.get_model("qwen/qwen3-embedding-8b")["kind"] == "embed"
+    db.close()
+
+
+def test_sync_offline_and_dry_run(tmp_path):
+    db_path = str(tmp_path / "cat.sqlite3")
+    result = sync_mod.sync(db_path, CURATED, "http://x", "", fetcher=lambda *a, **k: {})
+    assert result["synced"] >= 5 and result["live_catalog"] == 0
+    dry = sync_mod.sync(db_path, CURATED, "http://x", "", dry_run=True,
+                        fetcher=lambda *a, **k: {})
+    assert dry["dry_run"] is True
+
+
+# ------------------------------------------------------------ probe_models --
+
+
+def test_percentile_nearest_rank():
+    vals = [10.0, 20.0, 30.0, 40.0]
+    assert probe_mod.percentile(vals, 50) == 30.0 or probe_mod.percentile(vals, 50) == 20.0
+    assert probe_mod.percentile(vals, 95) == 40.0
+    assert probe_mod.percentile([], 50) == 0.0
+    assert probe_mod.percentile([5.0], 95) == 5.0
+
+
+@pytest.fixture(scope="module")
+def live_stack():
+    from llm_mcp_tpu.api.server import CoreServer
+    from llm_mcp_tpu.executor import GenerationEngine
+    from llm_mcp_tpu.state.db import Database
+    from llm_mcp_tpu.utils.config import Config
+    from llm_mcp_tpu.worker import CoreClient, Executors, Worker
+
+    gen = GenerationEngine(
+        "tiny-llm", max_slots=4, max_seq_len=128, dtype=jnp.float32, decode_chunk=4
+    ).start()
+    srv = CoreServer(
+        Config(db_path=":memory:", discovery_interval_s=10_000),
+        db=Database(":memory:"),
+        gen_engines={"tiny-llm": gen},
+        device_id="tpu-local",
+    ).start("127.0.0.1", 0)
+    client = CoreClient(f"http://127.0.0.1:{srv.api.port}", backoff_s=0.01)
+    worker = Worker(client, Executors(gen_engines={"tiny-llm": gen}), worker_id="w-probe")
+    worker.register_forever()
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            if not worker.run_once():
+                stop.wait(0.05)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    yield srv
+    stop.set()
+    t.join(timeout=5)
+    srv.shutdown()
+
+
+def test_probe_through_real_stack(live_stack, tmp_path):
+    core = f"http://127.0.0.1:{live_stack.api.port}"
+    result = probe_mod.probe_model(core, "tiny-llm", "generate", 2,
+                                   "hello", timeout_s=60.0, max_tokens=8)
+    assert result["ok"] == 2, result["errors"]
+    assert result["p50_ms"] > 0 and result["p95_ms"] >= result["p50_ms"]
+    assert result["avg_tps"] > 0
+
+    db_path = str(tmp_path / "probe.sqlite3")
+    recorded = probe_mod.record(db_path, "cloud-probe", "generate", [result])
+    assert recorded == 1
+
+    from llm_mcp_tpu.state import Catalog, Database
+
+    db = Database(db_path)
+    cat = Catalog(db)
+    rows = cat.list_benchmarks()
+    assert rows and rows[0]["device_id"] == "cloud-probe" and rows[0]["tps"] > 0
+    dev = cat.get_device("cloud-probe")
+    assert dev is not None
+    db.close()
+
+
+def test_probe_unknown_model_reports_errors(live_stack):
+    core = f"http://127.0.0.1:{live_stack.api.port}"
+    result = probe_mod.probe_model(core, "no-such-model", "generate", 1,
+                                   "hi", timeout_s=10.0, max_tokens=4)
+    assert result["ok"] == 0 and result["errors"]
+
+
+def test_nameless_upsert_preserves_friendly_name(tmp_path):
+    from llm_mcp_tpu.state import Catalog, Database
+
+    db = Database(":memory:")
+    cat = Catalog(db)
+    cat.upsert_model("m/x", name="Fancy X")
+    cat.upsert_model("m/x")  # discovery-style upsert without a name
+    assert cat.get_model("m/x")["name"] == "Fancy X"
+    cat.upsert_model("m/x", name="Fancier X")
+    assert cat.get_model("m/x")["name"] == "Fancier X"
+    db.close()
+
+
+def test_zero_live_pricing_falls_back_to_curated(tmp_path):
+    db_path = str(tmp_path / "cat0.sqlite3")
+
+    def fetch_zero_priced(base_url, api_key, timeout=30.0):
+        return {"z-ai/glm-4.7": {"id": "z-ai/glm-4.7",
+                                 "pricing": {"prompt": "0", "completion": "0"}}}
+
+    sync_mod.sync(db_path, CURATED, "http://x", "", fetcher=fetch_zero_priced)
+    from llm_mcp_tpu.state import Catalog, Database
+
+    db = Database(db_path)
+    assert Catalog(db).get_pricing("z-ai/glm-4.7")["input_per_1m"] == pytest.approx(0.45)
+    db.close()
+
+
+def test_submit_rejects_bad_deadline(live_stack):
+    import httpx
+
+    core = f"http://127.0.0.1:{live_stack.api.port}"
+    r = httpx.post(f"{core}/v1/jobs", json={"kind": "echo", "deadline_at": "tomorrow"})
+    assert r.status_code == 400
