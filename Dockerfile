@@ -1,0 +1,28 @@
+# One image for every service (core / worker / telemetry / mcp bridge);
+# the compose/k8s manifests pick the process via `command:`.
+# Role parity: the reference builds one image per service directory
+# (compose.yml build contexts); with a single Python package a single
+# image is simpler and keeps versions in lockstep.
+FROM python:3.12-slim
+
+WORKDIR /app
+
+# TPU hosts: swap the jax extra for the libtpu wheel, e.g.
+#   pip install "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+RUN pip install --no-cache-dir \
+    "jax[cpu]" flax optax orbax-checkpoint einops \
+    grpcio protobuf httpx pyyaml
+
+COPY pyproject.toml ./
+COPY llm_mcp_tpu ./llm_mcp_tpu
+COPY scripts ./scripts
+COPY config ./config
+COPY proto ./proto
+
+ENV PYTHONPATH=/app \
+    DB_PATH=/data/llmmcp.sqlite3
+
+VOLUME ["/data"]
+
+# default process: the core API server (overridden per service)
+CMD ["python", "-m", "llm_mcp_tpu.api"]

@@ -24,7 +24,7 @@ from typing import Any
 
 from ..executor import EmbeddingEngine, GenerationEngine
 from ..routing import CircuitBreaker, LimitsEngine, Router
-from ..state.catalog import Catalog
+from ..state.catalog import Catalog, cloud_pricing_per_1m
 from ..state.db import Database
 from ..state.queue import JobQueue
 from ..telemetry import Metrics
@@ -277,15 +277,14 @@ class CoreServer:
                     if not mid:
                         continue
                     ctx = int(m.get("context_length") or 0)
-                    self.catalog.upsert_model(mid, context_k=ctx // 1024 if ctx else None)
-                    pricing = m.get("pricing") or {}
-                    try:
-                        p_in = float(pricing.get("prompt") or 0) * 1e6
-                        p_out = float(pricing.get("completion") or 0) * 1e6
-                        if p_in or p_out:
-                            self.catalog.set_pricing(mid, p_in, p_out)
-                    except (TypeError, ValueError):
-                        pass
+                    self.catalog.upsert_model(
+                        mid,
+                        name=str(m.get("name") or "") or None,
+                        context_k=ctx // 1024 if ctx else None,
+                    )
+                    pricing = cloud_pricing_per_1m(m)
+                    if pricing is not None:
+                        self.catalog.set_pricing(mid, pricing[0], pricing[1])
                     cloud_synced += 1
             except Exception as e:
                 resp.write_json(

@@ -87,6 +87,27 @@ def infer_model_meta(name: str, params_b: float = 0.0) -> dict[str, Any]:
     }
 
 
+def cloud_pricing_per_1m(entry: dict[str, Any]) -> tuple[float, float] | None:
+    """Cloud-catalog pricing → USD per 1M tokens, or None when unusable.
+
+    Providers (OpenRouter wire format) quote per-TOKEN prices as decimal
+    strings; -1 marks dynamic pricing and must not be stored (reference
+    converts per-token→per-1M in `scripts/sync_openrouter_models.py`).
+    All-zero pricing is treated as missing so curated fallbacks can win.
+    """
+    pricing = entry.get("pricing") or {}
+    try:
+        p_in = float(pricing.get("prompt") or 0) * 1_000_000.0
+        p_out = float(pricing.get("completion") or 0) * 1_000_000.0
+    except (TypeError, ValueError):
+        return None
+    if p_in < 0 or p_out < 0:
+        return None
+    if p_in == 0 and p_out == 0:
+        return None
+    return p_in, p_out
+
+
 def record_benchmark_from_job(catalog: "Catalog", job: Any) -> None:
     """benchmark.* job results feed the benchmarks table that routing ranks
     by (`grpcserver/server.go:302-327`, `main.py:471-518`). Shared by the
@@ -189,19 +210,22 @@ class Catalog:
     ) -> None:
         meta = infer_model_meta(model_id, params_b or 0.0)
         now = time.time()
+        # Fresh INSERTs fall back to name-inference defaults; conflicting
+        # UPDATEs only touch the columns the caller explicitly provided, so
+        # a partial upsert (engine registration, discovery, sync) never
+        # wipes richer catalog data another path stored earlier.
         self.db.execute(
             "INSERT INTO models(id, name, family, kind, params_b, size_gb, tier,"
             " thinking, context_k, created_at) VALUES(?,?,?,?,?,?,?,?,?,?)"
-            # name updates only when an explicit display name was given —
-            # name-less upserts (engine registration, discovery) must not
-            # wipe a friendly name the catalog sync stored earlier
             " ON CONFLICT(id) DO UPDATE SET"
-            " name=CASE WHEN excluded.name<>excluded.id THEN excluded.name"
-            "      ELSE models.name END,"
-            " kind=excluded.kind,"
-            " params_b=excluded.params_b, size_gb=excluded.size_gb,"
-            " tier=excluded.tier, thinking=excluded.thinking,"
-            " context_k=excluded.context_k, family=excluded.family",
+            " name=COALESCE(?, models.name),"
+            " family=COALESCE(?, models.family),"
+            " kind=COALESCE(?, models.kind),"
+            " params_b=COALESCE(?, models.params_b),"
+            " size_gb=CASE WHEN ? THEN excluded.size_gb ELSE models.size_gb END,"
+            " tier=COALESCE(?, models.tier),"
+            " thinking=COALESCE(?, models.thinking),"
+            " context_k=COALESCE(?, models.context_k)",
             (
                 model_id,
                 name or model_id,
@@ -213,6 +237,15 @@ class Catalog:
                 1 if (thinking if thinking is not None else meta["thinking"]) else 0,
                 context_k or meta["context_k"],
                 now,
+                # update-only-when-provided params
+                name,
+                family,
+                kind,
+                params_b,
+                1 if size_gb else 0,
+                tier,
+                None if thinking is None else (1 if thinking else 0),
+                context_k,
             ),
         )
 

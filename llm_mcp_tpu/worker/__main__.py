@@ -50,8 +50,29 @@ def main() -> None:
         )
 
     cloud = CloudClient(cfg) if (cfg.has_openrouter() or cfg.has_openai()) else None
+    # gRPC transport when configured (reference worker parity: gRPC-only,
+    # `main.py:536-599`); HTTP otherwise. Worker is transport-agnostic.
+    grpc_target = os.environ.get("CORE_GRPC_TARGET", "")
+    client = CoreClient(core_url)
+    if grpc_target:
+        try:
+            from ..rpc.client import GrpcCoreClient
+
+            client = GrpcCoreClient(grpc_target)
+        except Exception as e:
+            # Downgrading to HTTP is only safe when CORE_URL was explicitly
+            # configured — otherwise fail fast instead of silently spinning
+            # against the localhost default.
+            if not os.environ.get("CORE_URL"):
+                raise SystemExit(
+                    f"CORE_GRPC_TARGET={grpc_target!r} set but gRPC client "
+                    f"unavailable ({e}) and no CORE_URL fallback configured"
+                ) from e
+            logging.getLogger("main").warning(
+                "gRPC unavailable (%s); falling back to HTTP at %s", e, core_url
+            )
     worker = Worker(
-        CoreClient(core_url),
+        client,
         Executors(gen_engines=gen_engines, embed_engines=embed_engines, cloud=cloud),
         worker_id=cfg.worker_id,
         name=cfg.worker_name,

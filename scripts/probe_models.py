@@ -60,7 +60,10 @@ def probe_model(
     tokens_out_total = 0
     errors: list[str] = []
     for i in range(rounds):
-        payload = {"model": model, "prompt": prompt, "max_tokens": max_tokens}
+        if kind == "embed" or kind.endswith(".embed"):
+            payload: dict[str, Any] = {"model": model, "input": [prompt]}
+        else:
+            payload = {"model": model, "prompt": prompt, "max_tokens": max_tokens}
         try:
             status, out = _http("POST", f"{core}/v1/jobs", {"kind": kind, "payload": payload})
         except OSError as e:

@@ -28,7 +28,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import yaml  # noqa: E402
 
-PER_TOKEN_TO_PER_1M = 1_000_000.0
+from llm_mcp_tpu.state.catalog import cloud_pricing_per_1m  # noqa: E402
 
 
 def load_curated(path: str) -> list[dict[str, Any]]:
@@ -60,19 +60,9 @@ def fetch_provider_catalog(base_url: str, api_key: str, timeout: float = 30.0) -
     return {m["id"]: m for m in doc.get("data", []) if isinstance(m, dict) and m.get("id")}
 
 
-def per_1m_pricing(entry: dict[str, Any]) -> tuple[float, float] | None:
-    """OpenRouter prices are USD per token as strings ('0.0000008')."""
-    pricing = entry.get("pricing") or {}
-    try:
-        p_in = float(pricing.get("prompt", "0")) * PER_TOKEN_TO_PER_1M
-        p_out = float(pricing.get("completion", "0")) * PER_TOKEN_TO_PER_1M
-    except (TypeError, ValueError):
-        return None
-    if p_in < 0 or p_out < 0:  # OpenRouter uses -1 for dynamic pricing
-        return None
-    if p_in == 0 and p_out == 0:  # missing/zeroed pricing: let curated win
-        return None
-    return p_in, p_out
+# shared with the core's /v1/models/sync path so the two conversions can
+# never disagree on the -1 dynamic-pricing sentinel
+per_1m_pricing = cloud_pricing_per_1m
 
 
 def sync(

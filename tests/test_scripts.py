@@ -201,3 +201,33 @@ def test_submit_rejects_bad_deadline(live_stack):
     core = f"http://127.0.0.1:{live_stack.api.port}"
     r = httpx.post(f"{core}/v1/jobs", json={"kind": "echo", "deadline_at": "tomorrow"})
     assert r.status_code == 400
+
+
+def test_partial_upsert_preserves_context_and_tier():
+    from llm_mcp_tpu.state import Catalog, Database
+
+    db = Database(":memory:")
+    cat = Catalog(db)
+    cat.upsert_model("m/ctx", name="Rich", context_k=256, tier="premium", kind="llm")
+    cat.upsert_model("m/ctx")  # partial upsert: nothing explicit
+    row = cat.get_model("m/ctx")
+    assert row["context_k"] == 256 and row["tier"] == "premium" and row["name"] == "Rich"
+    cat.upsert_model("m/ctx", context_k=128)
+    assert cat.get_model("m/ctx")["context_k"] == 128
+    db.close()
+
+
+def test_dynamic_pricing_sentinel_shared():
+    from llm_mcp_tpu.state.catalog import cloud_pricing_per_1m
+
+    assert cloud_pricing_per_1m({"pricing": {"prompt": "-1", "completion": "2e-6"}}) is None
+    assert cloud_pricing_per_1m({"pricing": {"prompt": "1e-6", "completion": "2e-6"}}) == \
+        pytest.approx((1.0, 2.0))
+
+
+def test_probe_embed_kind_builds_input_payload(live_stack):
+    # live_stack has no embed engine; assert the payload shape via the job record
+    core = f"http://127.0.0.1:{live_stack.api.port}"
+    probe_mod.probe_model(core, "tiny-embed", "embed", 1, "hello", timeout_s=5.0, max_tokens=4)
+    jobs = live_stack.queue.list(kind="embed", limit=5)
+    assert jobs and jobs[0].payload.get("input") == ["hello"]
