@@ -34,6 +34,24 @@ class Tokenizer(Protocol):
     def decode_flush(self, pending: bytes) -> str: ...
 
 
+def utf8_hold(data: bytes) -> int:
+    """How many trailing bytes form an INCOMPLETE UTF-8 sequence (0-3).
+
+    Single source of truth for the streaming hold-back boundary scan; the
+    native scanner (`native/bpe_tokenizer.cpp::utf8_hold`) mirrors this and
+    is equivalence-tested against it.
+    """
+    for i in range(1, min(3, len(data)) + 1):
+        b = data[-i]
+        if b < 0x80:  # ASCII — sequence complete
+            return 0
+        if b >= 0xC0:  # lead byte of a 2-4 byte sequence
+            need = 2 if b < 0xE0 else 3 if b < 0xF0 else 4
+            return i if i < need else 0
+        # else continuation byte — keep scanning backwards
+    return 0
+
+
 class ByteTokenizer:
     """UTF-8 byte-level tokenizer: 0=pad, 1=bos, 2=eos, byte b → 3+b."""
 
@@ -70,17 +88,7 @@ class ByteTokenizer:
         # (≤3 continuation-pending bytes); everything before it decodes now,
         # with invalid bytes becoming U+FFFD — a model emitting garbage bytes
         # must not stall the stream by buffering forever.
-        hold = 0
-        for i in range(1, min(3, len(data)) + 1):
-            b = data[-i]
-            if b < 0x80:  # ASCII — sequence complete
-                break
-            if b >= 0xC0:  # lead byte of a 2-4 byte sequence
-                need = 2 if b < 0xE0 else 3 if b < 0xF0 else 4
-                if i < need:
-                    hold = i
-                break
-            # else continuation byte — keep scanning backwards
+        hold = utf8_hold(data)
         if hold:
             return data[:-hold].decode("utf-8", errors="replace"), data[-hold:]
         return data.decode("utf-8", errors="replace"), b""
@@ -143,9 +151,29 @@ class HFTokenizer:
 
 
 def load_tokenizer(weights_dir: str = "") -> Tokenizer:
-    """HF tokenizer if `tokenizer.json` exists in the weights dir, else bytes."""
+    """Tokenizer for a weights dir: the in-repo native BPE when a
+    `tokenizer.json` exists (C++ merge core via ctypes, Python-merge
+    fallback), the HF `tokenizers` wrapper on request or when the file uses
+    a non-BPE model, else the dependency-free byte tokenizer.
+
+    `LLM_MCP_TPU_TOKENIZER=native|python|hf|byte` forces a backend.
+    """
     if weights_dir:
         path = os.path.join(weights_dir, "tokenizer.json")
         if os.path.exists(path):
+            choice = os.environ.get("LLM_MCP_TPU_TOKENIZER", "native")
+            if choice == "byte":
+                return ByteTokenizer()
+            if choice in ("native", "python"):
+                try:
+                    from .bpe import BPETokenizer
+
+                    return BPETokenizer(path, force_python=(choice == "python"))
+                except Exception as e:  # non-BPE model / missing regex: try HF
+                    import logging
+
+                    logging.getLogger("executor").warning(
+                        "native BPE unavailable for %s (%s); trying HF", path, e
+                    )
             return HFTokenizer(path)
     return ByteTokenizer()

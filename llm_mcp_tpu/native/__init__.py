@@ -1,0 +1,97 @@
+"""ctypes loader for the framework's native (C++) components.
+
+`load_bpe()` returns the compiled `libbpe` handle, building it from
+`native/bpe_tokenizer.cpp` on first use (g++ is in the base image; pybind11
+is not, hence the plain C ABI + ctypes). Builds are cached in
+`native/build/` next to the source; set `LLM_MCP_TPU_NO_NATIVE=1` to force
+the pure-Python fallbacks everywhere (CI images without a toolchain).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger("native")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "bpe_tokenizer.cpp")
+_SO = os.path.join(_REPO_ROOT, "native", "build", "libbpe.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_failed = False
+
+
+def _build() -> bool:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    # compile to a per-process temp name, then atomically rename: concurrent
+    # processes (core + worker on a shared volume, parallel test workers)
+    # must never load a half-written .so
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-Wall", "-std=c++17", "-fPIC", "-shared", "-o", tmp, _SRC]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("native build failed to run: %s", e)
+        return False
+    if r.returncode != 0:
+        log.warning("native build failed:\n%s", r.stderr[-2000:])
+        return False
+    try:
+        os.replace(tmp, _SO)
+    except OSError as e:
+        log.warning("native build rename failed: %s", e)
+        return False
+    return True
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p, i32p = ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32)
+    lib.bpe_new.restype = ctypes.c_void_p
+    lib.bpe_free.argtypes = [ctypes.c_void_p]
+    lib.bpe_add_token.argtypes = [ctypes.c_void_p, u8p, ctypes.c_int, ctypes.c_int32]
+    lib.bpe_add_token.restype = ctypes.c_int
+    lib.bpe_add_merge.argtypes = [ctypes.c_void_p] + [ctypes.c_int32] * 4
+    lib.bpe_add_merge.restype = ctypes.c_int
+    lib.bpe_num_tokens.argtypes = [ctypes.c_void_p]
+    lib.bpe_num_tokens.restype = ctypes.c_int
+    lib.bpe_encode.argtypes = [ctypes.c_void_p, u8p, ctypes.c_int, i32p, ctypes.c_int]
+    lib.bpe_encode.restype = ctypes.c_int
+    lib.bpe_encode_batch.argtypes = [
+        ctypes.c_void_p, u8p, i32p, ctypes.c_int, i32p, ctypes.c_int
+    ]
+    lib.bpe_encode_batch.restype = ctypes.c_int
+    lib.bpe_decode.argtypes = [ctypes.c_void_p, i32p, ctypes.c_int, u8p, ctypes.c_int]
+    lib.bpe_decode.restype = ctypes.c_int
+    lib.utf8_hold.argtypes = [u8p, ctypes.c_int]
+    lib.utf8_hold.restype = ctypes.c_int
+    return lib
+
+
+def load_bpe() -> ctypes.CDLL | None:
+    """The libbpe handle, or None when native code is unavailable."""
+    global _lib, _failed
+    if _lib is not None:
+        return _lib
+    if _failed or os.environ.get("LLM_MCP_TPU_NO_NATIVE", "") in ("1", "true"):
+        return None
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        needs_build = not os.path.exists(_SO) or (
+            os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        )
+        if needs_build and not _build():
+            _failed = True
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(_SO))
+        except OSError as e:
+            log.warning("failed to load %s: %s", _SO, e)
+            _failed = True
+            return None
+    return _lib

@@ -1,0 +1,251 @@
+"""Native C++ BPE tokenizer tests: exact three-way parity (C++ core vs
+pure-Python core vs HuggingFace `tokenizers`) on a trained tokenizer.json,
+plus the streaming UTF-8 boundary scanner."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from llm_mcp_tpu.executor.bpe import (
+    BPETokenizer,
+    gpt2_byte_to_unicode,
+    token_str_to_bytes,
+)
+from llm_mcp_tpu.native import load_bpe
+
+CORPUS = [
+    "The quick brown fox jumps over the lazy dog. " * 8,
+    "Sharded attention over a TPU mesh: pjit, shard_map, psum, all_gather!",
+    "Numbers 123 4567 890, punctuation?! (parens) [brackets] {braces}",
+    "naïve café résumé — ünïcödé tëxt with diacritics",
+    "русский текст и ελληνικά плюс 中文字符 and 日本語テキスト",
+    "emoji soup: 🚀🔥✨🎉 🧪🤖",
+    "def f(x):\n    return x * 2  # comment\n\n\nclass A:\n    pass\n",
+    "don't can't won't it's we're they'll I'd you've",
+]
+
+SAMPLES = CORPUS + [
+    "",
+    " ",
+    "\n",
+    "a",
+    "hello world",
+    "  leading and trailing  ",
+    "MixedCASE and camelCase and snake_case",
+    "🚀 rocket at start",
+    "tab\tseparated\tvalues",
+]
+
+
+@pytest.fixture(scope="module")
+def tok_json(tmp_path_factory):
+    """Train a small byte-level BPE with the HF library → tokenizer.json."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders, trainers
+
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False, use_regex=True)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=800,
+        special_tokens=["<|begin_of_text|>", "<|end_of_text|>", "<pad>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    tok.train_from_iterator(CORPUS * 4, trainer)
+    path = str(tmp_path_factory.mktemp("tok") / "tokenizer.json")
+    tok.save(path)
+    return path
+
+
+def test_native_lib_builds_and_loads():
+    lib = load_bpe()
+    assert lib is not None, "C++ toolchain present but native build failed"
+    h = lib.bpe_new()
+    assert h
+    lib.bpe_free(h)
+
+
+def test_gpt2_byte_table_is_bijective():
+    table = gpt2_byte_to_unicode()
+    assert len(table) == 256
+    assert len(set(table.values())) == 256
+    assert token_str_to_bytes("".join(table[b] for b in range(256))) == bytes(range(256))
+
+
+@pytest.fixture(scope="module")
+def three_way(tok_json):
+    native = BPETokenizer(tok_json)
+    python = BPETokenizer(tok_json, force_python=True)
+    from llm_mcp_tpu.executor.tokenizer import HFTokenizer
+
+    hf = HFTokenizer(tok_json)
+    return native, python, hf
+
+
+def test_native_core_selected(three_way):
+    native, python, _ = three_way
+    assert native.is_native is True
+    assert python.is_native is False
+
+
+@pytest.mark.parametrize("idx", range(len(SAMPLES)))
+def test_three_way_encode_parity(three_way, idx):
+    native, python, hf = three_way
+    text = SAMPLES[idx]
+    n = native.encode(text, add_bos=False)
+    p = python.encode(text, add_bos=False)
+    h = hf.encode(text, add_bos=False)
+    assert n == p, f"native != python for {text!r}"
+    assert n == h, f"native != HF for {text!r}"
+
+
+@pytest.mark.parametrize("idx", range(len(SAMPLES)))
+def test_decode_roundtrip(three_way, idx):
+    native, python, _ = three_way
+    text = SAMPLES[idx]
+    ids = native.encode(text, add_bos=False)
+    assert native.decode(ids) == text
+    assert python.decode(ids) == text
+
+
+def test_special_ids_resolved(three_way):
+    native, _, hf = three_way
+    assert native.bos_id == hf.bos_id
+    assert native.eos_id == hf.eos_id
+    assert native.encode("hi", add_bos=True)[0] == native.bos_id
+
+
+def test_decode_skips_specials_and_unknown_ids(three_way):
+    native, _, _ = three_way
+    ids = native.encode("ok", add_bos=False)
+    noisy = [native.bos_id] + ids + [native.eos_id, 10_000_000]
+    assert native.decode(noisy) == "ok"
+
+
+def test_streaming_decode_multibyte_boundaries(three_way):
+    native, _, _ = three_way
+    text = "héllo 🚀 wörld"
+    ids = native.encode(text, add_bos=False)
+    # feed one id at a time; concatenated stream must reproduce the text
+    out, pending = [], b""
+    for i in ids:
+        chunk, pending = native.decode_stream(pending, [i])
+        out.append(chunk)
+        assert "\ufffd" not in chunk  # boundary scanner must prevent splits
+    out.append(native.decode_flush(pending))
+    assert "".join(out) == text
+
+
+def test_utf8_hold_native_matches_python():
+    lib = load_bpe()
+    assert lib is not None
+    import ctypes
+
+    from llm_mcp_tpu.executor.tokenizer import utf8_hold as py_hold
+
+    def native_hold(data: bytes) -> int:
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        return lib.utf8_hold(buf, len(data))
+
+    cases = [b"abc", "é".encode()[:1], "🚀".encode()[:2], "🚀".encode()[:3],
+             "🚀".encode(), "中".encode()[:2], b"a" + "é".encode()[:1],
+             b"\xff\xfe", b"\x80\x80\x80", "日本語".encode()]
+    for data in cases:
+        assert native_hold(data) == py_hold(data), data
+    # fuzz all 2-byte suffixes
+    for a in range(0, 256, 7):
+        for b in range(0, 256, 7):
+            data = bytes([a, b])
+            assert native_hold(data) == py_hold(data), data
+
+
+def test_load_tokenizer_prefers_native(tok_json, monkeypatch):
+    from llm_mcp_tpu.executor.tokenizer import load_tokenizer
+
+    weights_dir = os.path.dirname(tok_json)
+    t = load_tokenizer(weights_dir)
+    assert isinstance(t, BPETokenizer) and t.is_native
+    monkeypatch.setenv("LLM_MCP_TPU_TOKENIZER", "hf")
+    from llm_mcp_tpu.executor.tokenizer import HFTokenizer
+
+    assert isinstance(load_tokenizer(weights_dir), HFTokenizer)
+    monkeypatch.setenv("LLM_MCP_TPU_TOKENIZER", "byte")
+    from llm_mcp_tpu.executor.tokenizer import ByteTokenizer
+
+    assert isinstance(load_tokenizer(weights_dir), ByteTokenizer)
+
+
+def test_llama3_style_split_pattern_detected(tmp_path, tok_json):
+    """A tokenizer.json with an embedded Split regex must use that regex."""
+    with open(tok_json) as f:
+        doc = json.load(f)
+    doc["pre_tokenizer"] = {
+        "type": "Sequence",
+        "pretokenizers": [
+            {"type": "Split",
+             "pattern": {"Regex": r"\p{N}{1,3}|[^\s\p{N}]+|\s+"},
+             "behavior": "Isolated"},
+            {"type": "ByteLevel", "add_prefix_space": False, "use_regex": False},
+        ],
+    }
+    path = str(tmp_path / "tokenizer.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    t = BPETokenizer(path)
+    # the custom pattern splits digit runs of 3: "12345" -> "123","45"
+    pieces = t._pretok.findall("12345")
+    assert pieces == ["123", "45"]
+
+
+def test_sentencepiece_style_vocab_rejected(tmp_path):
+    # '<0x41>'-style byte tokens, no single-byte coverage -> must raise so
+    # load_tokenizer falls back to the HF backend instead of silently
+    # encoding every prompt to nothing
+    doc = {
+        "model": {"type": "BPE",
+                  "vocab": {f"<0x{b:02X}>": b for b in range(256)},
+                  "merges": []},
+        "added_tokens": [],
+    }
+    path = str(tmp_path / "tokenizer.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    with pytest.raises(ValueError, match="byte-level"):
+        BPETokenizer(path)
+
+
+def test_all_special_tokens_stripped_from_decode(tok_json, tmp_path):
+    with open(tok_json) as f:
+        doc = json.load(f)
+    next_id = max(doc["model"]["vocab"].values()) + 1
+    doc.setdefault("added_tokens", []).append(
+        {"id": next_id, "content": "<|eot_id|>", "special": True}
+    )
+    path = str(tmp_path / "tokenizer.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    t = BPETokenizer(path)
+    ids = t.encode("ok", add_bos=False) + [next_id]
+    assert t.decode(ids) == "ok"
+    text, pending = t.decode_stream(b"", ids)
+    assert "<|eot_id|>" not in text + t.decode_flush(pending)
+
+
+def test_gpt2_style_endoftext_resolves_specials(tok_json, tmp_path):
+    with open(tok_json) as f:
+        doc = json.load(f)
+    # strip the llama-style specials, add GPT-2's single special token
+    doc["added_tokens"] = []
+    vocab = doc["model"]["vocab"]
+    for name in ("<|begin_of_text|>", "<|end_of_text|>", "<pad>"):
+        vocab.pop(name, None)
+    eot = max(vocab.values()) + 1
+    vocab["<|endoftext|>"] = eot
+    path = str(tmp_path / "tokenizer.json")
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    t = BPETokenizer(path)
+    assert t.bos_id == eot and t.eos_id == eot and t.pad_id == eot
