@@ -40,6 +40,7 @@ def main() -> None:
             max_seq_len=cfg.tpu_max_seq_len,
             dtype=jnp.bfloat16,
             weights_dir=cfg.tpu_weights_dir,
+            quant=cfg.tpu_quant,
         ).start()
         emodel = cfg.tpu_embed_model
         log.info("loading embedding engine: %s", emodel)

@@ -107,6 +107,7 @@ class GenerationEngine:
         seed: int = 0,
         decode_chunk: int = 4,
         weights_dir: str = "",
+        quant: str = "",
     ):
         self.cfg = get_config(model) if isinstance(model, str) else model
         self.mesh = mesh
@@ -121,15 +122,32 @@ class GenerationEngine:
             resolve_attn_impl(mesh) if pallas_supported(max_seq_len, hd) else "xla"
         )
 
+        # weight-only int8 (TPU_QUANT=int8 via Config.tpu_quant): decode is
+        # weight-bandwidth bound, so halving weight bytes ≈ halves step time
+        # (models/quant.py)
+        self.quant = quant
+        if self.quant and self.quant != "int8":
+            log.warning("unknown quant mode %r (supported: int8); serving unquantized",
+                        self.quant)
+            self.quant = ""
+
         if params is None and _has_safetensors(weights_dir):
             # Real checkpoint: stream safetensors shards straight into
-            # (sharded) HBM — already placed, no re-shard needed.
+            # (sharded) HBM — already placed.
             params = load_llama_checkpoint(self.cfg, weights_dir, dtype=dtype, mesh=mesh)
-        else:
-            if params is None:
-                params = init_llama_params(self.cfg, jax.random.PRNGKey(seed), dtype=dtype)
-            if mesh is not None:
-                params = shard_pytree(params, llama_param_specs(self.cfg), mesh)
+        elif params is None:
+            params = init_llama_params(self.cfg, jax.random.PRNGKey(seed), dtype=dtype)
+        if self.quant == "int8":
+            from ..models.quant import quantize_params
+
+            params = quantize_params(params)
+        if mesh is not None:
+            specs = llama_param_specs(self.cfg)
+            if self.quant == "int8":
+                from ..models.quant import quantized_specs
+
+                specs = quantized_specs(specs)
+            params = shard_pytree(params, specs, mesh)
         self.params = params
 
         cache = init_kv_cache(self.cfg, max_slots, max_seq_len, dtype=dtype)

@@ -37,6 +37,7 @@ from ..ops.norms import rms_norm as _rms_norm
 from ..ops.rope import rope_frequencies, apply_rope
 from .configs import ModelConfig
 from .moe import init_moe_layer_params, moe_ffn
+from .quant import embed_lookup, logits_head, qdot
 
 Params = dict[str, Any]
 
@@ -98,8 +99,8 @@ def init_kv_cache(
 
 def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
     h = _rms_norm(h, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return jnp.einsum("...d,dv->...v", h, head).astype(jnp.float32)
+    src = params["embed"] if cfg.tie_embeddings else params["lm_head"]
+    return logits_head(src, h, tied=cfg.tie_embeddings)
 
 
 def prefill_masks(
@@ -134,9 +135,9 @@ def prefill_layer(
     neg = jnp.float32(-1e30)
 
     x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
-    q = jnp.einsum("bsd,de->bse", x, lp["wq"]).reshape(B, S, H, hd)
-    k = jnp.einsum("bsd,de->bse", x, lp["wk"]).reshape(B, S, Hkv, hd)
-    v = jnp.einsum("bsd,de->bse", x, lp["wv"]).reshape(B, S, Hkv, hd)
+    q = qdot(x, lp["wq"]).reshape(B, S, H, hd)
+    k = qdot(x, lp["wk"]).reshape(B, S, Hkv, hd)
+    v = qdot(x, lp["wv"]).reshape(B, S, Hkv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -155,15 +156,15 @@ def prefill_layer(
         scores = jnp.where(mask[:, None, None, :, :], scores, neg)
         probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
         ctx = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(B, S, H * hd)
-    h = h + jnp.einsum("bse,ed->bsd", ctx, lp["wo"])
+    h = h + qdot(ctx, lp["wo"])
 
     x = _rms_norm(h, lp["ffn_norm"], cfg.norm_eps)
     if cfg.n_experts:
         h = h + moe_ffn(cfg, lp, x.reshape(B * S, -1)).reshape(B, S, -1)
     else:
-        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", x, lp["w1"]))
-        up = jnp.einsum("bsd,df->bsf", x, lp["w3"])
-        h = h + jnp.einsum("bsf,fd->bsd", gate * up, lp["w2"])
+        gate = jax.nn.silu(qdot(x, lp["w1"]))
+        up = qdot(x, lp["w3"])
+        h = h + qdot(gate * up, lp["w2"])
     return h, (kh, vh)
 
 
@@ -180,7 +181,7 @@ def llama_prefill(
     prompt KV to be inserted into the engine cache at the request's slot.
     """
     B, S = tokens.shape
-    h = params["embed"][tokens]  # [B, S, D]
+    h = embed_lookup(params["embed"], tokens)  # [B, S, D]
     cos, sin, mask = prefill_masks(cfg, S, lengths)
 
     def layer(h, lp):
@@ -214,7 +215,7 @@ def llama_decode_step(
     H = cfg.n_heads
     G = H // Hkv
 
-    h = params["embed"][tokens]  # [B, D]
+    h = embed_lookup(params["embed"], tokens)  # [B, D]
     cos, sin = rope_frequencies(hd, cfg.rope_theta, lengths)  # [B, hd/2]
 
     b_idx = jnp.arange(B)[:, None]  # [B, 1]
@@ -227,9 +228,9 @@ def llama_decode_step(
     def layer(h, xs):
         lp, ck, cv = xs  # ck, cv: [B, Hkv, S, hd]
         x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
-        q = (x @ lp["wq"]).reshape(B, H, hd)
-        k = (x @ lp["wk"]).reshape(B, Hkv, hd)
-        v = (x @ lp["wv"]).reshape(B, Hkv, hd)
+        q = qdot(x, lp["wq"]).reshape(B, H, hd)
+        k = qdot(x, lp["wk"]).reshape(B, Hkv, hd)
+        v = qdot(x, lp["wv"]).reshape(B, Hkv, hd)
         q = apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]  # [B, H, hd]
         k = apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
 
@@ -245,15 +246,15 @@ def llama_decode_step(
             scores = jnp.where(attn_mask[:, None, None, :], scores, neg)
             probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
             ctx = jnp.einsum("bhgs,bhsd->bhgd", probs, cv).reshape(B, H * hd)
-        h = h + ctx @ lp["wo"]
+        h = h + qdot(ctx, lp["wo"])
 
         x = _rms_norm(h, lp["ffn_norm"], cfg.norm_eps)
         if cfg.n_experts:
             h = h + moe_ffn(cfg, lp, x, capacity=B)  # dropless at decode
         else:
-            gate = jax.nn.silu(x @ lp["w1"])
-            up = x @ lp["w3"]
-            h = h + (gate * up) @ lp["w2"]
+            gate = jax.nn.silu(qdot(x, lp["w1"]))
+            up = qdot(x, lp["w3"])
+            h = h + qdot(gate * up, lp["w2"])
         return h, (ck, cv)
 
     h, (new_k, new_v) = jax.lax.scan(layer, h, (params["layers"], cache_k, cache_v))

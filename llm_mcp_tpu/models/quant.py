@@ -1,0 +1,153 @@
+"""Weight-only int8 quantization for the serving path.
+
+TPU decode is HBM-bandwidth-bound: every step streams all weights once, so
+halving weight bytes nearly halves step time. Per-output-channel symmetric
+int8 (the standard weight-only scheme: negligible quality loss, no
+activation calibration needed) stores each linear as `{"q": int8, "s":
+bf16-scale}`; the matmul reads int8 from HBM and XLA fuses the int8→bf16
+convert into the operand load, so VMEM/MXU still run bf16 × bf16 → f32.
+
+Parity note: the reference's executor (Ollama/llama.cpp) serves q4/q8 GGUF
+models by default — quantized inference is its normal operating mode, and
+this module is that capability rebuilt TPU-style. (`worker/llm_worker/
+main.py:222-243` merely proxies; quantization lived inside the native
+dependency.)
+
+Scales are per-OUTPUT-channel so dequantization commutes with the matmul:
+    x @ (q * s[None, :]) == (x @ q) * s
+which keeps the int8 tensor the only weight-sized HBM read.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+# linear weights quantized inside each stacked layer pytree: [L, in, out]
+LAYER_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
+
+
+def _quantize_slice(w: jnp.ndarray, axis: int) -> dict[str, jnp.ndarray]:
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": jnp.squeeze(scale, axis=axis).astype(w.dtype)}
+
+
+def quantize_weight(w: jnp.ndarray, axis: int = -2) -> dict[str, jnp.ndarray]:
+    """Symmetric per-output-channel int8: reduce |max| over the CONTRACTION
+    axis (default -2 = the `in` dim of an [..., in, out] linear). Scales
+    keep the weight's dtype, so f32 test models stay f32 end-to-end.
+
+    Stacked [L, in, out] tensors are quantized one layer-slice at a time:
+    the f32 working copy is 2x the bf16 weight, and at engine init the full
+    bf16 tree is still resident — a whole-tensor upcast of e.g. Llama-8B's
+    stacked FFN (3.8 GB bf16) would spike ~8 GB and OOM the exact
+    single-chip deployments int8 exists to enable. Per-slice, the transient
+    is 1/L of that."""
+    if w.ndim >= 3:
+        parts = [_quantize_slice(w[i], axis) for i in range(w.shape[0])]
+        return {
+            "q": jnp.stack([p["q"] for p in parts]),
+            "s": jnp.stack([p["s"] for p in parts]),
+        }
+    return _quantize_slice(w, axis)
+
+
+def qdot(x: jnp.ndarray, w) -> jnp.ndarray:
+    """Matmul over the last axis of x; transparent for plain arrays."""
+    if isinstance(w, dict):
+        y = jnp.matmul(x, w["q"].astype(x.dtype))
+        return y * w["s"].astype(y.dtype)
+    return jnp.matmul(x, w)
+
+
+def is_quantized(w) -> bool:
+    return isinstance(w, dict) and "q" in w
+
+
+def embed_lookup(embed, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Embedding rows for token ids; per-ROW scales when quantized. The
+    activation dtype follows the scale dtype (model compute dtype)."""
+    if isinstance(embed, dict):
+        rows = embed["q"][tokens].astype(embed["s"].dtype)
+        return rows * embed["s"][tokens][..., None]
+    return embed[tokens]
+
+
+def logits_head(embed_or_head, h: jnp.ndarray, tied: bool) -> jnp.ndarray:
+    """Final projection to vocab logits (f32). For tied embeddings the table
+    is [V, D] with per-V-row scales == per-output-channel of its transpose."""
+    if isinstance(embed_or_head, dict):
+        q, s = embed_or_head["q"], embed_or_head["s"]
+        m = q.T if tied else q
+        y = jnp.matmul(h, m.astype(h.dtype)).astype(jnp.float32)
+        return y * s.astype(jnp.float32)
+    head = embed_or_head.T if tied else embed_or_head
+    return jnp.einsum("...d,dv->...v", h, head).astype(jnp.float32)
+
+
+def quantize_params(params: Params) -> Params:
+    """Quantize all dense linears (+ the embedding/LM head) of a Llama-family
+    param tree in place-compatible form. Norm weights stay bf16 (tiny, and
+    precision-sensitive); MoE expert banks stay unquantized (their dispatch
+    einsums in models/moe.py have their own path) — on MoE models only the
+    attention linears and embedding quantize."""
+    layers = dict(params["layers"])
+    for k in LAYER_QUANT_KEYS:
+        if k in layers and not is_quantized(layers[k]):
+            layers[k] = quantize_weight(layers[k])
+    out: Params = dict(params)
+    out["layers"] = layers
+    if not is_quantized(params["embed"]):
+        # per-row (vocab) scales: contraction axis for the tied head is D,
+        # but the LOOKUP needs row scales; per-row also equals per-output-
+        # channel of embed.T, which is exactly what the tied logits head
+        # contracts against.
+        out["embed"] = quantize_weight(params["embed"], axis=-1)
+    if "lm_head" in params and not is_quantized(params["lm_head"]):
+        out["lm_head"] = quantize_weight(params["lm_head"], axis=-2)
+    return out
+
+
+def quantized_specs(specs: Params) -> Params:
+    """Map a param PartitionSpec tree (parallel/sharding.py:llama_param_specs)
+    onto the quantized tree shape: `q` keeps the weight's spec, `s` drops the
+    contracted axis (scales are per-output-channel, so their sharding is the
+    weight's spec minus the reduced dim). Lets TP-sharded serving run int8 —
+    the v5e-8 baseline config — instead of carving quantization out for
+    meshes."""
+    from jax.sharding import PartitionSpec as P
+
+    def drop(spec, axis: int):
+        t = list(spec)
+        del t[axis]
+        return P(*t)
+
+    layers = dict(specs["layers"])
+    for k in LAYER_QUANT_KEYS:
+        if k in layers:
+            layers[k] = {"q": layers[k], "s": drop(layers[k], -2)}
+    out: Params = dict(specs)
+    out["layers"] = layers
+    out["embed"] = {"q": specs["embed"], "s": drop(specs["embed"], -1)}
+    if "lm_head" in specs:
+        out["lm_head"] = {"q": specs["lm_head"], "s": drop(specs["lm_head"], -2)}
+    return out
+
+
+def quantized_bytes(params: Params) -> tuple[int, int]:
+    """(bytes_quantized_tree, bytes_bf16_equivalent) for logging."""
+
+    def nbytes(t) -> int:
+        return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(t))
+
+    def bf16_bytes(t) -> int:
+        return sum(x.size * 2 for x in jax.tree_util.tree_leaves(t))
+
+    return nbytes(params), bf16_bytes(params)

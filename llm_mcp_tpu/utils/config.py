@@ -102,6 +102,7 @@ class Config:
     tpu_max_slots: int = field(default_factory=lambda: getenv_int("TPU_MAX_SLOTS", 32))
     tpu_max_seq_len: int = field(default_factory=lambda: getenv_int("TPU_MAX_SEQ_LEN", 2048))
     tpu_mesh_shape: str = field(default_factory=lambda: getenv("TPU_MESH_SHAPE", ""))  # e.g. "dp=1,tp=8"
+    tpu_quant: str = field(default_factory=lambda: getenv("TPU_QUANT", ""))  # "" | int8
 
     def has_openai(self) -> bool:
         return bool(self.openai_api_key)

@@ -41,6 +41,7 @@ def main() -> None:
             max_seq_len=cfg.tpu_max_seq_len,
             dtype=jnp.bfloat16,
             weights_dir=cfg.tpu_weights_dir,
+            quant=cfg.tpu_quant,
         ).start()
         embed_engines[cfg.tpu_embed_model] = EmbeddingEngine(
             cfg.tpu_embed_model,
