@@ -44,7 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..kernels.attention import pallas_supported, resolve_attn_impl
+from ..kernels.attention import pallas_supported, resolve_attn_impl, resolve_decode_impl
 from ..models.configs import ModelConfig, get_config
 from ..models.weights import load_llama_checkpoint
 from ..models.llama import (
@@ -118,9 +118,13 @@ class GenerationEngine:
         self.tokenizer: Tokenizer = tokenizer or load_tokenizer(weights_dir)
 
         hd = self.cfg.resolved_head_dim
+        # Prefill and decode resolve separately: flash-prefill is a real win
+        # (no O(S²) score materialization) while decode is fastest on the
+        # fused XLA einsum path — see kernels/attention.py:resolve_decode_impl.
         self.attn_impl = (
             resolve_attn_impl(mesh) if pallas_supported(max_seq_len, hd) else "xla"
         )
+        self.decode_impl = resolve_decode_impl(mesh)
 
         # weight-only int8 (TPU_QUANT=int8 via Config.tpu_quant): decode is
         # weight-bandwidth bound, so halving weight bytes ≈ halves step time
@@ -225,7 +229,7 @@ class GenerationEngine:
         cfg = self.cfg
         K = self.decode_chunk
         mask = self._allowed_mask
-        impl = self.attn_impl
+        impl = self.decode_impl
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def decode_chunk_fn(params, ck, cv, tokens, lengths, rng, temp, topk, topp):
