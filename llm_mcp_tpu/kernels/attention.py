@@ -123,7 +123,8 @@ def _interpret() -> bool:
 
 
 def _flash_prefill_kernel(
-    lengths_ref,  # [B] int32 (scalar prefetch, SMEM)
+    lengths_ref,  # [B] int32 (SMEM)
+    window_ref,  # [1] int32 (SMEM) — sliding window, 0 = global
     q_ref,  # [1, 1, BQ, hd]
     k_ref,  # [1, 1, S, hd]
     v_ref,  # [1, 1, S, hd]
@@ -132,12 +133,14 @@ def _flash_prefill_kernel(
     scale: float,
     block_k: int,
     seq_len: int,
+    softcap: float,
 ):
     b = pl.program_id(0)
     qi = pl.program_id(2)
     bq = q_ref.shape[2]
     hd = q_ref.shape[3]
     valid_len = lengths_ref[b]
+    window = window_ref[0]
 
     q = q_ref[0, 0].astype(jnp.float32) * scale  # [BQ, hd]
     q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)  # [BQ, 1]
@@ -156,10 +159,13 @@ def _flash_prefill_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [BQ, BK]
+        if softcap:
+            s = jnp.tanh(s / softcap) * softcap
         k_pos = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1
         )  # [1, BK]
         mask = (k_pos <= q_pos) & (k_pos < valid_len)
+        mask &= (window == 0) | (q_pos - k_pos < window)
         s = jnp.where(mask, s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -182,13 +188,18 @@ def _flash_prefill_kernel(
     o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "block_k", "interpret", "softcap", "scale")
+)
 def flash_prefill_attention(
     q: jnp.ndarray,  # [B, H, S, hd]
     k: jnp.ndarray,  # [B, Hkv, S, hd]
     v: jnp.ndarray,  # [B, Hkv, S, hd]
     lengths: jnp.ndarray,  # [B] int32
     *,
+    window: jnp.ndarray | int = 0,  # sliding window (0 = global); may be traced
+    softcap: float = 0.0,  # Gemma2-style score soft-capping (0 = off)
+    scale: float = 0.0,  # query scale override (0 = head_dim**-0.5)
     block_q: int = 128,
     block_k: int = 128,
     interpret: bool | None = None,
@@ -203,13 +214,19 @@ def flash_prefill_attention(
     interp = _interpret() if interpret is None else interpret
 
     kernel = functools.partial(
-        _flash_prefill_kernel, scale=hd**-0.5, block_k=bk, seq_len=S
+        _flash_prefill_kernel,
+        scale=scale or hd**-0.5,
+        block_k=bk,
+        seq_len=S,
+        softcap=softcap,
     )
+    win = jnp.reshape(jnp.asarray(window, dtype=jnp.int32), (1,))
     return pl.pallas_call(
         kernel,
         grid=(B, H, S // bq),
         in_specs=[
             _smem_spec(),  # lengths [B]
+            _smem_spec(),  # window [1]
             pl.BlockSpec((1, 1, bq, hd), lambda b, h, qi: (b, h, qi, 0)),
             pl.BlockSpec((1, 1, S, hd), lambda b, h, qi: (b, h // G, 0, 0)),
             pl.BlockSpec((1, 1, S, hd), lambda b, h, qi: (b, h // G, 0, 0)),
@@ -217,7 +234,7 @@ def flash_prefill_attention(
         out_specs=pl.BlockSpec((1, 1, bq, hd), lambda b, h, qi: (b, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, S, hd), q.dtype),
         interpret=interp,
-    )(lengths.astype(jnp.int32), q, k, v)
+    )(lengths.astype(jnp.int32), win, q, k, v)
 
 
 # ---------------------------------------------------------------------------

@@ -34,6 +34,22 @@ class ModelConfig:
     # encoder-only fields
     pooling: str = "mean"  # mean | cls
     embed_dim: int = 0  # output embedding dim (0 → dim)
+    # family variation knobs (one shared decoder serves all families, the
+    # way the reference's one Ollama runtime serves its whole catalog):
+    qkv_bias: bool = False  # Qwen2: biases on q/k/v projections
+    act: str = "silu"  # FFN activation: silu (llama/qwen/mistral) | gelu (gemma)
+    norm_weight_offset: float = 0.0  # Gemma: RMSNorm computes x * (1 + w)
+    embed_scale: bool = False  # Gemma: hidden = embed * sqrt(dim)
+    logit_softcap: float = 0.0  # Gemma2: logits = cap * tanh(logits / cap)
+    attn_softcap: float = 0.0  # Gemma2: same cap on attention scores
+    sliding_window: int = 0  # Mistral/Gemma2: local-attention window (0 = off)
+    # Gemma2 query_pre_attn_scalar: scores scale by this**-0.5 instead of
+    # head_dim**-0.5 (9B: dim/n_heads = 224 while head_dim = 256). 0 → head_dim.
+    query_pre_attn_scalar: float = 0.0
+    # every `sliding_pattern`-th layer is GLOBAL, the rest sliding
+    # (1 = all layers sliding, Mistral; 2 = alternating, Gemma2)
+    sliding_pattern: int = 1
+    post_norms: bool = False  # Gemma2: extra RMSNorm after attn and after FFN
     # serving metadata
     params_b: float = 0.0
     tie_embeddings: bool = False
@@ -41,6 +57,10 @@ class ModelConfig:
     @property
     def resolved_head_dim(self) -> int:
         return self.head_dim or self.dim // self.n_heads
+
+    @property
+    def attn_scale(self) -> float:
+        return (self.query_pre_attn_scalar or self.resolved_head_dim) ** -0.5
 
     def param_count(self) -> int:
         """Approximate parameter count (embedding + layers + head)."""
@@ -139,6 +159,132 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         params_b=0.002,
         tie_embeddings=True,
     ),
+    # Qwen2.5 per the published architecture: GQA with q/k/v biases,
+    # untied head at 7B (tied at 0.5B), 1M rope theta, 152k vocab.
+    "qwen2.5-7b": ModelConfig(
+        name="qwen2.5-7b",
+        vocab_size=152_064,
+        dim=3584,
+        n_layers=28,
+        n_heads=28,
+        n_kv_heads=4,
+        ffn_hidden=18_944,
+        rope_theta=1_000_000.0,
+        norm_eps=1e-6,
+        max_seq_len=32_768,
+        qkv_bias=True,
+        params_b=7.6,
+    ),
+    "qwen2.5-0.5b": ModelConfig(
+        name="qwen2.5-0.5b",
+        vocab_size=151_936,
+        dim=896,
+        n_layers=24,
+        n_heads=14,
+        n_kv_heads=2,
+        ffn_hidden=4864,
+        rope_theta=1_000_000.0,
+        norm_eps=1e-6,
+        max_seq_len=32_768,
+        qkv_bias=True,
+        tie_embeddings=True,
+        params_b=0.49,
+    ),
+    # Mistral-7B-v0.1: llama-shaped GQA with a 4096-token sliding window on
+    # every layer.
+    "mistral-7b": ModelConfig(
+        name="mistral-7b",
+        vocab_size=32_000,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_hidden=14_336,
+        rope_theta=10_000.0,
+        max_seq_len=32_768,
+        sliding_window=4096,
+        sliding_pattern=1,
+        params_b=7.2,
+    ),
+    # Gemma-2-9B: gelu FFN, (1+w) RMSNorm with post-norms, sqrt(dim) embed
+    # scaling, attention/logit soft-capping, alternating 4096 sliding window,
+    # wide 256k tied vocab, head_dim 256.
+    "gemma2-9b": ModelConfig(
+        name="gemma2-9b",
+        vocab_size=256_000,
+        dim=3584,
+        n_layers=42,
+        n_heads=16,
+        n_kv_heads=8,
+        ffn_hidden=14_336,
+        head_dim=256,
+        rope_theta=10_000.0,
+        norm_eps=1e-6,
+        max_seq_len=8192,
+        act="gelu",
+        norm_weight_offset=1.0,
+        embed_scale=True,
+        logit_softcap=30.0,
+        attn_softcap=50.0,
+        sliding_window=4096,
+        sliding_pattern=2,
+        query_pre_attn_scalar=224.0,  # dim / n_heads, NOT head_dim
+        post_norms=True,
+        tie_embeddings=True,
+        params_b=9.24,
+    ),
+    # Tiny family configs for tests / CPU dev — same code paths, toy sizes.
+    "tiny-qwen": ModelConfig(
+        name="tiny-qwen",
+        vocab_size=512,
+        dim=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_hidden=256,
+        rope_theta=10_000.0,
+        max_seq_len=512,
+        qkv_bias=True,
+        tie_embeddings=True,
+        params_b=0.001,
+    ),
+    "tiny-mistral": ModelConfig(
+        name="tiny-mistral",
+        vocab_size=512,
+        dim=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_hidden=256,
+        rope_theta=10_000.0,
+        max_seq_len=512,
+        sliding_window=64,
+        sliding_pattern=1,
+        tie_embeddings=True,
+        params_b=0.001,
+    ),
+    "tiny-gemma": ModelConfig(
+        name="tiny-gemma",
+        vocab_size=512,
+        dim=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_hidden=256,
+        rope_theta=10_000.0,
+        max_seq_len=512,
+        act="gelu",
+        norm_weight_offset=1.0,
+        embed_scale=True,
+        logit_softcap=30.0,
+        attn_softcap=50.0,
+        sliding_window=64,
+        sliding_pattern=2,
+        query_pre_attn_scalar=24.0,  # ≠ head_dim (32) so tests exercise it
+        post_norms=True,
+        tie_embeddings=True,
+        params_b=0.001,
+    ),
     "nomic-embed-text": ModelConfig(
         name="nomic-embed-text",
         arch="encoder",
@@ -208,6 +354,16 @@ def get_config(name: str) -> ModelConfig:
         return MODEL_CONFIGS["llama-3.2-1b"]
     if "llama" in key:
         return MODEL_CONFIGS["llama-3.1-8b"]
+    if "qwen" in key and "0.5b" in key:
+        return MODEL_CONFIGS["qwen2.5-0.5b"]
+    if "qwen" in key:
+        return MODEL_CONFIGS["qwen2.5-7b"]
+    if "mixtral" in key:
+        return MODEL_CONFIGS["mixtral-8x7b"]
+    if "mistral" in key:
+        return MODEL_CONFIGS["mistral-7b"]
+    if "gemma" in key:
+        return MODEL_CONFIGS["gemma2-9b"]
     if "embed" in key:
         return MODEL_CONFIGS["nomic-embed-text"]
     raise KeyError(f"unknown model config: {name}")

@@ -61,14 +61,24 @@ def init_llama_params(
     def w(k, shape, fan_in):
         return (jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in**-0.5)).astype(dtype)
 
+    # norm weights init to 1 - offset so an offset-norm family (Gemma's
+    # x * (1 + w)) starts at the same identity scale as plain RMSNorm.
+    norm_init = jnp.full((L, D), 1.0 - cfg.norm_weight_offset, dtype=dtype)
     layers: Params = {
-        "attn_norm": jnp.ones((L, D), dtype=dtype),
+        "attn_norm": norm_init,
         "wq": w(keys[1], (L, D, H * hd), D),
         "wk": w(keys[2], (L, D, Hkv * hd), D),
         "wv": w(keys[3], (L, D, Hkv * hd), D),
         "wo": w(keys[4], (L, H * hd, D), H * hd),
-        "ffn_norm": jnp.ones((L, D), dtype=dtype),
+        "ffn_norm": norm_init,
     }
+    if cfg.qkv_bias:
+        layers["bq"] = jnp.zeros((L, H * hd), dtype=dtype)
+        layers["bk"] = jnp.zeros((L, Hkv * hd), dtype=dtype)
+        layers["bv"] = jnp.zeros((L, Hkv * hd), dtype=dtype)
+    if cfg.post_norms:
+        layers["post_attn_norm"] = norm_init
+        layers["post_ffn_norm"] = norm_init
     if cfg.n_experts:
         layers.update(init_moe_layer_params(cfg, keys[5], dtype))
     else:
@@ -82,7 +92,7 @@ def init_llama_params(
     params: Params = {
         "embed": w(keys[0], (V, D), D),
         "layers": layers,
-        "final_norm": jnp.ones((D,), dtype=dtype),
+        "final_norm": jnp.full((D,), 1.0 - cfg.norm_weight_offset, dtype=dtype),
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = w(jax.random.fold_in(key, 99), (D, V), D)
@@ -97,10 +107,46 @@ def init_kv_cache(
     return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
 
 
+def _norm(cfg: ModelConfig, x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """RMSNorm with the family's weight convention: llama scales by w,
+    Gemma by (1 + w) (norm_weight_offset)."""
+    if cfg.norm_weight_offset:
+        w = w + jnp.asarray(cfg.norm_weight_offset, dtype=w.dtype)
+    return _rms_norm(x, w, cfg.norm_eps)
+
+
+def _act(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.gelu(x) if cfg.act == "gelu" else jax.nn.silu(x)
+
+
+def _softcap(x: jnp.ndarray, cap: float) -> jnp.ndarray:
+    return jnp.tanh(x / cap) * cap if cap else x
+
+
+def layer_windows(cfg: ModelConfig) -> jnp.ndarray:
+    """Per-layer attention window sizes, [L] int32 (0 = global attention).
+
+    `sliding_pattern=1` → every layer sliding (Mistral); `=p` → every p-th
+    layer global, the rest sliding (Gemma2 alternation with p=2)."""
+    p = max(cfg.sliding_pattern, 1)
+    wins = [
+        cfg.sliding_window if cfg.sliding_window and (p == 1 or li % p != p - 1) else 0
+        for li in range(cfg.n_layers)
+    ]
+    return jnp.asarray(wins, dtype=jnp.int32)
+
+
+def _embed_in(cfg: ModelConfig, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
+    h = embed_lookup(params["embed"], tokens)
+    if cfg.embed_scale:
+        h = h * jnp.asarray(cfg.dim**0.5, dtype=h.dtype)
+    return h
+
+
 def _logits(cfg: ModelConfig, params: Params, h: jnp.ndarray) -> jnp.ndarray:
-    h = _rms_norm(h, params["final_norm"], cfg.norm_eps)
+    h = _norm(cfg, h, params["final_norm"])
     src = params["embed"] if cfg.tie_embeddings else params["lm_head"]
-    return logits_head(src, h, tied=cfg.tie_embeddings)
+    return _softcap(logits_head(src, h, tied=cfg.tie_embeddings), cfg.logit_softcap)
 
 
 def prefill_masks(
@@ -125,6 +171,7 @@ def prefill_layer(
     mask: jnp.ndarray,  # [B, S, S]
     lengths: jnp.ndarray,  # [B]
     attn_impl: str = "xla",
+    window: jnp.ndarray | int = 0,  # this layer's sliding window (0 = global)
 ) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray]]:
     """One decoder layer over a full prompt. Shared by the scan in
     `llama_prefill` and the stage loop in parallel/pipeline.py."""
@@ -133,11 +180,16 @@ def prefill_layer(
     H, Hkv = cfg.n_heads, cfg.n_kv_heads
     G = H // Hkv
     neg = jnp.float32(-1e30)
+    window = jnp.asarray(window, dtype=jnp.int32)
 
-    x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
+    x = _norm(cfg, h, lp["attn_norm"])
     q = qdot(x, lp["wq"]).reshape(B, S, H, hd)
     k = qdot(x, lp["wk"]).reshape(B, S, Hkv, hd)
     v = qdot(x, lp["wv"]).reshape(B, S, Hkv, hd)
+    if cfg.qkv_bias:
+        q = q + lp["bq"].reshape(H, hd)
+        k = k + lp["bk"].reshape(Hkv, hd)
+        v = v + lp["bv"].reshape(Hkv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -147,24 +199,43 @@ def prefill_layer(
 
     if attn_impl == "pallas":
         qh = q.transpose(0, 2, 1, 3)  # [B, H, S, hd]
-        ctx = flash_prefill_attention(qh, kh, vh, lengths)
+        ctx = flash_prefill_attention(
+            qh,
+            kh,
+            vh,
+            lengths,
+            window=window,
+            softcap=cfg.attn_softcap,
+            scale=cfg.attn_scale,
+        )
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, H * hd)
     else:
         qg = q.reshape(B, S, Hkv, G, hd)
         scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32)
-        scores = scores * (hd**-0.5)
-        scores = jnp.where(mask[:, None, None, :, :], scores, neg)
+        scores = _softcap(scores * cfg.attn_scale, cfg.attn_softcap)
+        m = mask
+        if cfg.sliding_window:
+            # q_pos - k_pos < window; window == 0 disables (global layer)
+            diff = jnp.arange(S)[:, None] - jnp.arange(S)[None, :]  # [S, S]
+            m = m & ((window == 0) | (diff < window))[None]
+        scores = jnp.where(m[:, None, None, :, :], scores, neg)
         probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
         ctx = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(B, S, H * hd)
-    h = h + qdot(ctx, lp["wo"])
+    attn_out = qdot(ctx, lp["wo"])
+    if cfg.post_norms:
+        attn_out = _norm(cfg, attn_out, lp["post_attn_norm"])
+    h = h + attn_out
 
-    x = _rms_norm(h, lp["ffn_norm"], cfg.norm_eps)
+    x = _norm(cfg, h, lp["ffn_norm"])
     if cfg.n_experts:
         h = h + moe_ffn(cfg, lp, x.reshape(B * S, -1)).reshape(B, S, -1)
     else:
-        gate = jax.nn.silu(qdot(x, lp["w1"]))
+        gate = _act(cfg, qdot(x, lp["w1"]))
         up = qdot(x, lp["w3"])
-        h = h + qdot(gate * up, lp["w2"])
+        ffn_out = qdot(gate * up, lp["w2"])
+        if cfg.post_norms:
+            ffn_out = _norm(cfg, ffn_out, lp["post_ffn_norm"])
+        h = h + ffn_out
     return h, (kh, vh)
 
 
@@ -181,13 +252,14 @@ def llama_prefill(
     prompt KV to be inserted into the engine cache at the request's slot.
     """
     B, S = tokens.shape
-    h = embed_lookup(params["embed"], tokens)  # [B, S, D]
+    h = _embed_in(cfg, params, tokens)  # [B, S, D]
     cos, sin, mask = prefill_masks(cfg, S, lengths)
 
-    def layer(h, lp):
-        return prefill_layer(cfg, lp, h, cos, sin, mask, lengths, attn_impl)
+    def layer(h, xs):
+        lp, win = xs
+        return prefill_layer(cfg, lp, h, cos, sin, mask, lengths, attn_impl, window=win)
 
-    h, (ks, vs) = jax.lax.scan(layer, h, params["layers"])
+    h, (ks, vs) = jax.lax.scan(layer, h, (params["layers"], layer_windows(cfg)))
 
     last = jnp.take_along_axis(
         h, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
@@ -215,7 +287,16 @@ def llama_decode_step(
     H = cfg.n_heads
     G = H // Hkv
 
-    h = embed_lookup(params["embed"], tokens)  # [B, D]
+    # Sliding windows / score softcaps / non-default query scaling aren't
+    # implemented in the pallas decode kernels; those families take the
+    # (default, and faster — see kernels/attention.py:resolve_decode_impl)
+    # fused XLA path.
+    if attn_impl == "pallas" and (
+        cfg.sliding_window or cfg.attn_softcap or cfg.query_pre_attn_scalar
+    ):
+        attn_impl = "xla"
+
+    h = _embed_in(cfg, params, tokens)  # [B, D]
     cos, sin = rope_frequencies(hd, cfg.rope_theta, lengths)  # [B, hd/2]
 
     b_idx = jnp.arange(B)[:, None]  # [B, 1]
@@ -232,12 +313,17 @@ def llama_decode_step(
     # per-layer one-token scatters, which XLA performs in place on the
     # donated buffers inside the loop; step time becomes weights + one cache
     # READ, which is the decode minimum.
-    def layer(carry, lp):
+    def layer(carry, xs):
+        lp, win = xs
         h, ck_all, cv_all, li = carry
-        x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
+        x = _norm(cfg, h, lp["attn_norm"])
         q = qdot(x, lp["wq"]).reshape(B, H, hd)
         k = qdot(x, lp["wk"]).reshape(B, Hkv, hd)
         v = qdot(x, lp["wv"]).reshape(B, Hkv, hd)
+        if cfg.qkv_bias:
+            q = q + lp["bq"].reshape(H, hd)
+            k = k + lp["bk"].reshape(Hkv, hd)
+            v = v + lp["bv"].reshape(Hkv, hd)
         q = apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]  # [B, H, hd]
         k = apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
 
@@ -255,22 +341,33 @@ def llama_decode_step(
             ck = jax.lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False)
             cv = jax.lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False)
             scores = jnp.einsum("bhgd,bhsd->bhgs", qg, ck).astype(jnp.float32)
-            scores = scores * (hd**-0.5)
-            scores = jnp.where(attn_mask[:, None, None, :], scores, neg)
+            scores = _softcap(scores * cfg.attn_scale, cfg.attn_softcap)
+            m = attn_mask
+            if cfg.sliding_window:
+                m = m & ((win == 0) | (key_pos > (lengths[:, None] - win)))
+            scores = jnp.where(m[:, None, None, :], scores, neg)
             probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
             ctx = jnp.einsum("bhgs,bhsd->bhgd", probs, cv).reshape(B, H * hd)
-        h = h + qdot(ctx, lp["wo"])
+        attn_out = qdot(ctx, lp["wo"])
+        if cfg.post_norms:
+            attn_out = _norm(cfg, attn_out, lp["post_attn_norm"])
+        h = h + attn_out
 
-        x = _rms_norm(h, lp["ffn_norm"], cfg.norm_eps)
+        x = _norm(cfg, h, lp["ffn_norm"])
         if cfg.n_experts:
             h = h + moe_ffn(cfg, lp, x, capacity=B)  # dropless at decode
         else:
-            gate = jax.nn.silu(qdot(x, lp["w1"]))
+            gate = _act(cfg, qdot(x, lp["w1"]))
             up = qdot(x, lp["w3"])
-            h = h + qdot(gate * up, lp["w2"])
+            ffn_out = qdot(gate * up, lp["w2"])
+            if cfg.post_norms:
+                ffn_out = _norm(cfg, ffn_out, lp["post_ffn_norm"])
+            h = h + ffn_out
         return (h, ck_all, cv_all, li + 1), None
 
     (h, new_k, new_v, _), _ = jax.lax.scan(
-        layer, (h, cache_k, cache_v, jnp.int32(0)), params["layers"]
+        layer,
+        (h, cache_k, cache_v, jnp.int32(0)),
+        (params["layers"], layer_windows(cfg)),
     )
     return _logits(cfg, params, h), new_k, new_v

@@ -144,6 +144,30 @@ _LLAMA_LAYER_MAP = [
 ]
 
 
+def _layer_map(cfg: ModelConfig) -> list[tuple[str, str, bool]]:
+    """Per-family HF suffix map. NB the naming trap: in llama/qwen/mistral
+    checkpoints `post_attention_layernorm` is the PRE-FFN norm; Gemma2
+    (post_norms) uses it for the actual post-attention norm and names the
+    pre-FFN norm `pre_feedforward_layernorm`."""
+    m = list(_LLAMA_LAYER_MAP)
+    if cfg.n_experts:
+        m = [e for e in m if e[0] not in ("w1", "w3", "w2")]
+    if cfg.post_norms:
+        m = [e for e in m if e[0] != "ffn_norm"]
+        m += [
+            ("ffn_norm", "pre_feedforward_layernorm.weight", False),
+            ("post_attn_norm", "post_attention_layernorm.weight", False),
+            ("post_ffn_norm", "post_feedforward_layernorm.weight", False),
+        ]
+    if cfg.qkv_bias:
+        m += [
+            ("bq", "self_attn.q_proj.bias", False),
+            ("bk", "self_attn.k_proj.bias", False),
+            ("bv", "self_attn.v_proj.bias", False),
+        ]
+    return m
+
+
 # Mixtral-style MoE layers: router + per-expert w1/w2/w3 (HF [out, in]).
 _MOE_GATE = "block_sparse_moe.gate.weight"
 
@@ -172,9 +196,7 @@ def hf_to_llama_params(
         return tensors[name]
 
     L = cfg.n_layers
-    layer_map = _LLAMA_LAYER_MAP
-    if cfg.n_experts:
-        layer_map = [m for m in _LLAMA_LAYER_MAP if m[0] not in ("w1", "w3", "w2")]
+    layer_map = _layer_map(cfg)
     layers: dict[str, np.ndarray] = {}
     for ours, suffix, transpose in layer_map:
         per_layer = []
@@ -222,9 +244,7 @@ def llama_to_hf_tensors(
         f"{prefix}embed_tokens.weight": np.asarray(params["embed"]),
         f"{prefix}norm.weight": np.asarray(params["final_norm"]),
     }
-    layer_map = _LLAMA_LAYER_MAP
-    if cfg.n_experts:
-        layer_map = [m for m in _LLAMA_LAYER_MAP if m[0] not in ("w1", "w3", "w2")]
+    layer_map = _layer_map(cfg)
     for ours, suffix, transpose in layer_map:
         stacked = np.asarray(params["layers"][ours])
         for i in range(cfg.n_layers):

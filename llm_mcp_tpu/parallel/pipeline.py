@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.configs import ModelConfig
-from ..models.llama import prefill_masks, prefill_layer, _logits
+from ..models.llama import _embed_in, _logits, layer_windows, prefill_layer, prefill_masks
 from .ring import _shard_map
 
 
@@ -65,7 +65,7 @@ def pipeline_prefill(
     Lp = L // PP
     Hkv, hd = cfg.n_kv_heads, cfg.resolved_head_dim
 
-    h = params["embed"][tokens]  # [B, S, D] (embed replicated over pp)
+    h = _embed_in(cfg, params, tokens)  # [B, S, D] (embed replicated over pp)
     D = h.shape[-1]
     cos, sin, mask = prefill_masks(cfg, S, lengths)
 
@@ -74,20 +74,23 @@ def pipeline_prefill(
     lenm = lengths.reshape(M, mb)
 
     stage_lp = stack_stages(params["layers"], PP)  # [PP, Lp, ...]
+    stage_win = layer_windows(cfg).reshape(PP, Lp)  # per-stage sliding windows
 
-    def run(stage_lp, hm, maskm, lenm, cos, sin):
+    def run(stage_lp, stage_win, hm, maskm, lenm, cos, sin):
         # Local views: stage_lp leaves arrive as [1, Lp, ...].
         lp = jax.tree.map(lambda x: x[0], stage_lp)
+        win = stage_win[0]  # [Lp]
         stage = jax.lax.axis_index("pp")
         steps = M + PP - 1
 
         def run_stage(x, mask_j, len_j):
-            def layer(h, one_lp):
+            def layer(h, xs):
+                one_lp, w = xs
                 return prefill_layer(
-                    cfg, one_lp, h, cos, sin, mask_j, len_j, attn_impl
+                    cfg, one_lp, h, cos, sin, mask_j, len_j, attn_impl, window=w
                 )
 
-            return jax.lax.scan(layer, x, lp)
+            return jax.lax.scan(layer, x, (lp, win))
 
         out0 = jnp.zeros((M, mb, S, D), dtype=h.dtype)
         kv0 = jnp.zeros((M, Lp, mb, Hkv, S, hd), dtype=h.dtype)
@@ -123,10 +126,10 @@ def pipeline_prefill(
     shmap = _shard_map(
         run,
         mesh,
-        in_specs=(P("pp"), P(), P(), P(), P(), P()),
+        in_specs=(P("pp"), P("pp"), P(), P(), P(), P(), P()),
         out_specs=(P(), P(None, "pp"), P(None, "pp")),
     )
-    out, k, v = shmap(stage_lp, hm, maskm, lenm, cos, sin)
+    out, k, v = shmap(stage_lp, stage_win, hm, maskm, lenm, cos, sin)
 
     h = out.reshape(B, S, D)
     # [M, L, mb, Hkv, S, hd] → [L, B, Hkv, S, hd]

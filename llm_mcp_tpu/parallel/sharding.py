@@ -34,6 +34,13 @@ def llama_param_specs(cfg: ModelConfig) -> dict[str, Any]:
         "wo": P(None, "tp", None),
         "ffn_norm": P(None, None),
     }
+    if cfg.qkv_bias:
+        # biases follow their projection's output sharding
+        layers.update({"bq": P(None, "tp"), "bk": P(None, "tp"), "bv": P(None, "tp")})
+    if cfg.post_norms:
+        layers.update(
+            {"post_attn_norm": P(None, None), "post_ffn_norm": P(None, None)}
+        )
     if cfg.n_experts:
         # Experts on ep, expert FFN hidden on tp: the dispatch einsums in
         # models/moe.py become the token all-to-all over ep under GSPMD.

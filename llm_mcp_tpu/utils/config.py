@@ -99,6 +99,9 @@ class Config:
     tpu_model: str = field(default_factory=lambda: getenv("TPU_MODEL", "llama-3.1-8b"))
     tpu_embed_model: str = field(default_factory=lambda: getenv("TPU_EMBED_MODEL", "nomic-embed-text"))
     tpu_weights_dir: str = field(default_factory=lambda: getenv("TPU_WEIGHTS_DIR", ""))
+    # 32 fits the default llama-3.1-8b KV cache alongside its weights on one
+    # chip; for 1B-class models TPU_MAX_SLOTS=64 is the measured throughput
+    # optimum (bench.py sweep — larger hits an XLA full-cache-copy cliff).
     tpu_max_slots: int = field(default_factory=lambda: getenv_int("TPU_MAX_SLOTS", 32))
     tpu_max_seq_len: int = field(default_factory=lambda: getenv_int("TPU_MAX_SEQ_LEN", 2048))
     tpu_mesh_shape: str = field(default_factory=lambda: getenv("TPU_MESH_SHAPE", ""))  # e.g. "dp=1,tp=8"

@@ -1,0 +1,156 @@
+"""Model-family coverage: Qwen2 (qkv bias), Mistral (sliding window), Gemma2
+(gelu, (1+w)-norms, post-norms, embed scaling, soft-capping, alternating
+window). One shared decoder serves all families (models/llama.py), the way
+the reference's single Ollama runtime serves its whole catalog
+(`discovery.go:482-560` just infers metadata per family name)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_mcp_tpu.models import get_config, init_llama_params, init_kv_cache
+from llm_mcp_tpu.models.configs import MODEL_CONFIGS
+from llm_mcp_tpu.models.llama import (
+    layer_windows,
+    llama_decode_step,
+    llama_prefill,
+)
+
+FAMILIES = ["tiny-qwen", "tiny-mistral", "tiny-gemma"]
+
+
+@pytest.fixture(scope="module", params=FAMILIES)
+def fam(request):
+    cfg = get_config(request.param)
+    params = init_llama_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return cfg, params
+
+
+def test_decode_matches_prefill(fam):
+    """Incremental decode == one-shot prefill for every family's extras
+    (biases, post-norms, softcaps, windows all hit both paths)."""
+    cfg, params = fam
+    key = jax.random.PRNGKey(1)
+    prompt = jax.random.randint(key, (1, 7), 3, cfg.vocab_size)
+    lengths = jnp.array([7], dtype=jnp.int32)
+    full_logits, _, _ = llama_prefill(cfg, params, prompt, lengths)
+
+    l6 = jnp.array([6], dtype=jnp.int32)
+    _, ks6, vs6 = llama_prefill(cfg, params, prompt[:, :6], l6)
+    cache = init_kv_cache(cfg, batch=1, max_seq=16, dtype=jnp.float32)
+    ck = cache["k"].at[:, :, :, :6].set(ks6)
+    cv = cache["v"].at[:, :, :, :6].set(vs6)
+    tok = jnp.array([int(prompt[0, 6])], dtype=jnp.int32)
+    lens = jnp.array([6], dtype=jnp.int32)
+    step_logits, _, _ = llama_decode_step(cfg, params, ck, cv, tok, lens)
+    np.testing.assert_allclose(
+        np.asarray(step_logits[0]), np.asarray(full_logits[0]), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_flash_prefill_matches_xla(fam):
+    """The pallas flash kernel (window + softcap path) agrees with the
+    einsum reference for each family."""
+    cfg, params = fam
+    key = jax.random.PRNGKey(2)
+    prompt = jax.random.randint(key, (2, 128), 3, cfg.vocab_size)
+    lengths = jnp.array([128, 77], dtype=jnp.int32)
+    lx, _, _ = llama_prefill(cfg, params, prompt, lengths, attn_impl="xla")
+    lp, _, _ = llama_prefill(cfg, params, prompt, lengths, attn_impl="pallas")
+    np.testing.assert_allclose(np.asarray(lx), np.asarray(lp), rtol=5e-3, atol=5e-3)
+
+
+def test_sliding_window_limits_context():
+    """A token far outside every layer's window cannot influence the last
+    token's logits; a token inside it does."""
+    cfg = get_config("tiny-mistral")  # window 64 on ALL layers
+    params = init_llama_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    key = jax.random.PRNGKey(3)
+    S = 128
+    prompt = jax.random.randint(key, (1, S), 3, cfg.vocab_size)
+    lengths = jnp.array([S], dtype=jnp.int32)
+    base, _, _ = llama_prefill(cfg, params, prompt, lengths)
+
+    # position 10 is > 64 tokens before the last query (127) — outside the
+    # window of every layer, and (single-layer-hop) cannot leak through two
+    # sliding layers either since 127 - 10 > 2*64 is false... use pos 0:
+    # 127 - 0 = 127 < 2*64 = 128 could leak via layer stacking, so compare
+    # against receptive-field math: L layers × window W gives reach L*(W-1).
+    # tiny-mistral: 2 * 63 = 126 < 127 ⇒ position 0 is unreachable.
+    changed = prompt.at[0, 0].set((prompt[0, 0] + 1) % cfg.vocab_size)
+    out_far, _, _ = llama_prefill(cfg, params, changed, lengths)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(out_far), rtol=1e-5, atol=1e-5)
+
+    # position 100 is inside the last token's window — must change logits
+    changed_near = prompt.at[0, 100].set((prompt[0, 100] + 1) % cfg.vocab_size)
+    out_near, _, _ = llama_prefill(cfg, params, changed_near, lengths)
+    assert float(jnp.max(jnp.abs(out_near - base))) > 1e-4
+
+
+def test_gemma_alternating_windows():
+    cfg = get_config("tiny-gemma")
+    wins = np.asarray(layer_windows(cfg))
+    assert wins.tolist() == [64, 0]  # layer 0 sliding, layer 1 global
+    mis = np.asarray(layer_windows(get_config("tiny-mistral")))
+    assert mis.tolist() == [64, 64]
+    lla = np.asarray(layer_windows(get_config("tiny-llm")))
+    assert lla.tolist() == [0, 0]
+
+
+def test_gemma_logit_softcap_bounds_logits():
+    cfg = get_config("tiny-gemma")
+    params = init_llama_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    # scale up the embedding to force large pre-cap logits
+    params = dict(params, embed=params["embed"] * 50.0)
+    prompt = jnp.ones((1, 8), dtype=jnp.int32) * 5
+    logits, _, _ = llama_prefill(cfg, params, prompt, jnp.array([8], jnp.int32))
+    assert float(jnp.max(jnp.abs(logits))) <= cfg.logit_softcap + 1e-3
+
+
+def test_qwen_bias_params_exist_and_matter():
+    cfg = get_config("tiny-qwen")
+    params = init_llama_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    assert set(params["layers"]) >= {"bq", "bk", "bv"}
+    prompt = jnp.array([[7, 9, 11]], dtype=jnp.int32)
+    lens = jnp.array([3], dtype=jnp.int32)
+    base, _, _ = llama_prefill(cfg, params, prompt, lens)
+    bumped = dict(params)
+    bumped["layers"] = dict(params["layers"], bq=params["layers"]["bq"] + 1.0)
+    out, _, _ = llama_prefill(cfg, bumped, prompt, lens)
+    assert float(jnp.max(jnp.abs(out - base))) > 1e-4
+
+
+def test_real_configs_resolve_and_count():
+    for name, pb in [
+        ("qwen2.5-7b", 7.6),
+        ("qwen2.5-0.5b", 0.49),
+        ("mistral-7b", 7.2),
+        ("gemma2-9b", 9.24),
+    ]:
+        cfg = MODEL_CONFIGS[name]
+        approx = cfg.param_count() / 1e9
+        assert abs(approx - pb) / pb < 0.15, (name, approx)
+    # alias resolution
+    assert get_config("Qwen/Qwen2.5-7B-Instruct").name == "qwen2.5-7b"
+    assert get_config("mistral:7b").name == "mistral-7b"
+    assert get_config("gemma2:9b").name == "gemma2-9b"
+
+
+def test_hf_roundtrip_families():
+    """HF-name export → import reproduces the stacked tree for every family
+    (exercises the Gemma2 norm-name remap and Qwen biases)."""
+    from llm_mcp_tpu.models.weights import hf_to_llama_params, llama_to_hf_tensors
+
+    for name in FAMILIES:
+        cfg = get_config(name)
+        params = init_llama_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+        tensors = llama_to_hf_tensors(cfg, params)
+        back = hf_to_llama_params(cfg, tensors)
+        for k, v in params["layers"].items():
+            np.testing.assert_array_equal(
+                np.asarray(v), np.asarray(back["layers"][k]), err_msg=f"{name}:{k}"
+            )
+        np.testing.assert_array_equal(np.asarray(params["embed"]), back["embed"])
