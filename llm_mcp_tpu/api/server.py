@@ -24,7 +24,7 @@ from typing import Any
 
 from ..executor import EmbeddingEngine, GenerationEngine
 from ..routing import CircuitBreaker, LimitsEngine, Router
-from ..state.catalog import Catalog, cloud_pricing_per_1m
+from ..state.catalog import Catalog, sync_cloud_catalog
 from ..state.db import Database
 from ..state.queue import JobQueue
 from ..telemetry import Metrics
@@ -113,6 +113,17 @@ class CoreServer:
             cfg=self.cfg,
             register_local=self.register_local_device,
             self_device_id=device_id,
+        )
+        from ..planner import Planner
+
+        self.planner = Planner(
+            self.cfg,
+            self.queue,
+            self.catalog,
+            cloud=self.cloud,
+            gen_models=list(self.gen_engines),
+            embed_models=list(self.embed_engines),
+            device_id=device_id,
         )
 
     # -- local engine device registration ----------------------------------
@@ -220,6 +231,10 @@ class CoreServer:
         # knowledge
         r("POST", "/v1/knowledge/ingest", self.handle_knowledge_ingest)
 
+        # planner (manual trigger + status; periodic runs via _ticker)
+        r("POST", "/v1/planner/run", self.handle_planner_run)
+        r("GET", "/v1/planner/status", self.handle_planner_status)
+
     # -- small handlers ------------------------------------------------------
 
     def handle_health(self, req: Request, resp: Response) -> None:
@@ -272,20 +287,7 @@ class CoreServer:
         cloud_synced = 0
         if self.cloud is not None:
             try:
-                for m in self.cloud.list_models():
-                    mid = str(m.get("id") or "")
-                    if not mid:
-                        continue
-                    ctx = int(m.get("context_length") or 0)
-                    self.catalog.upsert_model(
-                        mid,
-                        name=str(m.get("name") or "") or None,
-                        context_k=ctx // 1024 if ctx else None,
-                    )
-                    pricing = cloud_pricing_per_1m(m)
-                    if pricing is not None:
-                        self.catalog.set_pricing(mid, pricing[0], pricing[1])
-                    cloud_synced += 1
+                cloud_synced = sync_cloud_catalog(self.catalog, self.cloud)
             except Exception as e:
                 resp.write_json(
                     {"status": "partial", "local": synced, "cloud_error": str(e)}, 502
@@ -398,6 +400,19 @@ class CoreServer:
         except Exception as e:
             resp.write_error(f"lightrag unreachable: {e}", 502)
 
+    def handle_planner_run(self, req: Request, resp: Response) -> None:
+        resp.write_json({"status": "ok", "result": self.planner.run_once()})
+
+    def handle_planner_status(self, req: Request, resp: Response) -> None:
+        resp.write_json(
+            {
+                "runs": self.planner.runs,
+                "last_run": self.planner.last_run,
+                "last_result": self.planner.last_result,
+                "interval_s": self.cfg.planner_interval_s,
+            }
+        )
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self, host: str = "0.0.0.0", port: int = 8080) -> "CoreServer":
@@ -435,6 +450,10 @@ class CoreServer:
                     self.discovery.run()
                 except Exception:
                     log.exception("periodic discovery failed")
+            try:
+                self.planner.maybe_run(now)
+            except Exception:
+                log.exception("planner tick failed")
 
     def shutdown(self) -> None:
         self._bg_stop.set()

@@ -1,0 +1,163 @@
+"""Planner: background maintenance loop (sync / cleanup / benchmark refresh).
+
+The reference DOCUMENTS a `planner/` module (README structure section,
+`CHANGELOG_V2.md:7-60`, `V2_RELEASE_SUMMARY.md`) with periodic OpenRouter
+top-N sync under a price cap, stale-job cleanup (>7 days), and a
+`BENCHMARK_MAX_PRICE_PER_1M` benchmark-cost guard — but the directory does
+not exist in the snapshot (SURVEY.md "Documented-but-absent"). This module
+implements those roadmap capabilities for real:
+
+1. **Stale-job cleanup** — terminal jobs older than PLANNER_STALE_DAYS are
+   purged (`state/queue.py:purge_stale`), bounding queue-table growth.
+2. **Cloud catalog refresh** — re-sync the cloud provider's model list +
+   pricing every cycle so smart routing prices stay current; models priced
+   above PLANNER_MAX_PRICE_PER_1M (input side) are skipped, the documented
+   top-N price cap.
+3. **Benchmark refresh with cost guard** — local engine models with no
+   benchmark newer than PLANNER_BENCH_MAX_AGE_S get a `benchmark.generate`
+   job submitted through the normal queue (so routing stays
+   benchmark-driven, `router.go:290-322` equivalent); cloud models are
+   never auto-benchmarked when their blended price exceeds
+   BENCHMARK_MAX_PRICE_PER_1M.
+
+Wired as an extra tick in CoreServer's background ticker (api/server.py),
+mirroring how the reference runs discovery/limits from main.go:56-67,101-112.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any
+
+log = logging.getLogger("planner")
+
+
+class Planner:
+    def __init__(
+        self,
+        cfg,
+        queue,
+        catalog,
+        cloud=None,
+        gen_models=None,
+        embed_models=None,
+        device_id: str = "",
+    ):
+        self.cfg = cfg
+        self.queue = queue
+        self.catalog = catalog
+        self.cloud = cloud
+        self.gen_models = list(gen_models or [])
+        self.embed_models = list(embed_models or [])
+        # the planner benchmarks ITS core's local engines — stamp that
+        # device into the payload so record_benchmark_from_job attributes
+        # the tps to the right device row (it drops device-less results)
+        self.device_id = device_id
+        self.last_run: float = 0.0
+        self.runs = 0
+        self.last_result: dict[str, Any] = {}
+        # one run at a time: the HTTP trigger (POST /v1/planner/run) and the
+        # server ticker would otherwise race and double-submit/double-sync
+        self._run_lock = threading.Lock()
+
+    # -- policy ----------------------------------------------------------
+
+    def benchmark_allowed(self, model_id: str) -> bool:
+        """Cost guard: local models always; cloud models only under the
+        BENCHMARK_MAX_PRICE_PER_1M cap (0 disables auto cloud benches)."""
+        pricing = self.catalog.get_pricing(model_id)
+        if not pricing:  # unpriced → local/free
+            return True
+        cap = self.cfg.benchmark_max_price_per_1m
+        if cap <= 0:
+            return False
+        blended = (pricing.get("input_per_1m", 0.0) + pricing.get("output_per_1m", 0.0)) / 2
+        return blended <= cap
+
+    # -- tasks -----------------------------------------------------------
+
+    def cleanup_stale_jobs(self) -> int:
+        return self.queue.purge_stale(older_than_days=self.cfg.planner_stale_days)
+
+    def sync_cloud_models(self) -> int:
+        if self.cloud is None:
+            return 0
+        from .state.catalog import sync_cloud_catalog
+
+        return sync_cloud_catalog(
+            self.catalog, self.cloud, max_price_per_1m=self.cfg.planner_max_price_per_1m
+        )
+
+    def _benchmark_pending(self, model: str, task: str) -> bool:
+        """A queued/running benchmark job for (model, task) already exists —
+        don't stack duplicates while workers are down or jobs in flight."""
+        for status in ("queued", "running"):
+            for job in self.queue.list(status=status, kind=f"benchmark.{task}"):
+                if job.payload.get("model") == model:
+                    return True
+        return False
+
+    def refresh_benchmarks(self) -> int:
+        """Submit benchmark jobs for local models whose latest benchmark of
+        the matching task is older than PLANNER_BENCH_MAX_AGE_S (0 disables).
+        Generation engines get `benchmark.generate`, embedding engines
+        `benchmark.embed` (worker/executors.py:_benchmark)."""
+        max_age = self.cfg.planner_bench_max_age_s
+        if max_age <= 0:
+            return 0
+        now = time.time()
+        submitted = 0
+        for model, task in [(m, "generate") for m in self.gen_models] + [
+            (m, "embed") for m in self.embed_models
+        ]:
+            if not self.benchmark_allowed(model):
+                continue
+            latest = self.catalog.latest_benchmark_for_model(model, task_type=task)
+            if latest and now - float(latest.get("created_at") or 0) < max_age:
+                continue
+            if self._benchmark_pending(model, task):
+                continue
+            payload = {"model": model, "prompt": "benchmark", "max_tokens": 64,
+                       "_planner": True}
+            if self.device_id:
+                payload["device_id"] = self.device_id
+            self.queue.submit(kind=f"benchmark.{task}", payload=payload)
+            submitted += 1
+        return submitted
+
+    # -- loop ------------------------------------------------------------
+
+    def run_once(self) -> dict[str, Any]:
+        with self._run_lock:
+            result: dict[str, Any] = {}
+            for name, task in (
+                ("purged_jobs", self.cleanup_stale_jobs),
+                ("cloud_models_synced", self.sync_cloud_models),
+                ("benchmarks_submitted", self.refresh_benchmarks),
+            ):
+                try:
+                    result[name] = task()
+                except Exception as e:  # keep the loop alive; report per-task
+                    log.exception("planner task %s failed", name)
+                    result[name] = f"error: {e}"
+            self.last_run = time.time()
+            self.runs += 1
+            self.last_result = result
+            log.info("planner run #%d: %s", self.runs, result)
+            return result
+
+    def maybe_run(self, now: float | None = None) -> dict[str, Any] | None:
+        """Tick hook: run when the interval elapsed (0 disables). Skips
+        (rather than queues behind) a run already in progress."""
+        interval = self.cfg.planner_interval_s
+        if interval <= 0:
+            return None
+        now = time.time() if now is None else now
+        # first tick after boot runs immediately (fresh catalog/pricing)
+        if self.last_run and now - self.last_run < interval:
+            return None
+        if self._run_lock.locked():
+            return None
+        return self.run_once()

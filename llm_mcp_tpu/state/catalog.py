@@ -108,6 +108,32 @@ def cloud_pricing_per_1m(entry: dict[str, Any]) -> tuple[float, float] | None:
     return p_in, p_out
 
 
+def sync_cloud_catalog(catalog: "Catalog", cloud: Any, max_price_per_1m: float = 0.0) -> int:
+    """Upsert the cloud provider's model list + pricing into the catalog.
+
+    Single implementation shared by `POST /v1/models/sync` (api/server.py)
+    and the planner's periodic refresh — `max_price_per_1m > 0` applies the
+    planner's documented price cap (input-side) and skips pricier models."""
+    synced = 0
+    for m in cloud.list_models():
+        mid = str(m.get("id") or "")
+        if not mid:
+            continue
+        pricing = cloud_pricing_per_1m(m)
+        if pricing is not None and max_price_per_1m > 0 and pricing[0] > max_price_per_1m:
+            continue
+        ctx = int(m.get("context_length") or 0)
+        catalog.upsert_model(
+            mid,
+            name=str(m.get("name") or "") or None,
+            context_k=ctx // 1024 if ctx else None,
+        )
+        if pricing is not None:
+            catalog.set_pricing(mid, pricing[0], pricing[1])
+        synced += 1
+    return synced
+
+
 def record_benchmark_from_job(catalog: "Catalog", job: Any) -> None:
     """benchmark.* job results feed the benchmarks table that routing ranks
     by (`grpcserver/server.go:302-327`, `main.py:471-518`). Shared by the
@@ -328,6 +354,23 @@ class Catalog:
             "SELECT * FROM benchmarks WHERE device_id=? AND model_id=? AND task_type=?"
             " ORDER BY created_at DESC LIMIT 1",
             (device_id, model_id, task_type),
+        )
+
+    def latest_benchmark_for_model(
+        self, model_id: str, task_type: str | None = None
+    ) -> dict[str, Any] | None:
+        """Freshest benchmark across devices (planner staleness check); a
+        row for a DIFFERENT task must not mask staleness, so filter when the
+        caller refreshes a specific task."""
+        if task_type:
+            return self.db.query_one(
+                "SELECT * FROM benchmarks WHERE model_id=? AND task_type=?"
+                " ORDER BY created_at DESC LIMIT 1",
+                (model_id, task_type),
+            )
+        return self.db.query_one(
+            "SELECT * FROM benchmarks WHERE model_id=? ORDER BY created_at DESC LIMIT 1",
+            (model_id,),
         )
 
     def list_benchmarks(self, limit: int = 200) -> list[dict[str, Any]]:

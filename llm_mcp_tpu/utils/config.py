@@ -68,6 +68,14 @@ class Config:
     device_limits_json: str = field(default_factory=lambda: getenv("DEVICE_LIMITS_JSON", ""))
     device_limits_file: str = field(default_factory=lambda: getenv("DEVICE_LIMITS_FILE", ""))
     device_limits_interval_s: int = field(default_factory=lambda: getenv_int("DEVICE_LIMITS_INTERVAL", 300))
+    # planner (background maintenance, see llm_mcp_tpu/planner.py) — the
+    # reference documents these knobs for its absent planner/ module
+    # (CHANGELOG_V2.md); 0 interval disables the loop entirely.
+    planner_interval_s: int = field(default_factory=lambda: getenv_int("PLANNER_INTERVAL", 3600))
+    planner_stale_days: float = field(default_factory=lambda: getenv_float("PLANNER_STALE_DAYS", 7.0))
+    planner_max_price_per_1m: float = field(default_factory=lambda: getenv_float("PLANNER_MAX_PRICE_PER_1M", 0.0))
+    planner_bench_max_age_s: float = field(default_factory=lambda: getenv_float("PLANNER_BENCH_MAX_AGE_S", 0.0))
+    benchmark_max_price_per_1m: float = field(default_factory=lambda: getenv_float("BENCHMARK_MAX_PRICE_PER_1M", 10.0))
 
     # Worker
     worker_id: str = field(default_factory=lambda: getenv("WORKER_ID", ""))

@@ -1,0 +1,182 @@
+"""Planner: stale cleanup, cloud sync with price cap, benchmark refresh with
+cost guard, interval gating, and the HTTP trigger/status surface."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from llm_mcp_tpu.planner import Planner
+from llm_mcp_tpu.state import Database
+from llm_mcp_tpu.state.catalog import Catalog
+from llm_mcp_tpu.state.queue import JobQueue
+from llm_mcp_tpu.utils.config import Config
+
+
+class FakeCloud:
+    def __init__(self, models):
+        self.models = models
+
+    def list_models(self):
+        return self.models
+
+
+@pytest.fixture()
+def parts(monkeypatch):
+    monkeypatch.setenv("PLANNER_INTERVAL", "3600")
+    db = Database(":memory:")
+    q = JobQueue(db)
+    cat = Catalog(db)
+    cfg = Config()
+    return cfg, q, cat, db
+
+
+def test_cleanup_purges_only_old_terminal(parts):
+    cfg, q, cat, db = parts
+    old = q.submit("echo", {})
+    q.claim(worker_id="w")
+    q.complete(old.id, "w", {})
+    # age the job beyond the threshold
+    db.execute("UPDATE jobs SET updated_at = ? WHERE id = ?", (time.time() - 8 * 86400, old.id))
+    fresh = q.submit("echo", {})
+    p = Planner(cfg, q, cat)
+    assert p.cleanup_stale_jobs() == 1
+    assert q.get(old.id) is None
+    assert q.get(fresh.id) is not None
+
+
+def test_cloud_sync_respects_price_cap(parts, monkeypatch):
+    cfg, q, cat, db = parts
+    monkeypatch.setenv("PLANNER_MAX_PRICE_PER_1M", "5.0")
+    cfg = Config()
+    cloud = FakeCloud(
+        [
+            {"id": "cheap/model", "context_length": 8192,
+             "pricing": {"prompt": "0.000001", "completion": "0.000002"}},  # $1/$2 per 1M
+            {"id": "pricey/model", "context_length": 8192,
+             "pricing": {"prompt": "0.00002", "completion": "0.00006"}},  # $20/$60 per 1M
+        ]
+    )
+    p = Planner(cfg, q, cat, cloud=cloud)
+    assert p.sync_cloud_models() == 1
+    assert cat.get_model("cheap/model") is not None
+    assert cat.get_model("pricey/model") is None
+
+
+def test_benchmark_refresh_submits_for_stale_models(parts, monkeypatch):
+    cfg, q, cat, db = parts
+    monkeypatch.setenv("PLANNER_BENCH_MAX_AGE_S", "60")
+    cfg = Config()
+    cat.upsert_model("tiny-llm", kind="llm")
+    cat.upsert_model("fresh-llm", kind="llm")
+    cat.upsert_device("dev0", name="dev0", online=True)
+    cat.record_benchmark("dev0", "fresh-llm", "generate", tokens_in=1, tokens_out=64,
+                         latency_ms=10.0, tps=100.0)
+    p = Planner(cfg, q, cat, gen_models=["tiny-llm", "fresh-llm"],
+                embed_models=["tiny-embed"])
+    assert p.refresh_benchmarks() == 2  # un-benchmarked gen + embed models
+    jobs = q.list(status="queued")
+    kinds = sorted((j.kind, j.payload["model"]) for j in jobs)
+    assert kinds == [("benchmark.embed", "tiny-embed"),
+                     ("benchmark.generate", "tiny-llm")]
+    # queued duplicates must NOT stack while the jobs are still pending
+    assert p.refresh_benchmarks() == 0
+    # a completed benchmark row within max_age also suppresses resubmission
+    cat.record_benchmark("dev0", "tiny-llm", "generate", tokens_in=1, tokens_out=64,
+                         latency_ms=10.0, tps=50.0)
+    for j in q.list(status="queued"):
+        q.cancel(j.id)
+    cat.record_benchmark("dev0", "tiny-embed", "embed", tokens_in=64, tokens_out=0,
+                         latency_ms=5.0, tps=200.0)
+    assert p.refresh_benchmarks() == 0
+
+
+def test_benchmark_refresh_task_type_not_masked(parts, monkeypatch):
+    """A fresh EMBED benchmark must not mask a stale GENERATE one."""
+    cfg, q, cat, db = parts
+    monkeypatch.setenv("PLANNER_BENCH_MAX_AGE_S", "60")
+    cfg = Config()
+    cat.upsert_device("dev0", name="dev0", online=True)
+    cat.record_benchmark("dev0", "dual-model", "embed", tokens_in=64, tokens_out=0,
+                         latency_ms=5.0, tps=200.0)
+    p = Planner(cfg, q, cat, gen_models=["dual-model"])
+    assert p.refresh_benchmarks() == 1
+    assert q.list(status="queued")[0].kind == "benchmark.generate"
+
+
+def test_benchmark_cost_guard(parts, monkeypatch):
+    cfg, q, cat, db = parts
+    monkeypatch.setenv("BENCHMARK_MAX_PRICE_PER_1M", "10.0")
+    cfg = Config()
+    cat.upsert_model("openai/gpt-pricey", kind="llm")
+    cat.set_pricing("openai/gpt-pricey", 30.0, 60.0)
+    cat.upsert_model("openai/gpt-cheap", kind="llm")
+    cat.set_pricing("openai/gpt-cheap", 2.0, 6.0)
+    p = Planner(cfg, q, cat)
+    assert not p.benchmark_allowed("openai/gpt-pricey")
+    assert p.benchmark_allowed("openai/gpt-cheap")
+    assert p.benchmark_allowed("local-unpriced-model")
+    monkeypatch.setenv("BENCHMARK_MAX_PRICE_PER_1M", "0")
+    p0 = Planner(Config(), q, cat)
+    assert not p0.benchmark_allowed("openai/gpt-cheap")  # 0 disables cloud benches
+
+
+def test_maybe_run_interval_gating(parts, monkeypatch):
+    cfg, q, cat, db = parts
+    p = Planner(cfg, q, cat)
+    assert p.maybe_run(now=1000.0) is not None  # first run fires
+    assert p.maybe_run(now=1000.0 + 10) is None  # within interval
+    monkeypatch.setenv("PLANNER_INTERVAL", "0")
+    pd = Planner(Config(), q, cat)
+    assert pd.maybe_run() is None  # disabled
+
+
+def test_models_sync_handler_shares_planner_sync(parts):
+    """handle_models_sync and the planner call the same sync_cloud_catalog
+    implementation (no drift); uncapped handler syncs everything."""
+    from llm_mcp_tpu.state.catalog import sync_cloud_catalog
+
+    cfg, q, cat, db = parts
+    cloud = FakeCloud([
+        {"id": "a/m1", "context_length": 4096,
+         "pricing": {"prompt": "0.00002", "completion": "0.00002"}},
+    ])
+    assert sync_cloud_catalog(cat, cloud) == 1  # no cap → pricey model syncs
+    assert cat.get_model("a/m1") is not None
+    assert sync_cloud_catalog(cat, cloud, max_price_per_1m=5.0) == 0
+
+
+def test_run_once_survives_task_errors(parts):
+    cfg, q, cat, db = parts
+
+    class BoomCloud:
+        def list_models(self):
+            raise RuntimeError("cloud down")
+
+    p = Planner(cfg, q, cat, cloud=BoomCloud())
+    res = p.run_once()
+    assert str(res["cloud_models_synced"]).startswith("error:")
+    assert res["purged_jobs"] == 0  # other tasks still ran
+
+
+def test_planner_http_surface():
+    from llm_mcp_tpu.api.server import CoreServer
+
+    srv = CoreServer(Config(), db=Database(":memory:"))
+    srv.start("127.0.0.1", 0)
+    try:
+        import json
+        import urllib.request
+
+        base = f"http://127.0.0.1:{srv.api.port}"
+        req = urllib.request.Request(f"{base}/v1/planner/run", data=b"{}",
+                                     headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            body = json.loads(r.read())
+        assert body["status"] == "ok" and "purged_jobs" in body["result"]
+        with urllib.request.urlopen(f"{base}/v1/planner/status", timeout=30) as r:
+            st = json.loads(r.read())
+        assert st["runs"] >= 1
+    finally:
+        srv.shutdown()
