@@ -17,6 +17,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
 
+from ..utils.faults import maybe_fail
+
 log = logging.getLogger("api")
 
 MAX_BODY = 10 * 1024 * 1024  # 10MB cap, as the reference's chat handler
@@ -171,6 +173,7 @@ class HTTPApi:
             req = Request(handler, m.groupdict())
             resp = Response(handler)
             try:
+                maybe_fail("api.request", path)
                 r.fn(req, resp)
             except json.JSONDecodeError:
                 if not resp.started:

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..kernels.attention import pallas_supported, resolve_attn_impl, resolve_decode_impl
+from ..utils.faults import maybe_fail
 from ..models.configs import ModelConfig, get_config
 from ..models.weights import load_llama_checkpoint
 from ..models.llama import (
@@ -471,6 +472,9 @@ class GenerationEngine:
         self._emit_token(slot, tok0, pos=P - 1)
 
     def _decode_round(self, active: list[int]) -> None:
+        # chaos site: a failed round must fail active slots with error
+        # events, not hang callers (the poisoned-round guard in _run)
+        maybe_fail("engine.decode", f"active={len(active)}")
         out, self._ck, self._cv = self._decode_fn(
             self.params,
             self._ck,

@@ -20,6 +20,7 @@ import time
 import urllib.error
 from typing import Any
 
+from ..utils.faults import maybe_fail
 from ..utils.tokens import estimate_tokens, messages_to_prompt, split_think
 from .client import post_json
 
@@ -65,6 +66,7 @@ class Executors:
     # -- dispatch ----------------------------------------------------------
 
     def dispatch(self, kind: str, payload: dict[str, Any]) -> dict[str, Any]:
+        maybe_fail("worker.execute", f"kind={kind}")
         provider = str(payload.get("provider") or "tpu")
         if kind == "echo":
             return {"echo": payload.get("data", payload), "ok": True}

@@ -17,6 +17,7 @@ import time
 import uuid
 from typing import Any
 
+from ..utils.faults import FaultInjected, maybe_fail
 from .client import CoreClient, TerminalHTTPError
 from .executors import ExecutionError, Executors
 
@@ -115,6 +116,15 @@ class Worker:
             return
         hb_stop.set()
         hb.join(timeout=2.0)
+
+        try:
+            # chaos site: the job's work is DONE but the completion report
+            # never happens — exactly what a worker crash between execute
+            # and complete looks like; lease expiry must requeue the job.
+            maybe_fail("worker.complete", job_id)
+        except FaultInjected:
+            log.warning("fault: dropping completion report for %s (simulated death)", job_id)
+            return
 
         metrics = {
             "worker_id": self.worker_id,
