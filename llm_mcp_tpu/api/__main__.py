@@ -32,10 +32,21 @@ def main() -> None:
     gen_engines = {}
     embed_engines = {}
     if os.environ.get("TPU_DISABLE_ENGINES", "") not in ("1", "true"):
+        # multi-host first (must precede the first jax op), then the mesh:
+        # TPU_MESH_SHAPE="dp=1,tp=8" shards the engines over it; empty = one
+        # chip. make_global_mesh lays dp/pp over DCN on multi-slice fleets.
+        from ..parallel import distributed
+
+        mesh = None
+        if cfg.tpu_mesh_shape:
+            distributed.initialize()
+            mesh = distributed.make_global_mesh(cfg.tpu_mesh_shape)
+            log.info("serving over mesh %s", dict(zip(mesh.axis_names, mesh.devices.shape)))
         model = cfg.tpu_model
         log.info("loading generation engine: %s", model)
         gen_engines[model] = GenerationEngine(
             model,
+            mesh=mesh,
             max_slots=cfg.tpu_max_slots,
             max_seq_len=cfg.tpu_max_seq_len,
             dtype=jnp.bfloat16,

@@ -196,11 +196,54 @@ class GenerationEngine:
 
         impl = self.attn_impl
 
-        # jax.jit caches one executable per input shape, so prompt buckets
-        # (power-of-two padded) each compile once without any manual cache.
-        @jax.jit
-        def prefill_fn(params, tokens, lengths):
-            return llama_prefill(cfg_, params, tokens, lengths, attn_impl=impl)
+        # Long-context path: with an sp axis in the mesh, prefill runs
+        # sequence-parallel (ring attention over sp, Megatron TP over tp —
+        # parallel/ring.py:llama_prefill_sp): per-chip activations are
+        # [B, S/sp, D] and no full-sequence score matrix ever materializes,
+        # so prompts whose attention would blow a single chip's HBM still
+        # prefill. Decode is unchanged (its per-step work is tiny).
+        # The sp kernel covers the plain llama family in bf16/f32 — other
+        # families/quant keep the GSPMD prefill.
+        plain_family = not (
+            cfg_.n_experts
+            or cfg_.sliding_window
+            or cfg_.attn_softcap
+            or cfg_.qkv_bias
+            or cfg_.post_norms
+            or cfg_.norm_weight_offset
+            or cfg_.embed_scale
+            or cfg_.logit_softcap
+            or cfg_.query_pre_attn_scalar
+        )
+        self.sp = 1
+        if mesh is not None and not self.quant and plain_family:
+            axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            if (
+                axes.get("sp", 1) > 1
+                and axes.get("dp", 1) == 1  # engine prefills one prompt at a time
+                and axes.get("pp", 1) == 1
+                and axes.get("ep", 1) == 1
+                and cfg_.n_kv_heads % axes.get("tp", 1) == 0
+                and cfg_.vocab_size % axes.get("tp", 1) == 0
+            ):
+                self.sp = axes["sp"]
+
+        if self.sp > 1:
+            from ..parallel.ring import llama_prefill_sp
+
+            log.info("sequence-parallel prefill enabled: sp=%d", self.sp)
+
+            @jax.jit
+            def prefill_fn(params, tokens, lengths):
+                return llama_prefill_sp(cfg_, params, tokens, lengths, mesh)
+
+        else:
+
+            # jax.jit caches one executable per input shape, so prompt buckets
+            # (power-of-two padded) each compile once without any manual cache.
+            @jax.jit
+            def prefill_fn(params, tokens, lengths):
+                return llama_prefill(cfg_, params, tokens, lengths, attn_impl=impl)
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def insert_fn(ck, cv, ks, vs, slot):
@@ -356,7 +399,9 @@ class GenerationEngine:
     # -- engine loop -------------------------------------------------------
 
     def _bucket(self, n: int) -> int:
-        return pow2_bucket(n, self.max_seq_len)
+        # sp prefill shards the bucket over the sp axis — keep it divisible
+        # (both are powers of two, so clamping to >= sp suffices)
+        return max(pow2_bucket(n, self.max_seq_len), self.sp)
 
     def _recover_cache(self) -> None:
         """Re-allocate the KV cache if a failed dispatch consumed the donated

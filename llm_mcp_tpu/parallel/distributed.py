@@ -1,0 +1,147 @@
+"""Multi-host (DCN) bootstrap: jax.distributed + slice-aware global meshes.
+
+The reference scales across hosts with NCCL-free plumbing — HTTP/gRPC +
+Postgres + Tailscale (SURVEY.md §2.2 "Distributed communication backend").
+This framework keeps that control plane for the CLUSTER (queue, discovery,
+routing) and uses the TPU-native data plane for the MODEL: one
+`jax.sharding.Mesh` spanning every chip of every host, with XLA inserting
+ICI collectives inside a slice and DCN collectives across slices.
+
+Boot order on a multi-host TPU pod / multi-slice deployment:
+
+    from llm_mcp_tpu.parallel import distributed
+    distributed.initialize()          # once per process, BEFORE first jax op
+    mesh = distributed.make_global_mesh("dp=2,tp=8")
+
+`initialize()` wraps `jax.distributed.initialize`, which on Cloud TPU VMs
+auto-discovers the coordinator from the TPU metadata server; elsewhere it
+reads the standard env triplet (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES
+/ JAX_PROCESS_ID). Single-process runs skip cleanly, so the same serving
+entrypoint works from a laptop to a pod.
+
+`make_global_mesh` maps axes onto the physical fabric the way the scaling
+book prescribes: the LEADING configured axis (usually `dp`, else `pp`) is
+laid out across slices/hosts so its collectives (gradient-free at inference;
+just independent batch shards) ride DCN, while `tp`/`sp` — whose collectives
+are on the decode/prefill critical path — stay inside a slice on ICI.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import AXES, mesh_axis_sizes
+
+log = logging.getLogger("distributed")
+
+_initialized = False
+
+
+def env_process_info() -> tuple[str, int, int] | None:
+    """(coordinator, num_processes, process_id) from env, or None."""
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS", "")
+    if not addr:
+        return None
+    try:
+        n = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+        pid = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    except ValueError:
+        return None
+    return addr, n, pid
+
+
+def initialize(force: bool = False) -> bool:
+    """Idempotent `jax.distributed.initialize`. Returns True when a
+    multi-process runtime was (or already is) initialized.
+
+    - On Cloud TPU VMs with no env overrides, bare initialize() lets JAX
+      read the TPU metadata server (worker count, coordinator).
+    - Off-TPU, the JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID
+      triplet drives it (the k8s manifests set these from the StatefulSet
+      ordinal).
+    - Single-process (no env, not a TPU pod): no-op, returns False.
+    """
+    global _initialized
+    if _initialized and not force:
+        return jax.process_count() > 1
+    info = env_process_info()
+    on_tpu_pod = bool(os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+    if info is None and not on_tpu_pod:
+        log.debug("single-process run; jax.distributed not initialized")
+        return False
+    try:
+        if info is not None:
+            addr, n, pid = info
+            jax.distributed.initialize(
+                coordinator_address=addr, num_processes=n, process_id=pid
+            )
+        else:
+            jax.distributed.initialize()
+        _initialized = True
+        log.info(
+            "jax.distributed up: process %d/%d, %d global devices",
+            jax.process_index(),
+            jax.process_count(),
+            len(jax.devices()),
+        )
+        return jax.process_count() > 1
+    except Exception:
+        log.exception("jax.distributed.initialize failed; continuing single-process")
+        return False
+
+
+def dcn_axis(sizes: dict[str, int]) -> str:
+    """Which mesh axis should span slices/hosts (DCN): the first of dp/pp
+    with size > 1 — their communication is off the per-token critical path.
+    tp/sp collectives must stay on ICI."""
+    for a in ("dp", "pp"):
+        if sizes.get(a, 1) > 1:
+            return a
+    return ""
+
+
+def make_global_mesh(spec: str = "") -> Mesh:
+    """Build a mesh over ALL processes' devices, slice-topology-aware.
+
+    With multiple slices (device.slice_index present and > 1 distinct), the
+    DCN axis (dp/pp) is laid out across slices and the remaining axes within
+    each slice, via mesh_utils.create_hybrid_device_mesh. Single-slice (or
+    CPU test) runs reduce to the plain mesh — same axes, same semantics."""
+    devices = jax.devices()
+    sizes = mesh_axis_sizes(spec, len(devices))
+    slice_ids = sorted({getattr(d, "slice_index", 0) for d in devices})
+    n_slices = len(slice_ids)
+    dcn = dcn_axis(sizes)
+
+    if n_slices > 1 and dcn and sizes[dcn] % n_slices == 0:
+        from jax.experimental import mesh_utils
+
+        ici_sizes = dict(sizes)
+        dcn_sizes = {a: 1 for a in AXES}
+        dcn_sizes[dcn] = n_slices
+        ici_sizes[dcn] = sizes[dcn] // n_slices
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=[ici_sizes[a] for a in AXES],
+            dcn_mesh_shape=[dcn_sizes[a] for a in AXES],
+            devices=devices,
+        )
+        log.info(
+            "hybrid mesh: %s over %d slices (DCN axis %s)", sizes, n_slices, dcn
+        )
+        return Mesh(arr, axis_names=AXES)
+
+    arr = np.asarray(devices).reshape(*(sizes[a] for a in AXES))
+    return Mesh(arr, axis_names=AXES)
+
+
+def host_local_batch(global_batch: int) -> int:
+    """Slots this process feeds when the dp axis spans processes."""
+    n = jax.process_count()
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by {n} processes")
+    return global_batch // n

@@ -33,10 +33,17 @@ def main() -> None:
         import jax.numpy as jnp
 
         from ..executor import EmbeddingEngine, GenerationEngine
+        from ..parallel import distributed
+
+        mesh = None
+        if cfg.tpu_mesh_shape:
+            distributed.initialize()
+            mesh = distributed.make_global_mesh(cfg.tpu_mesh_shape)
 
         model = cfg.tpu_model
         gen_engines[model] = GenerationEngine(
             model,
+            mesh=mesh,
             max_slots=cfg.tpu_max_slots,
             max_seq_len=cfg.tpu_max_seq_len,
             dtype=jnp.bfloat16,
