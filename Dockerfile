@@ -11,7 +11,7 @@ WORKDIR /app
 #   pip install "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
 RUN pip install --no-cache-dir \
     "jax[cpu]" flax optax orbax-checkpoint einops \
-    grpcio protobuf httpx pyyaml
+    grpcio protobuf httpx pyyaml regex tokenizers
 
 COPY pyproject.toml ./
 COPY llm_mcp_tpu ./llm_mcp_tpu

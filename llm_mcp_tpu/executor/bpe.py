@@ -270,18 +270,23 @@ class BPETokenizer:
         for raw, i in token_ids.items():
             if raw.startswith(b"<") or raw.startswith(b"["):
                 specials.setdefault(raw.decode("utf-8", "replace"), i)
+        # -1 = unresolved: never matches a real token, so encode skips the
+        # bos prepend and decode never strips a legitimate id-0 vocab token
+        # (engine masking already guards with a 0 <= id < vocab check).
         self.bos_id = self._pick(
-            specials, "<|begin_of_text|>", "<s>", "[CLS]", "<|im_start|>", "<|endoftext|>",
-            default=0,
+            specials, "<|begin_of_text|>", "<s>", "[CLS]", "<|im_start|>", "<bos>",
+            "<|endoftext|>",
         )
         self.eos_id = self._pick(
             specials, "<|end_of_text|>", "<|eot_id|>", "</s>", "[SEP]", "<|im_end|>",
-            "<|endoftext|>", default=0,
+            "<eos>", "<end_of_turn>", "<|endoftext|>",
         )
         self.pad_id = self._pick(
-            specials, "<|finetune_right_pad_id|>", "<pad>", "[PAD]", "<|endoftext|>", default=0
+            specials, "<|finetune_right_pad_id|>", "<pad>", "[PAD]", "<|endoftext|>"
         )
-        self.special_ids.update((self.bos_id, self.eos_id, self.pad_id))
+        self.special_ids.update(
+            i for i in (self.bos_id, self.eos_id, self.pad_id) if i >= 0
+        )
 
         pre = doc.get("pre_tokenizer")
         pattern = _find_split_pattern(pre) or (
@@ -290,7 +295,7 @@ class BPETokenizer:
         self._pretok = regex.compile(pattern)
 
     @staticmethod
-    def _pick(specials: dict[str, int], *names: str, default: int = 0) -> int:
+    def _pick(specials: dict[str, int], *names: str, default: int = -1) -> int:
         for n in names:
             if n in specials:
                 return specials[n]
@@ -299,7 +304,7 @@ class BPETokenizer:
     # -- protocol ----------------------------------------------------------
 
     def encode(self, text: str, add_bos: bool = True) -> list[int]:
-        ids: list[int] = [self.bos_id] if add_bos else []
+        ids: list[int] = [self.bos_id] if add_bos and self.bos_id >= 0 else []
         pieces = [p.encode("utf-8") for p in self._pretok.findall(text)]
         if hasattr(self.core, "encode_pieces"):
             ids.extend(self.core.encode_pieces(pieces))

@@ -106,20 +106,25 @@ class HFTokenizer:
 
         self._tok = _Tok.from_file(path)
         self.vocab_size = self._tok.get_vocab_size()
-        self.pad_id = self._special("<|finetune_right_pad_id|>", "<pad>", "[PAD]") or 0
-        self.bos_id = self._special("<|begin_of_text|>", "<s>", "[CLS]") or 0
-        self.eos_id = self._special("<|end_of_text|>", "<|eot_id|>", "</s>", "[SEP]") or 0
+        # -1 = unresolved (same convention as BPETokenizer): a real vocab
+        # token at id 0 must not be masked/stripped just because the file has
+        # no recognizable pad/bos/eos names
+        self.pad_id = self._special("<|finetune_right_pad_id|>", "<pad>", "[PAD]")
+        self.bos_id = self._special("<|begin_of_text|>", "<s>", "[CLS]", "<bos>")
+        self.eos_id = self._special(
+            "<|end_of_text|>", "<|eot_id|>", "</s>", "[SEP]", "<eos>", "<end_of_turn>"
+        )
 
-    def _special(self, *names: str) -> int | None:
+    def _special(self, *names: str) -> int:
         for n in names:
             i = self._tok.token_to_id(n)
             if i is not None:
                 return i
-        return None
+        return -1
 
     def encode(self, text: str, add_bos: bool = True) -> list[int]:
         ids = self._tok.encode(text, add_special_tokens=False).ids
-        return ([self.bos_id] + ids) if add_bos else ids
+        return ([self.bos_id] + ids) if add_bos and self.bos_id >= 0 else ids
 
     def decode(self, ids: list[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
@@ -175,5 +180,18 @@ def load_tokenizer(weights_dir: str = "") -> Tokenizer:
                     logging.getLogger("executor").warning(
                         "native BPE unavailable for %s (%s); trying HF", path, e
                     )
-            return HFTokenizer(path)
+            if choice == "hf":
+                # explicitly forced backend: fail loudly, never degrade
+                return HFTokenizer(path)
+            try:
+                return HFTokenizer(path)
+            except ImportError as e:
+                import logging
+
+                logging.getLogger("executor").error(
+                    "no tokenizer backend available for %s (%s); degrading to "
+                    "BYTE tokenizer — decoded text will not match the model's "
+                    "vocabulary. Install `regex` or `tokenizers`.", path, e,
+                )
+                return ByteTokenizer()
     return ByteTokenizer()

@@ -118,7 +118,9 @@ class BridgeServer:
                     kind,
                     payload,
                     priority=int(body.get("priority") or 0),
-                    max_attempts=int(body.get("max_attempts") or 3),
+                    # 0 = queue default, matching the HTTP JobsAPI path so the
+                    # retry budget doesn't depend on the transport used
+                    max_attempts=int(body.get("max_attempts") or 0),
                     deadline_at=float(body.get("deadline_at") or 0.0),
                 )
             except (TypeError, ValueError) as e:
@@ -171,7 +173,8 @@ class BridgeServer:
                 resp.sse_event("error", {"error": f"core unreachable: {e}"})
                 return
             if status != 200:
-                resp.sse_event("error", {"error": "job not found", "status": status})
+                msg = "job not found" if status == 404 else f"core error {status}"
+                resp.sse_event("error", {"error": msg, "status": status})
                 return
             if job.get("status") != last:
                 last = job.get("status")

@@ -148,9 +148,15 @@ class Planner:
             log.info("planner run #%d: %s", self.runs, result)
             return result
 
-    def maybe_run(self, now: float | None = None) -> dict[str, Any] | None:
-        """Tick hook: run when the interval elapsed (0 disables). Skips
-        (rather than queues behind) a run already in progress."""
+    def maybe_run(self, now: float | None = None) -> threading.Thread | None:
+        """Tick hook: fire a run when the interval elapsed (0 disables);
+        returns the run's thread, or None when nothing fired. Skips (rather
+        than queues behind) a run already in progress.
+
+        The run itself happens on a dedicated daemon thread: a slow or
+        unreachable cloud endpoint during catalog sync must never stall the
+        shared discovery/limits ticker that calls this.
+        """
         interval = self.cfg.planner_interval_s
         if interval <= 0:
             return None
@@ -160,4 +166,9 @@ class Planner:
             return None
         if self._run_lock.locked():
             return None
-        return self.run_once()
+        # stamp before spawning so the next tick doesn't start a second
+        # thread in the window before run_once acquires the lock
+        self.last_run = now
+        t = threading.Thread(target=self.run_once, name="planner-run", daemon=True)
+        t.start()
+        return t

@@ -113,11 +113,21 @@ class _Registry:
     def maybe_fail(self, site: str, detail: str = "") -> None:
         if not self._loaded:
             self.configure()
-        cfg = self._sites.get(site)
-        if not cfg:
+        # unarmed fast path: no lock — probes on hot paths (engine decode,
+        # HTTP dispatch) must stay a single dict lookup when injection is off
+        # (dict reads are atomic under the GIL; configure swaps whole entries)
+        if site not in self._sites:
             return
+        # read the site config and its RNG under ONE lock acquisition: a
+        # concurrent configure() may swap both, and a half-read (cfg from the
+        # old map, missing rng in the new one) must disarm, not KeyError in
+        # the probed hot path
         with self._lock:
-            trip = self._rngs[site].random() < cfg["prob"]
+            cfg = self._sites.get(site)
+            rng = self._rngs.get(site)
+            if not cfg or rng is None:
+                return
+            trip = rng.random() < cfg["prob"]
             if trip:
                 self.trips[site] = self.trips.get(site, 0) + 1
         if not trip:
