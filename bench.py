@@ -1,57 +1,52 @@
-"""Round benchmark: steady-state decode throughput of the generation engine
-on the available accelerator (one real TPU chip under the driver; CPU when
+"""Round benchmark: steady-state decode throughput of the serving stack on
+the available accelerator (one real TPU chip under the driver; CPU when
 forced).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Baseline of record (BASELINE.md): 2000 tok/s/chip, Llama-3.1-8B streaming
-chat on v5e-8. A single v5e chip cannot hold 8B bf16 weights (16 GB), so the
-single-chip bench runs the same engine on Llama-3.2-1B and reports
-vs_baseline against the 2000 tok/s/chip bar; multi-chip sharded 8B is
-exercised by `__graft_entry__.dryrun_multichip` until multi-chip hardware is
-attached.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Baseline of record (BASELINE.md row 3): 2000 tok/s/chip, Llama-3.1-8B
+streaming chat on v5e. The headline metric IS the 8B config: weight-only
+int8 (~8.0 GB) + int8 KV cache fits a single 16 GB v5e chip at B=112
+slots, so the fight happens on the baseline's own model, not a stand-in.
+Secondary metrics (same JSON object, "secondary" key) cover the 1B config.
+
+Env knobs for sweeps (defaults are the driver configuration):
+  BENCH_MODEL / BENCH_B / BENCH_S / BENCH_K  — raw-loop shape override
+  BENCH_SECONDARY=0                          — headline only
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 
-def main() -> None:
+def raw_decode_tps(
+    model: str, B: int, S: int, K: int, rounds: int, kv_int8: bool = False
+) -> float:
+    """Steady-state tok/s of the jitted decode loop (chunked scan with
+    fused sampling — the same decode program GenerationEngine dispatches
+    per chunk, minus the engine's host-side admission/emission work, which
+    the serving-path metric measures separately)."""
+    from functools import partial
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from llm_mcp_tpu.models import (
-        get_config,
-        init_llama_params,
-        init_kv_cache,
-        llama_decode_step,
-    )
-    from llm_mcp_tpu.models.quant import quantize_params
+    from llm_mcp_tpu.kernels.attention import resolve_decode_impl
+    from llm_mcp_tpu.models import get_config, init_kv_cache, llama_decode_step
+    from llm_mcp_tpu.models.quant import init_llama_params_quantized
     from llm_mcp_tpu.ops.sampling import sample_tokens
 
-    platform = jax.devices()[0].platform
-    model = "llama-3.2-1b" if platform != "cpu" else "tiny-llm"
     cfg = get_config(model)
+    platform = jax.devices()[0].platform
     dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
-
-    # Measured single-chip sweet spot (sweep over B∈{32..256} × {bf16,int8}
-    # × attn impls): B=64, int8 weights, XLA-einsum decode attention with the
-    # cache carried in place through the layer scan. B=128+ hits an XLA
-    # full-cache-copy cliff; B=32 under-amortizes weight streaming. int8
-    # (models/quant.py) matches the reference's q8 Ollama operating point.
-    B, S, K = 64, 1024, 64
-    params = init_llama_params(cfg, jax.random.PRNGKey(0), dtype=dtype)
-    params = quantize_params(params)
-    model = f"{model}-int8"
-    cache = init_kv_cache(cfg, B, S, dtype=dtype)
-
-    from functools import partial
-
-    from llm_mcp_tpu.kernels.attention import resolve_decode_impl
-
-    impl = resolve_decode_impl()
+    # direct int8 init: 8B bf16 (16 GB) cannot be materialized-then-quantized
+    # on one v5e chip, so the quantized tree is built in place
+    params = init_llama_params_quantized(cfg, jax.random.PRNGKey(0), scale_dtype=dtype)
+    cache = init_kv_cache(cfg, B, S, dtype=dtype, quantized=kv_int8)
+    impl = resolve_decode_impl(quantized=kv_int8)
 
     @partial(jax.jit, donate_argnums=(1, 2))
     def decode_chunk(params, ck, cv, tokens, lengths, rng):
@@ -89,25 +84,67 @@ def main() -> None:
     out, ck, cv, toks, lens = decode_chunk(params, ck, cv, toks, lens, rng)
     np.asarray(out)
 
-    rounds = 6 if platform != "cpu" else 2
     t0 = time.perf_counter()
     for _ in range(rounds):
         out, ck, cv, toks, lens = decode_chunk(params, ck, cv, toks, lens, rng)
     np.asarray(out)
     dt = time.perf_counter() - t0
+    return rounds * K * B / dt
 
-    total_tokens = rounds * K * B
-    tps = total_tokens / dt
-    print(
-        json.dumps(
-            {
-                "metric": f"decode_tok_per_s_{model}_b{B}_{platform}",
-                "value": round(tps, 1),
-                "unit": "tok/s/chip",
-                "vs_baseline": round(tps / 2000.0, 3),
-            }
+
+def main() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform != "cpu"
+
+    if os.environ.get("BENCH_MODEL"):
+        model = os.environ["BENCH_MODEL"]
+        B = int(os.environ.get("BENCH_B", "32"))
+        S = int(os.environ.get("BENCH_S", "1024"))
+        K = int(os.environ.get("BENCH_K", "64"))
+        kv8 = os.environ.get("BENCH_KV", "") == "int8"
+        tps = raw_decode_tps(model, B, S, K, rounds=4 if on_tpu else 2, kv_int8=kv8)
+        kv = "_kv8" if kv8 else ""
+        print(
+            json.dumps(
+                {
+                    "metric": f"decode_tok_per_s_{model}-int8{kv}_b{B}_{platform}",
+                    "value": round(tps, 1),
+                    "unit": "tok/s/chip",
+                    "vs_baseline": round(tps / 2000.0, 3),
+                }
+            )
         )
-    )
+        return
+
+    secondary: dict[str, float] = {}
+    if on_tpu:
+        # Headline: the baseline's own model on one v5e chip. Measured sweep
+        # (r2): int8 weights (~8.0 GB) + int8 KV (B=112 x S=1024 ≈ 7.5 GB)
+        # is the HBM-optimal point; the int8 cache runs through the pallas
+        # decode_attend_q8 kernel (s8 MXU dots, no dequant materialization).
+        model, B, S, K = "llama-3.1-8b", 112, 1024, 64
+        tps = raw_decode_tps(model, B, S, K, rounds=4, kv_int8=True)
+        kv = "_kv8"
+        if os.environ.get("BENCH_SECONDARY", "1") != "0":
+            secondary[f"decode_tok_per_s_llama-3.2-1b-int8_b64_{platform}"] = round(
+                raw_decode_tps("llama-3.2-1b", 64, 1024, 64, rounds=4), 1
+            )
+    else:
+        model, B, S, K = "tiny-llm", 8, 256, 32
+        tps = raw_decode_tps(model, B, S, K, rounds=2)
+        kv = ""
+
+    line = {
+        "metric": f"decode_tok_per_s_{model}-int8{kv}_b{B}_{platform}",
+        "value": round(tps, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tps / 2000.0, 3),
+    }
+    if secondary:
+        line["secondary"] = secondary
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

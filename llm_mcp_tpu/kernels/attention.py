@@ -96,20 +96,28 @@ def resolve_attn_impl(mesh=None) -> str:
     return "pallas" if _on_tpu() else "xla"
 
 
-def resolve_decode_impl(mesh=None) -> str:
+def resolve_decode_impl(mesh=None, quantized: bool = False) -> str:
     """Attention impl for the DECODE step (prefill keeps resolve_attn_impl).
 
-    Default is the XLA einsum path even on TPU: with the cache carried
-    through the layer scan, XLA fuses the layer dynamic-slice into the
-    attention einsums and scatters the new token in place — measured
-    6.2 ms/step (B=32) vs 10.4 ms for the sliced Pallas kernel (the
-    pallas_call operand forces a materialized [B, Hkv, S, hd] copy per
-    layer) and 89 ms for the full-cache-operand kernel (XLA copies the
-    whole carried buffer around the custom call). env LLM_MCP_TPU_ATTN
-    still forces pallas for kernel tests."""
+    For the bf16 cache the default is the XLA einsum path even on TPU: with
+    the cache carried through the layer scan, XLA fuses the layer
+    dynamic-slice into the attention einsums and scatters the new token in
+    place — measured 6.2 ms/step (B=32) vs 10.4 ms for the sliced Pallas
+    kernel (the pallas_call operand forces a materialized [B, Hkv, S, hd]
+    copy per layer) and 89 ms for the full-cache-operand kernel (XLA copies
+    the whole carried buffer around the custom call).
+
+    For the INT8 cache the default on TPU is the `decode_attend_q8` Pallas
+    kernel: XLA's int8 einsum path materializes a bf16 copy of the
+    dequantized cache (measured 236 GB/s effective at 8B B=64 — slower than
+    the bf16 cache), while the kernel streams the int8 payload into s8 MXU
+    dots with no bulk converts. env LLM_MCP_TPU_ATTN still forces either
+    path for tests."""
     mode = os.environ.get("LLM_MCP_TPU_ATTN", "auto")
     if mode in ("pallas", "xla"):
         return mode
+    if quantized:
+        return "pallas" if _on_tpu() else "xla"
     return "xla"
 
 
@@ -355,6 +363,169 @@ def decode_attention_cache(
         q,
         cache_k,
         cache_v,
+    )
+
+
+def _attend_q8_kernel(
+    li_ref,  # [1] int32 (scalar prefetch) — layer index
+    lengths_ref,  # [B] int32 (scalar prefetch) — this step's position per slot
+    q_ref,  # [1, Hkv, G, hd]
+    nk_ref,  # [1, Hkv, 1, hd] — this step's K vectors (post-rope)
+    nv_ref,  # [1, Hkv, 1, hd]
+    k_ref,  # [1, 1, Hkv, S, hd] int8 — this layer's K payload, all heads
+    ks_ref,  # [1, 1, Hkv, S] — K scales
+    v_ref,  # [1, 1, Hkv, S, hd] int8
+    vs_ref,  # [1, 1, Hkv, S]
+    o_ref,  # [1, Hkv, G, hd] — attention output
+    *,
+    scale: float,
+):
+    """One grid cell = one batch row, all KV heads.
+
+    Perf-critical invariant: the int8 K/V payloads feed the MXU *as int8*
+    (s8 x s8 -> s32 dots). Converting them elementwise would bottleneck on
+    the VPU — int8->f32 converts run at ~1 elem/lane/cycle, about the same
+    rate HBM delivers bytes, doubling step time. Only the tiny per-row
+    tensors (q, scores, probs) are computed in f32.
+    """
+    b = pl.program_id(0)
+    w = lengths_ref[b]  # this step's position; attend to 0..w inclusive
+    Hkv, S = k_ref.shape[2], k_ref.shape[3]
+    G = q_ref.shape[2]
+
+    nk = nk_ref[0, :, 0].astype(jnp.float32)  # [Hkv, hd]
+    nv = nv_ref[0, :, 0].astype(jnp.float32)
+    q = q_ref[0].astype(jnp.float32)  # [Hkv, G, hd]
+    kss = ks_ref[0, 0].astype(jnp.float32)  # [Hkv, S]
+    vss = vs_ref[0, 0].astype(jnp.float32)
+
+    # quantize q per (h, g) row; fold the attention scale into the q scales
+    qa = jnp.max(jnp.abs(q), axis=-1)  # [Hkv, G]
+    qsc = jnp.maximum(qa / 127.0, 1e-30)
+    q8 = jnp.round(q / qsc[..., None]).astype(jnp.int8)
+
+    s_i = jax.lax.dot_general(
+        q8,
+        k_ref[0, 0],
+        (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.int32,
+    )  # [Hkv, G, S]
+    s = s_i.astype(jnp.float32) * (scale * qsc)[..., None] * kss[:, None, :]
+
+    pos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, S), 2)
+    # the tile holds the PRE-append cache — position w's score/value come
+    # from the unquantized new vectors instead (exact; the quantized row
+    # scatters into the cache outside the kernel)
+    s_new = jnp.sum(q * nk[:, None, :], axis=-1, keepdims=True) * scale  # [Hkv, G, 1]
+    s = jnp.where(pos == w, s_new, s)
+    s = jnp.where(pos <= w, s, NEG_INF)
+
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    p_w = jnp.sum(jnp.where(pos == w, p, 0.0), axis=-1, keepdims=True)  # [Hkv, G, 1]
+    # fold v's dequant scales into the probs, then quantize the prob rows so
+    # the PV dot also runs s8 x s8 on the MXU
+    pv = jnp.where(pos == w, 0.0, p * vss[:, None, :])  # [Hkv, G, S]
+    pa = jnp.max(pv, axis=-1)  # [Hkv, G]
+    psc = jnp.maximum(pa / 127.0, 1e-30)
+    p8 = jnp.round(pv / psc[..., None]).astype(jnp.int8)
+    ctx_i = jax.lax.dot_general(
+        p8,
+        v_ref[0, 0],
+        (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.int32,
+    )  # [Hkv, G, hd]
+    ctx = ctx_i.astype(jnp.float32) * psc[..., None] + p_w * nv[:, None, :]
+    o_ref[0] = (ctx / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "scale"))
+def decode_attend_q8(
+    q: jnp.ndarray,  # [B, Hkv, G, hd]
+    new_k: jnp.ndarray,  # [B, Hkv, hd] — post-rope K for this step
+    new_v: jnp.ndarray,  # [B, Hkv, hd]
+    cache_k: dict,  # {"q": int8 [L,B,Hkv,S,hd], "s": [L,B,Hkv,S]} PRE-append
+    cache_v: dict,
+    layer: jnp.ndarray,  # scalar int32
+    lengths: jnp.ndarray,  # [B] int32 — this step's position per slot
+    *,
+    scale: float = 0.0,  # query scale (0 = head_dim**-0.5)
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Attention over the int8 KV cache for one layer of the decode step.
+
+    The int8 payload streams from HBM straight into s8 x s8 -> s32 MXU dots
+    (XLA's einsum path materializes a dequantized bf16 copy and runs ~2x
+    slower than the bf16 cache); per-token dequant scales fold in post-dot.
+    The caller owns the cache append (single-row write-back blocks would
+    violate TPU (8, 128) block alignment): whether the row at `lengths[b]`
+    has been scattered yet or not, the kernel overrides that position's
+    score/value with the exact `new_k`/`new_v` vectors, so the appended
+    token is always attended at full precision.
+
+    Returns ctx [B, Hkv, G, hd].
+    """
+    B, Hkv, G, hd = q.shape
+    S = cache_k["q"].shape[3]
+    interp = _interpret() if interpret is None else interpret
+    sc = scale or hd**-0.5
+
+    if not _HAS_PLTPU:  # pragma: no cover — CPU builds without pallas-tpu
+        # Fallback mirroring the kernel's math in f32 (no q/prob requant).
+        kf = jax.lax.dynamic_index_in_dim(cache_k["q"], layer, 0, keepdims=False)
+        vf = jax.lax.dynamic_index_in_dim(cache_v["q"], layer, 0, keepdims=False)
+        kss = jax.lax.dynamic_index_in_dim(cache_k["s"], layer, 0, keepdims=False)
+        vss = jax.lax.dynamic_index_in_dim(cache_v["s"], layer, 0, keepdims=False)
+        qf = q.astype(jnp.float32) * sc
+        s = jnp.einsum("bhgd,bhsd->bhgs", qf, kf.astype(jnp.float32)) * kss.astype(
+            jnp.float32
+        )[:, :, None, :]
+        pos = jnp.arange(S)[None, None, None, :]
+        w = lengths[:, None, None, None]
+        s_new = jnp.einsum("bhgd,bhd->bhg", qf, new_k.astype(jnp.float32))
+        s = jnp.where(pos == w, s_new[..., None], s)
+        s = jnp.where(pos <= w, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        p_w = jnp.sum(jnp.where(pos == w, p, 0.0), axis=-1)  # [B, Hkv, G]
+        pv = jnp.where(pos == w, 0.0, p * vss.astype(jnp.float32)[:, :, None, :])
+        ctx = jnp.einsum("bhgs,bhsd->bhgd", pv, vf.astype(jnp.float32))
+        ctx = ctx + p_w[..., None] * new_v.astype(jnp.float32)[:, :, None, :]
+        return ctx.astype(q.dtype)
+
+    kernel = functools.partial(_attend_q8_kernel, scale=sc)
+    nk4 = new_k.reshape(B, Hkv, 1, hd)
+    nv4 = new_v.reshape(B, Hkv, 1, hd)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # layer [1], lengths [B]
+        grid=(B,),  # one cell per batch row: all heads, coarse enough that
+        #   per-cell overhead amortizes and the K/V DMA streams 2 MB blocks
+        in_specs=[
+            pl.BlockSpec((1, Hkv, G, hd), lambda b, li, lens: (b, 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, lens: (b, 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, lens: (b, 0, 0, 0)),
+            pl.BlockSpec((1, 1, Hkv, S, hd), lambda b, li, lens: (li[0], b, 0, 0, 0)),
+            pl.BlockSpec((1, 1, Hkv, S), lambda b, li, lens: (li[0], b, 0, 0)),
+            pl.BlockSpec((1, 1, Hkv, S, hd), lambda b, li, lens: (li[0], b, 0, 0, 0)),
+            pl.BlockSpec((1, 1, Hkv, S), lambda b, li, lens: (li[0], b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Hkv, G, hd), lambda b, li, lens: (b, 0, 0, 0)),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype),
+        interpret=interp,
+    )(
+        jnp.reshape(layer, (1,)).astype(jnp.int32),
+        lengths.astype(jnp.int32),
+        q,
+        nk4,
+        nv4,
+        cache_k["q"],
+        cache_k["s"],
+        cache_v["q"],
+        cache_v["s"],
     )
 
 

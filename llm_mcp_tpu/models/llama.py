@@ -32,7 +32,11 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from ..kernels.attention import decode_attention_cache, flash_prefill_attention
+from ..kernels.attention import (
+    decode_attend_q8,
+    decode_attention_cache,
+    flash_prefill_attention,
+)
 from ..ops.norms import rms_norm as _rms_norm
 from ..ops.rope import rope_frequencies, apply_rope
 from .configs import ModelConfig
@@ -100,11 +104,51 @@ def init_llama_params(
 
 
 def init_kv_cache(
-    cfg: ModelConfig, batch: int, max_seq: int, dtype: jnp.dtype = jnp.bfloat16
-) -> dict[str, jnp.ndarray]:
+    cfg: ModelConfig,
+    batch: int,
+    max_seq: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+    quantized: bool = False,
+) -> dict[str, Any]:
+    """KV cache buffers. `quantized=True` stores int8 payloads with
+    per-(token, head) scales — decode is cache-bandwidth-bound once weights
+    are int8, so halving KV bytes buys ~25-40% step time at 8B/B≥32 and
+    doubles the (batch × context) that fits beside the weights.
+
+    Quantized entries are {"q": int8 [L,B,Hkv,S,hd], "s": dtype [L,B,Hkv,S]};
+    plain entries are a bare [L,B,Hkv,S,hd] array. Both forms flow through
+    `llama_decode_step` (jit treats them as pytrees)."""
     hd = cfg.resolved_head_dim
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_seq, hd)
+    if quantized:
+        return {
+            "k": {
+                "q": jnp.zeros(shape, dtype=jnp.int8),
+                "s": jnp.zeros(shape[:-1], dtype=dtype),
+            },
+            "v": {
+                "q": jnp.zeros(shape, dtype=jnp.int8),
+                "s": jnp.zeros(shape[:-1], dtype=dtype),
+            },
+        }
     return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
+
+
+def quantize_kv(kv: jnp.ndarray, scale_dtype=None) -> dict[str, jnp.ndarray]:
+    """Quantize a bf16 K or V block to the int8 cache form over its last
+    (head_dim) axis: per-(…, token, head) symmetric scales, like the cache's
+    write path. Used when inserting prefill KV into a quantized cache."""
+    f = kv.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=-1)
+    s = amax / 127.0
+    q = jnp.where(
+        s[..., None] > 0, jnp.round(f / jnp.maximum(s, 1e-30)[..., None]), 0.0
+    ).astype(jnp.int8)
+    return {"q": q, "s": s.astype(scale_dtype or kv.dtype)}
+
+
+def _cache_shape(cache) -> tuple[int, ...]:
+    return cache["q"].shape if isinstance(cache, dict) else cache.shape
 
 
 def _norm(cfg: ModelConfig, x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
@@ -282,18 +326,26 @@ def llama_decode_step(
     ≤ lengths[b], returns (logits [B, V] f32, new_cache_k, new_cache_v).
     Inactive slots simply produce garbage logits that the engine ignores —
     keeping the step shape-static (no data-dependent control flow under jit).
+
+    The caches may be int8-quantized ({"q", "s"} pytrees — see
+    `init_kv_cache`): scales then fold into the attention einsums post-dot
+    (QK scores scale by k's per-token scale; v's folds into the probs), so
+    the HBM read is int8 payload + 1/head_dim of scales.
     """
-    L, B, Hkv, S, hd = cache_k.shape
+    quantized = isinstance(cache_k, dict)
+    L, B, Hkv, S, hd = _cache_shape(cache_k)
     H = cfg.n_heads
     G = H // Hkv
 
-    # Sliding windows / score softcaps / non-default query scaling aren't
-    # implemented in the pallas decode kernels; those families take the
-    # (default, and faster — see kernels/attention.py:resolve_decode_impl)
-    # fused XLA path.
-    if attn_impl == "pallas" and (
-        cfg.sliding_window or cfg.attn_softcap or cfg.query_pre_attn_scalar
-    ):
+    # Sliding windows / score softcaps aren't implemented in the pallas
+    # decode kernels; those families take the XLA path. For the int8 cache,
+    # "pallas" routes to the s8-MXU kernel (kernels/attention.py:
+    # decode_attend_q8) — the fast path on TPU. The bf16-cache kernel
+    # hardcodes head_dim**-0.5, so query_pre_attn_scalar families also
+    # reroute unless the q8 kernel (which takes cfg.attn_scale) serves them.
+    if attn_impl == "pallas" and (cfg.sliding_window or cfg.attn_softcap):
+        attn_impl = "xla"
+    if attn_impl == "pallas" and cfg.query_pre_attn_scalar and not quantized:
         attn_impl = "xla"
 
     h = _embed_in(cfg, params, tokens)  # [B, D]
@@ -327,14 +379,58 @@ def llama_decode_step(
         q = apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]  # [B, H, hd]
         k = apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
 
-        ck_all = ck_all.at[li, b_idx, h_idx, w_idx].set(k.astype(ck_all.dtype))
-        cv_all = cv_all.at[li, b_idx, h_idx, w_idx].set(v.astype(cv_all.dtype))
-
         qg = q.reshape(B, Hkv, G, hd)
-        if attn_impl == "pallas":
+        # Append this step's K/V row to the carry, quantizing when the cache
+        # is int8. The scatter happens BEFORE any kernel read: a scatter
+        # after a pallas read is a write-after-read hazard on the carried
+        # buffer that XLA resolves with a full-cache defensive copy (~10 ms
+        # at 8B B=64).
+        if quantized:
+            kq = quantize_kv(k, scale_dtype=ck_all["s"].dtype)
+            vq = quantize_kv(v, scale_dtype=cv_all["s"].dtype)
+            ck_all = {
+                "q": ck_all["q"].at[li, b_idx, h_idx, w_idx].set(kq["q"]),
+                "s": ck_all["s"].at[li, b_idx, h_idx, w_idx].set(kq["s"]),
+            }
+            cv_all = {
+                "q": cv_all["q"].at[li, b_idx, h_idx, w_idx].set(vq["q"]),
+                "s": cv_all["s"].at[li, b_idx, h_idx, w_idx].set(vq["s"]),
+            }
+        else:
+            ck_all = ck_all.at[li, b_idx, h_idx, w_idx].set(k.astype(ck_all.dtype))
+            cv_all = cv_all.at[li, b_idx, h_idx, w_idx].set(v.astype(cv_all.dtype))
+
+        if quantized and attn_impl == "pallas":
+            # s8-MXU kernel; position w's score/value come from the exact
+            # unquantized vectors (the kernel overrides that column).
+            ctx = decode_attend_q8(
+                qg, k, v, ck_all, cv_all, li, lengths, scale=cfg.attn_scale
+            ).reshape(B, H * hd)
+        elif attn_impl == "pallas":
             # Kernel indexes the L axis itself (scalar prefetch): no
             # dynamic-slice copy of the layer's cache.
             ctx = decode_attention_cache(qg, ck_all, cv_all, li, lengths).reshape(
+                B, H * hd
+            )
+        elif quantized:
+            ck = jax.lax.dynamic_index_in_dim(ck_all["q"], li, 0, keepdims=False)
+            cv = jax.lax.dynamic_index_in_dim(cv_all["q"], li, 0, keepdims=False)
+            ks = jax.lax.dynamic_index_in_dim(ck_all["s"], li, 0, keepdims=False)
+            vs = jax.lax.dynamic_index_in_dim(cv_all["s"], li, 0, keepdims=False)
+            # int8 K dot in compute dtype; per-key-token dequant scales the
+            # SCORES (cheap [B,Hkv,G,S] multiply), not the K payload
+            scores = jnp.einsum("bhgd,bhsd->bhgs", qg, ck.astype(h.dtype)).astype(
+                jnp.float32
+            ) * ks.astype(jnp.float32)[:, :, None, :]
+            scores = _softcap(scores * cfg.attn_scale, cfg.attn_softcap)
+            m = attn_mask
+            if cfg.sliding_window:
+                m = m & ((win == 0) | (key_pos > (lengths[:, None] - win)))
+            scores = jnp.where(m[:, None, None, :], scores, neg)
+            probs = jax.nn.softmax(scores, axis=-1)
+            # v's dequant folds into the probs before the PV dot
+            probs = (probs * vs.astype(jnp.float32)[:, :, None, :]).astype(h.dtype)
+            ctx = jnp.einsum("bhgs,bhsd->bhgd", probs, cv.astype(h.dtype)).reshape(
                 B, H * hd
             )
         else:
