@@ -52,6 +52,8 @@ def main() -> None:
             dtype=jnp.bfloat16,
             weights_dir=cfg.tpu_weights_dir,
             quant=cfg.tpu_quant,
+            kv_quant=cfg.tpu_kv_quant,
+            prefill_chunk=cfg.tpu_prefill_chunk,
         ).start()
         emodel = cfg.tpu_embed_model
         log.info("loading embedding engine: %s", emodel)

@@ -36,6 +36,7 @@ import queue
 import threading
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Iterator
@@ -52,7 +53,9 @@ from ..models.llama import (
     init_llama_params,
     init_kv_cache,
     llama_prefill,
+    llama_prefill_chunk,
     llama_decode_step,
+    quantize_kv,
 )
 from ..ops.sampling import sample_tokens
 from ..parallel.sharding import llama_param_specs, kv_cache_specs, shard_pytree
@@ -94,6 +97,16 @@ class _Slot:
     first_token_at: float = 0.0
 
 
+@dataclass
+class _PrefillState:
+    """A slot whose prompt is mid-way through chunked prefill. The slot is
+    reserved (not decodable, not free) until the last chunk lands."""
+
+    req: GenRequest
+    ids: list[int]
+    done: int = 0  # tokens already written into the cache
+
+
 class GenerationEngine:
     def __init__(
         self,
@@ -109,6 +122,8 @@ class GenerationEngine:
         decode_chunk: int = 4,
         weights_dir: str = "",
         quant: str = "",
+        kv_quant: str = "",
+        prefill_chunk: int = 256,
     ):
         self.cfg = get_config(model) if isinstance(model, str) else model
         self.mesh = mesh
@@ -125,7 +140,6 @@ class GenerationEngine:
         self.attn_impl = (
             resolve_attn_impl(mesh) if pallas_supported(max_seq_len, hd) else "xla"
         )
-        self.decode_impl = resolve_decode_impl(mesh)
 
         # weight-only int8 (TPU_QUANT=int8 via Config.tpu_quant): decode is
         # weight-bandwidth bound, so halving weight bytes ≈ halves step time
@@ -135,17 +149,41 @@ class GenerationEngine:
             log.warning("unknown quant mode %r (supported: int8); serving unquantized",
                         self.quant)
             self.quant = ""
+        # int8 KV cache (TPU_KV_QUANT=int8): once weights are int8, decode
+        # becomes cache-bandwidth bound — halving KV bytes buys another
+        # ~25-40% step time at 8B and doubles the (slots × context) that
+        # fits beside the weights. Reads route through the s8-MXU pallas
+        # kernel (kernels/attention.py:decode_attend_q8).
+        self.kv_quant = kv_quant
+        if self.kv_quant and self.kv_quant != "int8":
+            log.warning("unknown kv_quant mode %r (supported: int8); using %s cache",
+                        self.kv_quant, jnp.dtype(dtype).name)
+            self.kv_quant = ""
+        self.decode_impl = resolve_decode_impl(mesh, quantized=self.kv_quant == "int8")
+        # chunked prefill: bound the per-iteration prefill work so admissions
+        # interleave with decode rounds (0 disables; sp prefill is whole-prompt
+        # by design — the sp axis itself bounds per-chip work)
+        self.prefill_chunk = max(0, prefill_chunk)
 
         if params is None and _has_safetensors(weights_dir):
             # Real checkpoint: stream safetensors shards straight into
             # (sharded) HBM — already placed.
             params = load_llama_checkpoint(self.cfg, weights_dir, dtype=dtype, mesh=mesh)
         elif params is None:
-            params = init_llama_params(self.cfg, jax.random.PRNGKey(seed), dtype=dtype)
+            if self.quant == "int8":
+                # Direct int8 init: an 8B bf16 tree (16 GB) cannot be
+                # materialized-then-quantized inside one v5e chip's HBM.
+                from ..models.quant import init_llama_params_quantized
+
+                params = init_llama_params_quantized(
+                    self.cfg, jax.random.PRNGKey(seed), scale_dtype=dtype
+                )
+            else:
+                params = init_llama_params(self.cfg, jax.random.PRNGKey(seed), dtype=dtype)
         if self.quant == "int8":
             from ..models.quant import quantize_params
 
-            params = quantize_params(params)
+            params = quantize_params(params)  # no-op on already-int8 trees
         if mesh is not None:
             specs = llama_param_specs(self.cfg)
             if self.quant == "int8":
@@ -155,19 +193,32 @@ class GenerationEngine:
             params = shard_pytree(params, specs, mesh)
         self.params = params
 
-        cache = init_kv_cache(self.cfg, max_slots, max_seq_len, dtype=dtype)
+        cache = init_kv_cache(
+            self.cfg, max_slots, max_seq_len, dtype=dtype,
+            quantized=self.kv_quant == "int8",
+        )
         if mesh is not None:
-            cache = shard_pytree(cache, kv_cache_specs(), mesh)
+            cache = shard_pytree(
+                cache, kv_cache_specs(quantized=self.kv_quant == "int8"), mesh
+            )
         self._ck = cache["k"]
         self._cv = cache["v"]
 
-        # Host-side mirrors of per-slot device state.
-        self._lengths = np.zeros(max_slots, dtype=np.int32)
+        # Host-side mirrors of per-slot device state. Invariant: only ACTIVE
+        # (decoding) slots hold an in-range length; free/reserved slots park
+        # at max_seq_len so the decode step's unconditional per-row K/V
+        # scatter (models/llama.py w_idx) is out-of-bounds for them — JAX
+        # drops OOB scatter writes, so parked rows are never touched. Without
+        # this, decode rounds would write garbage rows inside a slot that is
+        # mid-chunked-prefill (stale length 0) and corrupt its prompt KV.
+        self._lengths = np.full(max_slots, max_seq_len, dtype=np.int32)
         self._last_tok = np.zeros(max_slots, dtype=np.int32)
         self._temp = np.zeros(max_slots, dtype=np.float32)
         self._topk = np.zeros(max_slots, dtype=np.int32)
         self._topp = np.ones(max_slots, dtype=np.float32)
         self._slots: list[_Slot | None] = [None] * max_slots
+        self._prefills: dict[int, _PrefillState] = {}
+        self._prefill_q: deque[int] = deque()
 
         self._rng_counter = 0
         self._base_key = jax.random.PRNGKey(seed + 1)
@@ -245,16 +296,39 @@ class GenerationEngine:
             def prefill_fn(params, tokens, lengths):
                 return llama_prefill(cfg_, params, tokens, lengths, attn_impl=impl)
 
+        kv_q = self.kv_quant == "int8"
+
         @partial(jax.jit, donate_argnums=(0, 1))
         def insert_fn(ck, cv, ks, vs, slot):
             # ks/vs: [L, 1, Hkv, bucket, hd] → write at [:, slot, :, :bucket];
             # `slot` is a traced scalar, so one executable serves all slots.
+            # Into an int8 cache the rows quantize on write (per-token scales
+            # over head_dim — the same form the decode step appends).
+            if kv_q:
+                kq = quantize_kv(ks, scale_dtype=ck["s"].dtype)
+                vq = quantize_kv(vs, scale_dtype=cv["s"].dtype)
+                ck = {
+                    "q": jax.lax.dynamic_update_slice(ck["q"], kq["q"], (0, slot, 0, 0, 0)),
+                    "s": jax.lax.dynamic_update_slice(ck["s"], kq["s"], (0, slot, 0, 0)),
+                }
+                cv = {
+                    "q": jax.lax.dynamic_update_slice(cv["q"], vq["q"], (0, slot, 0, 0, 0)),
+                    "s": jax.lax.dynamic_update_slice(cv["s"], vq["s"], (0, slot, 0, 0)),
+                }
+                return ck, cv
             ck = jax.lax.dynamic_update_slice(ck, ks.astype(ck.dtype), (0, slot, 0, 0, 0))
             cv = jax.lax.dynamic_update_slice(cv, vs.astype(cv.dtype), (0, slot, 0, 0, 0))
             return ck, cv
 
+        @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("skey",))
+        def prefill_chunk_fn(params, ck, cv, tokens, slot, start, nvalid, skey):
+            return llama_prefill_chunk(
+                cfg_, params, ck, cv, tokens, slot, start, nvalid, skey=skey
+            )
+
         self._prefill_fn = prefill_fn
         self._insert_fn = insert_fn
+        self._prefill_chunk_fn = prefill_chunk_fn
 
         self._admit: "queue.Queue[GenRequest]" = queue.Queue()
         self._stop_evt = threading.Event()
@@ -315,11 +389,7 @@ class GenerationEngine:
             self._thread = None
         # Drain every waiter — callers blocked in req.out.get() must not
         # deadlock when the engine stops mid-request.
-        for i, s in enumerate(self._slots):
-            if s is not None:
-                s.req.out.put({"type": "error", "error": "engine shutdown"})
-                s.req.out.put(_DONE)
-                self._slots[i] = None
+        self._abort_all("engine shutdown")
         while True:
             try:
                 req = self._admit.get_nowait()
@@ -394,7 +464,7 @@ class GenerationEngine:
         return toks / window_s
 
     def slots_in_use(self) -> int:
-        return sum(1 for s in self._slots if s is not None)
+        return sum(1 for s in self._slots if s is not None) + len(self._prefills)
 
     # -- engine loop -------------------------------------------------------
 
@@ -403,48 +473,78 @@ class GenerationEngine:
         # (both are powers of two, so clamping to >= sp suffices)
         return max(pow2_bucket(n, self.max_seq_len), self.sp)
 
-    def _recover_cache(self) -> None:
+    def _recover_cache(self) -> bool:
         """Re-allocate the KV cache if a failed dispatch consumed the donated
         buffers (donate_argnums invalidates inputs even when execution
-        raises); without this every later round would see a deleted Array."""
+        raises); without this every later round would see a deleted Array.
+        Returns True when a re-allocation happened (all slot KV was lost)."""
         try:
-            deleted = self._ck.is_deleted() or self._cv.is_deleted()
+            leaves = jax.tree.leaves({"k": self._ck, "v": self._cv})
+            deleted = any(x.is_deleted() for x in leaves)
         except AttributeError:
             deleted = False
-        if deleted:
-            log.warning("KV cache buffers were donated into a failed dispatch; re-allocating")
-            cache = init_kv_cache(self.cfg, self.max_slots, self.max_seq_len, dtype=self.dtype)
-            if self.mesh is not None:
-                cache = shard_pytree(cache, kv_cache_specs(), self.mesh)
-            self._ck = cache["k"]
-            self._cv = cache["v"]
+        if not deleted:
+            return False
+        log.warning("KV cache buffers were donated into a failed dispatch; re-allocating")
+        cache = init_kv_cache(
+            self.cfg, self.max_slots, self.max_seq_len, dtype=self.dtype,
+            quantized=self.kv_quant == "int8",
+        )
+        if self.mesh is not None:
+            cache = shard_pytree(
+                cache, kv_cache_specs(quantized=self.kv_quant == "int8"), self.mesh
+            )
+        self._ck = cache["k"]
+        self._cv = cache["v"]
+        return True
+
+    def _abort_all(self, error: str) -> None:
+        """Fail every in-flight request — decoding slots AND mid-prefill
+        reservations. Called when the KV cache had to be re-allocated: all
+        per-slot state on device is gone."""
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                s.req.out.put({"type": "error", "error": error})
+                s.req.out.put(_DONE)
+                self._slots[i] = None
+                self._lengths[i] = self.max_seq_len  # park (see __init__)
+        for slot in list(self._prefills):
+            st = self._prefills.pop(slot)
+            st.req.out.put({"type": "error", "error": error})
+            st.req.out.put(_DONE)
+        self._prefill_q.clear()
 
     def _free_slot(self) -> int | None:
         for i, s in enumerate(self._slots):
-            if s is None:
+            if s is None and i not in self._prefills:
                 return i
         return None
 
     def _run(self) -> None:
         while not self._stop_evt.is_set():
             admitted = self._admit_pending()
+            # One bounded prefill chunk per iteration: admission work
+            # interleaves with decode rounds instead of stalling them.
+            prefilled = self._prefill_round()
             active = [i for i, s in enumerate(self._slots) if s is not None]
-            if not active:
-                if not admitted:
-                    self._wake.wait(timeout=0.05)
-                    self._wake.clear()
-                continue
-            try:
-                self._decode_round(active)
-            except Exception as e:  # a poisoned round must not kill the loop
-                log.exception("decode round failed; failing %d active slots", len(active))
-                for b in active:
-                    s = self._slots[b]
-                    if s is not None:
-                        s.req.out.put({"type": "error", "error": str(e)})
-                        s.req.out.put(_DONE)
-                        self._slots[b] = None
-                self._recover_cache()
+            if active:
+                try:
+                    self._decode_round(active)
+                except Exception as e:  # a poisoned round must not kill the loop
+                    log.exception("decode round failed; failing %d active slots", len(active))
+                    for b in active:
+                        s = self._slots[b]
+                        if s is not None:
+                            s.req.out.put({"type": "error", "error": str(e)})
+                            s.req.out.put(_DONE)
+                            self._slots[b] = None
+                            self._lengths[b] = self.max_seq_len  # park
+                    if self._recover_cache():
+                        # mid-prefill KV lives in the same buffers
+                        self._abort_all("kv cache lost in failed decode round")
+            elif not (admitted or prefilled):
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
 
     def _admit_pending(self) -> bool:
         admitted = False
@@ -463,6 +563,8 @@ class GenerationEngine:
                 log.exception("prefill failed")
                 req.out.put({"type": "error", "error": str(e)})
                 req.out.put(_DONE)
+                if self._recover_cache():
+                    self._abort_all("kv cache lost in failed prefill")
         return admitted
 
     def _start_request(self, slot: int, req: GenRequest) -> None:
@@ -485,6 +587,15 @@ class GenerationEngine:
             req.out.put(_DONE)
             return
 
+        if self.sp == 1 and self.prefill_chunk and P > self.prefill_chunk:
+            # Long prompt: reserve the slot and prefill it chunk-by-chunk in
+            # _prefill_round, interleaved with decode rounds (no head-of-line
+            # blocking of in-flight streams). sp>1 keeps whole-prompt prefill:
+            # the sp axis already bounds per-chip work.
+            self._prefills[slot] = _PrefillState(req=req, ids=list(ids))
+            self._prefill_q.append(slot)
+            return
+
         bucket = self._bucket(P)
         tokens = np.zeros((1, bucket), dtype=np.int32)
         tokens[0, :P] = ids
@@ -494,7 +605,11 @@ class GenerationEngine:
         self._ck, self._cv = self._insert_fn(
             self._ck, self._cv, ks, vs, np.int32(slot)
         )
+        self._activate(slot, req, P, logits)
 
+    def _activate(self, slot: int, req: GenRequest, P: int, logits) -> None:
+        """Sample the first token from prefill logits [1, V] and switch the
+        slot from prefilling to decoding."""
         tok0 = self._sample1(
             logits,
             self._next_key(),
@@ -515,6 +630,47 @@ class GenerationEngine:
             self.total_requests += 1
         # tok0's KV will be written at position P in the first decode round.
         self._emit_token(slot, tok0, pos=P - 1)
+
+    def _prefill_round(self) -> bool:
+        """Run ONE bounded prefill chunk for the oldest mid-prefill slot.
+        Returns True when any chunk work happened."""
+        if not self._prefill_q:
+            return False
+        slot = self._prefill_q[0]
+        st = self._prefills[slot]
+        try:
+            maybe_fail("engine.prefill", f"slot={slot}")
+            start = st.done
+            n = min(self.prefill_chunk, len(st.ids) - start)
+            # never let the padded bucket run past the cache row end —
+            # dynamic_update_slice would CLAMP the start index and silently
+            # overwrite earlier prompt KV (prompts are pre-truncated to
+            # max_seq_len - decode_chunk, so S - start > n always holds)
+            bucket = min(pow2_bucket(n, self.prefill_chunk), self.max_seq_len - start)
+            buf = np.zeros((bucket,), dtype=np.int32)
+            buf[:n] = st.ids[start : start + n]
+            # static key-range bound (bucketed for jit-cache reuse): early
+            # chunks of a long prompt don't pay an O(max_seq_len) score tensor
+            skey = min(pow2_bucket(start + bucket, self.max_seq_len), self.max_seq_len)
+            logits, self._ck, self._cv = self._prefill_chunk_fn(
+                self.params, self._ck, self._cv, buf,
+                np.int32(slot), np.int32(start), np.int32(n), skey,
+            )
+            st.done += n
+            if st.done >= len(st.ids):
+                self._prefill_q.popleft()
+                del self._prefills[slot]
+                self._activate(slot, st.req, len(st.ids), logits)
+        except Exception as e:
+            log.exception("chunked prefill failed (slot %d)", slot)
+            if self._prefill_q and self._prefill_q[0] == slot:
+                self._prefill_q.popleft()
+            self._prefills.pop(slot, None)
+            st.req.out.put({"type": "error", "error": str(e)})
+            st.req.out.put(_DONE)
+            if self._recover_cache():
+                self._abort_all("kv cache lost in failed prefill chunk")
+        return True
 
     def _decode_round(self, active: list[int]) -> None:
         # chaos site: a failed round must fail active slots with error
@@ -537,6 +693,11 @@ class GenerationEngine:
         # tokens against their true per-token cache positions.
         base = self._lengths.copy()
         self._lengths += K
+        # re-clamp parked rows to exactly max_seq_len: left drifting += K
+        # forever they would eventually wrap int32 back into [0, S) and break
+        # the OOB-drop parking invariant (see __init__). Active rows never
+        # legitimately exceed max_seq_len (finish condition in _emit_token).
+        np.minimum(self._lengths, self.max_seq_len, out=self._lengths)
         self._last_tok = out[-1].copy()
         n_emitted = 0
         for b in active:
@@ -616,5 +777,6 @@ class GenerationEngine:
             )
             req.out.put(_DONE)
             self._slots[slot_idx] = None
+            self._lengths[slot_idx] = self.max_seq_len  # park (see __init__)
             return False
         return True

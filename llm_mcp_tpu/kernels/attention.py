@@ -113,6 +113,11 @@ def resolve_decode_impl(mesh=None, quantized: bool = False) -> str:
     the bf16 cache), while the kernel streams the int8 payload into s8 MXU
     dots with no bulk converts. env LLM_MCP_TPU_ATTN still forces either
     path for tests."""
+    if mesh is not None and mesh.size > 1:
+        # Same rule as resolve_attn_impl: the unwrapped pallas_call must not
+        # trace over GSPMD-sharded cache operands (the einsum path partitions
+        # cleanly; the q8 kernel would force replication or fail to compile).
+        return "xla"
     mode = os.environ.get("LLM_MCP_TPU_ATTN", "auto")
     if mode in ("pallas", "xla"):
         return mode

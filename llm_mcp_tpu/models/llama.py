@@ -167,6 +167,52 @@ def _softcap(x: jnp.ndarray, cap: float) -> jnp.ndarray:
     return jnp.tanh(x / cap) * cap if cap else x
 
 
+def _qkv(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
+    """Q/K/V projections (+ family bias) on [..., D] activations; outputs stay
+    flat [..., H*hd] / [..., Hkv*hd] — callers reshape for their layout."""
+    q = qdot(x, lp["wq"])
+    k = qdot(x, lp["wk"])
+    v = qdot(x, lp["wv"])
+    if cfg.qkv_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    return q, k, v
+
+
+def _attn_residual(cfg: ModelConfig, lp: Params, ctx: jnp.ndarray, h: jnp.ndarray):
+    """Output projection (+ optional post-attention norm) and residual add."""
+    out = qdot(ctx, lp["wo"])
+    if cfg.post_norms:
+        out = _norm(cfg, out, lp["post_attn_norm"])
+    return h + out
+
+
+def _ffn_residual(
+    cfg: ModelConfig, lp: Params, h: jnp.ndarray, moe_capacity: int = 0
+) -> jnp.ndarray:
+    """The FFN half of a decoder layer (pre-norm, MoE or gated-MLP, optional
+    post-norm, residual add) on [..., D] activations — shared by prefill,
+    chunked prefill, and decode so layer semantics live in one place."""
+    x = _norm(cfg, h, lp["ffn_norm"])
+    if cfg.n_experts:
+        lead = x.shape[:-1]
+        flat = x.reshape(-1, x.shape[-1])
+        out = (
+            moe_ffn(cfg, lp, flat, capacity=moe_capacity)
+            if moe_capacity
+            else moe_ffn(cfg, lp, flat)
+        )
+        out = out.reshape(*lead, -1)
+    else:
+        gate = _act(cfg, qdot(x, lp["w1"]))
+        up = qdot(x, lp["w3"])
+        out = qdot(gate * up, lp["w2"])
+    if cfg.post_norms:
+        out = _norm(cfg, out, lp["post_ffn_norm"])
+    return h + out
+
+
 def layer_windows(cfg: ModelConfig) -> jnp.ndarray:
     """Per-layer attention window sizes, [L] int32 (0 = global attention).
 
@@ -227,13 +273,10 @@ def prefill_layer(
     window = jnp.asarray(window, dtype=jnp.int32)
 
     x = _norm(cfg, h, lp["attn_norm"])
-    q = qdot(x, lp["wq"]).reshape(B, S, H, hd)
-    k = qdot(x, lp["wk"]).reshape(B, S, Hkv, hd)
-    v = qdot(x, lp["wv"]).reshape(B, S, Hkv, hd)
-    if cfg.qkv_bias:
-        q = q + lp["bq"].reshape(H, hd)
-        k = k + lp["bk"].reshape(Hkv, hd)
-        v = v + lp["bv"].reshape(Hkv, hd)
+    q, k, v = _qkv(cfg, lp, x)
+    q = q.reshape(B, S, H, hd)
+    k = k.reshape(B, S, Hkv, hd)
+    v = v.reshape(B, S, Hkv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -265,21 +308,8 @@ def prefill_layer(
         scores = jnp.where(m[:, None, None, :, :], scores, neg)
         probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
         ctx = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(B, S, H * hd)
-    attn_out = qdot(ctx, lp["wo"])
-    if cfg.post_norms:
-        attn_out = _norm(cfg, attn_out, lp["post_attn_norm"])
-    h = h + attn_out
-
-    x = _norm(cfg, h, lp["ffn_norm"])
-    if cfg.n_experts:
-        h = h + moe_ffn(cfg, lp, x.reshape(B * S, -1)).reshape(B, S, -1)
-    else:
-        gate = _act(cfg, qdot(x, lp["w1"]))
-        up = qdot(x, lp["w3"])
-        ffn_out = qdot(gate * up, lp["w2"])
-        if cfg.post_norms:
-            ffn_out = _norm(cfg, ffn_out, lp["post_ffn_norm"])
-        h = h + ffn_out
+    h = _attn_residual(cfg, lp, ctx, h)
+    h = _ffn_residual(cfg, lp, h)
     return h, (kh, vh)
 
 
@@ -309,6 +339,153 @@ def llama_prefill(
         h, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
     )[:, 0]  # [B, D]
     return _logits(cfg, params, last), ks, vs
+
+
+def llama_prefill_chunk(
+    cfg: ModelConfig,
+    params: Params,
+    cache_k: Any,  # [L, B, Hkv, S, hd] engine cache (or int8 {"q","s"} pytree)
+    cache_v: Any,
+    tokens: jnp.ndarray,  # [C] int32 — right-padded chunk of ONE slot's prompt
+    slot: jnp.ndarray,  # scalar int32 — engine slot (cache batch row)
+    start: jnp.ndarray,  # scalar int32 — absolute position of tokens[0]
+    nvalid: jnp.ndarray,  # scalar int32 — valid tokens in this chunk
+    skey: int = 0,  # STATIC key-range bound (0 = whole S); must be >= start+C
+) -> tuple[jnp.ndarray, Any, Any]:
+    """Prefill one bounded chunk of one slot's prompt straight into the
+    engine cache (chunked prefill, re-thought for XLA static shapes: the
+    chunk length is a compile-time bucket, all position offsets are traced
+    scalars, so one executable serves every slot/offset).
+
+    The engine interleaves these calls with decode rounds so a long prompt
+    admission never stalls in-flight streams — a problem the reference never
+    faces because it proxies Ollama (`core/internal/api/handlers.go:2427-2587`)
+    and lets the external runtime schedule.
+
+    Chunk queries attend causally over the slot's cache rows [0, start)
+    (earlier chunks of the same prompt) plus the chunk itself. K/V rows —
+    including padding rows past `nvalid` in a ragged final chunk — are
+    written at [start, start+C); the padding rows are never attended
+    (mask: key_pos <= q_pos, and q rows >= nvalid are never read) and are
+    overwritten in place by subsequent decode steps. With an int8 cache the
+    chunk's K/V quantize on write and the reads dequant post-dot, exactly
+    like `llama_decode_step`'s cache semantics.
+
+    Returns (logits [1, V] f32 at position start+nvalid-1, new_cache_k,
+    new_cache_v).
+
+    `skey` (a STATIC python int, jit-cached per value) bounds the attended
+    key range: scores materialize as [Hkv, G, C, skey] instead of whole-S —
+    the caller passes a bucketed bound >= start+C so early chunks of a long
+    prompt don't pay an O(S) score tensor per layer.
+    """
+    quantized = isinstance(cache_k, dict)
+    L, B, Hkv, S, hd = _cache_shape(cache_k)
+    H = cfg.n_heads
+    G = H // Hkv
+    C = tokens.shape[0]
+    Sk = min(skey, S) if skey else S
+    neg = jnp.float32(-1e30)
+    slot = jnp.asarray(slot, dtype=jnp.int32)
+    start = jnp.asarray(start, dtype=jnp.int32)
+
+    h = _embed_in(cfg, params, tokens[None, :])  # [1, C, D]
+    q_pos = start + jnp.arange(C, dtype=jnp.int32)  # [C]
+    cos, sin = rope_frequencies(hd, cfg.rope_theta, q_pos[None, :])  # [1, C, hd/2]
+    key_pos = jnp.arange(Sk, dtype=jnp.int32)[None, :]  # [1, Sk]
+    base_mask = key_pos <= q_pos[:, None]  # [C, Sk] — causal over past + chunk
+
+    def layer(carry, xs):
+        lp, win = xs
+        h, ck_all, cv_all, li = carry
+        x = _norm(cfg, h, lp["attn_norm"])
+        q, k, v = _qkv(cfg, lp, x)
+        q = apply_rope(q.reshape(1, C, H, hd), cos, sin)
+        k = apply_rope(k.reshape(1, C, Hkv, hd), cos, sin)
+        v = v.reshape(1, C, Hkv, hd)
+        kh = k[0].transpose(1, 0, 2)  # [Hkv, C, hd]
+        vh = v[0].transpose(1, 0, 2)
+
+        # Scatter the chunk's K/V rows BEFORE any cache read — the same
+        # write-after-read-hazard discipline as llama_decode_step (a read
+        # followed by a write on the carried buffer costs XLA a defensive
+        # full-cache copy).
+        if quantized:
+            kq = quantize_kv(kh, scale_dtype=ck_all["s"].dtype)
+            vq = quantize_kv(vh, scale_dtype=cv_all["s"].dtype)
+            ck_all = {
+                "q": jax.lax.dynamic_update_slice(
+                    ck_all["q"], kq["q"][None, None], (li, slot, 0, start, 0)
+                ),
+                "s": jax.lax.dynamic_update_slice(
+                    ck_all["s"], kq["s"][None, None], (li, slot, 0, start)
+                ),
+            }
+            cv_all = {
+                "q": jax.lax.dynamic_update_slice(
+                    cv_all["q"], vq["q"][None, None], (li, slot, 0, start, 0)
+                ),
+                "s": jax.lax.dynamic_update_slice(
+                    cv_all["s"], vq["s"][None, None], (li, slot, 0, start)
+                ),
+            }
+            krow = jax.lax.dynamic_slice(
+                ck_all["q"], (li, slot, 0, 0, 0), (1, 1, Hkv, Sk, hd)
+            )[0, 0]
+            vrow = jax.lax.dynamic_slice(
+                cv_all["q"], (li, slot, 0, 0, 0), (1, 1, Hkv, Sk, hd)
+            )[0, 0]
+            ksr = jax.lax.dynamic_slice(ck_all["s"], (li, slot, 0, 0), (1, 1, Hkv, Sk))[
+                0, 0
+            ]
+            vsr = jax.lax.dynamic_slice(cv_all["s"], (li, slot, 0, 0), (1, 1, Hkv, Sk))[
+                0, 0
+            ]
+        else:
+            ck_all = jax.lax.dynamic_update_slice(
+                ck_all, kh[None, None].astype(ck_all.dtype), (li, slot, 0, start, 0)
+            )
+            cv_all = jax.lax.dynamic_update_slice(
+                cv_all, vh[None, None].astype(cv_all.dtype), (li, slot, 0, start, 0)
+            )
+            krow = jax.lax.dynamic_slice(
+                ck_all, (li, slot, 0, 0, 0), (1, 1, Hkv, Sk, hd)
+            )[0, 0]
+            vrow = jax.lax.dynamic_slice(
+                cv_all, (li, slot, 0, 0, 0), (1, 1, Hkv, Sk, hd)
+            )[0, 0]
+
+        qg = q[0].reshape(C, Hkv, G, hd)  # [C, Hkv, G, hd]
+        scores = jnp.einsum("chgd,hsd->hgcs", qg, krow.astype(h.dtype)).astype(
+            jnp.float32
+        )
+        if quantized:
+            scores = scores * ksr.astype(jnp.float32)[:, None, None, :]
+        scores = _softcap(scores * cfg.attn_scale, cfg.attn_softcap)
+        m = base_mask
+        if cfg.sliding_window:
+            m = m & ((win == 0) | (q_pos[:, None] - key_pos < win))
+        scores = jnp.where(m[None, None], scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if quantized:
+            probs = probs * vsr.astype(jnp.float32)[:, None, None, :]
+        probs = probs.astype(h.dtype)
+        ctx = jnp.einsum("hgcs,hsd->chgd", probs, vrow.astype(h.dtype)).reshape(
+            1, C, H * hd
+        )
+        h = _attn_residual(cfg, lp, ctx, h)
+        h = _ffn_residual(cfg, lp, h)
+        return (h, ck_all, cv_all, li + 1), None
+
+    (h, new_k, new_v, _), _ = jax.lax.scan(
+        layer,
+        (h, cache_k, cache_v, jnp.int32(0)),
+        (params["layers"], layer_windows(cfg)),
+    )
+    last = jnp.take_along_axis(
+        h, (nvalid - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1
+    )[:, 0]  # [1, D]
+    return _logits(cfg, params, last), new_k, new_v
 
 
 def llama_decode_step(
@@ -369,13 +546,10 @@ def llama_decode_step(
         lp, win = xs
         h, ck_all, cv_all, li = carry
         x = _norm(cfg, h, lp["attn_norm"])
-        q = qdot(x, lp["wq"]).reshape(B, H, hd)
-        k = qdot(x, lp["wk"]).reshape(B, Hkv, hd)
-        v = qdot(x, lp["wv"]).reshape(B, Hkv, hd)
-        if cfg.qkv_bias:
-            q = q + lp["bq"].reshape(H, hd)
-            k = k + lp["bk"].reshape(Hkv, hd)
-            v = v + lp["bv"].reshape(Hkv, hd)
+        q, k, v = _qkv(cfg, lp, x)
+        q = q.reshape(B, H, hd)
+        k = k.reshape(B, Hkv, hd)
+        v = v.reshape(B, Hkv, hd)
         q = apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]  # [B, H, hd]
         k = apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
 
@@ -444,21 +618,8 @@ def llama_decode_step(
             scores = jnp.where(m[:, None, None, :], scores, neg)
             probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
             ctx = jnp.einsum("bhgs,bhsd->bhgd", probs, cv).reshape(B, H * hd)
-        attn_out = qdot(ctx, lp["wo"])
-        if cfg.post_norms:
-            attn_out = _norm(cfg, attn_out, lp["post_attn_norm"])
-        h = h + attn_out
-
-        x = _norm(cfg, h, lp["ffn_norm"])
-        if cfg.n_experts:
-            h = h + moe_ffn(cfg, lp, x, capacity=B)  # dropless at decode
-        else:
-            gate = _act(cfg, qdot(x, lp["w1"]))
-            up = qdot(x, lp["w3"])
-            ffn_out = qdot(gate * up, lp["w2"])
-            if cfg.post_norms:
-                ffn_out = _norm(cfg, ffn_out, lp["post_ffn_norm"])
-            h = h + ffn_out
+        h = _attn_residual(cfg, lp, ctx, h)
+        h = _ffn_residual(cfg, lp, h, moe_capacity=B)  # dropless at decode
         return (h, ck_all, cv_all, li + 1), None
 
     (h, new_k, new_v, _), _ = jax.lax.scan(

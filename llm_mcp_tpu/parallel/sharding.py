@@ -88,9 +88,15 @@ def embedder_param_specs(cfg: ModelConfig) -> dict[str, Any]:
     }
 
 
-def kv_cache_specs() -> dict[str, P]:
-    # [L, B, Hkv, S, hd] — batch slots on dp, KV heads on tp.
-    return {"k": P(None, "dp", "tp", None, None), "v": P(None, "dp", "tp", None, None)}
+def kv_cache_specs(quantized: bool = False) -> dict[str, Any]:
+    # [L, B, Hkv, S, hd] — batch slots on dp, KV heads on tp. The int8 cache
+    # ({"q", "s"} pytrees) shards the payload identically; scales [L,B,Hkv,S]
+    # drop the trailing head_dim axis.
+    row = P(None, "dp", "tp", None, None)
+    if quantized:
+        entry = {"q": row, "s": P(None, "dp", "tp", None)}
+        return {"k": entry, "v": entry}
+    return {"k": row, "v": row}
 
 
 def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:

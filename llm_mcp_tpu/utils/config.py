@@ -114,6 +114,9 @@ class Config:
     tpu_max_seq_len: int = field(default_factory=lambda: getenv_int("TPU_MAX_SEQ_LEN", 2048))
     tpu_mesh_shape: str = field(default_factory=lambda: getenv("TPU_MESH_SHAPE", ""))  # e.g. "dp=1,tp=8"
     tpu_quant: str = field(default_factory=lambda: getenv("TPU_QUANT", ""))  # "" | int8
+    tpu_kv_quant: str = field(default_factory=lambda: getenv("TPU_KV_QUANT", ""))  # "" | int8
+    # chunked prefill segment length (tokens); 0 disables interleaved prefill
+    tpu_prefill_chunk: int = field(default_factory=lambda: getenv_int("TPU_PREFILL_CHUNK", 256))
 
     def has_openai(self) -> bool:
         return bool(self.openai_api_key)

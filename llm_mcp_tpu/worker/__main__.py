@@ -49,6 +49,8 @@ def main() -> None:
             dtype=jnp.bfloat16,
             weights_dir=cfg.tpu_weights_dir,
             quant=cfg.tpu_quant,
+            kv_quant=cfg.tpu_kv_quant,
+            prefill_chunk=cfg.tpu_prefill_chunk,
         ).start()
         embed_engines[cfg.tpu_embed_model] = EmbeddingEngine(
             cfg.tpu_embed_model,

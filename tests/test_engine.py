@@ -174,3 +174,92 @@ def test_embedding_batch_exceeds_max_batch():
     a, _ = eng.embed(["anchor", "other1", "other2"])
     b, _ = eng.embed(["anchor"])
     np.testing.assert_allclose(a[0], b[0], rtol=1e-4, atol=1e-5)
+
+
+def test_chunked_prefill_matches_single_shot():
+    """A prompt prefilled chunk-by-chunk must produce the same greedy output
+    as one-shot prefill (VERDICT r1 #4: no head-of-line blocking, no drift)."""
+    kw = dict(max_slots=2, max_seq_len=256, dtype=jnp.float32, decode_chunk=2, seed=3)
+    a = GenerationEngine("tiny-llm", prefill_chunk=8, **kw).start()
+    b = GenerationEngine("tiny-llm", prefill_chunk=0, **kw).start()
+    prompt = "chunked prefill equivalence " * 6  # ~170 byte-tokens, many chunks
+    try:
+        ta = a.generate(prompt, max_tokens=12, temperature=0.0)
+        tb = b.generate(prompt, max_tokens=12, temperature=0.0)
+        assert ta["text"] == tb["text"]
+        assert ta["usage"] == tb["usage"]
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_chunked_prefill_interleaves_with_decode():
+    """While a long prompt is being admitted, an in-flight stream must keep
+    receiving tokens: decode rounds interleave between prefill chunks."""
+    import threading
+
+    eng = GenerationEngine(
+        "tiny-llm", max_slots=2, max_seq_len=512, dtype=jnp.float32,
+        decode_chunk=2, prefill_chunk=8,
+    )
+    trace: list[str] = []
+    orig_p, orig_d = eng._prefill_round, eng._decode_round
+    eng._prefill_round = lambda: (trace.append("p"), orig_p())[1]
+    eng._decode_round = lambda active: (trace.append("d"), orig_d(active))[1]
+    eng.start()
+    try:
+        results = {}
+
+        def gen(name, prompt, n):
+            results[name] = eng.generate(prompt, max_tokens=n, temperature=0.0)
+
+        t1 = threading.Thread(target=gen, args=("short", "hi", 200))
+        t1.start()
+        # wait until the short request is decoding, then admit a long prompt
+        import time as _t
+
+        for _ in range(200):
+            if eng.total_requests >= 1 and "d" in trace:
+                break
+            _t.sleep(0.01)
+        t2 = threading.Thread(target=gen, args=("long", "y" * 300, 4))
+        t2.start()
+        t1.join(timeout=60)
+        t2.join(timeout=60)
+        assert results["long"]["usage"]["prompt_tokens"] >= 295
+        joined = "".join(trace)
+        # at least one decode round ran BETWEEN two prefill chunks
+        if results["short"]["usage"]["completion_tokens"] >= 20:
+            assert "pdp" in joined, joined
+        # decode rounds running concurrently with the chunked prefill must
+        # not corrupt the prefilling slot's prompt KV: the long request's
+        # greedy output must match a quiet single-shot engine's
+        ref = GenerationEngine(
+            "tiny-llm", max_slots=2, max_seq_len=512, dtype=jnp.float32,
+            decode_chunk=2, prefill_chunk=0,
+        ).start()
+        try:
+            expect = ref.generate("y" * 300, max_tokens=4, temperature=0.0)
+            assert results["long"]["text"] == expect["text"]
+        finally:
+            ref.shutdown()
+    finally:
+        eng.shutdown()
+
+
+def test_engine_int8_kv_cache():
+    """int8 KV cache serves coherently through both prefill paths."""
+    eng = GenerationEngine(
+        "tiny-llm", max_slots=2, max_seq_len=256, dtype=jnp.float32,
+        decode_chunk=2, kv_quant="int8", prefill_chunk=8,
+    ).start()
+    try:
+        short = eng.generate("int8 kv", max_tokens=8, temperature=0.0)
+        assert short["usage"]["completion_tokens"] >= 1
+        long = eng.generate("int8 chunked " * 8, max_tokens=8, temperature=0.0)
+        assert long["usage"]["completion_tokens"] >= 1
+        # greedy determinism holds with the quantized cache too
+        again = eng.generate("int8 kv", max_tokens=8, temperature=0.0)
+        assert short["text"] == again["text"]
+    finally:
+        eng.shutdown()

@@ -123,3 +123,59 @@ def test_param_count_llama8b():
     cfg = get_config("llama-3.1-8b")
     n = cfg.param_count()
     assert 7.5e9 < n < 8.5e9
+
+
+def test_prefill_chunk_matches_full(params):
+    """Chunked prefill (llama_prefill_chunk) must reproduce one-shot prefill:
+    same cache contents, same final logits — including a ragged last chunk."""
+    from llm_mcp_tpu.models.llama import llama_prefill_chunk
+
+    key = jax.random.PRNGKey(3)
+    P = 11  # 4 + 4 + ragged 3
+    prompt = jax.random.randint(key, (1, 16), 3, CFG.vocab_size)
+    lengths = jnp.array([P], dtype=jnp.int32)
+    full_logits, ks, vs = llama_prefill(CFG, params, prompt, lengths)
+
+    cache = init_kv_cache(CFG, batch=2, max_seq=16, dtype=jnp.float32)
+    ck, cv = cache["k"], cache["v"]
+    slot = jnp.int32(1)
+    logits = None
+    for start, n in ((0, 4), (4, 4), (8, 3)):
+        chunk = jnp.zeros((4,), dtype=jnp.int32).at[:n].set(prompt[0, start : start + n])
+        logits, ck, cv = llama_prefill_chunk(
+            CFG, params, ck, cv, chunk, slot, jnp.int32(start), jnp.int32(n)
+        )
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), np.asarray(full_logits[0]), rtol=2e-4, atol=2e-4
+    )
+    # cache rows match the one-shot prompt KV (untouched slot 0 stays zero)
+    np.testing.assert_allclose(
+        np.asarray(ck[:, 1, :, :P]), np.asarray(ks[:, 0, :, :P]), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(cv[:, 1, :, :P]), np.asarray(vs[:, 0, :, :P]), rtol=2e-4, atol=2e-4
+    )
+    assert not np.asarray(ck[:, 0]).any()
+
+
+def test_prefill_chunk_int8_cache(params):
+    """Chunked prefill into an int8 cache stays close to the f32 path (the
+    chunk attends its own quantized K/V — bounded error, not divergence)."""
+    from llm_mcp_tpu.models.llama import llama_prefill_chunk
+
+    key = jax.random.PRNGKey(4)
+    P = 8
+    prompt = jax.random.randint(key, (1, 8), 3, CFG.vocab_size)
+    full_logits, _, _ = llama_prefill(CFG, params, prompt, jnp.array([P], dtype=jnp.int32))
+
+    cache = init_kv_cache(CFG, batch=1, max_seq=16, dtype=jnp.float32, quantized=True)
+    ck, cv = cache["k"], cache["v"]
+    logits = None
+    for start in (0, 4):
+        logits, ck, cv = llama_prefill_chunk(
+            CFG, params, ck, cv, prompt[0, start : start + 4],
+            jnp.int32(0), jnp.int32(start), jnp.int32(4),
+        )
+    a, b = np.asarray(logits[0]), np.asarray(full_logits[0])
+    assert np.argmax(a) == np.argmax(b)  # greedy token survives quantization
+    np.testing.assert_allclose(a, b, rtol=0.1, atol=0.35)
