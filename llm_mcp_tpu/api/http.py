@@ -202,8 +202,14 @@ class HTTPApi:
         class _Bound(_Handler):
             _api = api
 
-        self._server = ThreadingHTTPServer((host, port), _Bound)
-        self._server.daemon_threads = True
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            # default backlog (5) drops/resets connections when many SSE
+            # clients reconnect at once (64+ concurrent streams re-issuing
+            # requests hit this in the serving benchmark)
+            request_queue_size = 256
+
+        self._server = _Server((host, port), _Bound)
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="http-api", daemon=True
         )

@@ -39,7 +39,6 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.configs import ModelConfig
-from ..ops.norms import rms_norm as _rms_norm
 from ..ops.rope import rope_frequencies, apply_rope
 
 NEG_INF = float(-1e30)
@@ -71,21 +70,26 @@ def ring_attention_local(
     lengths: jnp.ndarray,  # [B] int32 global valid lengths (replicated)
     *,
     axis_name: str = "sp",
+    window: jnp.ndarray | int = 0,  # sliding window (0 = global); may be traced
+    softcap: float = 0.0,  # Gemma2-style score capping (0 = off)
+    scale: float = 0.0,  # query scale override (0 = head_dim**-0.5)
 ) -> jnp.ndarray:
     """Causal GQA attention with K/V rotating around the `axis_name` ring.
 
     Call inside `shard_map` with the sequence axis sharded over `axis_name`.
     Online softmax makes the P-step accumulation exact (not approximate);
-    tests assert bitwise-tolerance agreement with dense attention.
+    tests assert bitwise-tolerance agreement with dense attention. Sliding
+    windows and score softcaps thread through so the windowed families
+    (Mistral/Gemma2) long-context-prefill like plain Llama.
     """
     B, H, Sl, hd = q.shape
     Hkv = k.shape[1]
     G = H // Hkv
     nshards = jax.lax.psum(1, axis_name)  # static: axis size
     idx = jax.lax.axis_index(axis_name)
-    scale = hd**-0.5
+    window = jnp.asarray(window, dtype=jnp.int32)
 
-    qg = (q.astype(jnp.float32) * scale).reshape(B, Hkv, G, Sl, hd)
+    qg = (q.astype(jnp.float32) * (scale or hd**-0.5)).reshape(B, Hkv, G, Sl, hd)
     q_pos = idx * Sl + jnp.arange(Sl, dtype=jnp.int32)  # [Sl] global positions
 
     acc = jnp.zeros((B, Hkv, G, Sl, hd), jnp.float32)
@@ -102,7 +106,10 @@ def ring_attention_local(
 
         def compute(acc, m, l):
             s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kf)
+            if softcap:
+                s = jnp.tanh(s / softcap) * softcap
             causal = k_pos[None, :] <= q_pos[:, None]  # [Slq, Slk]
+            causal &= (window == 0) | (q_pos[:, None] - k_pos[None, :] < window)
             valid = k_pos[None, :] < lengths[:, None]  # [B, Slk]
             mask = causal[None, None, None] & valid[:, None, None, None, :]
             s = jnp.where(mask, s, NEG_INF)
@@ -143,14 +150,22 @@ def ring_attention_local(
 # ---------------------------------------------------------------------------
 
 
-def _dense_causal_attention(qg, k, v, lengths, pos_offset=0):
+def _dense_causal_attention(
+    qg, k, v, lengths, pos_offset=0, window=0, softcap=0.0, scale=0.0
+):
     """Reference dense causal GQA attention.  qg [B, Hkv, G, S, hd]."""
     B, Hkv, G, S, hd = qg.shape
+    window = jnp.asarray(window, dtype=jnp.int32)
     s = jnp.einsum(
-        "bhgqd,bhkd->bhgqk", qg.astype(jnp.float32) * hd**-0.5, k.astype(jnp.float32)
+        "bhgqd,bhkd->bhgqk",
+        qg.astype(jnp.float32) * (scale or hd**-0.5),
+        k.astype(jnp.float32),
     )
+    if softcap:
+        s = jnp.tanh(s / softcap) * softcap
     pos = pos_offset + jnp.arange(S, dtype=jnp.int32)
     causal = pos[None, :] <= pos[:, None]
+    causal &= (window == 0) | (pos[:, None] - pos[None, :] < window)
     valid = pos[None, :] < lengths[:, None]
     mask = causal[None, None, None] & valid[:, None, None, None, :]
     s = jnp.where(mask, s, NEG_INF)
@@ -168,6 +183,9 @@ def ulysses_attention_local(
     lengths: jnp.ndarray,  # [B] int32
     *,
     axis_name: str = "sp",
+    window: jnp.ndarray | int = 0,
+    softcap: float = 0.0,
+    scale: float = 0.0,
 ) -> jnp.ndarray:
     """All-to-all context parallelism (Ulysses): swap S-sharding for
     head-sharding, attend dense over the full sequence, swap back.
@@ -190,7 +208,8 @@ def ulysses_attention_local(
     vh = a2a(v, split_axis=1, concat_axis=2)
     Hl = qh.shape[1]
     out = _dense_causal_attention(
-        qh.reshape(B, Hl // G, G, qh.shape[2], hd), kh, vh, lengths
+        qh.reshape(B, Hl // G, G, qh.shape[2], hd), kh, vh, lengths,
+        window=window, softcap=softcap, scale=scale,
     )
     out = out.reshape(B, Hl, -1, hd).astype(q.dtype)
     return a2a(out, split_axis=2, concat_axis=1)  # back to [B, H, Sl, hd]
@@ -242,9 +261,26 @@ def llama_prefill_sp(
     directly in the engine cache's sharded layout — no full-sequence gather
     ever materializes. This is what lets one serving process accept prompts
     whose KV exceeds a single chip's HBM.
+
+    Composes with the whole family surface (Qwen biases, Gemma offset norms
+    / softcaps / embed scale / post-norms, Mistral/Gemma2 sliding windows via
+    per-layer window masks threaded into the ring/Ulysses kernels) and with
+    int8-quantized weights (the shared `qdot`/`embed_lookup`/`logits_head`
+    ops dequantize inside the shard_map). MoE stays on the GSPMD prefill
+    path — its expert all-to-all belongs to the `ep` axis, not `sp`.
     """
+    from ..models.llama import (  # local import to avoid cycle
+        _act,
+        _norm,
+        _qkv,
+        _softcap,
+        layer_windows,
+    )
+    from ..models.quant import embed_lookup, is_quantized, logits_head, qdot
     from .sharding import llama_param_specs  # local import to avoid cycle
 
+    if cfg.n_experts:
+        raise ValueError("sp prefill does not cover MoE (experts ride ep)")
     hd = cfg.resolved_head_dim
     H, Hkv = cfg.n_heads, cfg.n_kv_heads
     tp = mesh.shape["tp"]
@@ -257,7 +293,12 @@ def llama_prefill_sp(
         raise ValueError(
             f"ulysses needs sp={sp} | local kv heads {Hkv // tp}; use ring"
         )
-    attn = functools.partial(_ATTN_IMPLS[attn_impl], axis_name="sp")
+    attn = functools.partial(
+        _ATTN_IMPLS[attn_impl],
+        axis_name="sp",
+        softcap=cfg.attn_softcap,
+        scale=cfg.attn_scale,
+    )
 
     def local_fn(params, tokens, lengths):
         Bl, Sl = tokens.shape
@@ -267,41 +308,50 @@ def llama_prefill_sp(
         s0 = sp_idx * Sl  # global position offset of this sequence shard
 
         # Vocab-parallel embedding: each tp shard holds [V/tp, D]; lookups
-        # outside the local range contribute 0 and psum restores the row.
+        # outside the local range contribute 0 and psum restores the row
+        # (embed_lookup dequantizes int8 embedding rows in place).
         embed = params["embed"]
-        Vl = embed.shape[0]
+        Vl = embed["q"].shape[0] if isinstance(embed, dict) else embed.shape[0]
         v0 = tp_idx * Vl
         local_ids = tokens - v0
         in_range = (local_ids >= 0) & (local_ids < Vl)
-        h = embed[jnp.clip(local_ids, 0, Vl - 1)] * in_range[..., None].astype(
-            embed.dtype
-        )
+        rows = embed_lookup(embed, jnp.clip(local_ids, 0, Vl - 1))
+        h = rows * in_range[..., None].astype(rows.dtype)
         h = jax.lax.psum(h, "tp")  # [Bl, Sl, D]
+        if cfg.embed_scale:
+            h = h * jnp.asarray(cfg.dim**0.5, dtype=h.dtype)
 
         positions = (s0 + jnp.arange(Sl, dtype=jnp.int32))[None, :]
         cos, sin = rope_frequencies(hd, cfg.rope_theta, positions)
 
-        def layer(h, lp):
-            x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
-            q = jnp.einsum("bsd,de->bse", x, lp["wq"]).reshape(Bl, Sl, Hl, hd)
-            k = jnp.einsum("bsd,de->bse", x, lp["wk"]).reshape(Bl, Sl, Hkvl, hd)
-            v = jnp.einsum("bsd,de->bse", x, lp["wv"]).reshape(Bl, Sl, Hkvl, hd)
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
+        def layer(h, xs):
+            lp, win = xs
+            x = _norm(cfg, h, lp["attn_norm"])
+            q, k, v = _qkv(cfg, lp, x)  # qdot: dequant + bias, tp-local
+            q = apply_rope(q.reshape(Bl, Sl, Hl, hd), cos, sin)
+            k = apply_rope(k.reshape(Bl, Sl, Hkvl, hd), cos, sin)
+            v = v.reshape(Bl, Sl, Hkvl, hd)
             kh = k.transpose(0, 2, 1, 3)  # [Bl, Hkvl, Sl, hd]
             vh = v.transpose(0, 2, 1, 3)
-            ctx = attn(q.transpose(0, 2, 1, 3), kh, vh, lengths)
+            ctx = attn(q.transpose(0, 2, 1, 3), kh, vh, lengths, window=win)
             ctx = ctx.transpose(0, 2, 1, 3).reshape(Bl, Sl, Hl * hd)
-            # wo input dim sharded on tp — partial products reduce over tp.
-            h = h + jax.lax.psum(jnp.einsum("bse,ed->bsd", ctx, lp["wo"]), "tp")
+            # wo input dim sharded on tp — partial products reduce over tp
+            # BEFORE any post-norm (norming partial sums would be wrong math).
+            out = jax.lax.psum(qdot(ctx, lp["wo"]), "tp")
+            if cfg.post_norms:
+                out = _norm(cfg, out, lp["post_attn_norm"])
+            h = h + out
 
-            x = _rms_norm(h, lp["ffn_norm"], cfg.norm_eps)
-            gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", x, lp["w1"]))
-            up = jnp.einsum("bsd,df->bsf", x, lp["w3"])
-            h = h + jax.lax.psum(jnp.einsum("bsf,fd->bsd", gate * up, lp["w2"]), "tp")
+            x = _norm(cfg, h, lp["ffn_norm"])
+            gate = _act(cfg, qdot(x, lp["w1"]))
+            up = qdot(x, lp["w3"])
+            out = jax.lax.psum(qdot(gate * up, lp["w2"]), "tp")
+            if cfg.post_norms:
+                out = _norm(cfg, out, lp["post_ffn_norm"])
+            h = h + out
             return h, (kh, vh)
 
-        h, (ks, vs) = jax.lax.scan(layer, h, params["layers"])
+        h, (ks, vs) = jax.lax.scan(layer, h, (params["layers"], layer_windows(cfg)))
 
         # The last valid position lives on exactly one sp shard: every shard
         # contributes its row (or zeros) and a psum over sp assembles [Bl, D].
@@ -311,14 +361,19 @@ def llama_prefill_sp(
         h_last = jnp.take_along_axis(h, local_last[:, None, None], axis=1)[:, 0]
         h_last = jax.lax.psum(h_last * mine[:, None].astype(h_last.dtype), "sp")
 
-        h_last = _rms_norm(h_last, params["final_norm"], cfg.norm_eps)
-        head = (
-            params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-        )  # [D, V/tp] — vocab-parallel logits
-        logits = jnp.einsum("bd,dv->bv", h_last, head).astype(jnp.float32)
+        h_last = _norm(cfg, h_last, params["final_norm"])
+        src = params["embed"] if cfg.tie_embeddings else params["lm_head"]
+        # vocab-parallel logits [B, V/tp] (logits_head dequantizes int8 heads)
+        logits = _softcap(
+            logits_head(src, h_last, tied=cfg.tie_embeddings), cfg.logit_softcap
+        )
         return logits, ks, vs
 
     pspecs = llama_param_specs(cfg)
+    if is_quantized(params["layers"]["wq"]):
+        from ..models.quant import quantized_specs
+
+        pspecs = quantized_specs(pspecs)
     out_specs = (
         P("dp", "tp"),  # vocab-parallel logits [B, V]
         P(None, "dp", "tp", "sp", None),  # ks [L, B, Hkv, S, hd]

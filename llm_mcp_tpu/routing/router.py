@@ -160,11 +160,12 @@ class Router:
         rows = self.db.query(
             """
             SELECT d.id, d.name, d.addr, d.tags, d.last_seen,
-                   b.tps AS bench_tps, b.latency_ms AS bench_latency_ms
+                   b.tps AS bench_tps, b.latency_ms AS bench_latency_ms,
+                   b.p95_ms AS bench_p95_ms
             FROM devices d
             JOIN device_models dm ON dm.device_id = d.id AND dm.available = 1
             LEFT JOIN (
-                SELECT device_id, model_id, task_type, tps, latency_ms,
+                SELECT device_id, model_id, task_type, tps, latency_ms, p95_ms,
                        MAX(created_at)
                 FROM benchmarks GROUP BY device_id, model_id, task_type
             ) b ON b.device_id = d.id AND b.model_id = dm.model_id
@@ -182,7 +183,10 @@ class Router:
             dev_id = r["id"]
             if not self.circuit.allow(dev_id):
                 continue
-            if max_latency_ms > 0 and (r["bench_latency_ms"] or 0) > max_latency_ms:
+            # the latency constraint bites on TAIL latency when the probe
+            # measured it (p95, scripts/probe_models.py), else on p50
+            eff_latency = r["bench_p95_ms"] or r["bench_latency_ms"] or 0
+            if max_latency_ms > 0 and eff_latency > max_latency_ms:
                 continue
             if self.limits is not None:
                 ok, why = self.limits.model_allowed(dev_id, model, ctx_k)

@@ -153,6 +153,7 @@ def record_benchmark_from_job(catalog: "Catalog", job: Any) -> None:
         tokens_in=int(r.get("tokens_in") or 0),
         tokens_out=int(r.get("tokens_out") or 0),
         latency_ms=float(r.get("latency_ms") or 0),
+        p95_ms=float(r.get("p95_ms") or 0),
         tps=float(r.get("tps") or 0),
     )
 
@@ -339,12 +340,14 @@ class Catalog:
         tokens_in: int = 0,
         tokens_out: int = 0,
         latency_ms: float = 0.0,
+        p95_ms: float = 0.0,
         tps: float = 0.0,
     ) -> None:
         self.db.execute(
             "INSERT INTO benchmarks(device_id, model_id, task_type, tokens_in,"
-            " tokens_out, latency_ms, tps, created_at) VALUES(?,?,?,?,?,?,?,?)",
-            (device_id, model_id, task_type, tokens_in, tokens_out, latency_ms, tps, time.time()),
+            " tokens_out, latency_ms, p95_ms, tps, created_at) VALUES(?,?,?,?,?,?,?,?,?)",
+            (device_id, model_id, task_type, tokens_in, tokens_out, latency_ms,
+             p95_ms, tps, time.time()),
         )
 
     def latest_benchmark(

@@ -1,18 +1,24 @@
-"""Thread-safe SQLite database wrapper with an in-process notify bus.
+"""Thread-safe SQLite database wrapper with a cross-process notify bus.
 
 Role parity: the reference's pgx v5 pool over PostgreSQL 16 (`core/cmd/core/
 main.go:38-47`) plus the `pg_notify('job_update', id)` trigger
 (`db/migrations/03_notify_trigger.sql:4-18`). Postgres is external
 infrastructure in the reference; here the state layer is embedded (SQLite WAL)
-with identical queue semantics, and the notify trigger becomes an in-process
-listener registry fired by the queue layer on every status transition. SSE
-consumers in other processes fall back to polling, exactly like the
-reference's fallback path (`handlers.go:580-608`).
+with identical queue semantics, and the notify trigger becomes a listener
+registry fired by the queue layer on every status transition — plus, for
+file-backed databases, a loopback-UDP fan-out to every other process sharing
+the file (each registers an ephemeral port in `notify_peers`), so SSE
+streams served by a second core process get push wakeups exactly like the
+reference's LISTEN path (`handlers.go:504-577`). The bus is lossy-by-design
+(UDP, no acks): every waiter keeps its safety re-poll, matching the
+reference's own fallback (`handlers.go:580-608`).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import socket
 import sqlite3
 import threading
 import time
@@ -41,10 +47,27 @@ class Database:
         self._listeners: list[Callable[[str, str], None]] = []
         self._listeners_lock = threading.Lock()
         self._init_schema()
+        # Cross-process fan-out only makes sense for a shared file
+        # (":memory:" is single-process by definition). NOTIFY_BUS=0 opts out.
+        self._bus: _UdpBus | None = None
+        if path != ":memory:" and os.environ.get("NOTIFY_BUS", "1") != "0":
+            try:
+                self._bus = _UdpBus(self)
+            except OSError:
+                self._bus = None
 
     def _init_schema(self) -> None:
         with self._lock:
             self._conn.executescript(SCHEMA)
+            # Additive migrations for DB files created by older schemas
+            # (CREATE TABLE IF NOT EXISTS won't extend an existing table).
+            cols = {
+                r[1] for r in self._conn.execute("PRAGMA table_info(benchmarks)")
+            }
+            if "p95_ms" not in cols:
+                self._conn.execute(
+                    "ALTER TABLE benchmarks ADD COLUMN p95_ms REAL NOT NULL DEFAULT 0"
+                )
             self._conn.execute(
                 "INSERT INTO meta(key, value) VALUES('schema_version', ?) "
                 "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
@@ -79,6 +102,9 @@ class Database:
         return _Txn(self)
 
     def close(self) -> None:
+        if self._bus is not None:
+            self._bus.close()
+            self._bus = None
         with self._lock:
             self._conn.close()
 
@@ -97,6 +123,12 @@ class Database:
                 pass
 
     def notify(self, channel: str, payload: str) -> None:
+        """Fire local listeners, then fan out to peer processes on the bus."""
+        self._dispatch_local(channel, payload)
+        if self._bus is not None:
+            self._bus.publish(channel, payload)
+
+    def _dispatch_local(self, channel: str, payload: str) -> None:
         with self._listeners_lock:
             listeners = list(self._listeners)
         for fn in listeners:
@@ -123,6 +155,116 @@ class Database:
             return json.loads(s)
         except (ValueError, TypeError):
             return default
+
+
+class _UdpBus:
+    """Loopback-UDP notify fan-out between processes sharing a DB file.
+
+    Role parity with `pg_notify`/LISTEN (`db/migrations/03_notify_trigger.sql`
+    `:4-18`, `handlers.go:504-577`): the reference leans on Postgres to wake
+    SSE waiters in any process; the embedded SQLite layer carries its own
+    bus. Each process binds an ephemeral 127.0.0.1 UDP port, registers it in
+    `notify_peers`, and `publish()` sends every event to the other live
+    ports. Received events fire the local listener registry only (never
+    re-published — no loops). Liveness is heartbeat-based: the recv loop
+    refreshes this process's row on its socket-timeout cadence and publish
+    skips rows stale by 90 s, so a SIGKILLed peer just ages out.
+    """
+
+    HEARTBEAT_S = 15.0
+    STALE_S = 90.0
+    PEER_CACHE_S = 2.0
+
+    def __init__(self, db: "Database"):
+        self._db = db
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.settimeout(self.HEARTBEAT_S)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        self._peers: list[int] = []
+        self._peers_at = 0.0
+        self._last_heartbeat = time.time()
+        db.execute(
+            "INSERT OR REPLACE INTO notify_peers(port, pid, updated_at) VALUES(?,?,?)",
+            (self.port, os.getpid(), time.time()),
+        )
+        self._thread = threading.Thread(
+            target=self._recv_loop, name="notify-bus", daemon=True
+        )
+        self._thread.start()
+
+    def _recv_loop(self) -> None:
+        while not self._stop:
+            try:
+                data, _ = self._sock.recvfrom(65536)
+            except socket.timeout:
+                data = None
+            except OSError:
+                return
+            # heartbeat on a TIME cadence, not only on idle timeouts: a
+            # process receiving steady notify traffic never times out, and
+            # its row must not age past STALE_S while it is demonstrably alive
+            self._heartbeat()
+            if data is None:
+                continue
+            try:
+                msg = json.loads(data.decode("utf-8"))
+                self._db._dispatch_local(str(msg["channel"]), str(msg["payload"]))
+            except Exception:
+                pass  # malformed datagram — bus is best-effort
+
+    def _heartbeat(self) -> None:
+        now = time.time()
+        if now - self._last_heartbeat < self.HEARTBEAT_S:
+            return
+        self._last_heartbeat = now
+        try:
+            self._db.execute(
+                "UPDATE notify_peers SET updated_at=? WHERE port=?",
+                (now, self.port),
+            )
+            # prune long-dead rows here (bus thread, 15 s cadence) — not in
+            # publish(), which sits on the notify hot path and must stay
+            # read-only against the claim/complete write lock
+            self._db.execute(
+                "DELETE FROM notify_peers WHERE updated_at < ?",
+                (now - 4 * self.STALE_S,),
+            )
+        except Exception:
+            pass
+
+    def publish(self, channel: str, payload: str) -> None:
+        now = time.time()
+        if now - self._peers_at > self.PEER_CACHE_S:
+            try:
+                rows = self._db.query(
+                    "SELECT port FROM notify_peers WHERE port != ? AND updated_at > ?",
+                    (self.port, now - self.STALE_S),
+                )
+                self._peers = [int(r["port"]) for r in rows]
+                self._peers_at = now
+            except Exception:
+                self._peers = []
+        if not self._peers:
+            return
+        data = json.dumps({"channel": channel, "payload": payload}).encode("utf-8")
+        for port in self._peers:
+            try:
+                self._sock.sendto(data, ("127.0.0.1", port))
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._db.execute("DELETE FROM notify_peers WHERE port=?", (self.port,))
+        except Exception:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
 
 class _Txn:

@@ -112,20 +112,28 @@ class JobQueue:
     def __init__(self, db: Database, default_max_attempts: int = 3):
         self.db = db
         self.default_max_attempts = default_max_attempts
-        # Condition used by in-process waiters (claim long-poll, SSE bridge).
-        # _version is a monotonically increasing update counter: waiters pass
-        # the version they last observed so an update landing between their
-        # re-poll and their wait is never lost (no 15 s stall).
+        # Condition used by waiters (claim long-poll, SSE bridge). _version
+        # is a monotonically increasing update counter: waiters pass the
+        # version they last observed so an update landing between their
+        # re-poll and their wait is never lost (no 15 s stall). The bump
+        # rides the db listener registry, so job updates made by ANOTHER
+        # process (arriving over the cross-process notify bus, state/db.py)
+        # wake this process's waiters exactly like local ones.
         self._cond = threading.Condition()
         self._version = 0
+        self.db.add_listener(self._on_db_notify)
 
     # -- notify ------------------------------------------------------------
 
-    def _notify(self, job_id: str) -> None:
-        self.db.notify(JOB_UPDATE_CHANNEL, job_id)
+    def _on_db_notify(self, channel: str, payload: str) -> None:
+        if channel != JOB_UPDATE_CHANNEL:
+            return
         with self._cond:
             self._version += 1
             self._cond.notify_all()
+
+    def _notify(self, job_id: str) -> None:
+        self.db.notify(JOB_UPDATE_CHANNEL, job_id)
 
     @property
     def update_version(self) -> int:

@@ -89,7 +89,8 @@ CREATE TABLE IF NOT EXISTS benchmarks (
     task_type   TEXT NOT NULL DEFAULT 'generate',  -- generate | embed | chat
     tokens_in   INTEGER NOT NULL DEFAULT 0,
     tokens_out  INTEGER NOT NULL DEFAULT 0,
-    latency_ms  REAL NOT NULL DEFAULT 0,
+    latency_ms  REAL NOT NULL DEFAULT 0,  -- p50 when the probe ran rounds
+    p95_ms      REAL NOT NULL DEFAULT 0,  -- tail latency (0 = not measured)
     tps         REAL NOT NULL DEFAULT 0,
     created_at  REAL NOT NULL
 );
@@ -193,6 +194,17 @@ CREATE TABLE IF NOT EXISTS workers (
     kinds          TEXT NOT NULL DEFAULT '[]',  -- JSON list; empty = all kinds
     last_heartbeat REAL,
     started_at     REAL NOT NULL
+);
+
+-- Cross-process notify-bus peer registry: each process sharing this DB file
+-- binds a loopback UDP port and registers it here; Database.notify() fans
+-- events out to live peers. The reference gets this for free from Postgres
+-- (pg_notify trigger, db/migrations/03_notify_trigger.sql:4-18 + LISTEN in
+-- handlers.go:504-577); the embedded state layer carries its own bus.
+CREATE TABLE IF NOT EXISTS notify_peers (
+    port       INTEGER PRIMARY KEY,
+    pid        INTEGER NOT NULL,
+    updated_at REAL NOT NULL
 );
 
 -- Views: v_cost_stats (02_v2_improvements.sql:41), v_device_stats

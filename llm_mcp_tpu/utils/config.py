@@ -116,7 +116,7 @@ class Config:
     tpu_quant: str = field(default_factory=lambda: getenv("TPU_QUANT", ""))  # "" | int8
     tpu_kv_quant: str = field(default_factory=lambda: getenv("TPU_KV_QUANT", ""))  # "" | int8
     # chunked prefill segment length (tokens); 0 disables interleaved prefill
-    tpu_prefill_chunk: int = field(default_factory=lambda: getenv_int("TPU_PREFILL_CHUNK", 256))
+    tpu_prefill_chunk: int = field(default_factory=lambda: getenv_int("TPU_PREFILL_CHUNK", 512))
 
     def has_openai(self) -> bool:
         return bool(self.openai_api_key)

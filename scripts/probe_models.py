@@ -129,6 +129,7 @@ def record(db_path: str, device_id: str, task_type: str, results: list[dict[str,
                 task_type,
                 tokens_out=r["tokens_out"],
                 latency_ms=r["p50_ms"],
+                p95_ms=r["p95_ms"],
                 tps=r["avg_tps"],
             )
             n += 1

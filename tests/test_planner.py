@@ -180,3 +180,50 @@ def test_planner_http_surface():
         assert st["runs"] >= 1
     finally:
         srv.shutdown()
+
+
+def test_planner_benchmark_closes_routing_loop(parts):
+    """VERDICT r1 #10: the planner's scheduled benchmark.generate on the
+    flagship serving model lands measured tps in `benchmarks` and steers
+    `select_device` — the loop the reference runs with Ollama eval_duration
+    (`worker/llm_worker/main.py:471-518`)."""
+    import jax.numpy as jnp
+
+    from llm_mcp_tpu.executor import GenerationEngine
+    from llm_mcp_tpu.routing.router import Router
+    from llm_mcp_tpu.state.catalog import record_benchmark_from_job
+    from llm_mcp_tpu.worker.executors import Executors
+
+    cfg, q, cat, db = parts
+    cfg.planner_bench_max_age_s = 3600.0
+    cat.upsert_device("tpu-local", addr="127.0.0.1:8080", online=True)
+    cat.upsert_model("llama-3.1-8b", params_b=8.0, kind="llm")
+    cat.sync_device_models("tpu-local", ["llama-3.1-8b"])
+
+    p = Planner(cfg, q, cat, gen_models=["llama-3.1-8b"], device_id="tpu-local")
+    assert p.refresh_benchmarks() == 1
+
+    job = q.claim(worker_id="w1", kinds=["benchmark.generate"])
+    assert job is not None and job.payload["model"] == "llama-3.1-8b"
+    # the flagship NAME serves from the tiny architecture in tests — the
+    # executor dispatches by model name
+    eng = GenerationEngine(
+        "tiny-llm", max_slots=2, max_seq_len=128, dtype=jnp.float32
+    ).start()
+    try:
+        result = Executors(gen_engines={"llama-3.1-8b": eng}).dispatch(
+            job.kind, job.payload
+        )
+    finally:
+        eng.shutdown()
+    assert q.complete(job.id, worker_id="w1", result=result)
+    record_benchmark_from_job(cat, q.get(job.id))
+
+    row = cat.latest_benchmark("tpu-local", "llama-3.1-8b", "generate")
+    assert row is not None and row["tps"] > 0
+
+    dev = Router(db).select_device("llama-3.1-8b", "generate")
+    assert dev is not None and dev["id"] == "tpu-local"
+    assert dev["bench_tps"] == row["tps"]
+    # second refresh within max_age: fresh benchmark suppresses resubmission
+    assert p.refresh_benchmarks() == 0

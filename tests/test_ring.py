@@ -114,3 +114,78 @@ def test_llama_prefill_sp_rejects_bad_mesh():
     tokens = jnp.zeros((1, 60), dtype=jnp.int32)  # 60 % 8 != 0
     with pytest.raises(ValueError):
         llama_prefill_sp(cfg, params, tokens, jnp.array([60]), mesh)
+
+
+@pytest.mark.parametrize("family", ["tiny-qwen", "tiny-gemma", "tiny-mistral"])
+def test_llama_prefill_sp_family_parity(family):
+    """sp prefill composes with the non-plain families (VERDICT r1 #6):
+    biases, offset norms, softcaps, post-norms, and sliding windows must all
+    thread through the ring path and match the dense reference."""
+    cfg = get_config(family)
+    params = init_llama_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    mesh = make_mesh("tp=2,sp=2", devices=jax.devices()[:4])
+
+    # S=128 > the tiny families' sliding_window (64), so window masking is
+    # genuinely exercised across sp shard boundaries
+    B, S = 2, 128
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0, cfg.vocab_size)
+    lengths = jnp.array([128, 93], dtype=jnp.int32)
+
+    logits_sp, ks_sp, vs_sp = llama_prefill_sp(cfg, params, tokens, lengths, mesh)
+    logits, ks, vs = llama_prefill(cfg, params, tokens, lengths)
+    np.testing.assert_allclose(
+        np.asarray(logits_sp), np.asarray(logits), atol=3e-4, rtol=3e-4
+    )
+    for b, n in enumerate([128, 93]):
+        np.testing.assert_allclose(
+            np.asarray(ks_sp)[:, b, :, :n], np.asarray(ks)[:, b, :, :n],
+            atol=1e-4, rtol=1e-4,
+        )
+
+
+def test_llama_prefill_sp_int8_parity():
+    """sp prefill composes with int8-quantized weights (VERDICT r1 #6): the
+    shared qdot/embed_lookup/logits_head ops dequantize inside the shard_map
+    and must match the single-device quantized prefill."""
+    from llm_mcp_tpu.models.quant import quantize_params
+
+    cfg = get_config("tiny-llm")
+    params = quantize_params(
+        init_llama_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    )
+    mesh = make_mesh("tp=2,sp=2", devices=jax.devices()[:4])
+
+    B, S = 2, 64
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0, cfg.vocab_size)
+    lengths = jnp.array([64, 29], dtype=jnp.int32)
+
+    logits_sp, ks_sp, _ = llama_prefill_sp(cfg, params, tokens, lengths, mesh)
+    logits, ks, _ = llama_prefill(cfg, params, tokens, lengths)
+    # KV (the transformer body) agrees tightly; logits go through the w8a8
+    # head where a 1-ulp difference in the psum-assembled last hidden state
+    # can flip an int8 activation level, shifting logits by one quant step
+    # (~0.08 here). Assert the greedy choice and a quant-step-sized bound.
+    a, b = np.asarray(logits_sp), np.asarray(logits)
+    assert (np.argmax(a, axis=-1) == np.argmax(b, axis=-1)).all()
+    np.testing.assert_allclose(a, b, atol=0.2, rtol=0.05)
+    for bi, n in enumerate([64, 29]):
+        # layer 0 sees identical inputs → tight agreement; deeper layers
+        # re-quantize activations (w8a8) downstream of the attention diff,
+        # so they agree to a quant step, not to float tolerance
+        np.testing.assert_allclose(
+            np.asarray(ks_sp)[0, bi, :, :n], np.asarray(ks)[0, bi, :, :n],
+            atol=1e-4, rtol=1e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ks_sp)[:, bi, :, :n], np.asarray(ks)[:, bi, :, :n],
+            atol=0.25, rtol=0.25,
+        )
+
+
+def test_llama_prefill_sp_rejects_moe():
+    cfg = get_config("tiny-moe")
+    params = init_llama_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    mesh = make_mesh("tp=1,sp=2", devices=jax.devices()[:2])
+    tokens = jnp.zeros((1, 64), dtype=jnp.int32)
+    with pytest.raises(ValueError, match="MoE"):
+        llama_prefill_sp(cfg, params, tokens, jnp.array([60]), mesh)

@@ -254,3 +254,50 @@ def test_smart_routing_degrades_to_any_local_when_no_cloud(db, catalog):
     assert d.provider == "tpu"
     assert d.model == "tiny-llm"
     assert "degraded" in d.reason
+
+
+def test_select_device_latency_constraint_uses_p95(routed, catalog):
+    """When the probe measured tail latency, max_latency_ms bites on p95,
+    not the (rosier) p50 (scripts/probe_models.py parity with
+    probe_openrouter_models.py:113-124)."""
+    # fresh benchmark for tpu-fast: great p50, terrible p95
+    catalog.record_benchmark(
+        "tpu-fast", "llama-3.1-8b", "generate", tps=2500, latency_ms=40, p95_ms=900
+    )
+    dev = routed.select_device("llama-3.1-8b", "generate", max_latency_ms=100)
+    assert dev["id"] == "tpu-slow"  # fast device's tail blew the budget
+    # without a measured p95 the p50 column still governs
+    dev = routed.select_device("llama-3.1-8b", "generate", max_latency_ms=85)
+    assert dev["id"] == "tpu-slow"
+
+
+def test_benchmark_p95_migration(tmp_path):
+    """Old DB files (pre-p95 benchmarks table) gain the column on open."""
+    import sqlite3
+
+    from llm_mcp_tpu.state.db import Database
+
+    path = str(tmp_path / "old.db")
+    conn = sqlite3.connect(path)
+    conn.execute(
+        "CREATE TABLE benchmarks ("
+        " id INTEGER PRIMARY KEY AUTOINCREMENT,"
+        " device_id TEXT NOT NULL, model_id TEXT NOT NULL,"
+        " task_type TEXT NOT NULL DEFAULT 'generate',"
+        " tokens_in INTEGER NOT NULL DEFAULT 0,"
+        " tokens_out INTEGER NOT NULL DEFAULT 0,"
+        " latency_ms REAL NOT NULL DEFAULT 0,"
+        " tps REAL NOT NULL DEFAULT 0, created_at REAL NOT NULL)"
+    )
+    conn.execute(
+        "INSERT INTO benchmarks(device_id, model_id, latency_ms, created_at)"
+        " VALUES('d', 'm', 42, 1)"
+    )
+    conn.commit()
+    conn.close()
+    db = Database(path)
+    try:
+        rows = db.query("SELECT latency_ms, p95_ms FROM benchmarks")
+        assert rows == [{"latency_ms": 42.0, "p95_ms": 0.0}]
+    finally:
+        db.close()
