@@ -220,6 +220,10 @@ def serve_path_metrics(
     time.sleep(measure_s)
     tok1 = eng.total_tokens
     m1 = time.perf_counter()
+    # settle BEFORE stopping: requests POSTed near the window end whose first
+    # delta is still pending are exactly the tail the p95 must capture —
+    # cutting here would right-censor the percentiles low
+    time.sleep(8.0)
     stop.set()
     with lock:
         ttfts = [
@@ -279,6 +283,19 @@ def main() -> None:
         # decode loop (same program minus the serving stack) is reported as
         # secondary so the engine's host-side overhead stays visible.
         model, B, S = "llama-3.1-8b", int(os.environ.get("BENCH_SLOTS", "80")), 1024
+
+        def run_raw() -> float:
+            """The 8B raw-decode sweep — defined once so the secondary and
+            the fallback headline can never drift apart."""
+            try:
+                tps = round(raw_decode_tps(model, 112, S, 64, rounds=4, kv_int8=True), 1)
+                secondary[f"raw_decode_tok_per_s_{model}-int8_kv8_b112_{platform}"] = tps
+                return tps
+            except Exception as e:  # a failure must not eat the bench line
+                print(f"# raw-decode sweep failed: {e!r}", flush=True)
+                secondary["raw_decode_error"] = 0.0
+                return 0.0
+
         # raw loop FIRST: it frees cleanly on return, while the serve run's
         # HTTP threads can pin engine buffers past shutdown — running the 8B
         # raw sweep after the serve engine reliably OOMs a 16 GB chip
@@ -286,14 +303,7 @@ def main() -> None:
         raw_attempted = False
         if os.environ.get("BENCH_SECONDARY", "1") != "0":
             raw_attempted = True
-            try:
-                raw_tps = round(
-                    raw_decode_tps(model, 112, S, 64, rounds=4, kv_int8=True), 1
-                )
-                secondary[f"raw_decode_tok_per_s_{model}-int8_kv8_b112_{platform}"] = raw_tps
-            except Exception as e:  # a secondary failure must not eat the line
-                print(f"# raw-decode secondary failed: {e!r}", flush=True)
-                secondary["raw_decode_error"] = 0.0
+            raw_tps = run_raw()
             import gc
 
             gc.collect()
@@ -316,13 +326,7 @@ def main() -> None:
             # serve disabled/failed and the raw sweep was never attempted:
             # it becomes the headline. (If it was attempted and FAILED, do
             # not re-run the identical sweep — fail loudly below instead.)
-            try:
-                raw_tps = round(
-                    raw_decode_tps(model, 112, S, 64, rounds=4, kv_int8=True), 1
-                )
-                secondary[f"raw_decode_tok_per_s_{model}-int8_kv8_b112_{platform}"] = raw_tps
-            except Exception as e:
-                print(f"# raw-decode fallback failed: {e!r}", flush=True)
+            raw_tps = run_raw()
         if not serve and not raw_tps:
             raise SystemExit("bench: both serve-path and raw sweeps failed")
         if serve:

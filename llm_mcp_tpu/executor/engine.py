@@ -782,8 +782,12 @@ class GenerationEngine:
                 st.done += n
                 if st.done >= len(st.ids):
                     self._prefill_q.remove(slot)
-                    del self._prefills[slot]
+                    # _prefills entry is dropped only AFTER activation
+                    # succeeds: if _activate raises, the except path below
+                    # still finds the state and delivers error+_DONE to the
+                    # waiter (it would hang forever otherwise)
                     self._activate(slot, st.req, len(st.ids), logits[i : i + 1])
+                    del self._prefills[slot]
         except Exception as e:
             log.exception("chunked prefill failed (slots %s)", group)
             for slot in group:
@@ -793,6 +797,11 @@ class GenerationEngine:
                         self._prefill_q.remove(slot)
                     except ValueError:
                         pass
+                    # free the slot if activation partially completed
+                    s = self._slots[slot]
+                    if s is not None and s.req is st.req:
+                        self._slots[slot] = None
+                        self._lengths[slot] = self.max_seq_len  # park
                     st.req.out.put({"type": "error", "error": str(e)})
                     st.req.out.put(_DONE)
             if self._recover_cache():

@@ -116,6 +116,21 @@ class GrpcCoreServer:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self, addr: str = "127.0.0.1:0") -> "GrpcCoreServer":
+        # Server reflection when grpcio-reflection is installed (grpcurl
+        # discovery). The reference DOCUMENTS reflection but never registers
+        # it (main.go:92-93, SURVEY C9) — here it's best-effort real.
+        try:
+            from grpc_reflection.v1alpha import reflection
+
+            reflection.enable_server_reflection(
+                (
+                    pb.DESCRIPTOR.services_by_name["Core"].full_name,
+                    reflection.SERVICE_NAME,
+                ),
+                self._server,
+            )
+        except Exception:
+            log.debug("grpc reflection unavailable; continuing without it")
         self.port = self._server.add_insecure_port(addr)
         if self.port == 0:
             # grpc signals bind failure by returning port 0 instead of raising

@@ -121,10 +121,11 @@ def test_decode_impl_seq_cap():
         resolve_decode_impl,
     )
 
-    cap = decode_pallas_max_seq(128, 8, 32, quantized=True)
-    assert 1024 <= cap < 16_384  # 8B geometry: a few K positions
-    # within budget the resolver keeps its normal choice; beyond it, xla —
-    # even when the env var tries to force pallas
+    cap = decode_pallas_max_seq(128, 8, 32, quantized=False)
+    assert 1024 <= cap < 32_768  # 8B geometry: a few K positions
+    # bf16 cache: within budget the resolver honors the forced choice;
+    # beyond it, xla wins even over the env override. The int8 cache has no
+    # cap: decode_attend_q8 streams long rows blockwise from HBM.
     import os
 
     old = os.environ.get("LLM_MCP_TPU_ATTN")
@@ -132,15 +133,21 @@ def test_decode_impl_seq_cap():
     try:
         assert (
             resolve_decode_impl(
-                quantized=True, seq_len=cap, head_dim=128, n_kv_heads=8, n_heads=32
+                quantized=False, seq_len=cap, head_dim=128, n_kv_heads=8, n_heads=32
             )
             == "pallas"
         )
         assert (
             resolve_decode_impl(
-                quantized=True, seq_len=cap * 2, head_dim=128, n_kv_heads=8, n_heads=32
+                quantized=False, seq_len=cap * 2, head_dim=128, n_kv_heads=8, n_heads=32
             )
             == "xla"
+        )
+        assert (
+            resolve_decode_impl(
+                quantized=True, seq_len=cap * 8, head_dim=128, n_kv_heads=8, n_heads=32
+            )
+            == "pallas"
         )
     finally:
         if old is None:

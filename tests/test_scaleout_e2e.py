@@ -107,9 +107,33 @@ def test_core_plus_two_workers_scale_out(tmp_path):
                 )
             )
 
-        # submit N jobs; stream the first over SSE while workers process
+        # wait for BOTH workers to register before submitting: echo jobs
+        # drain in milliseconds, so a late-starting w2 would otherwise never
+        # claim one and the disjoint-owners assertion would flake
+        import sqlite3
+
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                conn = sqlite3.connect(db)
+                n = conn.execute("SELECT COUNT(*) FROM workers").fetchone()[0]
+                conn.close()
+                if n >= 2:
+                    break
+            except sqlite3.Error:
+                pass
+            time.sleep(0.3)
+        else:
+            raise AssertionError("workers never registered")
+
+        # submit N jobs; stream the first over SSE while workers process.
+        # delay_s makes each job non-instant so one fast worker cannot drain
+        # the queue before the other's next claim tick.
         job_ids = [
-            _post(f"{base}/v1/jobs", {"kind": "echo", "payload": {"data": i}})["job_id"]
+            _post(
+                f"{base}/v1/jobs",
+                {"kind": "echo", "payload": {"data": i, "delay_s": 0.4}},
+            )["job_id"]
             for i in range(N_JOBS)
         ]
         sse_statuses: list[str] = []
