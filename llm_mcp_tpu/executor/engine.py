@@ -313,11 +313,14 @@ class GenerationEngine:
 
             # jax.jit caches one executable per input shape, so prompt buckets
             # (power-of-two padded) each compile once without any manual cache.
+            # quant_kv quantizes per layer INSIDE the prefill scan: the
+            # stacked bf16 prompt KV of a batched admission never
+            # materializes (llama_prefill docstring).
             @jax.jit
             def prefill_fn(params, tokens, lengths):
-                logits, ks, vs = llama_prefill(cfg_, params, tokens, lengths, attn_impl=impl)
-                ks, vs = _maybe_quant_kv(ks, vs)
-                return logits, ks, vs
+                return llama_prefill(
+                    cfg_, params, tokens, lengths, attn_impl=impl, quant_kv=kv_q
+                )
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def insert_fn(ck, cv, ks, vs, i, slot):
@@ -332,7 +335,8 @@ class GenerationEngine:
                         (0, slot, 0, 0, 0),
                     ),
                     "s": jax.lax.dynamic_update_slice(
-                        ck["s"], jax.lax.dynamic_slice_in_dim(ks["s"], i, 1, 1),
+                        ck["s"],
+                        jax.lax.dynamic_slice_in_dim(ks["s"], i, 1, 1).astype(ck["s"].dtype),
                         (0, slot, 0, 0),
                     ),
                 }
@@ -342,7 +346,8 @@ class GenerationEngine:
                         (0, slot, 0, 0, 0),
                     ),
                     "s": jax.lax.dynamic_update_slice(
-                        cv["s"], jax.lax.dynamic_slice_in_dim(vs["s"], i, 1, 1),
+                        cv["s"],
+                        jax.lax.dynamic_slice_in_dim(vs["s"], i, 1, 1).astype(cv["s"].dtype),
                         (0, slot, 0, 0),
                     ),
                 }

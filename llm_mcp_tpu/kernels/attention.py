@@ -528,6 +528,10 @@ def _attend_q8_blocked_kernel(
     Hkv = k_buf.shape[1]
     nblk_max = seq_len // BS
     nblk = jnp.clip((w + BS) // BS, 1, nblk_max)
+    # parked/free rows (w >= S, engine convention) produce discarded output:
+    # stream one block instead of the whole row — at low occupancy most of
+    # the batch is parked and would otherwise dominate cache traffic
+    nblk = jnp.where(w >= seq_len, 1, nblk)
 
     def copies(j, slot):
         return (

@@ -320,11 +320,18 @@ def llama_prefill(
     tokens: jnp.ndarray,  # [B, S] int32 (right-padded prompts)
     lengths: jnp.ndarray,  # [B] int32 true prompt lengths
     attn_impl: str = "xla",
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    quant_kv: bool = False,
+) -> tuple[jnp.ndarray, Any, Any]:
     """Causal self-attention over fresh prompts (no past KV).
 
     Returns (last_logits [B, V] f32, k [L, B, Hkv, S, Dh], v [...]) — the
     prompt KV to be inserted into the engine cache at the request's slot.
+
+    `quant_kv=True` quantizes each layer's K/V INSIDE the scan, so the
+    stacked ys are int8 {"q","s"} pytrees and the full bf16 prompt KV never
+    materializes in HBM — at 8B a batch-8 × 256-bucket admission would
+    otherwise stack ~1 GB of bf16 KV before the engine's quantize step,
+    enough memory pressure to collapse serving throughput.
     """
     B, S = tokens.shape
     h = _embed_in(cfg, params, tokens)  # [B, S, D]
@@ -332,7 +339,12 @@ def llama_prefill(
 
     def layer(h, xs):
         lp, win = xs
-        return prefill_layer(cfg, lp, h, cos, sin, mask, lengths, attn_impl, window=win)
+        h, (kh, vh) = prefill_layer(
+            cfg, lp, h, cos, sin, mask, lengths, attn_impl, window=win
+        )
+        if quant_kv:
+            return h, (quantize_kv(kh), quantize_kv(vh))
+        return h, (kh, vh)
 
     h, (ks, vs) = jax.lax.scan(layer, h, (params["layers"], layer_windows(cfg)))
 

@@ -70,10 +70,14 @@ class Executors:
         provider = str(payload.get("provider") or "tpu")
         if kind == "echo":
             # optional bounded delay: lets scale-out tests make work
-            # non-instant so claims spread across workers deterministically
-            delay = float(payload.get("delay_s") or 0.0)
+            # non-instant so claims spread across workers deterministically.
+            # Client-controlled, so hard-capped small and parse-safe.
+            try:
+                delay = float(payload.get("delay_s") or 0.0)
+            except (TypeError, ValueError):
+                delay = 0.0
             if delay > 0:
-                time.sleep(min(delay, 5.0))
+                time.sleep(min(delay, 2.0))
             return {"echo": payload.get("data", payload), "ok": True}
         if kind.startswith("benchmark."):
             return self._benchmark(kind.removeprefix("benchmark."), payload)
