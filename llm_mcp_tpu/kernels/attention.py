@@ -417,7 +417,8 @@ def decode_attention_cache(
 
 def _attend_q8_kernel(
     li_ref,  # [1] int32 (scalar prefetch) — layer index
-    lengths_ref,  # [B] int32 (scalar prefetch) — this step's position per slot
+    ids_ref,  # [Ba] int32 (scalar prefetch) — cache row per batch position
+    lengths_ref,  # [Ba] int32 (scalar prefetch) — this step's position per row
     q_ref,  # [1, Hkv, G, hd]
     nk_ref,  # [1, Hkv, 1, hd] — this step's K vectors (post-rope)
     nv_ref,  # [1, Hkv, 1, hd]
@@ -491,7 +492,8 @@ def _attend_q8_kernel(
 
 def _attend_q8_blocked_kernel(
     li_ref,  # [1] int32 (scalar prefetch) — layer index
-    lengths_ref,  # [B] int32 (scalar prefetch) — this step's position per slot
+    ids_ref,  # [Ba] int32 (scalar prefetch) — cache row per batch position
+    lengths_ref,  # [Ba] int32 (scalar prefetch) — this step's position per row
     q_ref,  # [1, Hkv, G, hd] VMEM
     nk_ref,  # [1, Hkv, 1, hd] VMEM — this step's K vectors (post-rope)
     nv_ref,  # [1, Hkv, 1, hd] VMEM
@@ -523,6 +525,7 @@ def _attend_q8_blocked_kernel(
     """
     b = pl.program_id(0)
     li = li_ref[0]
+    row = ids_ref[b]  # cache row for this batch position (compaction)
     w = lengths_ref[b]
     BS = block_s
     Hkv = k_buf.shape[1]
@@ -536,16 +539,16 @@ def _attend_q8_blocked_kernel(
     def copies(j, slot):
         return (
             pltpu.make_async_copy(
-                kq_hbm.at[li, b, :, pl.ds(j * BS, BS), :], k_buf.at[slot], sems.at[slot, 0]
+                kq_hbm.at[li, row, :, pl.ds(j * BS, BS), :], k_buf.at[slot], sems.at[slot, 0]
             ),
             pltpu.make_async_copy(
-                ks_hbm.at[li, b, :, pl.ds(j * BS, BS)], ks_buf.at[slot], sems.at[slot, 1]
+                ks_hbm.at[li, row, :, pl.ds(j * BS, BS)], ks_buf.at[slot], sems.at[slot, 1]
             ),
             pltpu.make_async_copy(
-                vq_hbm.at[li, b, :, pl.ds(j * BS, BS), :], v_buf.at[slot], sems.at[slot, 2]
+                vq_hbm.at[li, row, :, pl.ds(j * BS, BS), :], v_buf.at[slot], sems.at[slot, 2]
             ),
             pltpu.make_async_copy(
-                vs_hbm.at[li, b, :, pl.ds(j * BS, BS)], vs_buf.at[slot], sems.at[slot, 3]
+                vs_hbm.at[li, row, :, pl.ds(j * BS, BS)], vs_buf.at[slot], sems.at[slot, 3]
             ),
         )
 
@@ -614,7 +617,9 @@ def _attend_q8_blocked_kernel(
     o_ref[0] = (acc / l).astype(o_ref.dtype)
 
 
-def _decode_attend_q8_fallback(q, new_k, new_v, cache_k, cache_v, layer, lengths, sc):
+def _decode_attend_q8_fallback(
+    q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids=None
+):
     """Exact-f32 mirror of the q8 kernels' math (no q/prob requant). Used on
     CPU builds without pallas-tpu and for cache lengths no int8-tileable
     block size divides."""
@@ -623,6 +628,9 @@ def _decode_attend_q8_fallback(q, new_k, new_v, cache_k, cache_v, layer, lengths
     vf = jax.lax.dynamic_index_in_dim(cache_v["q"], layer, 0, keepdims=False)
     kss = jax.lax.dynamic_index_in_dim(cache_k["s"], layer, 0, keepdims=False)
     vss = jax.lax.dynamic_index_in_dim(cache_v["s"], layer, 0, keepdims=False)
+    if slot_ids is not None:
+        kf, vf = jnp.take(kf, slot_ids, 0), jnp.take(vf, slot_ids, 0)
+        kss, vss = jnp.take(kss, slot_ids, 0), jnp.take(vss, slot_ids, 0)
     qf = q.astype(jnp.float32) * sc
     s = jnp.einsum("bhgd,bhsd->bhgs", qf, kf.astype(jnp.float32)) * kss.astype(
         jnp.float32
@@ -642,14 +650,15 @@ def _decode_attend_q8_fallback(q, new_k, new_v, cache_k, cache_v, layer, lengths
 
 @functools.partial(jax.jit, static_argnames=("interpret", "scale"))
 def decode_attend_q8(
-    q: jnp.ndarray,  # [B, Hkv, G, hd]
-    new_k: jnp.ndarray,  # [B, Hkv, hd] — post-rope K for this step
-    new_v: jnp.ndarray,  # [B, Hkv, hd]
+    q: jnp.ndarray,  # [Ba, Hkv, G, hd] — COMPACT batch (active rows only)
+    new_k: jnp.ndarray,  # [Ba, Hkv, hd] — post-rope K for this step
+    new_v: jnp.ndarray,  # [Ba, Hkv, hd]
     cache_k: dict,  # {"q": int8 [L,B,Hkv,S,hd], "s": [L,B,Hkv,S]} PRE-append
     cache_v: dict,
     layer: jnp.ndarray,  # scalar int32
-    lengths: jnp.ndarray,  # [B] int32 — this step's position per slot
+    lengths: jnp.ndarray,  # [Ba] int32 — this step's position per row
     *,
+    slot_ids: jnp.ndarray | None = None,  # [Ba] int32 cache rows (None = 1:1)
     scale: float = 0.0,  # query scale (0 = head_dim**-0.5)
     interpret: bool | None = None,
 ) -> jnp.ndarray:
