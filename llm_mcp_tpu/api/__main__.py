@@ -54,6 +54,7 @@ def main() -> None:
             quant=cfg.tpu_quant,
             kv_quant=cfg.tpu_kv_quant,
             prefill_chunk=cfg.tpu_prefill_chunk,
+            decode_compact=cfg.tpu_decode_compact,
         ).start()
         emodel = cfg.tpu_embed_model
         log.info("loading embedding engine: %s", emodel)

@@ -125,6 +125,7 @@ class GenerationEngine:
         kv_quant: str = "",
         prefill_chunk: int = 512,
         admit_batch: int = 4,
+        decode_compact: str = "auto",
     ):
         self.cfg = get_config(model) if isinstance(model, str) else model
         self.mesh = mesh
@@ -167,6 +168,24 @@ class GenerationEngine:
             head_dim=hd,
             n_kv_heads=self.cfg.n_kv_heads,
             n_heads=self.cfg.n_heads,
+        )
+        # Slot compaction: decode rounds dispatch only the ACTIVE rows
+        # (pow2-bucketed) instead of the full max_slots batch — the weights
+        # pass, sampling, and (on the kernels' scalar-prefetch indirection)
+        # cache traffic all scale with occupancy instead of capacity. "auto"
+        # enables it for the int8 cache (whose kernels take slot_ids);
+        # "on" forces it for bf16 too (xla gather path), "off" disables.
+        # ("auto" stays single-chip: under a mesh the compact batch's dynamic
+        # row gathers would cut across the dp/tp cache sharding — XLA inserts
+        # collectives per layer and the "optimization" inverts. "on" overrides
+        # for configs whose mesh doesn't shard the slot axis.)
+        dc = (decode_compact or "auto").lower()
+        if dc not in ("auto", "on", "off"):
+            log.warning("unknown decode_compact mode %r (auto|on|off); using auto", dc)
+            dc = "auto"
+        single_chip = mesh is None or mesh.size == 1
+        self.decode_compact = dc == "on" or (
+            dc == "auto" and self.kv_quant == "int8" and single_chip
         )
         # chunked prefill: bound the per-iteration prefill work so admissions
         # interleave with decode rounds (0 disables; sp prefill is whole-prompt
@@ -388,11 +407,17 @@ class GenerationEngine:
         impl = self.decode_impl
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def decode_chunk_fn(params, ck, cv, tokens, lengths, rng, temp, topk, topp):
+        def decode_chunk_fn(
+            params, ck, cv, tokens, lengths, slot_ids, rng, temp, topk, topp
+        ):
+            # slot_ids None = full batch (row b serves cache row b); an array
+            # = COMPACT batch (row i serves cache row slot_ids[i]) — the slot
+            # compaction path (_decode_round). One trace per (shape, mode).
             def step(carry, _):
                 ck, cv, toks, lens, rng = carry
                 logits, ck, cv = llama_decode_step(
-                    cfg, params, ck, cv, toks, lens, attn_impl=impl
+                    cfg, params, ck, cv, toks, lens, attn_impl=impl,
+                    slot_ids=slot_ids,
                 )
                 if mask is not None:
                     logits = jnp.where(mask, logits, -jnp.inf)
@@ -403,7 +428,7 @@ class GenerationEngine:
             (ck, cv, _, _, _), out = jax.lax.scan(
                 step, (ck, cv, tokens, lengths, rng), None, length=K
             )
-            return out, ck, cv  # out: [K, B]
+            return out, ck, cv  # out: [K, Ba]
 
         return decode_chunk_fn
 
@@ -817,41 +842,90 @@ class GenerationEngine:
         # events, not hang callers (the poisoned-round guard in _run)
         maybe_fail("engine.decode", f"active={len(active)}")
         round_t0 = time.perf_counter()
+        B = self.max_slots
+        nact = len(active)
+        # Slot compaction: dispatch a pow2 bucket of just the active rows.
+        # Floor 8 bounds the executable count (8, 16, 32, ... B); at Ba == B
+        # the full-batch trace (slot_ids=None) is reused instead — identical
+        # math, no indirection.
+        Ba = pow2_bucket(nact, B, floor=min(8, B)) if self.decode_compact else B
+        compact = Ba < B
+        if compact:
+            act = np.asarray(active, dtype=np.int32)
+            # Pad rows MUST target an INACTIVE cache row: pads are parked
+            # (length = S ⇒ the append kernels write nothing live), but each
+            # pallas grid cell still rewrites its target tile — aimed at an
+            # active row, a pad cell ordered after that row's real cell could
+            # write back a PRE-append tile and silently drop the append.
+            # Prefer a row that is neither active nor mid-chunked-prefill —
+            # those hold garbage by definition, so the no-op rewrite (and the
+            # attend kernel's discarded read) is trivially harmless. A
+            # mid-prefill row is still value-safe (parked pads write back
+            # byte-identical tiles; fallbacks drop OOB scatters) but only a
+            # last resort.
+            free = next(
+                (i for i in range(B)
+                 if self._slots[i] is None and i not in self._prefills),
+                next(i for i in range(B) if self._slots[i] is None),
+            )
+            ids = np.full(Ba, free, dtype=np.int32)
+            ids[:nact] = act
+            lens_in = np.full(Ba, self.max_seq_len, dtype=np.int32)
+            lens_in[:nact] = self._lengths[act]
+            toks = np.zeros(Ba, dtype=np.int32)
+            toks[:nact] = self._last_tok[act]
+            temp = np.zeros(Ba, dtype=np.float32)
+            temp[:nact] = self._temp[act]
+            topk = np.zeros(Ba, dtype=np.int32)
+            topk[:nact] = self._topk[act]
+            topp = np.ones(Ba, dtype=np.float32)
+            topp[:nact] = self._topp[act]
+            slot_ids = jnp.asarray(ids)
+        else:
+            lens_in, toks = self._lengths, self._last_tok
+            temp, topk, topp = self._temp, self._topk, self._topp
+            slot_ids = None
         out, self._ck, self._cv = self._decode_fn(
             self.params,
             self._ck,
             self._cv,
-            jnp.asarray(self._last_tok),
-            jnp.asarray(self._lengths),
+            jnp.asarray(toks),
+            jnp.asarray(lens_in),
+            slot_ids,
             self._next_key(),
-            jnp.asarray(self._temp),
-            jnp.asarray(self._topk),
-            jnp.asarray(self._topp),
+            jnp.asarray(temp),
+            jnp.asarray(topk),
+            jnp.asarray(topp),
         )
-        out = np.asarray(out)  # [K, B] — the only host sync per chunk
+        out = np.asarray(out)  # [K, Ba] — the only host sync per chunk
         # drives the chunked-prefill budget (_prefill_round): a smoothed
         # decode-round time keeps admission work ≈ one round per round
         self._last_decode_s = 0.7 * self._last_decode_s + 0.3 * (
             time.perf_counter() - round_t0
         )
         K = out.shape[0]
-        # Device advanced every slot K steps; mirror that, then process
-        # tokens against their true per-token cache positions.
+        # Device advanced every active slot K steps; mirror that, then
+        # process tokens against their true per-token cache positions.
+        # Parked rows stay pinned at exactly max_seq_len (drifting past it
+        # would eventually wrap int32 back into [0, S) and break the
+        # OOB-drop parking invariant — see __init__); active rows never
+        # legitimately exceed it (finish condition in _emit_token).
         base = self._lengths.copy()
-        self._lengths += K
-        # re-clamp parked rows to exactly max_seq_len: left drifting += K
-        # forever they would eventually wrap int32 back into [0, S) and break
-        # the OOB-drop parking invariant (see __init__). Active rows never
-        # legitimately exceed max_seq_len (finish condition in _emit_token).
+        act_ix = np.asarray(active, dtype=np.intp)
+        self._lengths[act_ix] += K
         np.minimum(self._lengths, self.max_seq_len, out=self._lengths)
-        self._last_tok = out[-1].copy()
+        if compact:
+            self._last_tok[act_ix] = out[-1, :nact]
+        else:
+            self._last_tok = out[-1].copy()
         before = self.total_tokens  # _emit_token counts delivered tokens
-        for b in active:
+        for i, b in enumerate(active):
             s = self._slots[b]
             if s is None:
                 continue
+            col = i if compact else b
             for k in range(K):
-                if not self._emit_token(b, int(out[k, b]), pos=int(base[b]) + k):
+                if not self._emit_token(b, int(out[k, col]), pos=int(base[b]) + k):
                     break
         with self.stats_lock:
             self._window.append((time.time(), self.total_tokens - before))

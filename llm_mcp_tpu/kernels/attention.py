@@ -682,7 +682,7 @@ def decode_attend_q8(
 
     if not _HAS_PLTPU:  # pragma: no cover — CPU builds without pallas-tpu
         return _decode_attend_q8_fallback(
-            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc
+            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids
         )
 
     nk4 = new_k.reshape(B, Hkv, 1, hd)
@@ -693,18 +693,30 @@ def decode_attend_q8(
         # serving sizes (24.1 vs 26.3 ms/step at 8B B=112 S=1024)
         kernel = functools.partial(_attend_q8_kernel, scale=sc)
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,  # layer [1], lengths [B]
+            num_scalar_prefetch=3,  # layer [1], slot ids [Ba], lengths [Ba]
             grid=(B,),
             in_specs=[
-                pl.BlockSpec((1, Hkv, G, hd), lambda b, li, lens: (b, 0, 0, 0)),
-                pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, lens: (b, 0, 0, 0)),
-                pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, lens: (b, 0, 0, 0)),
-                pl.BlockSpec((1, 1, Hkv, S, hd), lambda b, li, lens: (li[0], b, 0, 0, 0)),
-                pl.BlockSpec((1, 1, Hkv, S), lambda b, li, lens: (li[0], b, 0, 0)),
-                pl.BlockSpec((1, 1, Hkv, S, hd), lambda b, li, lens: (li[0], b, 0, 0, 0)),
-                pl.BlockSpec((1, 1, Hkv, S), lambda b, li, lens: (li[0], b, 0, 0)),
+                pl.BlockSpec((1, Hkv, G, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
+                pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
+                pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
+                # cache tiles follow the compaction indirection: batch cell b
+                # reads cache row ids[b]
+                pl.BlockSpec(
+                    (1, 1, Hkv, S, hd), lambda b, li, ids, lens: (li[0], ids[b], 0, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, Hkv, S), lambda b, li, ids, lens: (li[0], ids[b], 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, Hkv, S, hd), lambda b, li, ids, lens: (li[0], ids[b], 0, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, Hkv, S), lambda b, li, ids, lens: (li[0], ids[b], 0, 0)
+                ),
             ],
-            out_specs=pl.BlockSpec((1, Hkv, G, hd), lambda b, li, lens: (b, 0, 0, 0)),
+            out_specs=pl.BlockSpec(
+                (1, Hkv, G, hd), lambda b, li, ids, lens: (b, 0, 0, 0)
+            ),
         )
     else:
         # long context: rows stream blockwise from HBM with a dynamic trip
@@ -716,24 +728,26 @@ def decode_attend_q8(
             # no int8-tileable block divides S: use the exact f32 math of
             # the CPU fallback (slower, never wrong)
             return _decode_attend_q8_fallback(
-                q, new_k, new_v, cache_k, cache_v, layer, lengths, sc
+                q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids
             )
         kernel = functools.partial(
             _attend_q8_blocked_kernel, scale=sc, block_s=BS, seq_len=S
         )
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,  # layer [1], lengths [B]
+            num_scalar_prefetch=3,  # layer [1], slot ids [Ba], lengths [Ba]
             grid=(B,),
             in_specs=[
-                pl.BlockSpec((1, Hkv, G, hd), lambda b, li, lens: (b, 0, 0, 0)),
-                pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, lens: (b, 0, 0, 0)),
-                pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, lens: (b, 0, 0, 0)),
+                pl.BlockSpec((1, Hkv, G, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
+                pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
+                pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
                 pl.BlockSpec(memory_space=pltpu.ANY),  # K payload [L,B,Hkv,S,hd]
                 pl.BlockSpec(memory_space=pltpu.ANY),  # K scales
                 pl.BlockSpec(memory_space=pltpu.ANY),  # V payload
                 pl.BlockSpec(memory_space=pltpu.ANY),  # V scales
             ],
-            out_specs=pl.BlockSpec((1, Hkv, G, hd), lambda b, li, lens: (b, 0, 0, 0)),
+            out_specs=pl.BlockSpec(
+                (1, Hkv, G, hd), lambda b, li, ids, lens: (b, 0, 0, 0)
+            ),
             scratch_shapes=[
                 pltpu.VMEM((2, Hkv, BS, hd), jnp.int8),
                 pltpu.VMEM((2, Hkv, BS), cache_k["s"].dtype),
@@ -742,6 +756,11 @@ def decode_attend_q8(
                 pltpu.SemaphoreType.DMA((2, 4)),
             ],
         )
+    ids = (
+        jnp.arange(B, dtype=jnp.int32)
+        if slot_ids is None
+        else slot_ids.astype(jnp.int32)
+    )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -749,6 +768,7 @@ def decode_attend_q8(
         interpret=interp,
     )(
         jnp.reshape(layer, (1,)).astype(jnp.int32),
+        ids,
         lengths.astype(jnp.int32),
         q,
         nk4,
@@ -761,7 +781,10 @@ def decode_attend_q8(
 
 
 def _append_q8_kernel(
-    lengths_ref,  # [B] int32 (scalar prefetch) — this step's position per row
+    lengths_ref,  # [Ba] int32 (scalar prefetch) — this step's position per row
+    ids_ref,  # [Ba] int32 (scalar prefetch) — cache row per batch position
+    #          (consumed by the BlockSpec index maps only: grid cell b's
+    #          cache tiles are selected at row ids[b], the body never reads it)
     nk_ref,  # [L, 1, Hkv, hd] — this step's K vectors (post-rope, bf16)
     nv_ref,  # [L, 1, Hkv, hd]
     ckq_ref,  # [L, 1, Hkv, BSQ, hd] int8 — payload tile containing position w
@@ -808,10 +831,11 @@ def _append_q8_kernel(
 def append_kv_q8(
     cache_k: dict,  # {"q": int8 [L,B,Hkv,S,hd], "s": [L,B,Hkv,S]}
     cache_v: dict,
-    new_k: jnp.ndarray,  # [L, B, Hkv, hd] — post-rope K for this step, all layers
+    new_k: jnp.ndarray,  # [L, Ba, Hkv, hd] — post-rope K for this step, all layers
     new_v: jnp.ndarray,
-    lengths: jnp.ndarray,  # [B] int32 — write position per row (>= S: skip)
+    lengths: jnp.ndarray,  # [Ba] int32 — write position per row (>= S: skip)
     *,
+    slot_ids: jnp.ndarray | None = None,  # [Ba] int32 cache rows (None = 1:1)
     interpret: bool | None = None,
 ) -> tuple[dict, dict]:
     """Append one decode step's K/V (all layers at once) into the int8 cache
@@ -826,7 +850,13 @@ def append_kv_q8(
     rows (lengths >= S, see executor/engine.py) write nothing.
     """
     L, B, Hkv, S, hd = cache_k["q"].shape
+    Ba = new_k.shape[1]
     interp = _interpret() if interpret is None else interpret
+    rows = (
+        jnp.arange(Ba, dtype=jnp.int32)
+        if slot_ids is None
+        else slot_ids.astype(jnp.int32)
+    )
 
     # mosaic int8 stores want full 128-lane rows; small-head test configs
     # (hd 32/64) take the scatter fallback
@@ -836,7 +866,7 @@ def append_kv_q8(
         from ..models.llama import quantize_kv  # local import: avoid cycle
 
         l_idx = jnp.arange(L)[:, None, None]
-        b_idx = jnp.arange(B)[None, :, None]
+        b_idx = rows[None, :, None]
         h_idx = jnp.arange(Hkv)[None, None, :]
         w_idx = lengths[None, :, None]
         kq = quantize_kv(new_k, scale_dtype=cache_k["s"].dtype)
@@ -863,24 +893,40 @@ def append_kv_q8(
     def blks(lens, b):
         return jnp.minimum(lens[b], S - 1) // BSS
 
-    nk4 = new_k.reshape(L, B, Hkv, hd)
-    nv4 = new_v.reshape(L, B, Hkv, hd)
+    nk4 = new_k.reshape(L, Ba, Hkv, hd)
+    nv4 = new_v.reshape(L, Ba, Hkv, hd)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,  # lengths [B]
-        grid=(B,),
+        num_scalar_prefetch=2,  # lengths [Ba], cache row ids [Ba]
+        grid=(Ba,),
         in_specs=[
-            pl.BlockSpec((L, 1, Hkv, hd), lambda b, lens: (0, b, 0, 0)),
-            pl.BlockSpec((L, 1, Hkv, hd), lambda b, lens: (0, b, 0, 0)),
-            pl.BlockSpec((L, 1, Hkv, BSQ, hd), lambda b, lens: (0, b, 0, blkq(lens, b), 0)),
-            pl.BlockSpec((L, 1, Hkv, BSS), lambda b, lens: (0, b, 0, blks(lens, b))),
-            pl.BlockSpec((L, 1, Hkv, BSQ, hd), lambda b, lens: (0, b, 0, blkq(lens, b), 0)),
-            pl.BlockSpec((L, 1, Hkv, BSS), lambda b, lens: (0, b, 0, blks(lens, b))),
+            pl.BlockSpec((L, 1, Hkv, hd), lambda b, lens, ids: (0, b, 0, 0)),
+            pl.BlockSpec((L, 1, Hkv, hd), lambda b, lens, ids: (0, b, 0, 0)),
+            pl.BlockSpec(
+                (L, 1, Hkv, BSQ, hd), lambda b, lens, ids: (0, ids[b], 0, blkq(lens, b), 0)
+            ),
+            pl.BlockSpec(
+                (L, 1, Hkv, BSS), lambda b, lens, ids: (0, ids[b], 0, blks(lens, b))
+            ),
+            pl.BlockSpec(
+                (L, 1, Hkv, BSQ, hd), lambda b, lens, ids: (0, ids[b], 0, blkq(lens, b), 0)
+            ),
+            pl.BlockSpec(
+                (L, 1, Hkv, BSS), lambda b, lens, ids: (0, ids[b], 0, blks(lens, b))
+            ),
         ],
         out_specs=[
-            pl.BlockSpec((L, 1, Hkv, BSQ, hd), lambda b, lens: (0, b, 0, blkq(lens, b), 0)),
-            pl.BlockSpec((L, 1, Hkv, BSS), lambda b, lens: (0, b, 0, blks(lens, b))),
-            pl.BlockSpec((L, 1, Hkv, BSQ, hd), lambda b, lens: (0, b, 0, blkq(lens, b), 0)),
-            pl.BlockSpec((L, 1, Hkv, BSS), lambda b, lens: (0, b, 0, blks(lens, b))),
+            pl.BlockSpec(
+                (L, 1, Hkv, BSQ, hd), lambda b, lens, ids: (0, ids[b], 0, blkq(lens, b), 0)
+            ),
+            pl.BlockSpec(
+                (L, 1, Hkv, BSS), lambda b, lens, ids: (0, ids[b], 0, blks(lens, b))
+            ),
+            pl.BlockSpec(
+                (L, 1, Hkv, BSQ, hd), lambda b, lens, ids: (0, ids[b], 0, blkq(lens, b), 0)
+            ),
+            pl.BlockSpec(
+                (L, 1, Hkv, BSS), lambda b, lens, ids: (0, ids[b], 0, blks(lens, b))
+            ),
         ],
     )
     okq, oks, ovq, ovs = pl.pallas_call(
@@ -892,12 +938,13 @@ def append_kv_q8(
             jax.ShapeDtypeStruct(cache_v["q"].shape, cache_v["q"].dtype),
             jax.ShapeDtypeStruct(cache_v["s"].shape, cache_v["s"].dtype),
         ],
-        # operand indices include the prefetch scalar: lengths=0, nk=1, nv=2,
-        # ckq=3, cks=4, cvq=5, cvs=6 → outputs 0..3
-        input_output_aliases={3: 0, 4: 1, 5: 2, 6: 3},
+        # operand indices include the prefetch scalars: lengths=0, ids=1,
+        # nk=2, nv=3, ckq=4, cks=5, cvq=6, cvs=7 → outputs 0..3
+        input_output_aliases={4: 0, 5: 1, 6: 2, 7: 3},
         interpret=interp,
     )(
         lengths.astype(jnp.int32),
+        rows,
         nk4,
         nv4,
         cache_k["q"],

@@ -359,8 +359,9 @@ def _decode_step_q8(
     params: Params,
     cache_k: dict,
     cache_v: dict,
-    tokens: jnp.ndarray,  # [B] int32
-    lengths: jnp.ndarray,  # [B] int32
+    tokens: jnp.ndarray,  # [Ba] int32 (compact batch when slot_ids is given)
+    lengths: jnp.ndarray,  # [Ba] int32
+    slot_ids: jnp.ndarray | None = None,  # [Ba] int32 cache rows (None = 1:1)
 ) -> tuple[jnp.ndarray, dict, dict]:
     """Decode step for the int8 cache on the pallas path.
 
@@ -371,37 +372,45 @@ def _decode_step_q8(
     Instead the cache is a scan-invariant operand read by `decode_attend_q8`
     (which overrides this step's position with the exact in-register
     vectors, so correctness never depends on the append having happened),
-    the per-layer K/V stack out as scan ys ([L, B, Hkv, hd] — 3.7 MB), and
+    the per-layer K/V stack out as scan ys ([L, Ba, Hkv, hd] — 3.7 MB), and
     ONE `append_kv_q8` call rewrites just the 32-row tiles in place.
     Measured: 37.5 -> ~24 ms/step.
+
+    With `slot_ids` the batch axis is COMPACT: row i computes the forward
+    pass for cache row slot_ids[i] (slot compaction — at low occupancy the
+    weights pass and sampling shrink to the active rows instead of paying
+    for every parked slot; the kernels follow the indirection via scalar
+    prefetch, so cache traffic also shrinks on the blocked path).
     """
     L, B, Hkv, S, hd = _cache_shape(cache_k)
+    Ba = tokens.shape[0]
     H = cfg.n_heads
-    h = _embed_in(cfg, params, tokens)  # [B, D]
-    cos, sin = rope_frequencies(hd, cfg.rope_theta, lengths)  # [B, hd/2]
+    h = _embed_in(cfg, params, tokens)  # [Ba, D]
+    cos, sin = rope_frequencies(hd, cfg.rope_theta, lengths)  # [Ba, hd/2]
 
     def layer(carry, xs):
         lp, win = xs
         h, li = carry
         x = _norm(cfg, h, lp["attn_norm"])
         q, k, v = _qkv(cfg, lp, x)
-        q = q.reshape(B, H, hd)
-        k = k.reshape(B, Hkv, hd)
-        v = v.reshape(B, Hkv, hd)
+        q = q.reshape(Ba, H, hd)
+        k = k.reshape(Ba, Hkv, hd)
+        v = v.reshape(Ba, Hkv, hd)
         q = apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]
         k = apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
-        qg = q.reshape(B, Hkv, H // Hkv, hd)
+        qg = q.reshape(Ba, Hkv, H // Hkv, hd)
         ctx = decode_attend_q8(
-            qg, k, v, cache_k, cache_v, li, lengths, scale=cfg.attn_scale
-        ).reshape(B, H * hd)
+            qg, k, v, cache_k, cache_v, li, lengths,
+            slot_ids=slot_ids, scale=cfg.attn_scale,
+        ).reshape(Ba, H * hd)
         h = _attn_residual(cfg, lp, ctx, h)
-        h = _ffn_residual(cfg, lp, h, moe_capacity=B)
+        h = _ffn_residual(cfg, lp, h, moe_capacity=Ba)
         return (h, li + 1), (k, v)
 
     (h, _), (knew, vnew) = jax.lax.scan(
         layer, (h, jnp.int32(0)), (params["layers"], layer_windows(cfg))
     )
-    new_k, new_v = append_kv_q8(cache_k, cache_v, knew, vnew, lengths)
+    new_k, new_v = append_kv_q8(cache_k, cache_v, knew, vnew, lengths, slot_ids=slot_ids)
     return _logits(cfg, params, h), new_k, new_v
 
 
@@ -632,16 +641,23 @@ def llama_decode_step(
     params: Params,
     cache_k: jnp.ndarray,  # [L, B, Hkv, S, Dh]
     cache_v: jnp.ndarray,
-    tokens: jnp.ndarray,  # [B] int32 — last emitted token per slot
-    lengths: jnp.ndarray,  # [B] int32 — position to write (tokens already in cache)
+    tokens: jnp.ndarray,  # [Ba] int32 — last emitted token per batch row
+    lengths: jnp.ndarray,  # [Ba] int32 — position to write (tokens already in cache)
     attn_impl: str = "xla",
+    slot_ids: jnp.ndarray | None = None,  # [Ba] int32 cache rows (None = 1:1)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One batched autoregressive step for all slots.
 
     Writes this step's K/V at `lengths[b]`, attends over positions
-    ≤ lengths[b], returns (logits [B, V] f32, new_cache_k, new_cache_v).
+    ≤ lengths[b], returns (logits [Ba, V] f32, new_cache_k, new_cache_v).
     Inactive slots simply produce garbage logits that the engine ignores —
     keeping the step shape-static (no data-dependent control flow under jit).
+
+    With `slot_ids` the batch is COMPACT: row i serves cache row
+    slot_ids[i] (reads attend that row, the K/V append scatters into it).
+    The forward pass then sizes to the active rows only — the engine's slot
+    compaction (executor/engine.py:_decode_round) uses this so parked slots
+    stop costing weights-pass FLOPs and sampling work.
 
     The caches may be int8-quantized ({"q", "s"} pytrees — see
     `init_kv_cache`): scales then fold into the attention einsums post-dot
@@ -650,6 +666,7 @@ def llama_decode_step(
     """
     quantized = isinstance(cache_k, dict)
     L, B, Hkv, S, hd = _cache_shape(cache_k)
+    Ba = tokens.shape[0]
     H = cfg.n_heads
     G = H // Hkv
 
@@ -663,6 +680,10 @@ def llama_decode_step(
         attn_impl = "xla"
     if attn_impl == "pallas" and cfg.query_pre_attn_scalar and not quantized:
         attn_impl = "xla"
+    # the bf16-cache pallas kernel has no compaction indirection; compacted
+    # bf16 decode takes the (gathering) xla path instead
+    if attn_impl == "pallas" and not quantized and slot_ids is not None:
+        attn_impl = "xla"
 
     if quantized and attn_impl == "pallas":
         # The TPU hot path takes a different structure: cache is a
@@ -671,17 +692,26 @@ def llama_decode_step(
         # via the in-place tile-rewrite kernel (kernels/attention.py:
         # append_kv_q8). decode_attend_q8 is built for pre-append caches: it
         # overrides position w with the exact new vectors.
-        return _decode_step_q8(cfg, params, cache_k, cache_v, tokens, lengths)
+        return _decode_step_q8(
+            cfg, params, cache_k, cache_v, tokens, lengths, slot_ids=slot_ids
+        )
 
-    h = _embed_in(cfg, params, tokens)  # [B, D]
-    cos, sin = rope_frequencies(hd, cfg.rope_theta, lengths)  # [B, hd/2]
+    h = _embed_in(cfg, params, tokens)  # [Ba, D]
+    cos, sin = rope_frequencies(hd, cfg.rope_theta, lengths)  # [Ba, hd/2]
 
-    b_idx = jnp.arange(B)[:, None]  # [B, 1]
+    # row i of the compact batch scatters/gathers cache row rows[i]
+    rows = jnp.arange(B, dtype=jnp.int32) if slot_ids is None else slot_ids
+    b_idx = rows[:, None]  # [Ba, 1]
     h_idx = jnp.arange(Hkv)[None, :]  # [1, Hkv]
-    w_idx = lengths[:, None]  # [B, 1] — broadcast with h_idx to [B, Hkv]
+    w_idx = lengths[:, None]  # [Ba, 1] — broadcast with h_idx to [Ba, Hkv]
     key_pos = jnp.arange(S)[None, :]  # [1, S]
-    attn_mask = key_pos <= lengths[:, None]  # [B, S]
+    attn_mask = key_pos <= lengths[:, None]  # [Ba, S]
     neg = jnp.float32(-1e30)
+
+    def rowsel(x):
+        # gather the compact batch's cache rows for the einsum attention
+        # paths (identity when uncompacted — XLA elides the arange take)
+        return x if slot_ids is None else jnp.take(x, slot_ids, axis=0)
 
     # The full cache rides the layer scan as CARRY, not xs/ys: as ys the
     # scan would materialize a fresh [L, B, Hkv, S, hd] stack every step — a
@@ -695,13 +725,13 @@ def llama_decode_step(
         h, ck_all, cv_all, li = carry
         x = _norm(cfg, h, lp["attn_norm"])
         q, k, v = _qkv(cfg, lp, x)
-        q = q.reshape(B, H, hd)
-        k = k.reshape(B, Hkv, hd)
-        v = v.reshape(B, Hkv, hd)
-        q = apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]  # [B, H, hd]
+        q = q.reshape(Ba, H, hd)
+        k = k.reshape(Ba, Hkv, hd)
+        v = v.reshape(Ba, Hkv, hd)
+        q = apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]  # [Ba, H, hd]
         k = apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
 
-        qg = q.reshape(B, Hkv, G, hd)
+        qg = q.reshape(Ba, Hkv, G, hd)
         # Append this step's K/V row to the carry, quantizing when the cache
         # is int8. The scatter happens BEFORE any kernel read: a scatter
         # after a pallas read is a write-after-read hazard on the carried
@@ -726,21 +756,23 @@ def llama_decode_step(
             # s8-MXU kernel; position w's score/value come from the exact
             # unquantized vectors (the kernel overrides that column).
             ctx = decode_attend_q8(
-                qg, k, v, ck_all, cv_all, li, lengths, scale=cfg.attn_scale
-            ).reshape(B, H * hd)
+                qg, k, v, ck_all, cv_all, li, lengths,
+                slot_ids=slot_ids, scale=cfg.attn_scale,
+            ).reshape(Ba, H * hd)
         elif attn_impl == "pallas":
             # Kernel indexes the L axis itself (scalar prefetch): no
-            # dynamic-slice copy of the layer's cache.
+            # dynamic-slice copy of the layer's cache. (Never reached with
+            # slot_ids — compaction routes bf16 caches to the xla impl.)
             ctx = decode_attention_cache(qg, ck_all, cv_all, li, lengths).reshape(
-                B, H * hd
+                Ba, H * hd
             )
         elif quantized:
-            ck = jax.lax.dynamic_index_in_dim(ck_all["q"], li, 0, keepdims=False)
-            cv = jax.lax.dynamic_index_in_dim(cv_all["q"], li, 0, keepdims=False)
-            ks = jax.lax.dynamic_index_in_dim(ck_all["s"], li, 0, keepdims=False)
-            vs = jax.lax.dynamic_index_in_dim(cv_all["s"], li, 0, keepdims=False)
+            ck = rowsel(jax.lax.dynamic_index_in_dim(ck_all["q"], li, 0, keepdims=False))
+            cv = rowsel(jax.lax.dynamic_index_in_dim(cv_all["q"], li, 0, keepdims=False))
+            ks = rowsel(jax.lax.dynamic_index_in_dim(ck_all["s"], li, 0, keepdims=False))
+            vs = rowsel(jax.lax.dynamic_index_in_dim(cv_all["s"], li, 0, keepdims=False))
             # int8 K dot in compute dtype; per-key-token dequant scales the
-            # SCORES (cheap [B,Hkv,G,S] multiply), not the K payload
+            # SCORES (cheap [Ba,Hkv,G,S] multiply), not the K payload
             scores = jnp.einsum("bhgd,bhsd->bhgs", qg, ck.astype(h.dtype)).astype(
                 jnp.float32
             ) * ks.astype(jnp.float32)[:, :, None, :]
@@ -753,11 +785,11 @@ def llama_decode_step(
             # v's dequant folds into the probs before the PV dot
             probs = (probs * vs.astype(jnp.float32)[:, :, None, :]).astype(h.dtype)
             ctx = jnp.einsum("bhgs,bhsd->bhgd", probs, cv.astype(h.dtype)).reshape(
-                B, H * hd
+                Ba, H * hd
             )
         else:
-            ck = jax.lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False)
-            cv = jax.lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False)
+            ck = rowsel(jax.lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False))
+            cv = rowsel(jax.lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False))
             scores = jnp.einsum("bhgd,bhsd->bhgs", qg, ck).astype(jnp.float32)
             scores = _softcap(scores * cfg.attn_scale, cfg.attn_softcap)
             m = attn_mask
@@ -765,9 +797,9 @@ def llama_decode_step(
                 m = m & ((win == 0) | (key_pos > (lengths[:, None] - win)))
             scores = jnp.where(m[:, None, None, :], scores, neg)
             probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
-            ctx = jnp.einsum("bhgs,bhsd->bhgd", probs, cv).reshape(B, H * hd)
+            ctx = jnp.einsum("bhgs,bhsd->bhgd", probs, cv).reshape(Ba, H * hd)
         h = _attn_residual(cfg, lp, ctx, h)
-        h = _ffn_residual(cfg, lp, h, moe_capacity=B)  # dropless at decode
+        h = _ffn_residual(cfg, lp, h, moe_capacity=Ba)  # dropless at decode
         return (h, ck_all, cv_all, li + 1), None
 
     (h, new_k, new_v, _), _ = jax.lax.scan(

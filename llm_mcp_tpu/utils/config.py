@@ -117,6 +117,8 @@ class Config:
     tpu_kv_quant: str = field(default_factory=lambda: getenv("TPU_KV_QUANT", ""))  # "" | int8
     # chunked prefill segment length (tokens); 0 disables interleaved prefill
     tpu_prefill_chunk: int = field(default_factory=lambda: getenv_int("TPU_PREFILL_CHUNK", 512))
+    # slot compaction: decode only active rows (auto | on | off)
+    tpu_decode_compact: str = field(default_factory=lambda: getenv("TPU_DECODE_COMPACT", "auto"))
 
     def has_openai(self) -> bool:
         return bool(self.openai_api_key)

@@ -51,6 +51,7 @@ def main() -> None:
             quant=cfg.tpu_quant,
             kv_quant=cfg.tpu_kv_quant,
             prefill_chunk=cfg.tpu_prefill_chunk,
+            decode_compact=cfg.tpu_decode_compact,
         ).start()
         embed_engines[cfg.tpu_embed_model] = EmbeddingEngine(
             cfg.tpu_embed_model,

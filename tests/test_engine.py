@@ -247,6 +247,46 @@ def test_chunked_prefill_interleaves_with_decode():
         eng.shutdown()
 
 
+@pytest.mark.parametrize("kv_quant", ["int8", ""])
+def test_decode_compact_matches_full_batch(kv_quant):
+    """Slot compaction must not change a single greedy token.
+
+    Two engines, identical seed/config except decode_compact; max_slots=16
+    with ≤3 concurrent requests keeps the compact bucket (8) strictly below
+    the full batch, so the compacted engine really exercises the slot_ids
+    indirection (kernels/attention.py) every round. Covers both the int8
+    cache (q8 kernel/fallback path) and bf16 (xla gather path, forced on).
+    """
+    mk = lambda mode: GenerationEngine(
+        "tiny-llm", max_slots=16, max_seq_len=128, dtype=jnp.float32,
+        decode_chunk=2, kv_quant=kv_quant, prefill_chunk=8,
+        decode_compact=mode,
+    ).start()
+    on = mk("on")
+    off = mk("off")
+    try:
+        assert on.decode_compact and not off.decode_compact
+        prompts = [f"compaction check {i} " * (i + 1) for i in range(3)]
+        # staggered lifetimes: different max_tokens make slots free at
+        # different rounds, so the active set (and bucket) shifts mid-stream
+        toks = [6, 11, 16]
+        with cf.ThreadPoolExecutor(max_workers=3) as ex:
+            got = list(ex.map(
+                lambda i: on.generate(prompts[i], max_tokens=toks[i], temperature=0.0),
+                range(3),
+            ))
+        want = [
+            off.generate(prompts[i], max_tokens=toks[i], temperature=0.0)
+            for i in range(3)
+        ]
+        for g, w in zip(got, want):
+            assert g["text"] == w["text"]
+            assert g["usage"] == w["usage"]
+    finally:
+        on.shutdown()
+        off.shutdown()
+
+
 def test_engine_int8_kv_cache():
     """int8 KV cache serves coherently through both prefill paths."""
     eng = GenerationEngine(
