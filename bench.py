@@ -105,6 +105,7 @@ def serve_path_metrics(
     decode_chunk: int = 16,
     admit_batch: int = 4,
     warmup_timeout_s: float = 900.0,
+    decode_compact: str = "auto",
 ) -> dict[str, float]:
     """Steady-state tok/s and client-observed p50 TTFT through the REAL
     serving path — GenerationEngine behind CoreServer's /v1/chat/completions
@@ -139,6 +140,7 @@ def serve_path_metrics(
         quant=quant,
         kv_quant=kv_quant,
         admit_batch=admit_batch,
+        decode_compact=decode_compact,
     ).start()
     srv = CoreServer(
         Config(), db=Database(":memory:"), gen_engines={model: eng}, embed_engines={}
@@ -215,10 +217,14 @@ def serve_path_metrics(
                 break
         time.sleep(0.25)
 
-    tok0 = eng.total_tokens
+    with eng.stats_lock:
+        tok0, err0 = eng.total_tokens, eng.total_errors
+        fin0, ftok0 = eng.finished_requests, eng.finished_tokens
     m0 = time.perf_counter()
     time.sleep(measure_s)
-    tok1 = eng.total_tokens
+    with eng.stats_lock:
+        tok1, err1 = eng.total_tokens, eng.total_errors
+        fin1, ftok1 = eng.finished_requests, eng.finished_tokens
     m1 = time.perf_counter()
     # settle BEFORE stopping: requests POSTed near the window end whose first
     # delta is still pending are exactly the tail the p95 must capture —
@@ -242,11 +248,48 @@ def serve_path_metrics(
     del eng, srv
     gc.collect()
     out = {"tok_per_s": (tok1 - tok0) / (m1 - m0)}
+    # Degenerate-window evidence (a run where decode is broken still serves
+    # prefill first-tokens at a plausible-looking rate — VERDICT r2 recorded
+    # 26 tok/s of pure first-tokens as the metric of record):
+    out["window_errors"] = float(err1 - err0)
+    finished = fin1 - fin0
+    if finished > 0:
+        out["mean_completion_tokens"] = (ftok1 - ftok0) / finished
+    out["window_finished"] = float(finished)
     if ttfts:
         out["p50_ttft_ms"] = statistics.median(ttfts)
         out["p95_ttft_ms"] = sorted(ttfts)[max(0, int(len(ttfts) * 0.95) - 1)]
         out["ttft_samples"] = float(len(ttfts))
     return out
+
+
+def serve_window_degenerate(
+    serve: dict[str, float], max_tokens: int, raw_error: bool
+) -> str:
+    """Why a serve window must NOT become the metric of record ('' = fine).
+
+    A broken decode path still completes prefills and emits exactly one
+    sampled token per request, so 'tok/s >= 1' is no guard at all. Refuse
+    the window when the engine errored requests inside it, when finished
+    requests averaged < max_tokens/4 completion tokens (healthy clients all
+    run to max_tokens — eos on random-init weights is ~never sampled; a real
+    checkpoint's early-stop still clears a quarter), or when the raw decode
+    sweep crashed in this same process (same kernels, same bug) AND the
+    serve window carries no completion evidence of its own — a window that
+    demonstrably ran full completions stands on its own merits (the raw
+    sweep's B=112 config can OOM-fail for reasons serve's B=80 never hits,
+    and run_raw's contract is that its failure must not eat the bench line)."""
+    if raw_error and serve.get("window_finished", 0.0) <= 0:
+        return "raw decode sweep errored and the window finished no requests"
+    if serve.get("window_errors", 0.0) > 0:
+        return f"{int(serve['window_errors'])} requests errored in the window"
+    mean_done = serve.get("mean_completion_tokens")
+    if mean_done is not None and mean_done < max_tokens / 4:
+        return (
+            f"finished requests averaged {mean_done:.1f} completion tokens"
+            f" (< max_tokens/4 = {max_tokens / 4:.0f}: decode is not running)"
+        )
+    return ""
 
 
 def main() -> None:
@@ -308,6 +351,7 @@ def main() -> None:
             import gc
 
             gc.collect()
+        bench_max_tokens = int(os.environ.get("BENCH_MAX_TOKENS", "256"))
         if os.environ.get("BENCH_SERVE", "1") != "0":
             # one retry: a transient chip hiccup can zero a whole window, and
             # a silently-recorded 0.0 would corrupt the metric of record
@@ -316,12 +360,13 @@ def main() -> None:
                     serve = serve_path_metrics(
                         model,
                         n_clients=B,
-                        max_tokens=int(os.environ.get("BENCH_MAX_TOKENS", "256")),
+                        max_tokens=bench_max_tokens,
                         measure_s=float(os.environ.get("BENCH_MEASURE_S", "30")),
                         max_slots=B,
                         max_seq_len=S,
                         decode_chunk=int(os.environ.get("BENCH_DECODE_CHUNK", "32")),
                         admit_batch=int(os.environ.get("BENCH_ADMIT_BATCH", "4")),
+                        decode_compact=os.environ.get("BENCH_DECODE_COMPACT", "auto"),
                     )
                 except Exception as e:  # never lose the bench line to a serve bug
                     secondary["serve_path_error"] = 0.0
@@ -341,6 +386,21 @@ def main() -> None:
                 import gc
 
                 gc.collect()
+        if serve:
+            # A window can "succeed" at a plausible rate with decode 100%
+            # broken (prefill first-tokens only). Refuse it loudly: the raw
+            # sweep becomes the headline if it ran; otherwise hard-fail so
+            # the driver records rc != 0 instead of a quiet garbage number.
+            reason = serve_window_degenerate(
+                serve, bench_max_tokens, "raw_decode_error" in secondary
+            )
+            if reason:
+                print(f"# serve window DEGENERATE ({reason}); refusing headline",
+                      flush=True)
+                secondary["serve_degenerate_tok_per_s"] = round(
+                    serve.get("tok_per_s", 0.0), 1
+                )
+                serve = {}
         if not serve and not raw_attempted:
             # serve disabled/failed and the raw sweep was never attempted:
             # it becomes the headline. (If it was attempted and FAILED, do
@@ -356,6 +416,11 @@ def main() -> None:
                 "vs_baseline": round(serve["tok_per_s"] / 2000.0, 3),
                 "p50_ttft_ms": round(serve.get("p50_ttft_ms", -1.0), 1),
                 "p95_ttft_ms": round(serve.get("p95_ttft_ms", -1.0), 1),
+                # health evidence: the degenerate-window guard's inputs
+                "window_errors": serve.get("window_errors", 0.0),
+                "mean_completion_tokens": round(
+                    serve.get("mean_completion_tokens", -1.0), 1
+                ),
             }
             if secondary:
                 line["secondary"] = secondary

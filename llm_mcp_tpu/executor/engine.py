@@ -396,6 +396,14 @@ class GenerationEngine:
         self.stats_lock = threading.Lock()
         self.total_tokens = 0
         self.total_requests = 0
+        # requests failed with an error event (poisoned rounds, failed
+        # prefills, cache loss) — bench.py refuses a serve window where this
+        # moved (a degenerate run must never become the metric of record)
+        self.total_errors = 0
+        # cleanly finished requests + their completion tokens: the ratio is
+        # the mean completion length, bench.py's decode-actually-ran guard
+        self.finished_requests = 0
+        self.finished_tokens = 0
         self._window: list[tuple[float, int]] = []  # (ts, tokens) for tps
 
     # -- jit builders ------------------------------------------------------
@@ -567,12 +575,14 @@ class GenerationEngine:
         per-slot state on device is gone."""
         for i, s in enumerate(self._slots):
             if s is not None:
+                self.total_errors += 1
                 s.req.out.put({"type": "error", "error": error})
                 s.req.out.put(_DONE)
                 self._slots[i] = None
                 self._lengths[i] = self.max_seq_len  # park (see __init__)
         for slot in list(self._prefills):
             st = self._prefills.pop(slot)
+            self.total_errors += 1
             st.req.out.put({"type": "error", "error": error})
             st.req.out.put(_DONE)
         self._prefill_q.clear()
@@ -600,6 +610,7 @@ class GenerationEngine:
                     for b in active:
                         s = self._slots[b]
                         if s is not None:
+                            self.total_errors += 1
                             s.req.out.put({"type": "error", "error": str(e)})
                             s.req.out.put(_DONE)
                             self._slots[b] = None
@@ -669,6 +680,7 @@ class GenerationEngine:
                     if s is not None and s.req is req:
                         self._slots[slot] = None
                         self._lengths[slot] = self.max_seq_len  # park
+                    self.total_errors += 1
                     req.out.put({"type": "error", "error": str(e)})
                     req.out.put(_DONE)
                 if self._recover_cache():
@@ -832,6 +844,7 @@ class GenerationEngine:
                     if s is not None and s.req is st.req:
                         self._slots[slot] = None
                         self._lengths[slot] = self.max_seq_len  # park
+                    self.total_errors += 1
                     st.req.out.put({"type": "error", "error": str(e)})
                     st.req.out.put(_DONE)
             if self._recover_cache():
@@ -987,6 +1000,11 @@ class GenerationEngine:
         if emit:
             req.out.put({"type": "token", "text": emit})
         if finish is not None:
+            # counters move BEFORE the done/_DONE events publish: a caller
+            # unblocked by the queue must never observe stale counters
+            with self.stats_lock:
+                self.finished_requests += 1
+                self.finished_tokens += s.generated
             req.out.put(
                 {
                     "type": "done",
