@@ -116,10 +116,10 @@ def serve_path_metrics(
     request POST to the first SSE content delta, over requests *started*
     inside the window (so compile warmup never pollutes it).
     """
-    import json as _json
     import statistics
+    import subprocess
+    import sys
     import threading
-    import urllib.request
 
     import jax
     import jax.numpy as jnp
@@ -150,88 +150,73 @@ def serve_path_metrics(
     # bucket (a 268-token prompt pads to 512 and doubles admission cost)
     prompt = "benchmark the serving path end to end with a realistic chat turn. " * 3
 
-    stop = threading.Event()
-    lock = threading.Lock()
-    ttft_records: list[tuple[float, float]] = []  # (t_post, t_first_delta)
-    warmed: set[int] = set()  # client ids with >= 1 full round-trip behind them
-
-    def client(cid: int) -> None:
-        body = _json.dumps(
-            {
-                "model": model,
-                "stream": True,
-                "max_tokens": max_tokens,
-                "temperature": 0.7,
-                "messages": [{"role": "user", "content": prompt}],
-            }
-        ).encode()
-        while not stop.is_set():
-            req = urllib.request.Request(
-                url, data=body, headers={"Content-Type": "application/json"}
-            )
-            t0 = time.perf_counter()
-            first = None
-            try:
-                with urllib.request.urlopen(req, timeout=warmup_timeout_s) as resp:
-                    for raw in resp:
-                        line = raw.decode("utf-8", "replace").strip()
-                        if not line.startswith("data:"):
-                            continue
-                        payload = line[5:].strip()
-                        if payload == "[DONE]":
-                            break
-                        if first is None:
-                            evt = _json.loads(payload)
-                            if evt["choices"][0]["delta"].get("content"):
-                                first = time.perf_counter()
-                                # record AT first-delta time: a request whose
-                                # stream outlives the window must still land
-                                # in the percentiles (no survivorship bias)
-                                with lock:
-                                    ttft_records.append((t0, first))
-            except Exception as e:
-                if stop.is_set():
-                    return
-                # a transient HTTP/SSE error must not kill the client for the
-                # whole run (the headline would silently measure fewer
-                # clients) — log, back off, retry
-                print(f"# bench client {cid} request failed: {e!r}", flush=True)
-                time.sleep(0.5)
-                continue
-            with lock:
-                warmed.add(cid)
-
-    threads = [
-        threading.Thread(target=client, args=(i,), daemon=True)
-        for i in range(n_clients)
+    # Clients run in SEPARATE PROCESSES (the --client-proc mode below, pure
+    # stdlib, no jax import): real clients are remote, and 80 in-process
+    # SSE-parsing threads contend the server's GIL hard enough to become
+    # the bottleneck being measured (~20% at 8B B=80). 4 procs x B/4
+    # threads keeps any one client process from saturating its own GIL.
+    nprocs = min(4, n_clients)
+    sizes = [n_clients // nprocs + (1 if i < n_clients % nprocs else 0)
+             for i in range(nprocs)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--client-proc",
+             url, str(sz), str(max_tokens), model, prompt],
+            stdout=subprocess.PIPE, text=True,
+            env={k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"},
+        )
+        for sz in sizes
     ]
+    lock = threading.Lock()
+    ttft_records: list[tuple[float, float]] = []  # (t_post, t_first) epoch s
+    warmed: list[int] = []  # procs whose every client has a round-trip done
+
+    def reader(p: subprocess.Popen) -> None:
+        for line in p.stdout:
+            try:
+                if line.startswith("TTFT "):
+                    parts = line.split()
+                    with lock:
+                        ttft_records.append((float(parts[1]), float(parts[2])))
+                elif line.startswith("WARMED"):
+                    with lock:
+                        warmed.append(1)
+                elif line.startswith("#"):
+                    print(line.rstrip(), flush=True)
+            except (ValueError, IndexError):
+                # concurrent client threads can interleave stdout lines;
+                # a mangled record is dropped, never fatal to the reader
+                pass
+
+    readers = [threading.Thread(target=reader, args=(p,), daemon=True) for p in procs]
     t_start = time.perf_counter()
-    for t in threads:
+    for t in readers:
         t.start()
-    # Warmup: every DISTINCT client has a full round-trip behind it (all
-    # executables compiled, slots saturated) — a few fast clients looping
-    # must not open the window early.
+    # Warmup: every client in every process has a full round-trip behind it
+    # (all executables compiled, slots saturated) — a few fast clients
+    # looping must not open the window early.
     while time.perf_counter() - t_start < warmup_timeout_s:
         with lock:
-            if len(warmed) >= n_clients:
+            if len(warmed) >= nprocs:
                 break
         time.sleep(0.25)
 
     with eng.stats_lock:
         tok0, err0 = eng.total_tokens, eng.total_errors
         fin0, ftok0 = eng.finished_requests, eng.finished_tokens
-    m0 = time.perf_counter()
+    m0 = time.time()
     time.sleep(measure_s)
     with eng.stats_lock:
         tok1, err1 = eng.total_tokens, eng.total_errors
         fin1, ftok1 = eng.finished_requests, eng.finished_tokens
-    m1 = time.perf_counter()
+    m1 = time.time()
     # settle BEFORE stopping: requests POSTed near the window end whose first
     # delta is still pending are exactly the tail the p95 must capture —
     # cutting here would right-censor the percentiles low. Scaled so tiny
     # CPU smokes don't pay the full 8B-tail allowance.
     time.sleep(min(8.0, max(1.0, measure_s)))
-    stop.set()
+    for p in procs:
+        p.terminate()
     with lock:
         ttfts = [
             (first - t0) * 1000.0
@@ -460,5 +445,102 @@ def main() -> None:
     print(json.dumps(line))
 
 
+def client_proc(url: str, n: int, max_tokens: int, model: str, prompt: str) -> None:
+    """Bench client worker (separate process, pure stdlib — never imports
+    jax): loops streaming chat requests, prints `TTFT <post_epoch>
+    <first_delta_epoch>` per request and `WARMED` once every client thread
+    has a full round-trip behind it. Runs until terminated by the parent."""
+    import json as _json
+    import sys as _sys
+    import threading
+    import urllib.request
+
+    lock = threading.Lock()
+    warmed: set[int] = set()
+    announced = [False]
+    body = _json.dumps(
+        {
+            "model": model,
+            "stream": True,
+            "max_tokens": max_tokens,
+            "temperature": 0.7,
+            "messages": [{"role": "user", "content": prompt}],
+        }
+    ).encode()
+
+    def client(cid: int) -> None:
+        while True:
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type": "application/json"}
+            )
+            t0 = time.time()
+            first = None
+            try:
+                with urllib.request.urlopen(req, timeout=900.0) as resp:
+                    for raw in resp:
+                        line = raw.decode("utf-8", "replace").strip()
+                        if not line.startswith("data:"):
+                            continue
+                        payload = line[5:].strip()
+                        if payload == "[DONE]":
+                            break
+                        if first is None:
+                            evt = _json.loads(payload)
+                            if evt["choices"][0]["delta"].get("content"):
+                                first = time.time()
+                                # report AT first-delta time: a request whose
+                                # stream outlives the window must still land
+                                # in the percentiles (no survivorship bias).
+                                # single write + flush: concurrent client
+                                # threads must not interleave mid-line
+                                _sys.stdout.write(f"TTFT {t0} {first}\n")
+                                _sys.stdout.flush()
+            except Exception as e:
+                # a transient HTTP/SSE error must not kill the client for
+                # the whole run — log, back off, retry
+                print(f"# bench client {cid} request failed: {e!r}", flush=True)
+                time.sleep(0.5)
+                continue
+            with lock:
+                warmed.add(cid)
+                if len(warmed) >= n and not announced[0]:
+                    announced[0] = True
+                    print("WARMED", flush=True)
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True) for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()  # run until the parent terminates us
+
+
+def _exit_now(code: int) -> None:
+    """Hard-exit after the bench line printed: lingering TPU-runtime/client
+    threads (SSE handlers mid-stream, the tunnel's native threads) can abort
+    the interpreter during normal teardown (observed: 'FATAL: exception not
+    rethrown', rc=134 AFTER a successful line) — the driver must see the rc
+    that matches what was printed."""
+    import sys as _s
+
+    _s.stdout.flush()
+    _s.stderr.flush()
+    os._exit(code)
+
+
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+
+    if len(_sys.argv) > 1 and _sys.argv[1] == "--client-proc":
+        client_proc(
+            _sys.argv[2], int(_sys.argv[3]), int(_sys.argv[4]),
+            _sys.argv[5], _sys.argv[6],
+        )
+    else:
+        try:
+            main()
+        except SystemExit as e:
+            print(f"# bench failed: {e}", flush=True)
+            _exit_now(1)
+        _exit_now(0)

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import logging
+import sqlite3
 import time
 import uuid
 from typing import Any
@@ -307,13 +308,19 @@ class InferenceAPI:
             self.metrics.chat_tokens.labels(model=model, provider=provider, direction="in").inc(tin)
         if tout:
             self.metrics.chat_tokens.labels(model=model, provider=provider, direction="out").inc(tout)
-        cost = self.catalog.record_cost(model, provider, tin, tout)
-        if cost:
-            self.metrics.chat_cost_usd.labels(model=model, provider=provider).inc(cost)
-        self.catalog.update_model_stats(
-            model, tokens_in=tin, tokens_out=tout, cost_usd=cost,
-            duration_ms=dt * 1000.0, error=not ok,
-        )
+        try:
+            cost = self.catalog.record_cost(model, provider, tin, tout)
+            if cost:
+                self.metrics.chat_cost_usd.labels(model=model, provider=provider).inc(cost)
+            self.catalog.update_model_stats(
+                model, tokens_in=tin, tokens_out=tout, cost_usd=cost,
+                duration_ms=dt * 1000.0, error=not ok,
+            )
+        except sqlite3.ProgrammingError:
+            # server shutdown closed the DB while this handler's stream was
+            # still finishing — the client already has its [DONE]; dropping
+            # the post-hoc stats row beats crashing the handler
+            log.debug("stats recording skipped: database closed mid-shutdown")
 
     # -- embeddings --------------------------------------------------------
 

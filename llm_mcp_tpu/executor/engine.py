@@ -95,6 +95,34 @@ class _Slot:
     pending: bytes = b""
     prompt_len: int = 0
     first_token_at: float = 0.0
+    # lifecycle flags for the pipelined decode loop: emission of a round can
+    # run AFTER the slot's table entry was freed (fast finish-scan) or
+    # errored (abort) — both must stop any later deferred emission for this
+    # request (the consumer already received its terminal event)
+    done: bool = False
+    aborted: bool = False
+
+
+@dataclass
+class _DispatchedRound:
+    """A decode round in flight on device: dispatched, not yet fetched.
+    `entries` pins (slot index, slot OBJECT, out column) at dispatch time —
+    by fetch time the table entry may hold None or a different request, and
+    identity decides whether the column's tokens still belong to anyone."""
+
+    out: Any  # device array [K, Ba] (un-fetched)
+    entries: list  # [(b, _Slot, col)]
+    base: Any  # np lengths snapshot at dispatch
+    t0: float
+
+
+@dataclass
+class _PendingRound:
+    """A fetched decode round awaiting (deferred) emission."""
+
+    out: Any  # np [K, Ba]
+    entries: list  # [(b, _Slot, col)]
+    base: Any
 
 
 @dataclass
@@ -322,8 +350,7 @@ class GenerationEngine:
 
             log.info("sequence-parallel prefill enabled: sp=%d", self.sp)
 
-            @jax.jit
-            def prefill_fn(params, tokens, lengths):
+            def _prefill_body(params, tokens, lengths):
                 logits, ks, vs = llama_prefill_sp(cfg_, params, tokens, lengths, mesh)
                 ks, vs = _maybe_quant_kv(ks, vs)
                 return logits, ks, vs
@@ -335,18 +362,17 @@ class GenerationEngine:
             # quant_kv quantizes per layer INSIDE the prefill scan: the
             # stacked bf16 prompt KV of a batched admission never
             # materializes (llama_prefill docstring).
-            @jax.jit
-            def prefill_fn(params, tokens, lengths):
+            def _prefill_body(params, tokens, lengths):
                 return llama_prefill(
                     cfg_, params, tokens, lengths, attn_impl=impl, quant_kv=kv_q
                 )
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def insert_fn(ck, cv, ks, vs, i, slot):
+        def _insert_row(ck, cv, ks, vs, i, slot):
             # ks/vs: batched prompt KV [L, A, Hkv, bucket, hd] (already int8
             # {"q","s"} when the cache is) → write row `i` at
-            # [:, slot, :, :bucket]. `i`/`slot` are traced scalars, so one
-            # executable per (A, bucket) serves every admission.
+            # [:, slot, :, :bucket]. `i`/`slot` are traced scalars; the
+            # dynamic_update_slice form updates the donated cache in place
+            # (an advanced-index scatter would copy the full cache payload).
             if kv_q:
                 ck = {
                     "q": jax.lax.dynamic_update_slice(
@@ -377,15 +403,76 @@ class GenerationEngine:
             cv = jax.lax.dynamic_update_slice(cv, vr.astype(cv.dtype), (0, slot, 0, 0, 0))
             return ck, cv
 
+        mask_ = self._allowed_mask
+        base_key_ = self._base_key
+
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5))
+        def admit_fn(params, ck, cv, d_temp, d_topk, d_topp, tokens, ipack, fpack):
+            """Fused admission: prefill + cache insert + sampling-param
+            update + first-token sample in ONE dispatch.
+
+            The unfused form cost ~9+3A host<->device round trips per
+            admission batch (separate transfers for every small array, a
+            dispatch per cache-row insert, a sync for the sampled tokens) —
+            on a remote-TPU tunnel each trip is tens of ms and admission
+            dominated the serve loop (measured 56% of wall at 8B B=80).
+            Fused: tokens + 2 packed arrays up, one dispatch, one [Ab]
+            fetch.
+
+            ipack i32 [3*Ab+2]: slots, prompt lengths, top_k, A (live row
+            count), rng counter. fpack f32 [2*Ab]: temperature, top_p.
+            """
+            Ab = tokens.shape[0]
+            slots = ipack[:Ab]
+            lengths = ipack[Ab : 2 * Ab]
+            topks = ipack[2 * Ab : 3 * Ab]
+            live_n = ipack[3 * Ab]
+            counter = ipack[3 * Ab + 1]
+            temps = fpack[:Ab]
+            topps = fpack[Ab:]
+
+            logits, ks, vs = _prefill_body(params, tokens, lengths)
+
+            def body(i, cc):
+                ck, cv = cc
+                # pad rows (i >= live_n) duplicate garbage prompts — they
+                # must not write ANY cache row
+                return jax.lax.cond(
+                    i < live_n,
+                    lambda cc: _insert_row(cc[0], cc[1], ks, vs, i, slots[i]),
+                    lambda cc: cc,
+                    (ck, cv),
+                )
+
+            ck, cv = jax.lax.fori_loop(0, Ab, body, (ck, cv))
+            # sampling params live ON DEVICE between rounds (decode gathers
+            # them by slot id — never re-transferred per round). Pad rows
+            # scatter to row B: out of bounds, dropped (the same invariant
+            # the KV parking relies on).
+            row = jnp.where(jnp.arange(Ab) < live_n, slots, d_temp.shape[0])
+            d_temp = d_temp.at[row].set(temps)
+            d_topk = d_topk.at[row].set(topks)
+            d_topp = d_topp.at[row].set(topps)
+            if mask_ is not None:
+                logits = jnp.where(mask_, logits, -jnp.inf)
+            key = jax.random.fold_in(base_key_, counter)
+            toks0 = sample_tokens(logits, key, temps, topks, topps)
+            return ck, cv, d_temp, d_topk, d_topp, toks0
+
         @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("skey",))
         def prefill_chunk_fn(params, ck, cv, tokens, slots, starts, nvalid, skey):
             return llama_prefill_chunk_batch(
                 cfg_, params, ck, cv, tokens, slots, starts, nvalid, skey=skey
             )
 
-        self._prefill_fn = prefill_fn
-        self._insert_fn = insert_fn
+        self._admit_fn = admit_fn
         self._prefill_chunk_fn = prefill_chunk_fn
+        # device-resident sampling params (see admit_fn docstring); host
+        # mirrors (self._temp/_topk/_topp) stay the source of truth for
+        # rebuild after a poisoned dispatch consumed the donated buffers
+        self._d_temp = jnp.asarray(self._temp)
+        self._d_topk = jnp.asarray(self._topk)
+        self._d_topp = jnp.asarray(self._topp)
 
         self._admit: "queue.Queue[GenRequest]" = queue.Queue()
         self._stop_evt = threading.Event()
@@ -413,14 +500,35 @@ class GenerationEngine:
         K = self.decode_chunk
         mask = self._allowed_mask
         impl = self.decode_impl
+        base_key = self._base_key
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def decode_chunk_fn(
-            params, ck, cv, tokens, lengths, slot_ids, rng, temp, topk, topp
-        ):
-            # slot_ids None = full batch (row b serves cache row b); an array
-            # = COMPACT batch (row i serves cache row slot_ids[i]) — the slot
-            # compaction path (_decode_round). One trace per (shape, mode).
+        @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("compact",))
+        def decode_chunk_fn(params, ck, cv, packed, d_temp, d_topk, d_topp, compact):
+            """One decode round (K fused steps).
+
+            All per-round host inputs ride ONE packed i32 transfer (on a
+            remote-TPU tunnel every separate transfer/dispatch is tens of
+            ms): compact → [tokens | lengths | slot_ids | counter]
+            (3*Ba+1), full → [tokens | lengths | counter] (2*B+1). The RNG
+            key derives from the counter on device; sampling params are the
+            device-resident arrays, gathered by slot id on the compact path
+            (row i serves cache row slot_ids[i] — _dispatch_decode)."""
+            if compact:
+                Ba = (packed.shape[0] - 1) // 3
+                tokens = packed[:Ba]
+                lengths = packed[Ba : 2 * Ba]
+                slot_ids = packed[2 * Ba : 3 * Ba]
+                temp = d_temp[slot_ids]
+                topk = d_topk[slot_ids]
+                topp = d_topp[slot_ids]
+            else:
+                Ba = (packed.shape[0] - 1) // 2
+                tokens = packed[:Ba]
+                lengths = packed[Ba : 2 * Ba]
+                slot_ids = None
+                temp, topk, topp = d_temp, d_topk, d_topp
+            rng = jax.random.fold_in(base_key, packed[-1])
+
             def step(carry, _):
                 ck, cv, toks, lens, rng = carry
                 logits, ck, cv = llama_decode_step(
@@ -440,9 +548,15 @@ class GenerationEngine:
 
         return decode_chunk_fn
 
-    def _next_key(self):
+    def _next_counter(self) -> int:
+        """RNG stream position. The hot paths ship the counter inside their
+        packed int transfer and fold it into the base key ON DEVICE — a
+        host-side fold_in is one more dispatch per round."""
         self._rng_counter += 1
-        return jax.random.fold_in(self._base_key, self._rng_counter)
+        return self._rng_counter
+
+    def _next_key(self):
+        return jax.random.fold_in(self._base_key, self._next_counter())
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -550,12 +664,19 @@ class GenerationEngine:
         raises); without this every later round would see a deleted Array.
         Returns True when a re-allocation happened (all slot KV was lost)."""
         try:
-            leaves = jax.tree.leaves({"k": self._ck, "v": self._cv})
+            leaves = jax.tree.leaves(
+                {"k": self._ck, "v": self._cv, "p": (self._d_temp, self._d_topk, self._d_topp)}
+            )
             deleted = any(x.is_deleted() for x in leaves)
         except AttributeError:
             deleted = False
         if not deleted:
             return False
+        # the device sampling rows are also donated (admit_fn); host mirrors
+        # are the source of truth, so rebuilding them is lossless
+        self._d_temp = jnp.asarray(self._temp)
+        self._d_topk = jnp.asarray(self._topk)
+        self._d_topp = jnp.asarray(self._topp)
         log.warning("KV cache buffers were donated into a failed dispatch; re-allocating")
         cache = init_kv_cache(
             self.cfg, self.max_slots, self.max_seq_len, dtype=self.dtype,
@@ -575,6 +696,7 @@ class GenerationEngine:
         per-slot state on device is gone."""
         for i, s in enumerate(self._slots):
             if s is not None:
+                s.aborted = True
                 self.total_errors += 1
                 s.req.out.put({"type": "error", "error": error})
                 s.req.out.put(_DONE)
@@ -596,31 +718,76 @@ class GenerationEngine:
         return None
 
     def _run(self) -> None:
+        """Pipelined decode loop (depth 1): the next decode round is DISPATCHED
+        before the previous round's tokens are emitted, so host-side work —
+        token emission (tokenizer + queue puts, the dominant host cost at
+        8B B=80), admissions, prefill dispatches — overlaps the device
+        compute instead of serializing with it (measured: the serialized
+        loop idled the chip down to ~2.0k tok/s against a 4.8k raw decode
+        loop; the reference never faces this — Ollama owns its hot loop).
+
+        Order within one iteration:
+          1. dispatch round N (device starts; active set from round N-1's
+             fast finish-scan, so finished slots never decode an extra round)
+          2. emit round N-1's tokens (overlapped with 1's device time)
+          3. admissions + chunked prefill (their dispatches queue behind
+             round N on the stream — the device never goes idle)
+          4. fetch round N; fast finish-scan frees finishing slots and
+             advances host mirrors (emission itself is deferred to the next
+             iteration's step 2)
+        """
+        pending: _PendingRound | None = None
         while not self._stop_evt.is_set():
+            active = [i for i, s in enumerate(self._slots) if s is not None]
+            disp: _DispatchedRound | None = None
+            if active:
+                try:
+                    disp = self._dispatch_decode(active)
+                except Exception as e:  # a poisoned dispatch must not kill the loop
+                    if pending is not None:
+                        # deliver round N-1's already-fetched tokens BEFORE
+                        # the error events — _fail_round marks these same
+                        # slot objects aborted, which would silently drop
+                        # up to K computed tokens per stream
+                        self._emit_round(pending)
+                        pending = None
+                    self._fail_round(active, e)
+            if pending is not None:
+                self._emit_round(pending)
+                pending = None
             admitted = self._admit_pending()
             # One bounded prefill chunk per iteration: admission work
             # interleaves with decode rounds instead of stalling them.
             prefilled = self._prefill_round()
-            active = [i for i, s in enumerate(self._slots) if s is not None]
-            if active:
+            if disp is not None:
                 try:
-                    self._decode_round(active)
-                except Exception as e:  # a poisoned round must not kill the loop
-                    log.exception("decode round failed; failing %d active slots", len(active))
-                    for b in active:
-                        s = self._slots[b]
-                        if s is not None:
-                            self.total_errors += 1
-                            s.req.out.put({"type": "error", "error": str(e)})
-                            s.req.out.put(_DONE)
-                            self._slots[b] = None
-                            self._lengths[b] = self.max_seq_len  # park
-                    if self._recover_cache():
-                        # mid-prefill KV lives in the same buffers
-                        self._abort_all("kv cache lost in failed decode round")
-            elif not (admitted or prefilled):
+                    pending = self._complete_round(disp)
+                except Exception as e:  # poisoned execution surfaces at fetch
+                    self._fail_round(
+                        [b for b, s, _ in disp.entries if self._slots[b] is s], e
+                    )
+            elif not (active or admitted or prefilled):
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
+        if pending is not None:
+            # flush the deferred emission: consumers of slots the fast-scan
+            # already freed would otherwise never see their done event
+            self._emit_round(pending)
+
+    def _fail_round(self, slots: list[int], e: Exception) -> None:
+        log.exception("decode round failed; failing %d active slots", len(slots))
+        for b in slots:
+            s = self._slots[b]
+            if s is not None:
+                s.aborted = True
+                self.total_errors += 1
+                s.req.out.put({"type": "error", "error": str(e)})
+                s.req.out.put(_DONE)
+                self._slots[b] = None
+                self._lengths[b] = self.max_seq_len  # park
+        if self._recover_cache():
+            # mid-prefill KV lives in the same buffers
+            self._abort_all("kv cache lost in failed decode round")
 
     def _admit_pending(self) -> bool:
         admitted = False
@@ -698,26 +865,31 @@ class GenerationEngine:
         Ab = 1 << (A - 1).bit_length()  # pow2 pad: bounded executable count
         bucket = self._bucket(max(len(ids) for _, _, ids in batch))
         tokens = np.zeros((Ab, bucket), dtype=np.int32)
-        lengths = np.ones((Ab,), dtype=np.int32)  # dummy rows: 1 harmless token
-        for i, (_, _, ids) in enumerate(batch):
-            tokens[i, : len(ids)] = ids
-            lengths[i] = len(ids)
-
-        logits, ks, vs = self._prefill_fn(self.params, tokens, lengths)
-        pad = Ab - A
-        temps = np.array(
-            [r.temperature for _, r, _ in batch] + [0.0] * pad, dtype=np.float32
-        )
-        topks = np.array([r.top_k for _, r, _ in batch] + [0] * pad, dtype=np.int32)
-        topps = np.array([r.top_p for _, r, _ in batch] + [1.0] * pad, dtype=np.float32)
-        toks = np.asarray(
-            self._sample1(logits, self._next_key(), temps, topks, topps)
-        )
+        ipack = np.zeros((3 * Ab + 2,), dtype=np.int32)
+        fpack = np.zeros((2 * Ab,), dtype=np.float32)
+        ipack[Ab : 2 * Ab] = 1  # dummy rows: 1 harmless token
+        fpack[Ab:] = 1.0  # top_p
         for i, (slot, req, ids) in enumerate(batch):
-            self._ck, self._cv = self._insert_fn(
-                self._ck, self._cv, ks, vs, np.int32(i), np.int32(slot)
+            tokens[i, : len(ids)] = ids
+            ipack[i] = slot
+            ipack[Ab + i] = len(ids)
+            ipack[2 * Ab + i] = req.top_k
+            fpack[i] = req.temperature
+            fpack[Ab + i] = req.top_p
+        ipack[3 * Ab] = A
+        ipack[3 * Ab + 1] = self._next_counter()
+        # ONE fused dispatch: prefill + cache inserts + device sampling-param
+        # rows + first-token sample (see admit_fn)
+        self._ck, self._cv, self._d_temp, self._d_topk, self._d_topp, toks0 = (
+            self._admit_fn(
+                self.params, self._ck, self._cv,
+                self._d_temp, self._d_topk, self._d_topp,
+                jnp.asarray(tokens), jnp.asarray(ipack), jnp.asarray(fpack),
             )
-            self._activate_state(slot, req, len(ids), int(toks[i]))
+        )
+        toks0 = np.asarray(toks0)
+        for i, (slot, req, ids) in enumerate(batch):
+            self._activate_state(slot, req, len(ids), int(toks0[i]))
 
     def _activate(self, slot: int, req: GenRequest, P: int, logits) -> None:
         """Sample the first token from prefill logits [1, V] and switch the
@@ -729,6 +901,12 @@ class GenerationEngine:
             jnp.array([req.top_k], dtype=jnp.int32),
             jnp.array([req.top_p], dtype=jnp.float32),
         )
+        # the chunked path bypasses admit_fn, so the device-resident
+        # sampling rows update here (three tiny dispatches per LONG-prompt
+        # activation only; short prompts ride the fused admit_fn)
+        self._d_temp = self._d_temp.at[slot].set(req.temperature)
+        self._d_topk = self._d_topk.at[slot].set(req.top_k)
+        self._d_topp = self._d_topp.at[slot].set(req.top_p)
         self._activate_state(slot, req, P, int(np.asarray(tok0)[0]))
 
     def _activate_state(self, slot: int, req: GenRequest, P: int, tok0: int) -> None:
@@ -742,7 +920,7 @@ class GenerationEngine:
         with self.stats_lock:
             self.total_requests += 1
         # tok0's KV will be written at position P in the first decode round.
-        self._emit_token(slot, tok0, pos=P - 1)
+        self._emit_token(slot, s, tok0, pos=P - 1)
 
     def _prefill_round(self) -> bool:
         """Run chunked-prefill work for mid-prefill slots, bounded by roughly
@@ -850,7 +1028,9 @@ class GenerationEngine:
             if self._recover_cache():
                 self._abort_all("kv cache lost in failed prefill chunk")
 
-    def _decode_round(self, active: list[int]) -> None:
+    def _dispatch_decode(self, active: list[int]) -> _DispatchedRound:
+        """Phase 1: stage host inputs and dispatch one decode round (NO
+        fetch — the returned round is in flight on device)."""
         # chaos site: a failed round must fail active slots with error
         # events, not hang callers (the poisoned-round guard in _run)
         maybe_fail("engine.decode", f"active={len(active)}")
@@ -887,72 +1067,139 @@ class GenerationEngine:
             lens_in[:nact] = self._lengths[act]
             toks = np.zeros(Ba, dtype=np.int32)
             toks[:nact] = self._last_tok[act]
-            temp = np.zeros(Ba, dtype=np.float32)
-            temp[:nact] = self._temp[act]
-            topk = np.zeros(Ba, dtype=np.int32)
-            topk[:nact] = self._topk[act]
-            topp = np.ones(Ba, dtype=np.float32)
-            topp[:nact] = self._topp[act]
-            slot_ids = jnp.asarray(ids)
+            # ONE packed transfer per round (see decode_chunk_fn docstring)
+            packed = np.concatenate(
+                [toks, lens_in, ids, [self._next_counter()]]
+            ).astype(np.int32)
         else:
-            lens_in, toks = self._lengths, self._last_tok
-            temp, topk, topp = self._temp, self._topk, self._topp
-            slot_ids = None
+            packed = np.concatenate(
+                [self._last_tok, self._lengths, [self._next_counter()]]
+            ).astype(np.int32)
         out, self._ck, self._cv = self._decode_fn(
             self.params,
             self._ck,
             self._cv,
-            jnp.asarray(toks),
-            jnp.asarray(lens_in),
-            slot_ids,
-            self._next_key(),
-            jnp.asarray(temp),
-            jnp.asarray(topk),
-            jnp.asarray(topp),
+            jnp.asarray(packed),
+            self._d_temp,
+            self._d_topk,
+            self._d_topp,
+            compact=compact,
         )
-        out = np.asarray(out)  # [K, Ba] — the only host sync per chunk
+        entries = [
+            (b, self._slots[b], (i if compact else b)) for i, b in enumerate(active)
+        ]
+        return _DispatchedRound(
+            out=out, entries=entries, base=self._lengths.copy(), t0=round_t0
+        )
+
+    def _complete_round(self, disp: _DispatchedRound) -> _PendingRound:
+        """Phase 2 (the per-round sync point): fetch the round, fast-scan
+        finishes so the NEXT dispatch excludes finishing slots, and advance
+        the host mirrors. Token emission is deferred (_emit_round) so it
+        overlaps the next round's device time.
+
+        The fast-scan duplicates ONLY _emit_token's counter-based finish
+        rules (eos, max_tokens, seq-len cap) — a strict SUBSET of emission's
+        rules (which add stop sequences), so a fast-scan finish always
+        implies an emission finish on the same tokens; emission stays
+        authoritative for events, usage, and text."""
+        out = np.asarray(disp.out)  # [K, Ba] — the only host sync per round
         # drives the chunked-prefill budget (_prefill_round): a smoothed
         # decode-round time keeps admission work ≈ one round per round
         self._last_decode_s = 0.7 * self._last_decode_s + 0.3 * (
-            time.perf_counter() - round_t0
+            time.perf_counter() - disp.t0
         )
         K = out.shape[0]
-        # Device advanced every active slot K steps; mirror that, then
-        # process tokens against their true per-token cache positions.
-        # Parked rows stay pinned at exactly max_seq_len (drifting past it
-        # would eventually wrap int32 back into [0, S) and break the
-        # OOB-drop parking invariant — see __init__); active rows never
-        # legitimately exceed it (finish condition in _emit_token).
-        base = self._lengths.copy()
-        act_ix = np.asarray(active, dtype=np.intp)
-        self._lengths[act_ix] += K
-        np.minimum(self._lengths, self.max_seq_len, out=self._lengths)
-        if compact:
-            self._last_tok[act_ix] = out[-1, :nact]
-        else:
-            self._last_tok = out[-1].copy()
-        before = self.total_tokens  # _emit_token counts delivered tokens
-        for i, b in enumerate(active):
-            s = self._slots[b]
-            if s is None:
-                continue
-            col = i if compact else b
+        S = self.max_seq_len
+        eos = self.tokenizer.eos_id
+        # Device advanced every dispatched row K steps; mirror that for rows
+        # still owned by the SAME request (identity check: a slot freed by a
+        # stop-sequence finish and re-admitted between dispatch and fetch
+        # owns its new lengths — never touch them). Parked rows stay pinned
+        # at exactly max_seq_len (drifting past it would eventually wrap
+        # int32 back into [0, S) and break the OOB-drop parking invariant —
+        # see __init__).
+        for b, s, col in disp.entries:
+            if self._slots[b] is not s:
+                continue  # freed (and possibly re-admitted) since dispatch
+            g = s.generated
+            fin = False
+            base_b = int(disp.base[b])
             for k in range(K):
-                if not self._emit_token(b, int(out[k, col]), pos=int(base[b]) + k):
+                if int(out[k, col]) == eos:
+                    fin = True
                     break
+                g += 1
+                if g >= s.req.max_tokens:
+                    fin = True
+                    break
+                if base_b + k + 1 + K > S:
+                    fin = True
+                    break
+            if fin:
+                # free NOW: the next dispatch must exclude this slot and
+                # admission may reuse it immediately; the deferred emission
+                # delivers its events from the pinned slot object
+                self._slots[b] = None
+                self._lengths[b] = S  # park
+            else:
+                self._lengths[b] = min(base_b + K, S)
+                self._last_tok[b] = out[-1, col]
+        return _PendingRound(out=out, entries=disp.entries, base=disp.base)
+
+    def _emit_round(self, p: _PendingRound) -> None:
+        """Phase 3 (deferred, overlapped with the next round's device time):
+        decode token text, deliver events, finalize usage/finishes."""
+        K = p.out.shape[0]
+        before = self.total_tokens  # _process_token counts delivered tokens
+        for b, s, col in p.entries:
+            if s.done or s.aborted:
+                continue  # terminal event already delivered
+            parts: list[str] = []
+            finish = None
+            base_b = int(p.base[b])
+            for k in range(K):
+                emit, finish = self._process_token(s, int(p.out[k, col]), base_b + k)
+                if emit:
+                    parts.append(emit)
+                if finish is not None:
+                    break
+            if parts:
+                # ONE coalesced text event per slot per round: the K tokens
+                # were all learned at the same fetch, so splitting them into
+                # K queue events (and K SSE frames) adds overhead with zero
+                # client-visible timing difference
+                s.req.out.put({"type": "token", "text": "".join(parts)})
+            if finish is not None:
+                self._finish_slot(b, s, finish)
         with self.stats_lock:
             self._window.append((time.time(), self.total_tokens - before))
 
-    def _emit_token(self, slot_idx: int, tok: int, pos: int) -> bool:
+    def _emit_token(self, slot_idx: int, s: _Slot, tok: int, pos: int) -> bool:
         """Append one token to a slot; returns False when the slot finished.
 
         `pos` is the cache position this token's KV occupies (or will occupy,
         for the prefill's first sample). The slot must finish while the next
         decode chunk's K writes still fit: pos+1+K ≤ max_seq_len.
-        """
-        s = self._slots[slot_idx]
-        if s is None:
+
+        `s` is the slot OBJECT captured at dispatch time: under the
+        pipelined loop the table entry may already be freed (fast
+        finish-scan) or re-owned by a newer request — table mutations are
+        identity-guarded (_finish_slot)."""
+        emit, finish = self._process_token(s, tok, pos)
+        if emit:
+            s.req.out.put({"type": "token", "text": emit})
+        if finish is not None:
+            self._finish_slot(slot_idx, s, finish)
             return False
+        return True
+
+    def _process_token(self, s: _Slot, tok: int, pos: int) -> tuple[str, str | None]:
+        """Advance one slot by one token WITHOUT delivering events: returns
+        (text to emit, finish_reason | None). Event delivery is the caller's
+        job so _emit_round can coalesce a whole round's text into ONE queue
+        event per slot — the engine only learns tokens once per round, so
+        per-token events add queue/SSE overhead with zero timing benefit."""
         req = s.req
         finish = None
         emit = ""
@@ -997,28 +1244,33 @@ class GenerationEngine:
             if cut == -1:
                 emit += self.tokenizer.decode_flush(s.pending)
             s.pending = b""
-        if emit:
-            req.out.put({"type": "token", "text": emit})
-        if finish is not None:
-            # counters move BEFORE the done/_DONE events publish: a caller
-            # unblocked by the queue must never observe stale counters
-            with self.stats_lock:
-                self.finished_requests += 1
-                self.finished_tokens += s.generated
-            req.out.put(
-                {
-                    "type": "done",
-                    "finish_reason": finish,
-                    "usage": {
-                        "prompt_tokens": s.prompt_len,
-                        "completion_tokens": s.generated,
-                        "total_tokens": s.prompt_len + s.generated,
-                    },
-                    "ttft_ms": (s.first_token_at - req.created_at) * 1000.0,
-                }
-            )
-            req.out.put(_DONE)
+        return emit, finish
+
+    def _finish_slot(self, slot_idx: int, s: _Slot, finish: str) -> None:
+        """Deliver a slot's terminal events and release its table entry."""
+        req = s.req
+        s.done = True
+        # counters move BEFORE the done/_DONE events publish: a caller
+        # unblocked by the queue must never observe stale counters
+        with self.stats_lock:
+            self.finished_requests += 1
+            self.finished_tokens += s.generated
+        req.out.put(
+            {
+                "type": "done",
+                "finish_reason": finish,
+                "usage": {
+                    "prompt_tokens": s.prompt_len,
+                    "completion_tokens": s.generated,
+                    "total_tokens": s.prompt_len + s.generated,
+                },
+                "ttft_ms": (s.first_token_at - req.created_at) * 1000.0,
+            }
+        )
+        req.out.put(_DONE)
+        # identity-guarded: the fast-scan may have freed the entry
+        # already, and admission may have re-filled it with a NEW
+        # request whose slot state must not be clobbered
+        if self._slots[slot_idx] is s:
             self._slots[slot_idx] = None
             self._lengths[slot_idx] = self.max_seq_len  # park (see __init__)
-            return False
-        return True

@@ -656,7 +656,7 @@ def llama_decode_step(
     With `slot_ids` the batch is COMPACT: row i serves cache row
     slot_ids[i] (reads attend that row, the K/V append scatters into it).
     The forward pass then sizes to the active rows only — the engine's slot
-    compaction (executor/engine.py:_decode_round) uses this so parked slots
+    compaction (executor/engine.py:_dispatch_decode) uses this so parked slots
     stop costing weights-pass FLOPs and sampling work.
 
     The caches may be int8-quantized ({"q", "s"} pytrees — see

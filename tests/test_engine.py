@@ -203,9 +203,9 @@ def test_chunked_prefill_interleaves_with_decode():
         decode_chunk=2, prefill_chunk=8,
     )
     trace: list[str] = []
-    orig_p, orig_d = eng._prefill_round, eng._decode_round
+    orig_p, orig_d = eng._prefill_round, eng._dispatch_decode
     eng._prefill_round = lambda: (trace.append("p"), orig_p())[1]
-    eng._decode_round = lambda active: (trace.append("d"), orig_d(active))[1]
+    eng._dispatch_decode = lambda active: (trace.append("d"), orig_d(active))[1]
     eng.start()
     try:
         results = {}
