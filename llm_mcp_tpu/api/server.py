@@ -124,6 +124,7 @@ class CoreServer:
             gen_models=list(self.gen_engines),
             embed_models=list(self.embed_engines),
             device_id=device_id,
+            gen_engines=self.gen_engines,
         )
 
     # -- local engine device registration ----------------------------------

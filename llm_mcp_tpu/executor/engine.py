@@ -36,7 +36,7 @@ import queue
 import threading
 import time
 import uuid
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Iterator
@@ -154,6 +154,7 @@ class GenerationEngine:
         prefill_chunk: int = 512,
         admit_batch: int = 4,
         decode_compact: str = "auto",
+        prompt_cache_mb: int = 256,
     ):
         self.cfg = get_config(model) if isinstance(model, str) else model
         self.mesh = mesh
@@ -459,6 +460,27 @@ class GenerationEngine:
             toks0 = sample_tokens(logits, key, temps, topks, topps)
             return ck, cv, d_temp, d_topk, d_topp, toks0
 
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def insert_cached_fn(ck, cv, pk, pv, slots, live_n):
+            """Prefix-cache hit admission: write ONE cached prompt-prefix's
+            KV rows into N slots in one dispatch. pk/pv: the stored rows
+            [L, 1, Hkv, P0, hd] (int8 {"q","s"} pytree when the cache is).
+            The suffix then prefills through the ordinary chunked path
+            (start=P0) — reading these rows as its past; sampling params
+            are set at activation as usual."""
+
+            def body(i, cc):
+                ck, cv = cc
+                return jax.lax.cond(
+                    i < live_n,
+                    lambda cc: _insert_row(cc[0], cc[1], pk, pv, 0, slots[i]),
+                    lambda cc: cc,
+                    (ck, cv),
+                )
+
+            ck, cv = jax.lax.fori_loop(0, slots.shape[0], body, (ck, cv))
+            return ck, cv
+
         @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("skey",))
         def prefill_chunk_fn(params, ck, cv, tokens, slots, starts, nvalid, skey):
             return llama_prefill_chunk_batch(
@@ -466,7 +488,29 @@ class GenerationEngine:
             )
 
         self._admit_fn = admit_fn
+        self._insert_cached_fn = insert_cached_fn
         self._prefill_chunk_fn = prefill_chunk_fn
+        # Prompt-prefix KV cache (vLLM-style prefix reuse, exact-prefix
+        # match): production chat traffic repeats long shared prefixes
+        # (system prompts, few-shot preambles) across requests; their KV is
+        # a pure function of the weights, so re-prefilling them per request
+        # is pure waste. Entries store device-resident KV rows for a prompt
+        # PREFIX; a hit copies the rows into the slot (one fused dispatch
+        # per hit group) and only the suffix runs through chunked prefill.
+        # LRU by bytes; 0 disables. Gated to single-chip + chunked prefill
+        # (the sp path prefills whole prompts by design; sharded entries
+        # under a mesh aren't worth the complexity).
+        self._prefix_cache: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._prefix_cache_bytes = 0
+        self._prefix_budget = (
+            int(prompt_cache_mb) * (1 << 20)
+            if (mesh is None or mesh.size == 1) and self.prefill_chunk > 0
+            and self.sp == 1
+            else 0
+        )
+        self._recent_prompts: deque[tuple] = deque(maxlen=16)
+        self.prefix_cache_hits = 0
+        self.prefix_cache_misses = 0
         # device-resident sampling params (see admit_fn docstring); host
         # mirrors (self._temp/_topk/_topp) stay the source of truth for
         # rebuild after a poisoned dispatch consumed the donated buffers
@@ -491,6 +535,11 @@ class GenerationEngine:
         # the mean completion length, bench.py's decode-actually-ran guard
         self.finished_requests = 0
         self.finished_tokens = 0
+        # rolling client-observed TTFT samples (ts, ttft_ms): the planner
+        # records p50/p95 into `benchmarks` so routing's latency constraint
+        # sees REAL serve percentiles (reference analog: probe scripts
+        # writing p50/p95 rows, scripts/probe_openrouter_models.py:113-124)
+        self._ttft_window: deque[tuple[float, float]] = deque(maxlen=1024)
         self._window: list[tuple[float, int]] = []  # (ts, tokens) for tps
 
     # -- jit builders ------------------------------------------------------
@@ -640,6 +689,21 @@ class GenerationEngine:
             "usage": final.get("usage", {}),
             "finish_reason": final.get("finish_reason", "stop"),
         }
+
+    def ttft_percentiles(
+        self, window_s: float = 600.0
+    ) -> tuple[float, float, int]:
+        """(p50_ms, p95_ms, n) of client-observed TTFT over the recent
+        window — nearest-rank, matching scripts/probe_models.py."""
+        now = time.time()
+        with self.stats_lock:
+            vals = sorted(v for t, v in self._ttft_window if now - t <= window_s)
+        if not vals:
+            return 0.0, 0.0, 0
+        n = len(vals)
+        p50 = vals[max(0, (n + 1) // 2 - 1)]
+        p95 = vals[max(0, min(n - 1, int(n * 0.95 + 0.5) - 1))]
+        return p50, p95, n
 
     def current_tps(self, window_s: float = 10.0) -> float:
         now = time.time()
@@ -793,6 +857,9 @@ class GenerationEngine:
         admitted = False
         while True:
             batch: list[tuple[int, GenRequest, list[int]]] = []
+            # prefix-cache hits grouped by entry: one fused row-copy
+            # dispatch serves the whole group
+            hits: dict[int, tuple[dict, list]] = {}
             reserved: set[int] = set()
             while len(batch) < self.admit_batch:
                 slot = self._free_slot(reserved)
@@ -823,6 +890,16 @@ class GenerationEngine:
                     req.out.put(_DONE)
                     continue
                 admitted = True
+                ent = self._match_prefix(ids)
+                if ent is not None:
+                    # cached prefix: copy its KV rows, chunk-prefill only
+                    # the suffix (works for any suffix length — the chunked
+                    # machinery is ragged-safe)
+                    reserved.add(slot)
+                    hits.setdefault(id(ent), (ent, []))[1].append(
+                        (slot, req, list(ids))
+                    )
+                    continue
                 if self.sp == 1 and self.prefill_chunk and len(ids) > self.prefill_chunk:
                     # Long prompt: reserve the slot and prefill chunk-by-chunk
                     # in _prefill_round, interleaved with decode rounds (no
@@ -833,7 +910,25 @@ class GenerationEngine:
                     continue
                 reserved.add(slot)
                 batch.append((slot, req, list(ids)))
+            for ent, group in hits.values():
+                try:
+                    self._start_cached(ent, group)
+                except Exception as e:
+                    log.exception("prefix-cache admission failed")
+                    for slot, req, _ in group:
+                        self._prefills.pop(slot, None)
+                        try:
+                            self._prefill_q.remove(slot)
+                        except ValueError:
+                            pass
+                        self.total_errors += 1
+                        req.out.put({"type": "error", "error": str(e)})
+                        req.out.put(_DONE)
+                    if self._recover_cache():
+                        self._abort_all("kv cache lost in failed prefix admission")
             if not batch:
+                if hits:
+                    continue  # hit slots consumed; more queue may admit
                 break
             try:
                 self._start_batch(batch)
@@ -855,6 +950,103 @@ class GenerationEngine:
             if len(batch) < self.admit_batch:
                 break  # admit queue drained
         return admitted
+
+    # -- prompt-prefix KV cache --------------------------------------------
+
+    PREFIX_MIN = 32  # shortest prefix worth caching (tokens)
+
+    @staticmethod
+    def _common_len(a: tuple, b: tuple) -> int:
+        n = min(len(a), len(b))
+        i = 0
+        while i < n and a[i] == b[i]:
+            i += 1
+        return i
+
+    def _match_prefix(self, ids: list[int]) -> dict | None:
+        """Longest cached entry that is a STRICT prefix of `ids` (at least
+        one suffix token must remain — the suffix chunk produces the
+        first-sample logits)."""
+        if not self._prefix_budget or not self._prefix_cache:
+            return None
+        t = tuple(ids)
+        best_key, best = None, None
+        for key, e in self._prefix_cache.items():
+            if e["P"] < len(t) and (best is None or e["P"] > best["P"]) and t[: e["P"]] == key:
+                best_key, best = key, e
+        if best is not None:
+            self._prefix_cache.move_to_end(best_key)  # LRU touch
+            self.prefix_cache_hits += 1
+        else:
+            self.prefix_cache_misses += 1
+        return best
+
+    def _start_cached(self, ent: dict, group: list) -> None:
+        """Admit a group of prefix-cache hits: ONE fused dispatch copies the
+        entry's KV rows into every slot; the suffixes then ride the ordinary
+        chunked-prefill queue (start=P0) and activate as usual."""
+        maybe_fail("engine.prefill", f"prefix-hit slots={[s for s, _, _ in group]}")
+        n = len(group)
+        nb = 1 << (n - 1).bit_length()
+        slots = np.zeros(nb, dtype=np.int32)
+        for i, (slot, _, _) in enumerate(group):
+            slots[i] = slot
+        self._ck, self._cv = self._insert_cached_fn(
+            self._ck, self._cv, ent["k"], ent["v"], jnp.asarray(slots), np.int32(n)
+        )
+        for slot, req, ids in group:
+            self._prefills[slot] = _PrefillState(req=req, ids=list(ids), done=ent["P"])
+            self._prefill_q.append(slot)
+
+    def _maybe_store_prefix(self, slot: int, ids: list[int]) -> None:
+        """At activation: if this prompt shares a long prefix with recent
+        traffic, store that prefix's KV as a device SLICE of the slot's own
+        cache rows (positions [0, P0) hold exactly the prompt KV a cold
+        prefill computed — valid for any admission path, batch or chunked,
+        and never touched again while the slot decodes at positions >= P)."""
+        if not self._prefix_budget:
+            return
+        t = tuple(ids)
+        best = 0
+        for other in self._recent_prompts:
+            if other is not t:
+                best = max(best, self._common_len(t, other))
+        # identical prompts cap at len-1: a hit must keep >= 1 suffix
+        # token (PREFIX_MIN keeps trivial overlaps out)
+        p0 = min(best, len(t) - 1)
+        if p0 < self.PREFIX_MIN:
+            return
+        # pow2-FLOOR the stored length: insert_cached_fn compiles one
+        # executable per (entry length, group size) — raw P0 would compile
+        # per distinct prefix length on the serve loop (every other jit
+        # input shape in this engine is bucketed for exactly this reason).
+        # Rounding DOWN stays correct (a shorter prefix is still a prefix).
+        p0 = 1 << (p0.bit_length() - 1)
+        key = t[:p0]
+        if key in self._prefix_cache:
+            return
+        if isinstance(self._ck, dict):
+            pk = {
+                "q": self._ck["q"][:, slot : slot + 1, :, :p0],
+                "s": self._ck["s"][:, slot : slot + 1, :, :p0],
+            }
+            pv = {
+                "q": self._cv["q"][:, slot : slot + 1, :, :p0],
+                "s": self._cv["s"][:, slot : slot + 1, :, :p0],
+            }
+        else:
+            pk = self._ck[:, slot : slot + 1, :, :p0]
+            pv = self._cv[:, slot : slot + 1, :, :p0]
+        nbytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves((pk, pv)))
+        self._prefix_cache[key] = {"P": p0, "k": pk, "v": pv, "bytes": nbytes}
+        self._prefix_cache_bytes += nbytes
+        while self._prefix_cache_bytes > self._prefix_budget and self._prefix_cache:
+            _, old = self._prefix_cache.popitem(last=False)  # LRU evict
+            self._prefix_cache_bytes -= old["bytes"]
+        log.info(
+            "prefix cache: stored %d-token prefix (%.1f MB, %d entries)",
+            p0, nbytes / 1e6, len(self._prefix_cache),
+        )
 
     def _start_batch(self, batch: list[tuple[int, GenRequest, list[int]]]) -> None:
         """Admit up to admit_batch short prompts with ONE batched prefill
@@ -889,27 +1081,16 @@ class GenerationEngine:
         )
         toks0 = np.asarray(toks0)
         for i, (slot, req, ids) in enumerate(batch):
-            self._activate_state(slot, req, len(ids), int(toks0[i]))
+            self._activate_state(slot, req, ids, int(toks0[i]))
 
-    def _activate(self, slot: int, req: GenRequest, P: int, logits) -> None:
-        """Sample the first token from prefill logits [1, V] and switch the
-        slot from prefilling to decoding (chunked-prefill finalization)."""
-        tok0 = self._sample1(
-            logits,
-            self._next_key(),
-            jnp.array([req.temperature], dtype=jnp.float32),
-            jnp.array([req.top_k], dtype=jnp.int32),
-            jnp.array([req.top_p], dtype=jnp.float32),
-        )
-        # the chunked path bypasses admit_fn, so the device-resident
-        # sampling rows update here (three tiny dispatches per LONG-prompt
-        # activation only; short prompts ride the fused admit_fn)
-        self._d_temp = self._d_temp.at[slot].set(req.temperature)
-        self._d_topk = self._d_topk.at[slot].set(req.top_k)
-        self._d_topp = self._d_topp.at[slot].set(req.top_p)
-        self._activate_state(slot, req, P, int(np.asarray(tok0)[0]))
-
-    def _activate_state(self, slot: int, req: GenRequest, P: int, tok0: int) -> None:
+    def _activate_state(
+        self, slot: int, req: GenRequest, ids: list[int], tok0: int
+    ) -> None:
+        P = len(ids)
+        # the slot's cache rows [0, P) now hold exactly this prompt's KV —
+        # the moment to learn a shared prefix for future admissions
+        self._maybe_store_prefix(slot, ids)
+        self._recent_prompts.append(tuple(ids))
         s = _Slot(req=req, prompt_len=P, first_token_at=time.time())
         self._slots[slot] = s
         self._lengths[slot] = P
@@ -919,6 +1100,9 @@ class GenerationEngine:
         self._topp[slot] = req.top_p
         with self.stats_lock:
             self.total_requests += 1
+            self._ttft_window.append(
+                (s.first_token_at, (s.first_token_at - req.created_at) * 1000.0)
+            )
         # tok0's KV will be written at position P in the first decode round.
         self._emit_token(slot, s, tok0, pos=P - 1)
 
@@ -998,15 +1182,36 @@ class GenerationEngine:
                 self.params, self._ck, self._cv, tokens,
                 slots_arr, starts_arr, nv_arr, f_skey,
             )
+            fin: list[tuple[int, int, _PrefillState]] = []
             for i, (slot, st, n) in enumerate(metas):
                 st.done += n
                 if st.done >= len(st.ids):
+                    fin.append((i, slot, st))
+            if fin:
+                # BATCHED activation: one first-token sample + one update
+                # per device sampling array for the whole finishing group
+                # (per-slot activation cost ~5 host<->device round trips —
+                # with prefix-cache hits riding this path, that tax would
+                # dominate admission again)
+                rows = np.asarray([i for i, _, _ in fin])
+                slots_fin = jnp.asarray([s for _, s, _ in fin])
+                temps = np.asarray([st.req.temperature for _, _, st in fin], np.float32)
+                topks = np.asarray([st.req.top_k for _, _, st in fin], np.int32)
+                topps = np.asarray([st.req.top_p for _, _, st in fin], np.float32)
+                toks0 = self._sample1(
+                    logits[rows], self._next_key(), temps, topks, topps
+                )
+                self._d_temp = self._d_temp.at[slots_fin].set(jnp.asarray(temps))
+                self._d_topk = self._d_topk.at[slots_fin].set(jnp.asarray(topks))
+                self._d_topp = self._d_topp.at[slots_fin].set(jnp.asarray(topps))
+                toks0 = np.asarray(toks0)
+                for k, (_, slot, st) in enumerate(fin):
                     self._prefill_q.remove(slot)
                     # _prefills entry is dropped only AFTER activation
-                    # succeeds: if _activate raises, the except path below
-                    # still finds the state and delivers error+_DONE to the
-                    # waiter (it would hang forever otherwise)
-                    self._activate(slot, st.req, len(st.ids), logits[i : i + 1])
+                    # succeeds: on a raise the except path below still finds
+                    # the state and delivers error+_DONE to the waiter (it
+                    # would hang forever otherwise)
+                    self._activate_state(slot, st.req, st.ids, int(toks0[k]))
                     del self._prefills[slot]
         except Exception as e:
             log.exception("chunked prefill failed (slots %s)", group)

@@ -740,10 +740,10 @@ def decode_attend_q8(
                 pl.BlockSpec((1, Hkv, G, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
                 pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
                 pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
-                pl.BlockSpec(memory_space=pltpu.ANY),  # K payload [L,B,Hkv,S,hd]
-                pl.BlockSpec(memory_space=pltpu.ANY),  # K scales
-                pl.BlockSpec(memory_space=pltpu.ANY),  # V payload
-                pl.BlockSpec(memory_space=pltpu.ANY),  # V scales
+                pl.BlockSpec(memory_space=pl.ANY),  # K payload [L,B,Hkv,S,hd]
+                pl.BlockSpec(memory_space=pl.ANY),  # K scales
+                pl.BlockSpec(memory_space=pl.ANY),  # V payload
+                pl.BlockSpec(memory_space=pl.ANY),  # V scales
             ],
             out_specs=pl.BlockSpec(
                 (1, Hkv, G, hd), lambda b, li, ids, lens: (b, 0, 0, 0)

@@ -44,6 +44,7 @@ class Planner:
         gen_models=None,
         embed_models=None,
         device_id: str = "",
+        gen_engines=None,
     ):
         self.cfg = cfg
         self.queue = queue
@@ -51,6 +52,9 @@ class Planner:
         self.cloud = cloud
         self.gen_models = list(gen_models or [])
         self.embed_models = list(embed_models or [])
+        # live engine objects (optional): lets the planner snapshot REAL
+        # client-observed serve TTFT percentiles into `benchmarks`
+        self.gen_engines = dict(gen_engines or {})
         # the planner benchmarks ITS core's local engines — stamp that
         # device into the payload so record_benchmark_from_job attributes
         # the tps to the right device row (it drops device-less results)
@@ -127,6 +131,33 @@ class Planner:
             submitted += 1
         return submitted
 
+    def record_serve_ttft(self) -> int:
+        """Snapshot each live engine's client-observed TTFT percentiles into
+        `benchmarks` (task_type 'serve'), so the router's latency constraint
+        ranks the local TPU device on REAL serve latency, not only synthetic
+        benchmark jobs. Reference analog: the probe script writing p50/p95
+        rows under a synthetic device (scripts/probe_models.py)."""
+        if not self.device_id:
+            return 0
+        recorded = 0
+        for model, eng in self.gen_engines.items():
+            try:
+                p50, p95, n = eng.ttft_percentiles()
+                tps = eng.current_tps()
+            except AttributeError:
+                continue  # not a generation engine
+            if n == 0 or tps <= 0.0:
+                # idle engine: the TTFT window (600 s) outlives the tps
+                # window (10 s) — recording would pair an old burst's
+                # latency with 0 tok/s and poison throughput ranking
+                continue
+            self.catalog.record_benchmark(
+                self.device_id, model, "serve",
+                latency_ms=p50, p95_ms=p95, tps=tps,
+            )
+            recorded += 1
+        return recorded
+
     # -- loop ------------------------------------------------------------
 
     def run_once(self) -> dict[str, Any]:
@@ -136,6 +167,7 @@ class Planner:
                 ("purged_jobs", self.cleanup_stale_jobs),
                 ("cloud_models_synced", self.sync_cloud_models),
                 ("benchmarks_submitted", self.refresh_benchmarks),
+                ("serve_ttft_recorded", self.record_serve_ttft),
             ):
                 try:
                     result[name] = task()

@@ -185,6 +185,18 @@ class _UdpBus:
         self._peers: list[int] = []
         self._peers_at = 0.0
         self._last_heartbeat = time.time()
+        # Shared secret per DB file: any local process (other users on a
+        # shared host) can send loopback UDP to our port — datagrams without
+        # the token are dropped, so only DB-file sharers can wake listeners
+        # (ADVICE r2: forged job_update datagrams → poll storms). First
+        # binder mints it; INSERT OR IGNORE makes the race single-winner.
+        db.execute(
+            "INSERT OR IGNORE INTO notify_meta(key, value) VALUES('bus_token', ?)",
+            (os.urandom(16).hex(),),
+        )
+        self._token = db.query_one(
+            "SELECT value FROM notify_meta WHERE key='bus_token'"
+        )["value"]
         db.execute(
             "INSERT OR REPLACE INTO notify_peers(port, pid, updated_at) VALUES(?,?,?)",
             (self.port, os.getpid(), time.time()),
@@ -210,6 +222,8 @@ class _UdpBus:
                 continue
             try:
                 msg = json.loads(data.decode("utf-8"))
+                if msg.get("token") != self._token:
+                    continue  # forged/foreign datagram: drop silently
                 self._db._dispatch_local(str(msg["channel"]), str(msg["payload"]))
             except Exception:
                 pass  # malformed datagram — bus is best-effort
@@ -248,7 +262,9 @@ class _UdpBus:
                 self._peers = []
         if not self._peers:
             return
-        data = json.dumps({"channel": channel, "payload": payload}).encode("utf-8")
+        data = json.dumps(
+            {"channel": channel, "payload": payload, "token": self._token}
+        ).encode("utf-8")
         for port in self._peers:
             try:
                 self._sock.sendto(data, ("127.0.0.1", port))

@@ -207,6 +207,14 @@ CREATE TABLE IF NOT EXISTS notify_peers (
     updated_at REAL NOT NULL
 );
 
+-- Per-DB-file shared secrets (the notify bus token): any local process can
+-- send loopback UDP, so datagrams carry a random token only DB-file sharers
+-- know; receivers drop everything else (forged job_update wake storms).
+CREATE TABLE IF NOT EXISTS notify_meta (
+    key   TEXT PRIMARY KEY,
+    value TEXT NOT NULL
+);
+
 -- Views: v_cost_stats (02_v2_improvements.sql:41), v_device_stats
 -- (04_smart_routing.sql:71).
 CREATE VIEW IF NOT EXISTS v_cost_stats AS

@@ -55,5 +55,7 @@ def split_think(text: str) -> tuple[str, str]:
         # Unterminated think block: everything is thinking.
         return stripped[len("<think>"):].strip(), ""
     thinking = stripped[len("<think>"):end].strip()
-    answer = stripped[end + len("</think>"):].lstrip("\n")
+    # reference strips the remaining response fully
+    # (worker/llm_worker/main.py:218: `response.strip()`)
+    answer = stripped[end + len("</think>"):].strip()
     return thinking, answer

@@ -52,6 +52,7 @@ def main() -> None:
             kv_quant=cfg.tpu_kv_quant,
             prefill_chunk=cfg.tpu_prefill_chunk,
             decode_compact=cfg.tpu_decode_compact,
+            prompt_cache_mb=cfg.tpu_prompt_cache_mb,
         ).start()
         embed_engines[cfg.tpu_embed_model] = EmbeddingEngine(
             cfg.tpu_embed_model,

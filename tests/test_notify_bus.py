@@ -135,3 +135,35 @@ def test_dead_peer_does_not_block_notify(tmp_path):
         assert rows == []
     finally:
         a.close()
+
+
+def test_forged_datagram_dropped(tmp_path):
+    """Datagrams without the per-DB-file token are dropped: any local
+    process can send loopback UDP, and forged job_update events must not
+    wake listeners (poll storms / cross-tenant interference)."""
+    import json
+    import socket
+
+    path = str(tmp_path / "bus.db")
+    a, b = Database(path), Database(path)
+    try:
+        got = []
+        evt = threading.Event()
+        b.add_listener(lambda c, p: (got.append((c, p)), evt.set()))
+        port = b._bus.port
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        # no token / wrong token: both dropped
+        s.sendto(json.dumps({"channel": "job_update", "payload": "forged"}).encode(),
+                 ("127.0.0.1", port))
+        s.sendto(json.dumps({"channel": "job_update", "payload": "forged",
+                             "token": "not-the-token"}).encode(),
+                 ("127.0.0.1", port))
+        assert not evt.wait(timeout=1.0), f"forged datagram dispatched: {got}"
+        # the real bus still works (token attached by publish)
+        a.notify("job_update", "legit")
+        assert evt.wait(timeout=5.0)
+        assert ("job_update", "legit") in got
+        assert ("job_update", "forged") not in got
+    finally:
+        a.close()
+        b.close()

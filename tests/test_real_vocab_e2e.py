@@ -1,0 +1,109 @@
+"""Real-vocabulary end-to-end generation smoke (VERDICT r2 #6).
+
+Every other engine test runs the byte-fallback tokenizer and random-init
+weights, which can never catch a tokenizer-merge or HF-weight-mapping
+regression. Here: a COMMITTED real byte-level-BPE vocabulary (441 tokens
+with real merges, trained once and checked in at
+tests/fixtures/tiny_real_vocab/tokenizer.json) + an HF-layout safetensors
+checkpoint written through `llama_to_hf_tensors` and read back through the
+engine's own `load_llama_checkpoint` path — the reference's equivalent
+surface is Ollama's own tokenizer+weights
+(/root/reference/worker/llm_worker/main.py:222-243, think-split 207-219).
+"""
+
+import os
+import shutil
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_mcp_tpu.executor import GenerationEngine
+from llm_mcp_tpu.executor.bpe import BPETokenizer
+from llm_mcp_tpu.models import get_config, init_llama_params
+from llm_mcp_tpu.models.weights import llama_to_hf_tensors, write_safetensors
+from llm_mcp_tpu.utils.tokens import split_think
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "tiny_real_vocab"
+)
+
+
+@pytest.fixture(scope="module")
+def ckpt_dir(tmp_path_factory):
+    """HF-layout checkpoint dir: real tokenizer.json + model.safetensors."""
+    d = tmp_path_factory.mktemp("real_vocab_ckpt")
+    cfg = get_config("tiny-llm")  # vocab_size 512 >= the fixture's 441
+    params = init_llama_params(cfg, jax.random.PRNGKey(11), dtype=jnp.float32)
+    write_safetensors(
+        str(d / "model.safetensors"), llama_to_hf_tensors(cfg, params)
+    )
+    shutil.copy(os.path.join(FIXTURE, "tokenizer.json"), d / "tokenizer.json")
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def engine(ckpt_dir):
+    eng = GenerationEngine(
+        "tiny-llm", weights_dir=ckpt_dir, max_slots=2, max_seq_len=128,
+        dtype=jnp.float32, decode_chunk=4,
+    ).start()
+    yield eng
+    eng.shutdown()
+
+
+def test_real_bpe_loaded_not_byte_fallback(engine):
+    assert isinstance(engine.tokenizer, BPETokenizer)
+    assert engine.tokenizer.vocab_size == 441
+    assert engine.tokenizer.eos_id >= 0
+
+
+def test_merges_compress_and_roundtrip(engine):
+    text = "the quick brown fox jumps over the lazy dog."
+    ids = engine.tokenizer.encode(text)
+    # real merges: far fewer tokens than bytes (the corpus contains these
+    # words, so they merge into multi-byte subwords)
+    assert len(ids) < len(text.encode()) // 2, (len(ids), len(text.encode()))
+    assert engine.tokenizer.decode(ids) == text
+
+
+def test_generate_decodes_real_subwords(engine):
+    out = engine.generate("the quick brown fox", max_tokens=12, temperature=0.0)
+    assert isinstance(out["text"], str)
+    out["text"].encode("utf-8")  # must be valid (encodable) text
+    assert out["usage"]["prompt_tokens"] == len(
+        engine.tokenizer.encode("the quick brown fox")
+    )
+    assert out["finish_reason"] in ("stop", "length")
+    # greedy determinism through the real-vocab path
+    again = engine.generate("the quick brown fox", max_tokens=12, temperature=0.0)
+    assert out["text"] == again["text"]
+
+
+def test_stop_sequence_on_real_token_boundaries(engine):
+    base = engine.generate("hello world", max_tokens=16, temperature=0.0)
+    if len(base["text"]) < 4:
+        pytest.skip("random-weight greedy produced <4 chars (immediate eos)")
+    # pick a stop string from inside the greedy output: the rerun must cut
+    # exactly before it even though it may straddle subword boundaries
+    mid = len(base["text"]) // 2
+    stop_s = base["text"][mid : mid + 3]
+    cut = engine.generate(
+        "hello world", max_tokens=16, temperature=0.0, stop=[stop_s]
+    )
+    assert stop_s not in cut["text"]
+    assert base["text"].startswith(cut["text"])
+    assert cut["finish_reason"] == "stop"
+
+
+def test_think_split_through_real_vocab(engine):
+    # <think> appears in the training corpus, so it tokenizes through real
+    # merges; the round-trip must preserve it exactly for split_think
+    # (reference behavior: worker/llm_worker/main.py:207-219)
+    text = "<think>reasoning goes here</think> the answer follows"
+    ids = engine.tokenizer.encode(text)
+    decoded = engine.tokenizer.decode(ids)
+    assert decoded == text
+    think, answer = split_think(decoded)
+    assert think == "reasoning goes here"
+    assert answer == "the answer follows"
