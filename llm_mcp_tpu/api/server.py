@@ -166,13 +166,19 @@ class CoreServer:
     def engines_info(self) -> dict[str, Any]:
         info: dict[str, Any] = {}
         for name, e in self.gen_engines.items():
+            p50, p95, n = e.ttft_percentiles()
             info[name] = {
                 "kind": "generate",
                 "slots_in_use": e.slots_in_use(),
                 "max_slots": e.max_slots,
                 "total_tokens": e.total_tokens,
                 "total_requests": e.total_requests,
+                "total_errors": e.total_errors,
                 "tps_10s": round(e.current_tps(), 1),
+                "ttft_p50_ms": round(p50, 1),
+                "ttft_p95_ms": round(p95, 1),
+                "decode_compact": e.decode_compact,
+                "prefix_cache": e.prefix_cache_stats(),
             }
             self.metrics.engine_slots_in_use.set(e.slots_in_use())
             self.metrics.engine_tps.set(e.current_tps())

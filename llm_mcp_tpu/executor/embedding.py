@@ -82,7 +82,7 @@ class EmbeddingEngine:
                 # distinct final-chunk size compiles a fresh executable
                 # (VERDICT r2 weak #7 — B=7 vs B=8 were separate compiles);
                 # pad rows hold 1 dummy token and their vectors are dropped
-                Bb = min(pow2_bucket(B, self.max_batch, floor=1), self.max_batch)
+                Bb = pow2_bucket(B, self.max_batch, floor=1)
                 bucket = self._bucket(max(len(c) for c in chunk))
                 tokens = np.zeros((Bb, bucket), dtype=np.int32)
                 lengths = np.ones(Bb, dtype=np.int32)

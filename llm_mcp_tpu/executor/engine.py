@@ -690,6 +690,16 @@ class GenerationEngine:
             "finish_reason": final.get("finish_reason", "stop"),
         }
 
+    def prefix_cache_stats(self) -> dict[str, int]:
+        """Snapshot for dashboards/metrics (the cache itself is engine-thread
+        private state — callers must not reach into it)."""
+        return {
+            "entries": len(self._prefix_cache),
+            "bytes": self._prefix_cache_bytes,
+            "hits": self.prefix_cache_hits,
+            "misses": self.prefix_cache_misses,
+        }
+
     def ttft_percentiles(
         self, window_s: float = 600.0
     ) -> tuple[float, float, int]:

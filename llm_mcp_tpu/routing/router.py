@@ -157,6 +157,12 @@ class Router:
         """
         if self.db is None:
             return None
+        # Generation routing also consumes 'serve' rows — REAL client-observed
+        # TTFT/tps snapshots the planner records from live engines
+        # (planner.record_serve_ttft). The freshest row wins (SQLite's
+        # bare-column-with-MAX picks that row), so during live traffic the
+        # measured serving numbers displace stale synthetic benchmarks.
+        alt_type = "serve" if task_type == "generate" else task_type
         rows = self.db.query(
             """
             SELECT d.id, d.name, d.addr, d.tags, d.last_seen,
@@ -165,17 +171,17 @@ class Router:
             FROM devices d
             JOIN device_models dm ON dm.device_id = d.id AND dm.available = 1
             LEFT JOIN (
-                SELECT device_id, model_id, task_type, tps, latency_ms, p95_ms,
+                SELECT device_id, model_id, tps, latency_ms, p95_ms,
                        MAX(created_at)
-                FROM benchmarks GROUP BY device_id, model_id, task_type
+                FROM benchmarks WHERE task_type IN (?, ?)
+                GROUP BY device_id, model_id
             ) b ON b.device_id = d.id AND b.model_id = dm.model_id
-                AND b.task_type = ?
             WHERE d.online = 1 AND dm.model_id = ?
             ORDER BY COALESCE(b.tps, 0) DESC,
                      COALESCE(b.latency_ms, 1e12) ASC,
                      d.last_seen DESC
             """,
-            (task_type, model),
+            (task_type, alt_type, model),
         )
         model_row = self.catalog.get_model(model) if self.catalog else None
         ctx_k = int(model_row["context_k"]) if model_row else 0

@@ -256,5 +256,16 @@ def test_planner_records_serve_ttft(db):
         assert row is not None
         assert row["latency_ms"] > 0
         assert row["p95_ms"] >= row["latency_ms"]
+        # ...and ROUTING actually consumes it: generation device selection
+        # joins the freshest of ('generate', 'serve') rows, so the real
+        # serve snapshot reaches the ranking/latency constraint
+        from llm_mcp_tpu.routing import Router
+
+        catalog.upsert_device("tpu-local", name="local", online=True)
+        catalog.sync_device_models("tpu-local", ["tiny-llm"])
+        dev = Router(db).select_device("tiny-llm", "generate")
+        assert dev is not None and dev["id"] == "tpu-local"
+        assert dev["bench_latency_ms"] == row["latency_ms"]
+        assert dev["bench_tps"] == row["tps"]
     finally:
         eng.shutdown()
