@@ -461,6 +461,35 @@ class CoreServer:
                 self.planner.maybe_run(now)
             except Exception:
                 log.exception("planner tick failed")
+            try:
+                self._check_engine_stalls()
+            except Exception:
+                log.exception("engine stall check failed")
+
+    def _check_engine_stalls(self) -> None:
+        """Map a wedged accelerator to device state: while any local engine's
+        loop is stalled, the self-device goes OFFLINE (its running jobs'
+        leases reset so queue work re-routes — offline_handler.go:12-38
+        analog) and the circuit records failures so sync routing fails over
+        to other devices/cloud. Recovery flips it back online."""
+        if not self.gen_engines or not self.device_id:
+            return
+        stalled = [n for n, e in self.gen_engines.items() if e.stalled]
+        row = self.catalog.get_device(self.device_id)
+        online = bool(row and row["online"])
+        if stalled and online:
+            log.error("local engines stalled (%s): marking %s offline",
+                      ", ".join(stalled), self.device_id)
+            self.catalog.set_device_online(self.device_id, False)
+            self.router.circuit.record(self.device_id, ok=False)
+            self.queue.requeue_device_jobs([self.device_id])
+            self._stall_offlined = True
+        elif not stalled and getattr(self, "_stall_offlined", False):
+            # recovery re-onlines ONLY what the stall path took offline — an
+            # operator's explicit /v1/devices/offline must stick
+            self._stall_offlined = False
+            if row is not None and not online:
+                self.catalog.set_device_online(self.device_id, True)
 
     def shutdown(self) -> None:
         self._bg_stop.set()

@@ -523,6 +523,29 @@ class GenerationEngine:
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
 
+        # Stall watchdog: a wedged accelerator link (observed in the field:
+        # the remote-TPU tunnel's session lock held by a dead client — even
+        # jax.devices() blocks forever) leaves the engine thread stuck in a
+        # device call it can never be interrupted out of. The loop stamps
+        # progress each iteration; when in-flight work exists and the stamp
+        # goes stale past TPU_STALL_TIMEOUT_S (default 600 s — first 8B
+        # compiles legitimately take minutes), the watchdog sheds load:
+        # new submits are rejected, queued-but-unadmitted requests get
+        # error events (their consumers would otherwise hang), and
+        # stall_seconds() lets the serving layer flip the device offline
+        # so routing fails over (the reference's analog maps connection
+        # errors to device-offline: worker/llm_worker/main.py:189-196 —
+        # a wedged XLA runtime produces no error to map, only silence).
+        self.last_progress = time.time()
+        self.stall_timeout_s = float(
+            os.environ.get("TPU_STALL_TIMEOUT_S", "600") or 0
+        )
+        self.stalled = False
+        if self.stall_timeout_s > 0:
+            threading.Thread(
+                target=self._watchdog, name="engine-watchdog", daemon=True
+            ).start()
+
         # rolling stats for dashboard/benchmarks
         self.stats_lock = threading.Lock()
         self.total_tokens = 0
@@ -597,6 +620,47 @@ class GenerationEngine:
 
         return decode_chunk_fn
 
+    def stall_seconds(self) -> float:
+        """Age of the engine loop's last progress stamp. Large values with
+        in-flight work mean the thread is wedged inside an uninterruptible
+        device call (serving layer: flip the device offline, fail over)."""
+        return max(0.0, time.time() - self.last_progress)
+
+    def _watchdog(self) -> None:
+        poll = min(30.0, max(1.0, self.stall_timeout_s / 4))
+        while not self._stop_evt.wait(timeout=poll):
+            age = self.stall_seconds()
+            if age > self.stall_timeout_s:
+                if not self.stalled:
+                    self.stalled = True
+                    log.error(
+                        "engine stalled: no loop progress for %.0f s "
+                        "(wedged device call?); shedding queued load", age,
+                    )
+                # Drain requests the blocked loop can never admit — their
+                # consumers would hang past any reasonable client timeout.
+                # Re-check staleness per pop: if the loop resumed we must
+                # not steal legitimate requests.
+                drained = 0
+                while self.stall_seconds() > self.stall_timeout_s:
+                    try:
+                        req = self._admit.get_nowait()
+                    except queue.Empty:
+                        break
+                    with self.stats_lock:
+                        self.total_errors += 1
+                    req.out.put(
+                        {"type": "error",
+                         "error": "engine stalled: accelerator unresponsive"}
+                    )
+                    req.out.put(_DONE)
+                    drained += 1
+                if drained:
+                    log.error("engine watchdog errored %d queued requests", drained)
+            elif self.stalled:
+                self.stalled = False
+                log.warning("engine loop recovered after stall")
+
     def _next_counter(self) -> int:
         """RNG stream position. The hot paths ship the counter inside their
         packed int transfer and fold it into the base key ON DEVICE — a
@@ -637,6 +701,16 @@ class GenerationEngine:
     def submit(self, req: GenRequest) -> GenRequest:
         if self._stop_evt.is_set():
             req.out.put({"type": "error", "error": "engine shutdown"})
+            req.out.put(_DONE)
+            return req
+        if self.stalled:
+            # fail fast instead of queueing behind a wedged device call —
+            # the router sees the device offline and falls back to cloud
+            with self.stats_lock:
+                self.total_errors += 1
+            req.out.put(
+                {"type": "error", "error": "engine stalled: accelerator unresponsive"}
+            )
             req.out.put(_DONE)
             return req
         self._admit.put(req)
@@ -812,6 +886,15 @@ class GenerationEngine:
         """
         pending: _PendingRound | None = None
         while not self._stop_evt.is_set():
+            # watchdog stamp: idle loops iterate (the _wake wait times out),
+            # so staleness only accrues while a device call blocks. A
+            # resuming loop clears the stall flag itself — waiting for the
+            # watchdog's next poll (up to 30 s) would keep rejecting
+            # submits from an engine that is demonstrably serving again.
+            self.last_progress = time.time()
+            if self.stalled:
+                self.stalled = False
+                log.warning("engine loop resumed; clearing stall flag")
             active = [i for i, s in enumerate(self._slots) if s is not None]
             disp: _DispatchedRound | None = None
             if active:

@@ -175,6 +175,39 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         qkv_bias=True,
         params_b=7.6,
     ),
+    # DeepSeek-R1 distills — the local deepseek models the reference's
+    # smart routing seeds and tier-infers (`db/migrations/04_smart_routing
+    # .sql:20,35`, `discovery.go:510` thinking-model detection). They are
+    # published Qwen2.5/Llama-3.x checkpoints fine-tuned for <think>
+    # reasoning, so the existing families serve them verbatim (think-tag
+    # splitting: utils/tokens.py:split_think).
+    "deepseek-r1-distill-qwen-1.5b": ModelConfig(
+        name="deepseek-r1-distill-qwen-1.5b",
+        vocab_size=151_936,
+        dim=1536,
+        n_layers=28,
+        n_heads=12,
+        n_kv_heads=2,
+        ffn_hidden=8960,
+        rope_theta=10_000.0,
+        norm_eps=1e-6,
+        max_seq_len=131_072,
+        qkv_bias=True,  # Qwen2 architecture keeps attention biases
+        tie_embeddings=True,
+        params_b=1.78,
+    ),
+    "deepseek-r1-distill-llama-8b": ModelConfig(
+        name="deepseek-r1-distill-llama-8b",
+        vocab_size=128_256,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_hidden=14_336,
+        rope_theta=500_000.0,
+        max_seq_len=131_072,
+        params_b=8.0,
+    ),
     "qwen2.5-0.5b": ModelConfig(
         name="qwen2.5-0.5b",
         vocab_size=151_936,
@@ -350,6 +383,18 @@ def get_config(name: str) -> ModelConfig:
         cc = _compact(cname)
         if cc == ck or cc in ck:
             return cfg
+    if "deepseek-r1" in key or "deepseek_r1" in key or "deepscaler" in key or "deepcoder" in key:
+        # Ollama-style "deepseek-r1:1.5b" etc (reference tier seeds). Size
+        # decides the BASE ARCHITECTURE: 1.5b/7b are Qwen2.5 distills, 8b
+        # the llama distill. Other sizes (14b/32b/70b) have no config here
+        # — falling through to the KeyError beats resolving to a
+        # categorically wrong family (shape-mismatched weights, wrong vocab).
+        if "1.5b" in key:
+            return MODEL_CONFIGS["deepseek-r1-distill-qwen-1.5b"]
+        if "7b" in key:
+            return MODEL_CONFIGS["qwen2.5-7b"]  # R1-Distill-Qwen-7B base arch
+        if "8b" in key or "llama" in key:
+            return MODEL_CONFIGS["deepseek-r1-distill-llama-8b"]
     if "llama" in key and "1b" in key:
         return MODEL_CONFIGS["llama-3.2-1b"]
     if "llama" in key:

@@ -154,3 +154,23 @@ def test_hf_roundtrip_families():
                 np.asarray(v), np.asarray(back["layers"][k]), err_msg=f"{name}:{k}"
             )
         np.testing.assert_array_equal(np.asarray(params["embed"]), back["embed"])
+
+
+def test_deepseek_r1_distill_configs():
+    """The reference's seeded local deepseek names (04_smart_routing.sql:20,
+    35; discovery.go:510 thinking inference) resolve to real configs with
+    plausible parameter counts, and qkv_bias follows the base family."""
+    cfg = get_config("deepseek-r1:1.5b")
+    assert cfg.name == "deepseek-r1-distill-qwen-1.5b"
+    approx = cfg.param_count() / 1e9
+    assert abs(approx - 1.78) / 1.78 < 0.15, approx
+    assert get_config("deepseek-r1:8b").name == "deepseek-r1-distill-llama-8b"
+    assert get_config("deepscaler:1.5b").name == "deepseek-r1-distill-qwen-1.5b"
+    assert get_config(
+        "deepseek-ai/DeepSeek-R1-Distill-Qwen-1.5B"
+    ).name == "deepseek-r1-distill-qwen-1.5b"
+    # size decides base architecture: 7b is the Qwen2.5 distill; sizes with
+    # no in-repo config must FAIL, not silently resolve cross-family
+    assert get_config("deepseek-r1:7b").name == "qwen2.5-7b"
+    with pytest.raises(KeyError):
+        get_config("deepseek-r1:14b")

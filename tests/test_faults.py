@@ -155,3 +155,91 @@ def test_api_request_fault_returns_500_and_contains(stack):
     faults.configure("")
     with urllib.request.urlopen(f"{base}/health", timeout=10) as r:
         assert r.status == 200
+
+
+def test_engine_stall_watchdog(monkeypatch):
+    """A wedged device call (field incident: remote-TPU tunnel session lock
+    held by a dead client — uninterruptible, error-less silence) must not
+    strand callers: the watchdog detects the stalled loop, errors queued
+    requests, fails new submits fast, and clears on recovery."""
+    import threading
+    import time
+
+    import jax.numpy as jnp
+    import pytest
+
+    from llm_mcp_tpu.executor import GenerationEngine
+    from llm_mcp_tpu.executor.engine import GenRequest
+
+    monkeypatch.setenv("TPU_STALL_TIMEOUT_S", "0.5")
+    eng = GenerationEngine(
+        "tiny-llm", max_slots=2, max_seq_len=64, dtype=jnp.float32, decode_chunk=2
+    ).start()
+    try:
+        assert eng.generate("ok", max_tokens=2, temperature=0.0)["finish_reason"]
+        release = threading.Event()
+        orig = eng._admit_pending
+        state = {"wedged": False}
+
+        def wedge():
+            if not state["wedged"]:
+                state["wedged"] = True
+                release.wait(20)  # simulated uninterruptible device call
+            return orig()
+
+        eng._admit_pending = wedge
+        # a request already queued behind the wedge: the watchdog must
+        # error it (its consumer would otherwise hang forever)
+        stuck = GenRequest(prompt_ids=[1, 2, 3], max_tokens=4)
+        eng._admit.put(stuck)
+        eng._wake.set()
+        deadline = time.time() + 15
+        while not eng.stalled and time.time() < deadline:
+            time.sleep(0.05)
+        assert eng.stalled, "watchdog never flagged the stall"
+        evt = stuck.out.get(timeout=10)
+        assert evt["type"] == "error" and "stalled" in evt["error"]
+        # new submissions fail fast instead of queueing behind the wedge
+        with pytest.raises(RuntimeError, match="stalled"):
+            eng.generate("fail fast", max_tokens=2)
+        release.set()
+        deadline = time.time() + 15
+        while eng.stalled and time.time() < deadline:
+            time.sleep(0.05)
+        assert not eng.stalled, "watchdog never cleared after recovery"
+        # and the engine serves again
+        assert eng.generate("back", max_tokens=2, temperature=0.0)["finish_reason"]
+    finally:
+        release.set()
+        eng.shutdown()
+
+
+def test_server_flips_device_offline_on_stall():
+    """Serving layer maps an engine stall to device state: offline + circuit
+    failure while stalled (routing fails over), back online on recovery —
+    the reference's offline propagation (offline_handler.go:12-38) driven
+    by silence instead of connection errors."""
+    import jax.numpy as jnp
+
+    from llm_mcp_tpu.api.server import CoreServer
+    from llm_mcp_tpu.executor import GenerationEngine
+    from llm_mcp_tpu.state.db import Database
+    from llm_mcp_tpu.utils.config import Config
+
+    eng = GenerationEngine(
+        "tiny-llm", max_slots=2, max_seq_len=64, dtype=jnp.float32, decode_chunk=2
+    ).start()
+    srv = CoreServer(
+        Config(), db=Database(":memory:"), gen_engines={"tiny-llm": eng}
+    )
+    try:
+        srv.register_local_device()
+        eng.stalled = True
+        srv._check_engine_stalls()
+        row = srv.catalog.get_device(srv.device_id)
+        assert row is not None and not row["online"]
+        eng.stalled = False
+        srv._check_engine_stalls()
+        assert srv.catalog.get_device(srv.device_id)["online"]
+    finally:
+        eng.shutdown()
