@@ -284,6 +284,27 @@ def serve_window_degenerate(
     return ""
 
 
+def _arm_deadline(seconds: float, what: str) -> "threading.Timer":
+    """Hard-exit (rc=3) if `seconds` elapse: a wedged accelerator link makes
+    device calls block FOREVER with no error (observed live: the remote-TPU
+    tunnel's session lock held by a dead client wedged even jax.devices()
+    for hours). A hung bench is worse than a failed one — the driver must
+    get an rc and a diagnostic line, not silence."""
+    import threading
+
+    def boom() -> None:
+        print(
+            f"# bench DEADLINE EXCEEDED ({what} > {seconds:.0f}s): accelerator"
+            " link unresponsive (wedged session lock?); aborting", flush=True,
+        )
+        _exit_now(3)
+
+    t = threading.Timer(seconds, boom)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main() -> None:
     import jax
 
@@ -292,7 +313,12 @@ def main() -> None:
         # too late under the axon sitecustomize (it imports jax at
         # interpreter start); the config update still works pre-device-query
         jax.config.update("jax_platforms", "cpu")
+    init_guard = _arm_deadline(
+        float(os.environ.get("BENCH_INIT_TIMEOUT_S", "300")), "backend init"
+    )
     platform = jax.devices()[0].platform
+    init_guard.cancel()
+    _arm_deadline(float(os.environ.get("BENCH_DEADLINE_S", "3600")), "total bench")
     on_tpu = platform != "cpu"
 
     if os.environ.get("BENCH_MODEL"):

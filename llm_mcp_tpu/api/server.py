@@ -485,11 +485,13 @@ class CoreServer:
             self.queue.requeue_device_jobs([self.device_id])
             self._stall_offlined = True
         elif not stalled and getattr(self, "_stall_offlined", False):
-            # recovery re-onlines ONLY what the stall path took offline — an
-            # operator's explicit /v1/devices/offline must stick
+            # Recovery does NOT flip the device back itself: another path
+            # (operator /v1/devices/offline, worker connection-failure
+            # reports) may have offlined it during the stall window, and
+            # re-onlining here would override that. The periodic discovery
+            # tick re-registers the healthy self-device online on its own
+            # cadence (register_local_device via Runner.run).
             self._stall_offlined = False
-            if row is not None and not online:
-                self.catalog.set_device_online(self.device_id, True)
 
     def shutdown(self) -> None:
         self._bg_stop.set()

@@ -133,6 +133,9 @@ class _PrefillState:
     req: GenRequest
     ids: list[int]
     done: int = 0  # tokens already written into the cache
+    # terminal error already delivered by the stall watchdog — activation
+    # and chunk failure paths must not double-publish
+    aborted: bool = False
 
 
 class GenerationEngine:
@@ -657,6 +660,38 @@ class GenerationEngine:
                     drained += 1
                 if drained:
                     log.error("engine watchdog errored %d queued requests", drained)
+                # In-flight consumers must not hang forever either: deliver
+                # their terminal errors now. The wedged loop cannot race us
+                # (it is blocked inside a device call); if it resumes
+                # anyway, the aborted flag + identity guards turn its later
+                # emissions into no-ops against dead queues, and the slots
+                # self-clean through the normal finish path.
+                for s in list(self._slots):
+                    if (
+                        s is not None and not s.aborted and not s.done
+                        and self.stall_seconds() > self.stall_timeout_s
+                    ):
+                        s.aborted = True
+                        with self.stats_lock:
+                            self.total_errors += 1
+                        s.req.out.put(
+                            {"type": "error",
+                             "error": "engine stalled: accelerator unresponsive"}
+                        )
+                        s.req.out.put(_DONE)
+                for st in list(self._prefills.values()):
+                    if (
+                        not st.aborted
+                        and self.stall_seconds() > self.stall_timeout_s
+                    ):
+                        st.aborted = True
+                        with self.stats_lock:
+                            self.total_errors += 1
+                        st.req.out.put(
+                            {"type": "error",
+                             "error": "engine stalled: accelerator unresponsive"}
+                        )
+                        st.req.out.put(_DONE)
             elif self.stalled:
                 self.stalled = False
                 log.warning("engine loop recovered after stall")
@@ -1241,6 +1276,16 @@ class GenerationEngine:
         prompts."""
         group: list[int] = []
         metas: list[tuple[int, _PrefillState, int]] = []
+        # states the stall watchdog error-terminated while the loop was
+        # wedged: reclaim silently (their consumers are gone)
+        for slot in [
+            s for s in self._prefill_q if self._prefills.get(s, None) is not None
+            and self._prefills[s].aborted
+        ]:
+            self._prefill_q.remove(slot)
+            del self._prefills[slot]
+        if not self._prefill_q:
+            return
         try:  # the whole step: staging bugs must also fail over to waiters
             first = self._prefill_q[0]
             _, _, f_bucket, f_skey = self._chunk_shape(first)
@@ -1320,9 +1365,10 @@ class GenerationEngine:
                     if s is not None and s.req is st.req:
                         self._slots[slot] = None
                         self._lengths[slot] = self.max_seq_len  # park
-                    self.total_errors += 1
-                    st.req.out.put({"type": "error", "error": str(e)})
-                    st.req.out.put(_DONE)
+                    if not st.aborted:  # watchdog may have terminated it already
+                        self.total_errors += 1
+                        st.req.out.put({"type": "error", "error": str(e)})
+                        st.req.out.put(_DONE)
             if self._recover_cache():
                 self._abort_all("kv cache lost in failed prefill chunk")
 
@@ -1420,6 +1466,13 @@ class GenerationEngine:
         for b, s, col in disp.entries:
             if self._slots[b] is not s:
                 continue  # freed (and possibly re-admitted) since dispatch
+            if s.aborted:
+                # stall watchdog already delivered this consumer's terminal
+                # error while the loop was wedged — reclaim the slot now
+                # instead of decoding garbage until the seq cap
+                self._slots[b] = None
+                self._lengths[b] = S  # park
+                continue
             g = s.generated
             fin = False
             base_b = int(disp.base[b])

@@ -393,7 +393,7 @@ def get_config(name: str) -> ModelConfig:
             return MODEL_CONFIGS["deepseek-r1-distill-qwen-1.5b"]
         if "7b" in key:
             return MODEL_CONFIGS["qwen2.5-7b"]  # R1-Distill-Qwen-7B base arch
-        if "8b" in key or "llama" in key:
+        if "8b" in key:
             return MODEL_CONFIGS["deepseek-r1-distill-llama-8b"]
     if "llama" in key and "1b" in key:
         return MODEL_CONFIGS["llama-3.2-1b"]

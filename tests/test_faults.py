@@ -171,34 +171,56 @@ def test_engine_stall_watchdog(monkeypatch):
     from llm_mcp_tpu.executor import GenerationEngine
     from llm_mcp_tpu.executor.engine import GenRequest
 
-    monkeypatch.setenv("TPU_STALL_TIMEOUT_S", "0.5")
+    monkeypatch.setenv("TPU_STALL_TIMEOUT_S", "3")
     eng = GenerationEngine(
         "tiny-llm", max_slots=2, max_seq_len=64, dtype=jnp.float32, decode_chunk=2
     ).start()
+    release = threading.Event()
     try:
+        # warm BEFORE wedging: first-compile time must not trip the watchdog
         assert eng.generate("ok", max_tokens=2, temperature=0.0)["finish_reason"]
-        release = threading.Event()
-        orig = eng._admit_pending
         state = {"wedged": False}
+        orig_p = eng._prefill_round
 
         def wedge():
+            # _prefill_round runs right AFTER admission activates a request:
+            # wedging here guarantees an in-flight slot exists when the loop
+            # blocks (simulated uninterruptible device call)
             if not state["wedged"]:
                 state["wedged"] = True
-                release.wait(20)  # simulated uninterruptible device call
-            return orig()
+                release.wait(40)
+            return orig_p()
 
-        eng._admit_pending = wedge
+        eng._prefill_round = wedge
+        # an IN-FLIGHT stream when the wedge hits: its consumer must get a
+        # terminal error too, not hang forever on req.out.get()
+        results: list = []
+
+        def consume():
+            try:
+                results.append(eng.generate("inflight", max_tokens=100_000))
+            except RuntimeError as e:
+                results.append(e)
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        deadline = time.time() + 15
+        while not state["wedged"] and time.time() < deadline:
+            time.sleep(0.02)
+        assert state["wedged"], "loop never reached the wedge"
         # a request already queued behind the wedge: the watchdog must
         # error it (its consumer would otherwise hang forever)
         stuck = GenRequest(prompt_ids=[1, 2, 3], max_tokens=4)
         eng._admit.put(stuck)
-        eng._wake.set()
         deadline = time.time() + 15
         while not eng.stalled and time.time() < deadline:
             time.sleep(0.05)
         assert eng.stalled, "watchdog never flagged the stall"
         evt = stuck.out.get(timeout=10)
         assert evt["type"] == "error" and "stalled" in evt["error"]
+        t.join(timeout=10)
+        assert results and isinstance(results[0], RuntimeError), results
+        assert "stalled" in str(results[0])
         # new submissions fail fast instead of queueing behind the wedge
         with pytest.raises(RuntimeError, match="stalled"):
             eng.generate("fail fast", max_tokens=2)
@@ -239,7 +261,12 @@ def test_server_flips_device_offline_on_stall():
         row = srv.catalog.get_device(srv.device_id)
         assert row is not None and not row["online"]
         eng.stalled = False
+        # recovery does NOT flip the device back itself (another path may
+        # have offlined it meanwhile); the periodic discovery re-register
+        # brings a healthy self-device online on its own cadence
         srv._check_engine_stalls()
+        assert not srv.catalog.get_device(srv.device_id)["online"]
+        srv.register_local_device()  # the discovery tick's effect
         assert srv.catalog.get_device(srv.device_id)["online"]
     finally:
         eng.shutdown()
