@@ -313,6 +313,9 @@ def main() -> None:
         # too late under the axon sitecustomize (it imports jax at
         # interpreter start); the config update still works pre-device-query
         jax.config.update("jax_platforms", "cpu")
+    from llm_mcp_tpu.utils.config import enable_compile_cache
+
+    enable_compile_cache()
     init_guard = _arm_deadline(
         float(os.environ.get("BENCH_INIT_TIMEOUT_S", "300")), "backend init"
     )
@@ -475,8 +478,10 @@ def main() -> None:
     else:
         if os.environ.get("BENCH_SERVE", "") == "1":
             # CPU smoke for the serve-path harness itself (tiny model)
+            # 8 s window: a single mid-window executable compile on a busy
+            # CPU box can eat a 3 s window whole (observed 0.0 smokes)
             serve = serve_path_metrics(
-                "tiny-llm", n_clients=4, max_tokens=16, measure_s=3.0,
+                "tiny-llm", n_clients=4, max_tokens=16, measure_s=8.0,
                 quant="", kv_quant="", max_slots=4, max_seq_len=512,
                 decode_chunk=4,
             )

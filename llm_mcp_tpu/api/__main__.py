@@ -20,9 +20,10 @@ def main() -> None:
     )
     log = logging.getLogger("main")
 
-    from ..utils.config import Config
+    from ..utils.config import Config, enable_compile_cache
 
     cfg = Config()
+    enable_compile_cache()
 
     import jax.numpy as jnp
 

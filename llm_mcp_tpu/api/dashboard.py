@@ -53,7 +53,8 @@ class DashboardAPI:
         costs = self.catalog.costs_summary(since=time.time() - 86400)
         circuit = self.router.circuit.snapshot()
         hosts = self._host_tree(devices, circuit)
-        issues = self._issues(counts, devices, workers, circuit)
+        engines = self.engines_info()
+        issues = self._issues(counts, devices, workers, circuit, engines)
         resp.write_json(
             {
                 "ts": time.time(),
@@ -67,7 +68,7 @@ class DashboardAPI:
                 "workers": workers,
                 "costs_24h": costs,
                 "circuit": circuit,
-                "engines": self.engines_info(),
+                "engines": engines,
                 "issues": issues,
             }
         )
@@ -117,7 +118,7 @@ class DashboardAPI:
             return "inference"
         return "node"
 
-    def _issues(self, counts, devices, workers, circuit) -> list[str]:
+    def _issues(self, counts, devices, workers, circuit, engines=None) -> list[str]:
         """Plain-language cluster problems (`handlers.go:1295-1339`)."""
         issues: list[str] = []
         online = [d for d in devices if d["online"]]
@@ -141,6 +142,16 @@ class DashboardAPI:
         ]
         if stale:
             issues.append(f"Online devices not seen for >10min: {', '.join(sorted(stale))}.")
+        stalled = [
+            name
+            for name, info in (engines if engines is not None else self.engines_info()).items()
+            if info.get("stalled")
+        ]
+        if stalled:
+            issues.append(
+                "Local engine(s) STALLED — accelerator link unresponsive, "
+                f"requests failing over: {', '.join(sorted(stalled))}."
+            )
         return issues
 
     # -- debug -------------------------------------------------------------

@@ -178,6 +178,7 @@ class CoreServer:
                 "ttft_p50_ms": round(p50, 1),
                 "ttft_p95_ms": round(p95, 1),
                 "decode_compact": e.decode_compact,
+                "stalled": e.stalled,
                 "prefix_cache": e.prefix_cache_stats(),
             }
             self.metrics.engine_slots_in_use.set(e.slots_in_use())

@@ -127,3 +127,30 @@ class Config:
 
     def has_openrouter(self) -> bool:
         return bool(self.openrouter_api_key)
+
+
+def enable_compile_cache(path: str | None = None) -> None:
+    """Persistent XLA compile cache (serving entrypoints + bench): first 8B
+    compiles cost 1-2 min each on a remote chip, and engine restarts would
+    otherwise re-pay the whole executable zoo (prompt buckets, compact
+    buckets, admit shapes).
+
+    STRICTLY OPT-IN via JAX_COMPILATION_CACHE_DIR: measured on the CPU
+    backend, cached AOT executables can carry target-machine features the
+    loader host lacks (+prefer-no-scatter et al.) — XLA loads them anyway
+    with SIGILL warnings and a large slowdown. Only enable where you've
+    verified the backend round-trips its own cache."""
+    import logging as _logging
+
+    cache_dir = path if path is not None else getenv("JAX_COMPILATION_CACHE_DIR", "")
+    if not cache_dir:
+        return
+    # jax imports only on the enabled path — proxy-only workers deliberately
+    # never import jax (worker/__main__.py lazy-imports inside its engines
+    # branch), and this must stay a no-op for them
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # pragma: no cover — older jax
+        _logging.getLogger("config").debug("compile cache unavailable", exc_info=True)

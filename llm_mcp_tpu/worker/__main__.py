@@ -19,12 +19,13 @@ def main() -> None:
         format='{"ts":"%(asctime)s","level":"%(levelname)s","logger":"%(name)s","msg":"%(message)s"}',
     )
     from ..api.providers import CloudClient
-    from ..utils.config import Config
+    from ..utils.config import Config, enable_compile_cache
     from .client import CoreClient
     from .executors import Executors
     from .worker import Worker
 
     cfg = Config()
+    enable_compile_cache()
     core_url = os.environ.get("CORE_URL", "http://localhost:8080")
 
     gen_engines: dict = {}

@@ -248,9 +248,12 @@ def test_server_flips_device_offline_on_stall():
     from llm_mcp_tpu.state.db import Database
     from llm_mcp_tpu.utils.config import Config
 
+    # UNSTARTED engine: the running idle loop clears a manually-set stall
+    # flag within one iteration (correct behavior — but this test drives
+    # the SERVER mapping, so the flag must hold still)
     eng = GenerationEngine(
         "tiny-llm", max_slots=2, max_seq_len=64, dtype=jnp.float32, decode_chunk=2
-    ).start()
+    )
     srv = CoreServer(
         Config(), db=Database(":memory:"), gen_engines={"tiny-llm": eng}
     )
