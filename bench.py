@@ -411,7 +411,10 @@ def main() -> None:
                         max_slots=B,
                         max_seq_len=S,
                         decode_chunk=int(os.environ.get("BENCH_DECODE_CHUNK", "32")),
-                        admit_batch=int(os.environ.get("BENCH_ADMIT_BATCH", "4")),
+                        # 8 measured better p50 TTFT than 4 at B=80 (2286 vs
+                        # 2645 ms) at equal throughput: fewer, larger fused
+                        # admissions amortize the prompt weight pass
+                        admit_batch=int(os.environ.get("BENCH_ADMIT_BATCH", "8")),
                         decode_compact=os.environ.get("BENCH_DECODE_COMPACT", "auto"),
                     )
                 except Exception as e:  # never lose the bench line to a serve bug
