@@ -193,6 +193,17 @@ class GenerationEngine:
             log.warning("unknown kv_quant mode %r (supported: int8); using %s cache",
                         self.kv_quant, jnp.dtype(dtype).name)
             self.kv_quant = ""
+        if self.cfg.kv_lora_rank:
+            # MLA (models/mla.py): the latent cache is already ~3.6x smaller
+            # than GQA K/V — int8 KV buys little and isn't implemented; the
+            # chunked-prefill kernel is llama-shaped, so MLA prefills whole
+            # prompts (its cache rows per token are small enough that the
+            # admission weight pass dominates anyway).
+            if self.kv_quant:
+                log.warning("int8 KV cache unsupported for MLA %s; using %s latents",
+                            self.cfg.name, jnp.dtype(dtype).name)
+                self.kv_quant = ""
+            prefill_chunk = 0
         self.decode_impl = resolve_decode_impl(
             mesh,
             quantized=self.kv_quant == "int8",
@@ -264,7 +275,8 @@ class GenerationEngine:
         )
         if mesh is not None:
             cache = shard_pytree(
-                cache, kv_cache_specs(quantized=self.kv_quant == "int8"), mesh
+                cache, kv_cache_specs(quantized=self.kv_quant == "int8",
+                               latent=bool(self.cfg.kv_lora_rank)), mesh
             )
         self._ck = cache["k"]
         self._cv = cache["v"]
@@ -322,8 +334,11 @@ class GenerationEngine:
         # into the ring masks, int8 weights dequant inside the shard_map —
         # so long context composes with quantization (the 8B int8 target).
         # MoE keeps the GSPMD prefill: experts ride the ep axis, not sp.
+        # MLA keeps GSPMD too: the ring kernels are GQA-shaped (an MLA tree
+        # has no wq/wk/wv) — its long-context prefill memory is bounded by
+        # the query-blocked form instead (models/mla.py).
         self.sp = 1
-        if mesh is not None and not cfg_.n_experts:
+        if mesh is not None and not cfg_.n_experts and not cfg_.kv_lora_rank:
             axes = dict(zip(mesh.axis_names, mesh.devices.shape))
             if (
                 axes.get("sp", 1) > 1
@@ -867,7 +882,8 @@ class GenerationEngine:
         )
         if self.mesh is not None:
             cache = shard_pytree(
-                cache, kv_cache_specs(quantized=self.kv_quant == "int8"), self.mesh
+                cache, kv_cache_specs(quantized=self.kv_quant == "int8",
+                               latent=bool(self.cfg.kv_lora_rank)), self.mesh
             )
         self._ck = cache["k"]
         self._cv = cache["v"]

@@ -50,6 +50,16 @@ class ModelConfig:
     # (1 = all layers sliding, Mistral; 2 = alternating, Gemma2)
     sliding_pattern: int = 1
     post_norms: bool = False  # Gemma2: extra RMSNorm after attn and after FFN
+    # MLA (DeepSeek-V2/V3 multi-head latent attention, arch="mla"): q/kv
+    # project through low-rank latents; the KV cache stores ONE latent
+    # vector (+ a shared rope key) per token instead of per-head K/V —
+    # kv_lora_rank + qk_rope_head_dim floats/token vs 2*n_kv_heads*head_dim
+    # (e.g. 576 vs 2048 at 8B-class GQA: ~3.6x more context per HBM byte).
+    q_lora_rank: int = 0  # 0 → dense q projection (V2-Lite style)
+    kv_lora_rank: int = 0  # >0 enables MLA
+    qk_rope_head_dim: int = 0  # per-head rope dims (shared key)
+    qk_nope_head_dim: int = 0  # per-head non-rope dims
+    v_head_dim: int = 0  # per-head value dims
     # serving metadata
     params_b: float = 0.0
     tie_embeddings: bool = False
@@ -68,13 +78,21 @@ class ModelConfig:
         ffn = 3 * self.dim * self.ffn_hidden
         if self.n_experts:
             ffn = self.n_experts * ffn + self.dim * self.n_experts  # experts + router
-        per_layer = (
-            self.dim * self.n_heads * hd  # wq
-            + 2 * self.dim * self.n_kv_heads * hd  # wk, wv
-            + self.n_heads * hd * self.dim  # wo
-            + ffn
-            + 2 * self.dim  # norms
-        )
+        if self.kv_lora_rank:  # MLA factorized attention
+            dn, dr, dv = self.qk_nope_head_dim, self.qk_rope_head_dim, self.v_head_dim
+            attn = (
+                self.dim * self.n_heads * (dn + dr)  # q proj (dense-q)
+                + self.dim * (self.kv_lora_rank + dr)  # kv down + rope key
+                + self.kv_lora_rank * self.n_heads * (dn + dv)  # kv up
+                + self.n_heads * dv * self.dim  # o proj
+            )
+        else:
+            attn = (
+                self.dim * self.n_heads * hd  # wq
+                + 2 * self.dim * self.n_kv_heads * hd  # wk, wv
+                + self.n_heads * hd * self.dim  # wo
+            )
+        per_layer = attn + ffn + 2 * self.dim  # + norms
         embed = self.vocab_size * self.dim
         head = 0 if self.tie_embeddings or self.arch == "encoder" else self.vocab_size * self.dim
         return embed + self.n_layers * per_layer + head + self.dim
@@ -109,6 +127,45 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         max_seq_len=131_072,
         params_b=1.24,
         tie_embeddings=True,
+    ),
+    # MLA (DeepSeek-style latent attention) at llama-8B-scale proportions:
+    # an in-repo long-context serving config (NOT a published checkpoint) —
+    # its KV cache costs 576 values/token/layer vs llama-3.1-8b's 2048, so
+    # the same HBM serves ~3.6x the (slots x context). models/mla.py.
+    "mla-8b": ModelConfig(
+        name="mla-8b",
+        arch="mla",
+        vocab_size=128_256,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=1,  # latent cache: one shared row per token
+        ffn_hidden=14_336,
+        rope_theta=500_000.0,
+        max_seq_len=131_072,
+        kv_lora_rank=512,
+        qk_rope_head_dim=64,
+        qk_nope_head_dim=128,
+        v_head_dim=128,
+        params_b=9.2,
+    ),
+    "tiny-mla": ModelConfig(
+        name="tiny-mla",
+        arch="mla",
+        vocab_size=512,
+        dim=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=1,
+        ffn_hidden=256,
+        rope_theta=10_000.0,
+        max_seq_len=512,
+        kv_lora_rank=32,
+        qk_rope_head_dim=16,
+        qk_nope_head_dim=32,
+        v_head_dim=32,
+        tie_embeddings=True,
+        params_b=0.001,
     ),
     # Tiny config for tests / CPU dev — same code paths, toy sizes.
     "tiny-llm": ModelConfig(

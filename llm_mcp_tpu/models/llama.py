@@ -48,10 +48,17 @@ Params = dict[str, Any]
 
 
 def init_llama_params(
-    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
+    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16,
+    _dispatch: bool = True,
 ) -> Params:
     """Random-init weights with fan-in scaling (used when no checkpoint is
-    supplied; real weights load via models/weights.py)."""
+    supplied; real weights load via models/weights.py). MLA configs
+    (kv_lora_rank > 0) dispatch to models/mla.py, which reuses this body
+    for the shared embed/FFN/norm structure via _dispatch=False."""
+    if _dispatch and cfg.kv_lora_rank:
+        from .mla import init_mla_params
+
+        return init_mla_params(cfg, key, dtype=dtype)
     hd = cfg.resolved_head_dim
     L, D, H, Hkv, F, V = (
         cfg.n_layers,
@@ -69,14 +76,20 @@ def init_llama_params(
     # norm weights init to 1 - offset so an offset-norm family (Gemma's
     # x * (1 + w)) starts at the same identity scale as plain RMSNorm.
     norm_init = jnp.full((L, D), 1.0 - cfg.norm_weight_offset, dtype=dtype)
-    layers: Params = {
-        "attn_norm": norm_init,
-        "wq": w(keys[1], (L, D, H * hd), D),
-        "wk": w(keys[2], (L, D, Hkv * hd), D),
-        "wv": w(keys[3], (L, D, Hkv * hd), D),
-        "wo": w(keys[4], (L, H * hd, D), H * hd),
-        "ffn_norm": norm_init,
-    }
+    layers: Params = {"attn_norm": norm_init, "ffn_norm": norm_init}
+    if not cfg.kv_lora_rank:
+        # GQA projections — MLA configs (reached with _dispatch=False from
+        # init_mla_params) build their factorized attention instead; at
+        # 8B-class shapes the discarded GQA weights would be a ~4 GB
+        # init-time transient
+        layers.update(
+            {
+                "wq": w(keys[1], (L, D, H * hd), D),
+                "wk": w(keys[2], (L, D, Hkv * hd), D),
+                "wv": w(keys[3], (L, D, Hkv * hd), D),
+                "wo": w(keys[4], (L, H * hd, D), H * hd),
+            }
+        )
     if cfg.qkv_bias:
         layers["bq"] = jnp.zeros((L, H * hd), dtype=dtype)
         layers["bk"] = jnp.zeros((L, Hkv * hd), dtype=dtype)
@@ -118,7 +131,22 @@ def init_kv_cache(
 
     Quantized entries are {"q": int8 [L,B,Hkv,S,hd], "s": dtype [L,B,Hkv,S]};
     plain entries are a bare [L,B,Hkv,S,hd] array. Both forms flow through
-    `llama_decode_step` (jit treats them as pytrees)."""
+    `llama_decode_step` (jit treats them as pytrees).
+
+    MLA configs store latents instead (models/mla.py:init_mla_cache) in the
+    same (k, v) pair convention; the int8 form is unnecessary there (the
+    latent cache is already ~3.6x smaller than GQA K/V) and unsupported."""
+    if cfg.kv_lora_rank:
+        from .mla import init_mla_cache
+
+        if quantized:
+            import logging
+
+            logging.getLogger("models").warning(
+                "int8 KV cache unsupported for MLA (%s); using %s latents",
+                cfg.name, jnp.dtype(dtype).name,
+            )
+        return init_mla_cache(cfg, batch, max_seq, dtype=dtype)
     hd = cfg.resolved_head_dim
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_seq, hd)
     if quantized:
@@ -333,6 +361,10 @@ def llama_prefill(
     otherwise stack ~1 GB of bf16 KV before the engine's quantize step,
     enough memory pressure to collapse serving throughput.
     """
+    if cfg.kv_lora_rank:  # MLA family: latent cache, expanded prefill
+        from .mla import mla_prefill
+
+        return mla_prefill(cfg, params, tokens, lengths)
     B, S = tokens.shape
     h = _embed_in(cfg, params, tokens)  # [B, S, D]
     cos, sin, mask = prefill_masks(cfg, S, lengths)
@@ -664,6 +696,12 @@ def llama_decode_step(
     (QK scores scale by k's per-token scale; v's folds into the probs), so
     the HBM read is int8 payload + 1/head_dim of scales.
     """
+    if cfg.kv_lora_rank:  # MLA family: absorbed decode over the latent cache
+        from .mla import mla_decode_step
+
+        return mla_decode_step(
+            cfg, params, cache_k, cache_v, tokens, lengths, slot_ids=slot_ids
+        )
     quantized = isinstance(cache_k, dict)
     L, B, Hkv, S, hd = _cache_shape(cache_k)
     Ba = tokens.shape[0]

@@ -1,0 +1,295 @@
+"""Multi-head Latent Attention (MLA) — DeepSeek-V2/V3-style KV compression,
+TPU-first.
+
+Why it exists here: decode is cache-bandwidth-bound (see
+kernels/attention.py), and long-context serving is capped by KV bytes per
+token. GQA at 8B-class shapes stores 2 * n_kv_heads * head_dim = 2048
+values/token/layer; MLA stores ONE shared latent (kv_lora_rank) plus a
+shared rope key (qk_rope_head_dim) — 576 values/token/layer at DeepSeek
+proportions, ~3.6x more context per HBM byte, with per-head K/V
+re-expanded from the latent by weight matrices that live in HBM once.
+
+TPU-first choices:
+  - **Decode runs ABSORBED**: queries fold through the k-up-projection
+    (q̃ = q_nope @ W_uk per head) so attention works directly against the
+    latent cache — two dense einsums on the MXU, no per-head K/V ever
+    materialized at decode time. The value side re-expands only the
+    attended context vector (H x kv_lora_rank @ kv_lora_rank x v_dim).
+  - **Prefill runs EXPANDED**: at prompt lengths the O(S) per-head K/V is
+    cheap relative to the weight pass, and the expanded form is one
+    standard masked attention XLA fuses well.
+  - **Engine compatibility by shape**: the latent cache poses as a
+    one-kv-head llama cache — k-cache := latents [L, B, 1, S, kv_lora_rank],
+    v-cache := rope keys [L, B, 1, S, qk_rope_head_dim] — so the engine's
+    entire slot machinery (bucketed inserts, chunk writes, compaction
+    scatter, donation, recovery) works unchanged. `llama_prefill` /
+    `llama_decode_step` dispatch here when cfg.kv_lora_rank > 0.
+
+Reference parity note: the reference serves deepseek-architecture models
+only through Ollama (`discovery.go:510` infers metadata from the name);
+this module is what "serving a deepseek-class architecture in-process"
+means TPU-side. Rope here is the repo's split-half convention; loading
+published DeepSeek checkpoints additionally needs their yarn-scaled rope
+and shared-expert MoE (tracked in NOTES_r03.md), so the in-repo configs
+are the `tiny-mla` test config and an `mla-8b` long-context serving
+config with llama-8B-scale proportions.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.rope import apply_rope, rope_frequencies
+from .configs import ModelConfig
+
+Params = Any
+
+
+def _dims(cfg: ModelConfig) -> tuple[int, int, int, int]:
+    """(n_heads, qk_nope, qk_rope, v_dim)."""
+    return cfg.n_heads, cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+
+
+def mla_scale(cfg: ModelConfig) -> float:
+    return (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
+
+
+def init_mla_params(
+    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    """Random-init MLA decoder weights (dense-q variant: q_lora_rank == 0
+    projects queries directly, as DeepSeek-V2-Lite does)."""
+    from .llama import init_llama_params  # shared embed/ffn/norm structure
+
+    if cfg.q_lora_rank:
+        raise ValueError(
+            "q_lora_rank > 0 (low-rank query path) is not implemented; use "
+            "the dense-q MLA variant (q_lora_rank=0, V2-Lite style)"
+        )
+    H, dn, dr, dv = _dims(cfg)
+    L, D, R = cfg.n_layers, cfg.dim, cfg.kv_lora_rank
+    # the base init skips wq/wk/wv/wo for MLA configs (they would be
+    # built at full GQA size only to be discarded — a ~4 GB transient at
+    # 8B-class shapes)
+    base = init_llama_params(cfg, key, dtype=dtype, _dispatch=False)
+    layers = base["layers"]
+    for k in ("wq", "wk", "wv", "wo", "bq", "bk", "bv"):
+        layers.pop(k, None)
+
+    def w(k, shape, fan_in):
+        return (
+            jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in**-0.5)
+        ).astype(dtype)
+
+    kq = jax.random.split(jax.random.fold_in(key, 7), 4)
+    layers["wq_mla"] = w(kq[0], (L, D, H * (dn + dr)), D)
+    # one matmul produces (latent c_kv | shared rope key), HF
+    # kv_a_proj_with_mqa layout
+    layers["w_dkv"] = w(kq[1], (L, D, R + dr), D)
+    layers["kv_norm"] = jnp.ones((L, R), dtype=dtype)  # kv_a_layernorm
+    # up-projection from the latent to per-head (k_nope | v)
+    layers["w_ukv"] = w(kq[2], (L, R, H * (dn + dv)), R)
+    layers["wo_mla"] = w(kq[3], (L, H * dv, D), H * dv)
+    return base
+
+
+def init_mla_cache(
+    cfg: ModelConfig, batch: int, max_seq: int, dtype: jnp.dtype = jnp.bfloat16
+) -> dict[str, jnp.ndarray]:
+    """Latent cache in the engine's (k, v) pair convention:
+    k := latents [L, B, 1, S, kv_lora_rank], v := rope keys
+    [L, B, 1, S, qk_rope_head_dim]. The fake one-head axis keeps every
+    slot-machinery code path (inserts, chunked writes, compaction)
+    byte-compatible with the llama cache layout."""
+    L, R, dr = cfg.n_layers, cfg.kv_lora_rank, cfg.qk_rope_head_dim
+    return {
+        "k": jnp.zeros((L, batch, 1, max_seq, R), dtype=dtype),
+        "v": jnp.zeros((L, batch, 1, max_seq, dr), dtype=dtype),
+    }
+
+
+def _latents(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
+    """x [..., D] → (c_kv [..., R] normed, k_rope [..., dr] pre-rope)."""
+    from .llama import _rms_norm
+    from .quant import qdot
+
+    R = cfg.kv_lora_rank
+    ckr = qdot(x, lp["w_dkv"])  # [..., R + dr]
+    c = _rms_norm(ckr[..., :R], lp["kv_norm"], cfg.norm_eps)
+    return c, ckr[..., R:]
+
+
+def _queries(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
+    """x [..., D] → (q_nope [..., H, dn], q_rope [..., H, dr])."""
+    from .quant import qdot
+
+    H, dn, dr, _ = _dims(cfg)
+    q = qdot(x, lp["wq_mla"]).reshape(*x.shape[:-1], H, dn + dr)
+    return q[..., :dn], q[..., dn:]
+
+
+def mla_prefill(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32 right-padded prompts
+    lengths: jnp.ndarray,  # [B] int32 true lengths
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Causal prefill with QUERY-BLOCKED expanded attention: per-head K/V
+    re-materialize once (O(S) memory), but scores/probs only ever exist for
+    one query block at a time — [B, H, QB, S] instead of [B, H, S, S].
+    A naive expanded form would build an 8.6 GB f32 score tensor per layer
+    at S=8192/H=32; blocking keeps long-context prefill linear in S (the
+    same job chunked prefill does for the llama families).
+
+    Returns (last_logits [B, V] f32, latents [L, B, 1, S, R], rope_keys
+    [L, B, 1, S, dr]) — the cache rows to insert at the request's slot
+    (post-rope, decode-ready)."""
+    from .llama import _embed_in, _ffn_residual, _logits, _norm
+    from .quant import qdot
+
+    H, dn, dr, dv = _dims(cfg)
+    B, S = tokens.shape
+    scale = mla_scale(cfg)
+    h = _embed_in(cfg, params, tokens)  # [B, S, D]
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :]
+    cos, sin = rope_frequencies(dr, cfg.rope_theta, positions)  # [1, S, dr/2]
+    key_pos = jnp.arange(S, dtype=jnp.int32)
+    valid_k = key_pos[None, :] < lengths[:, None]  # [B, S]
+    neg = jnp.float32(-1e30)
+    QB = next((c for c in (256, 128, 64, 32, 16, 8, 4, 2, 1) if S % c == 0))
+    nb = S // QB
+
+    def layer(h, lp):
+        x = _norm(cfg, h, lp["attn_norm"])
+        qn, qr = _queries(cfg, lp, x)  # [B, S, H, dn/dr]
+        qr = apply_rope(qr, cos, sin)
+        c, kr = _latents(cfg, lp, x)  # [B, S, R], [B, S, dr]
+        kr = apply_rope(kr[..., None, :], cos, sin)[..., 0, :]  # shared key
+        kv = qdot(c, lp["w_ukv"]).reshape(B, S, H, dn + dv)
+        kn, v = kv[..., :dn], kv[..., dn:]
+
+        # query blocks ride a scan: [nb, B, QB, H, d] xs against the full
+        # (linear-size) keys closed over — one block's [B, H, QB, S] scores
+        # live at a time
+        qn_b = qn.reshape(B, nb, QB, H, dn).transpose(1, 0, 2, 3, 4)
+        qr_b = qr.reshape(B, nb, QB, H, dr).transpose(1, 0, 2, 3, 4)
+        pos_b = jnp.arange(S, dtype=jnp.int32).reshape(nb, QB)
+
+        def qblock(_, xs):
+            qnj, qrj, posj = xs  # [B, QB, H, ·], [QB]
+            scores = (
+                jnp.einsum("bqhd,bkhd->bhqk", qnj, kn)
+                + jnp.einsum("bqhd,bkd->bhqk", qrj, kr)
+            ).astype(jnp.float32) * scale
+            mask = (key_pos[None, :] <= posj[:, None])[None, None] & valid_k[
+                :, None, None, :
+            ]  # [B, 1|QB, S] → [B, 1, QB, S]
+            scores = jnp.where(mask, scores, neg)
+            probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)  # [B, QB, H, dv]
+            return None, ctx
+
+        _, ctx_b = jax.lax.scan(qblock, None, (qn_b, qr_b, pos_b))
+        ctx = ctx_b.transpose(1, 0, 2, 3, 4).reshape(B, S, H * dv)
+        h = h + qdot(ctx, lp["wo_mla"])
+        h = _ffn_residual(cfg, lp, h)
+        return h, (c, kr)
+
+    def scan_layer(carry, lp):
+        h = carry
+        h, (c, kr) = layer(h, lp)
+        return h, (c, kr)
+
+    h, (cs, krs) = jax.lax.scan(scan_layer, h, params["layers"])
+    last = jnp.clip(lengths - 1, 0, S - 1)
+    h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
+    logits = _logits(cfg, params, h_last)
+    # [L, B, S, ·] → engine layout [L, B, 1, S, ·]
+    return logits, cs[:, :, None], krs[:, :, None]
+
+
+def mla_decode_step(
+    cfg: ModelConfig,
+    params: Params,
+    cache_c: jnp.ndarray,  # [L, B, 1, S, R] latents (engine "k")
+    cache_r: jnp.ndarray,  # [L, B, 1, S, dr] rope keys (engine "v")
+    tokens: jnp.ndarray,  # [Ba] int32
+    lengths: jnp.ndarray,  # [Ba] int32 — write position per row
+    slot_ids: jnp.ndarray | None = None,  # [Ba] compaction indirection
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One absorbed-attention decode step for all slots.
+
+    Attention runs IN LATENT SPACE: q̃[h] = q_nope[h] @ W_uk[:, h] gives
+    per-head queries against the shared latents; the value side re-expands
+    only the attended [H, R] context. The caches follow the llama xla-path
+    structure (scan carry, in-place scatter at `lengths`, OOB rows
+    dropped → parked-slot invariant preserved)."""
+    from .llama import _embed_in, _ffn_residual, _logits, _norm
+    from .quant import qdot
+
+    H, dn, dr, dv = _dims(cfg)
+    L, B, _, S, R = cache_c.shape
+    Ba = tokens.shape[0]
+    scale = mla_scale(cfg)
+    h = _embed_in(cfg, params, tokens)  # [Ba, D]
+    cos, sin = rope_frequencies(dr, cfg.rope_theta, lengths)  # [Ba, dr/2]
+
+    rows = jnp.arange(B, dtype=jnp.int32) if slot_ids is None else slot_ids
+    b_idx = rows[:, None]  # [Ba, 1] scatter rows
+    w_idx = lengths[:, None]  # [Ba, 1] — broadcast to [Ba, 1(head)]
+    key_pos = jnp.arange(S)[None, :]
+    attn_mask = key_pos <= lengths[:, None]  # [Ba, S]
+    neg = jnp.float32(-1e30)
+
+    def rowsel(x):
+        return x if slot_ids is None else jnp.take(x, slot_ids, axis=0)
+
+    def layer(carry, lp):
+        h, cc_all, cr_all, li = carry
+        x = _norm(cfg, h, lp["attn_norm"])
+        qn, qr = _queries(cfg, lp, x)  # [Ba, H, dn/dr]
+        qr = apply_rope(qr, cos, sin)
+        c, kr = _latents(cfg, lp, x)  # [Ba, R], [Ba, dr]
+        kr = apply_rope(kr[:, None], cos, sin)[:, 0]
+        # scatter this step's latent/rope-key at (layer, row, 0, position) —
+        # in place on the scan-carried donated buffers (the llama xla-path
+        # pattern: per-layer one-token scatters, never a full-cache copy);
+        # OOB (parked) rows dropped
+        cc_all = cc_all.at[li, b_idx, jnp.zeros_like(b_idx), w_idx].set(
+            c[:, None].astype(cc_all.dtype)
+        )
+        cr_all = cr_all.at[li, b_idx, jnp.zeros_like(b_idx), w_idx].set(
+            kr[:, None].astype(cr_all.dtype)
+        )
+        # absorbed queries: q̃[h] = q_nope[h] @ W_uk[:, h]  → [Ba, H, R]
+        w_ukv = lp["w_ukv"]
+        if isinstance(w_ukv, dict):  # int8 weights: dequant once per step
+            w_ukv = w_ukv["q"].astype(h.dtype) * w_ukv["s"].astype(h.dtype)
+        w_uk = w_ukv.reshape(R, H, dn + dv)[:, :, :dn]  # [R, H, dn]
+        w_uv = w_ukv.reshape(R, H, dn + dv)[:, :, dn:]  # [R, H, dv]
+        qt = jnp.einsum("bhd,rhd->bhr", qn, w_uk)
+        lat = rowsel(
+            jax.lax.dynamic_index_in_dim(cc_all, li, 0, keepdims=False)[:, 0]
+        )  # [Ba, S, R]
+        rop = rowsel(
+            jax.lax.dynamic_index_in_dim(cr_all, li, 0, keepdims=False)[:, 0]
+        )  # [Ba, S, dr]
+        scores = (
+            jnp.einsum("bhr,bsr->bhs", qt, lat.astype(qt.dtype))
+            + jnp.einsum("bhd,bsd->bhs", qr, rop.astype(qr.dtype))
+        ).astype(jnp.float32) * scale
+        scores = jnp.where(attn_mask[:, None, :], scores, neg)
+        probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+        ctx_lat = jnp.einsum("bhs,bsr->bhr", probs, lat.astype(probs.dtype))
+        ctx = jnp.einsum("bhr,rhd->bhd", ctx_lat, w_uv).reshape(Ba, H * dv)
+        h = h + qdot(ctx, lp["wo_mla"])
+        h = _ffn_residual(cfg, lp, h)
+        return (h, cc_all, cr_all, li + 1), None
+
+    (h, cache_c, cache_r, _), _ = jax.lax.scan(
+        layer, (h, cache_c, cache_r, jnp.int32(0)), params["layers"]
+    )
+    return _logits(cfg, params, h), cache_c, cache_r

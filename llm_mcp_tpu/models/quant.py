@@ -28,7 +28,12 @@ import jax.numpy as jnp
 Params = dict[str, Any]
 
 # linear weights quantized inside each stacked layer pytree: [L, in, out]
-LAYER_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
+LAYER_QUANT_KEYS = (
+    "wq", "wk", "wv", "wo", "w1", "w2", "w3",
+    # MLA factorization (models/mla.py): qdot consumes these transparently;
+    # the absorbed decode dequantizes w_ukv once per step
+    "wq_mla", "w_dkv", "w_ukv", "wo_mla",
+)
 
 
 def _quantize_slice(w: jnp.ndarray, axis: int) -> dict[str, jnp.ndarray]:
@@ -180,14 +185,30 @@ def init_llama_params_quantized(
         return {"q": q, "s": s}
 
     norm_init = jnp.full((L, D), 1.0 - cfg.norm_weight_offset, dtype=scale_dtype)
-    layers: Params = {
-        "attn_norm": norm_init,
-        "wq": qw((L, D, H * hd), D, (L, H * hd)),
-        "wk": qw((L, D, Hkv * hd), D, (L, Hkv * hd)),
-        "wv": qw((L, D, Hkv * hd), D, (L, Hkv * hd)),
-        "wo": qw((L, H * hd, D), H * hd, (L, D)),
-        "ffn_norm": norm_init,
-    }
+    layers: Params = {"attn_norm": norm_init, "ffn_norm": norm_init}
+    if getattr(cfg, "kv_lora_rank", 0):
+        # MLA factorized attention (models/mla.py), direct-int8 — the
+        # latent down-projection's RMSNorm weight stays full precision
+        dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+        R = cfg.kv_lora_rank
+        layers.update(
+            {
+                "wq_mla": qw((L, D, H * (dn + dr)), D, (L, H * (dn + dr))),
+                "w_dkv": qw((L, D, R + dr), D, (L, R + dr)),
+                "kv_norm": jnp.ones((L, R), dtype=scale_dtype),
+                "w_ukv": qw((L, R, H * (dn + dv)), R, (L, H * (dn + dv))),
+                "wo_mla": qw((L, H * dv, D), H * dv, (L, D)),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "wq": qw((L, D, H * hd), D, (L, H * hd)),
+                "wk": qw((L, D, Hkv * hd), D, (L, Hkv * hd)),
+                "wv": qw((L, D, Hkv * hd), D, (L, Hkv * hd)),
+                "wo": qw((L, H * hd, D), H * hd, (L, D)),
+            }
+        )
     if cfg.qkv_bias:
         layers["bq"] = jnp.zeros((L, H * hd), dtype=scale_dtype)
         layers["bk"] = jnp.zeros((L, Hkv * hd), dtype=scale_dtype)

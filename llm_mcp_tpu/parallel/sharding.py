@@ -26,6 +26,31 @@ from ..models.configs import ModelConfig
 
 
 def llama_param_specs(cfg: ModelConfig) -> dict[str, Any]:
+    if cfg.kv_lora_rank:
+        # MLA (models/mla.py): heads live inside flat [.., D, H*(dn+dr)]
+        # projections — tp shards the head-packed output axes; the shared
+        # latent down-projection and its norm replicate (the latent is
+        # per-token global state every head reads).
+        layers: dict[str, Any] = {
+            "attn_norm": P(None, None),
+            "wq_mla": P(None, None, "tp"),
+            "w_dkv": P(None, None, None),
+            "kv_norm": P(None, None),
+            "w_ukv": P(None, None, "tp"),
+            "wo_mla": P(None, "tp", None),
+            "ffn_norm": P(None, None),
+            "w1": P(None, None, "tp"),
+            "w3": P(None, None, "tp"),
+            "w2": P(None, "tp", None),
+        }
+        specs: dict[str, Any] = {
+            "embed": P("tp", None),
+            "layers": layers,
+            "final_norm": P(None),
+        }
+        if not cfg.tie_embeddings:
+            specs["lm_head"] = P(None, "tp")
+        return specs
     layers: dict[str, Any] = {
         "attn_norm": P(None, None),
         "wq": P(None, None, "tp"),
@@ -88,10 +113,16 @@ def embedder_param_specs(cfg: ModelConfig) -> dict[str, Any]:
     }
 
 
-def kv_cache_specs(quantized: bool = False) -> dict[str, Any]:
+def kv_cache_specs(quantized: bool = False, latent: bool = False) -> dict[str, Any]:
     # [L, B, Hkv, S, hd] — batch slots on dp, KV heads on tp. The int8 cache
     # ({"q", "s"} pytrees) shards the payload identically; scales [L,B,Hkv,S]
     # drop the trailing head_dim axis.
+    if latent:
+        # MLA latent cache [L, B, 1, S, R]: the fake one-head axis cannot
+        # shard — every tp shard's heads read the SAME latent row, so it
+        # replicates over tp and shards batch on dp only (models/mla.py).
+        row = P(None, "dp", None, None, None)
+        return {"k": row, "v": row}
     row = P(None, "dp", "tp", None, None)
     if quantized:
         entry = {"q": row, "s": P(None, "dp", "tp", None)}
