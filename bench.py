@@ -16,6 +16,7 @@ Env knobs for sweeps (defaults are the driver configuration):
 
 from __future__ import annotations
 
+import gc
 import json
 import os
 import time
@@ -364,8 +365,6 @@ def main() -> None:
             except Exception as e:  # a failure must not eat the bench line
                 print(f"# raw-decode sweep failed: {e!r}", flush=True)
                 secondary["raw_decode_error"] = 0.0
-            import gc
-
             gc.collect()  # drop the B=112 sweep's weights+cache before re-building
             # run even when the B=112 sweep failed: the small B=8 config can
             # survive an OOM that killed the big one, and it is the only
@@ -384,6 +383,23 @@ def main() -> None:
                 except Exception as e:
                     print(f"# long-context raw sweep failed: {e!r}", flush=True)
                     secondary["raw_long_s_error"] = 0.0
+            gc.collect()  # each sweep below re-builds a ~14 GB model
+            if os.environ.get("BENCH_MLA", "1") != "0":
+                # MLA latent-cache long context (models/mla.py): 4 slots x
+                # 32k context costs ~4.8 GB of bf16 latents (576 values x
+                # 2 B x 32 layers) beside ~9.3 GB of int8 weights — 14 GB
+                # on the 16 GB chip. The GQA 8B config's bf16 KV at the
+                # same (B, S) would be ~8.6 GB (3.6x the values); its int8
+                # KV ~4.4 GB (MLA latents are bf16 until int8 latents land).
+                try:
+                    mt = round(
+                        raw_decode_tps("mla-8b", 4, 32_768, 32, rounds=2), 1
+                    )
+                    secondary[f"raw_decode_tok_per_s_mla-8b-int8_b4_s32768_{platform}"] = mt
+                except Exception as e:
+                    print(f"# mla long-context sweep failed: {e!r}", flush=True)
+                    secondary["raw_mla_error"] = 0.0
+                gc.collect()
             return tps
 
         # raw loop FIRST: it frees cleanly on return, while the serve run's

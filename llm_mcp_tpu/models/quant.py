@@ -189,6 +189,13 @@ def init_llama_params_quantized(
     if getattr(cfg, "kv_lora_rank", 0):
         # MLA factorized attention (models/mla.py), direct-int8 — the
         # latent down-projection's RMSNorm weight stays full precision
+        if getattr(cfg, "q_lora_rank", 0):
+            # same guard as init_mla_params: a silent dense-q tree would be
+            # the wrong architecture for a V2/V3-layout config
+            raise ValueError(
+                "q_lora_rank > 0 (low-rank query path) is not implemented; "
+                "use the dense-q MLA variant (q_lora_rank=0, V2-Lite style)"
+            )
         dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
         R = cfg.kv_lora_rank
         layers.update(
