@@ -194,15 +194,18 @@ class GenerationEngine:
                         self.kv_quant, jnp.dtype(dtype).name)
             self.kv_quant = ""
         if self.cfg.kv_lora_rank:
-            # MLA (models/mla.py): the latent cache is already ~3.6x smaller
-            # than GQA K/V — int8 KV buys little and isn't implemented; the
-            # chunked-prefill kernel is llama-shaped, so MLA prefills whole
-            # prompts (its cache rows per token are small enough that the
-            # admission weight pass dominates anyway).
+            # MLA (models/mla.py): the chunked-prefill kernel is
+            # llama-shaped, so MLA prefills whole prompts (query-blocked —
+            # linear memory in S; the admission weight pass dominates
+            # anyway). int8 latents (kv_quant=int8) are a CAPACITY trade:
+            # ~7x fewer cache bytes than bf16 GQA K/V, but the XLA path
+            # dequantizes each layer's latent row before the dot (no s8-MXU
+            # kernel for MLA yet) — expect slower steps than bf16 latents.
             if self.kv_quant:
-                log.warning("int8 KV cache unsupported for MLA %s; using %s latents",
-                            self.cfg.name, jnp.dtype(dtype).name)
-                self.kv_quant = ""
+                log.info(
+                    "MLA int8 latents: ~2x context capacity vs bf16 latents; "
+                    "step time may regress (dequant-then-dot XLA path)"
+                )
             prefill_chunk = 0
         self.decode_impl = resolve_decode_impl(
             mesh,

@@ -139,14 +139,7 @@ def init_kv_cache(
     if cfg.kv_lora_rank:
         from .mla import init_mla_cache
 
-        if quantized:
-            import logging
-
-            logging.getLogger("models").warning(
-                "int8 KV cache unsupported for MLA (%s); using %s latents",
-                cfg.name, jnp.dtype(dtype).name,
-            )
-        return init_mla_cache(cfg, batch, max_seq, dtype=dtype)
+        return init_mla_cache(cfg, batch, max_seq, dtype=dtype, quantized=quantized)
     hd = cfg.resolved_head_dim
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_seq, hd)
     if quantized:
@@ -361,10 +354,10 @@ def llama_prefill(
     otherwise stack ~1 GB of bf16 KV before the engine's quantize step,
     enough memory pressure to collapse serving throughput.
     """
-    if cfg.kv_lora_rank:  # MLA family: latent cache, expanded prefill
+    if cfg.kv_lora_rank:  # MLA family: latent cache, query-blocked prefill
         from .mla import mla_prefill
 
-        return mla_prefill(cfg, params, tokens, lengths)
+        return mla_prefill(cfg, params, tokens, lengths, quant_kv=quant_kv)
     B, S = tokens.shape
     h = _embed_in(cfg, params, tokens)  # [B, S, D]
     cos, sin, mask = prefill_masks(cfg, S, lengths)

@@ -97,14 +97,34 @@ def init_mla_params(
 
 
 def init_mla_cache(
-    cfg: ModelConfig, batch: int, max_seq: int, dtype: jnp.dtype = jnp.bfloat16
-) -> dict[str, jnp.ndarray]:
+    cfg: ModelConfig,
+    batch: int,
+    max_seq: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+    quantized: bool = False,
+) -> dict[str, Any]:
     """Latent cache in the engine's (k, v) pair convention:
     k := latents [L, B, 1, S, kv_lora_rank], v := rope keys
     [L, B, 1, S, qk_rope_head_dim]. The fake one-head axis keeps every
     slot-machinery code path (inserts, chunked writes, compaction)
-    byte-compatible with the llama cache layout."""
+    byte-compatible with the llama cache layout.
+
+    `quantized=True` stores int8 payloads with per-token scales (the same
+    post-dot scale-folding scheme as the GQA int8 cache): MLA's latent is
+    already ~3.6x smaller than GQA K/V by VALUE COUNT; int8 makes it
+    ~7x smaller by BYTES — double the context per HBM byte again."""
     L, R, dr = cfg.n_layers, cfg.kv_lora_rank, cfg.qk_rope_head_dim
+    if quantized:
+        return {
+            "k": {
+                "q": jnp.zeros((L, batch, 1, max_seq, R), dtype=jnp.int8),
+                "s": jnp.zeros((L, batch, 1, max_seq), dtype=dtype),
+            },
+            "v": {
+                "q": jnp.zeros((L, batch, 1, max_seq, dr), dtype=jnp.int8),
+                "s": jnp.zeros((L, batch, 1, max_seq), dtype=dtype),
+            },
+        }
     return {
         "k": jnp.zeros((L, batch, 1, max_seq, R), dtype=dtype),
         "v": jnp.zeros((L, batch, 1, max_seq, dr), dtype=dtype),
@@ -136,7 +156,8 @@ def mla_prefill(
     params: Params,
     tokens: jnp.ndarray,  # [B, S] int32 right-padded prompts
     lengths: jnp.ndarray,  # [B] int32 true lengths
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    quant_kv: bool = False,  # int8 latents (per-token scales) inside the scan
+) -> tuple[jnp.ndarray, Any, Any]:
     """Causal prefill with QUERY-BLOCKED expanded attention: per-head K/V
     re-materialize once (O(S) memory), but scores/probs only ever exist for
     one query block at a time — [B, H, QB, S] instead of [B, H, S, S].
@@ -196,6 +217,12 @@ def mla_prefill(
         ctx = ctx_b.transpose(1, 0, 2, 3, 4).reshape(B, S, H * dv)
         h = h + qdot(ctx, lp["wo_mla"])
         h = _ffn_residual(cfg, lp, h)
+        if quant_kv:
+            # quantize INSIDE the scan: the stacked bf16 latents of a long
+            # admission never materialize (llama_prefill's same trick)
+            from .llama import quantize_kv
+
+            return h, (quantize_kv(c), quantize_kv(kr))
         return h, (c, kr)
 
     def scan_layer(carry, lp):
@@ -207,8 +234,14 @@ def mla_prefill(
     last = jnp.clip(lengths - 1, 0, S - 1)
     h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
     logits = _logits(cfg, params, h_last)
-    # [L, B, S, ·] → engine layout [L, B, 1, S, ·]
-    return logits, cs[:, :, None], krs[:, :, None]
+
+    def to_engine_layout(x):
+        # [L, B, S, ·] → engine layout [L, B, 1, S, ·]
+        if isinstance(x, dict):
+            return {"q": x["q"][:, :, None], "s": x["s"][:, :, None]}
+        return x[:, :, None]
+
+    return logits, to_engine_layout(cs), to_engine_layout(krs)
 
 
 def mla_decode_step(
@@ -227,11 +260,12 @@ def mla_decode_step(
     only the attended [H, R] context. The caches follow the llama xla-path
     structure (scan carry, in-place scatter at `lengths`, OOB rows
     dropped → parked-slot invariant preserved)."""
-    from .llama import _embed_in, _ffn_residual, _logits, _norm
+    from .llama import _embed_in, _ffn_residual, _logits, _norm, quantize_kv
     from .quant import qdot
 
     H, dn, dr, dv = _dims(cfg)
-    L, B, _, S, R = cache_c.shape
+    quantized = isinstance(cache_c, dict)
+    L, B, _, S, R = (cache_c["q"] if quantized else cache_c).shape
     Ba = tokens.shape[0]
     scale = mla_scale(cfg)
     h = _embed_in(cfg, params, tokens)  # [Ba, D]
@@ -258,12 +292,28 @@ def mla_decode_step(
         # in place on the scan-carried donated buffers (the llama xla-path
         # pattern: per-layer one-token scatters, never a full-cache copy);
         # OOB (parked) rows dropped
-        cc_all = cc_all.at[li, b_idx, jnp.zeros_like(b_idx), w_idx].set(
-            c[:, None].astype(cc_all.dtype)
-        )
-        cr_all = cr_all.at[li, b_idx, jnp.zeros_like(b_idx), w_idx].set(
-            kr[:, None].astype(cr_all.dtype)
-        )
+        zero = jnp.zeros_like(b_idx)
+        if quantized:
+            cq, krq = quantize_kv(c), quantize_kv(kr)
+            cc_all = {
+                "q": cc_all["q"].at[li, b_idx, zero, w_idx].set(cq["q"][:, None]),
+                "s": cc_all["s"].at[li, b_idx, zero, w_idx].set(
+                    cq["s"][:, None].astype(cc_all["s"].dtype)
+                ),
+            }
+            cr_all = {
+                "q": cr_all["q"].at[li, b_idx, zero, w_idx].set(krq["q"][:, None]),
+                "s": cr_all["s"].at[li, b_idx, zero, w_idx].set(
+                    krq["s"][:, None].astype(cr_all["s"].dtype)
+                ),
+            }
+        else:
+            cc_all = cc_all.at[li, b_idx, zero, w_idx].set(
+                c[:, None].astype(cc_all.dtype)
+            )
+            cr_all = cr_all.at[li, b_idx, zero, w_idx].set(
+                kr[:, None].astype(cr_all.dtype)
+            )
         # absorbed queries: q̃[h] = q_nope[h] @ W_uk[:, h]  → [Ba, H, R]
         w_ukv = lp["w_ukv"]
         if isinstance(w_ukv, dict):  # int8 weights: dequant once per step
@@ -271,19 +321,41 @@ def mla_decode_step(
         w_uk = w_ukv.reshape(R, H, dn + dv)[:, :, :dn]  # [R, H, dn]
         w_uv = w_ukv.reshape(R, H, dn + dv)[:, :, dn:]  # [R, H, dv]
         qt = jnp.einsum("bhd,rhd->bhr", qn, w_uk)
-        lat = rowsel(
-            jax.lax.dynamic_index_in_dim(cc_all, li, 0, keepdims=False)[:, 0]
-        )  # [Ba, S, R]
-        rop = rowsel(
-            jax.lax.dynamic_index_in_dim(cr_all, li, 0, keepdims=False)[:, 0]
-        )  # [Ba, S, dr]
-        scores = (
-            jnp.einsum("bhr,bsr->bhs", qt, lat.astype(qt.dtype))
-            + jnp.einsum("bhd,bsd->bhs", qr, rop.astype(qr.dtype))
-        ).astype(jnp.float32) * scale
-        scores = jnp.where(attn_mask[:, None, :], scores, neg)
-        probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
-        ctx_lat = jnp.einsum("bhs,bsr->bhr", probs, lat.astype(probs.dtype))
+
+        def sel(x):
+            return rowsel(
+                jax.lax.dynamic_index_in_dim(x, li, 0, keepdims=False)[:, 0]
+            )
+
+        if quantized:
+            lat = sel(cc_all["q"])  # [Ba, S, R] int8 payload
+            rop = sel(cr_all["q"])  # [Ba, S, dr] int8
+            ls = sel(cc_all["s"]).astype(jnp.float32)  # [Ba, S]
+            rs = sel(cr_all["s"]).astype(jnp.float32)
+            # per-token dequant scales fold POST-DOT (the GQA int8 cache's
+            # trick): each dot's scores multiply by its own scale row, and
+            # the value-side scale folds into the probs before the PV dot
+            s_nope = jnp.einsum("bhr,bsr->bhs", qt, lat.astype(qt.dtype)).astype(
+                jnp.float32
+            ) * ls[:, None, :]
+            s_rope = jnp.einsum("bhd,bsd->bhs", qr, rop.astype(qr.dtype)).astype(
+                jnp.float32
+            ) * rs[:, None, :]
+            scores = (s_nope + s_rope) * scale
+            scores = jnp.where(attn_mask[:, None, :], scores, neg)
+            probs = jax.nn.softmax(scores, axis=-1)
+            pl = (probs * ls[:, None, :]).astype(h.dtype)
+            ctx_lat = jnp.einsum("bhs,bsr->bhr", pl, lat.astype(h.dtype))
+        else:
+            lat = sel(cc_all)  # [Ba, S, R]
+            rop = sel(cr_all)  # [Ba, S, dr]
+            scores = (
+                jnp.einsum("bhr,bsr->bhs", qt, lat.astype(qt.dtype))
+                + jnp.einsum("bhd,bsd->bhs", qr, rop.astype(qr.dtype))
+            ).astype(jnp.float32) * scale
+            scores = jnp.where(attn_mask[:, None, :], scores, neg)
+            probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+            ctx_lat = jnp.einsum("bhs,bsr->bhr", probs, lat.astype(probs.dtype))
         ctx = jnp.einsum("bhr,rhd->bhd", ctx_lat, w_uv).reshape(Ba, H * dv)
         h = h + qdot(ctx, lp["wo_mla"])
         h = _ffn_residual(cfg, lp, h)

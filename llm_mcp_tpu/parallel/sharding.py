@@ -122,6 +122,9 @@ def kv_cache_specs(quantized: bool = False, latent: bool = False) -> dict[str, A
         # shard — every tp shard's heads read the SAME latent row, so it
         # replicates over tp and shards batch on dp only (models/mla.py).
         row = P(None, "dp", None, None, None)
+        if quantized:
+            entry = {"q": row, "s": P(None, "dp", None, None)}
+            return {"k": entry, "v": entry}
         return {"k": row, "v": row}
     row = P(None, "dp", "tp", None, None)
     if quantized:
