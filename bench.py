@@ -236,8 +236,6 @@ def serve_path_metrics(
     # Drop every reference to the engine's device buffers (8B weights + KV)
     # before returning: the caller may immediately build another model, and
     # two 8B footprints do not fit one 16 GB chip.
-    import gc
-
     del eng, srv
     gc.collect()
     out = {"tok_per_s": (tok1 - tok0) / (m1 - m0)}
@@ -322,7 +320,21 @@ def main() -> None:
     )
     platform = jax.devices()[0].platform
     init_guard.cancel()
-    _arm_deadline(float(os.environ.get("BENCH_DEADLINE_S", "3600")), "total bench")
+    deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "3600"))
+    _arm_deadline(deadline_s, "total bench")
+    t_bench0 = time.time()
+
+    def over_budget(share: float, what: str, marker: str) -> bool:
+        """Secondary sweeps yield to the serve HEADLINE (which runs last):
+        once `share` of the deadline is spent, remaining secondaries skip
+        loudly — with a machine-readable marker, so a vanished metric key
+        reads as 'skipped for time', never as silent loss."""
+        if time.time() - t_bench0 > share * deadline_s:
+            print(f"# skipping {what}: {share:.0%} of BENCH_DEADLINE_S spent",
+                  flush=True)
+            secondary[marker] = 1.0
+            return True
+        return False
     on_tpu = platform != "cpu"
 
     if os.environ.get("BENCH_MODEL"):
@@ -369,7 +381,9 @@ def main() -> None:
             # run even when the B=112 sweep failed: the small B=8 config can
             # survive an OOM that killed the big one, and it is the only
             # on-hardware exercise of the blocked kernel
-            if os.environ.get("BENCH_LONG_S", "1") != "0":
+            if os.environ.get("BENCH_LONG_S", "1") != "0" and not over_budget(
+                0.25, "long-context sweep", "raw_long_s_skipped"
+            ):
                 # long-context decode on the real chip: S=8192 routes through
                 # the BLOCKED q8 kernel (manual-DMA double buffering, dynamic
                 # trip count — kernels/attention.py:_attend_q8_blocked_kernel),
@@ -384,7 +398,9 @@ def main() -> None:
                     print(f"# long-context raw sweep failed: {e!r}", flush=True)
                     secondary["raw_long_s_error"] = 0.0
             gc.collect()  # each sweep below re-builds a ~14 GB model
-            if os.environ.get("BENCH_MLA", "1") != "0":
+            if os.environ.get("BENCH_MLA", "1") != "0" and not over_budget(
+                0.35, "mla long-context sweep", "raw_mla_skipped"
+            ):
                 # MLA latent-cache long context (models/mla.py): 4 slots x
                 # 32k context costs ~4.8 GB of bf16 latents (576 values x
                 # 2 B x 32 layers) beside ~9.3 GB of int8 weights — 14 GB
@@ -410,8 +426,6 @@ def main() -> None:
         if os.environ.get("BENCH_SECONDARY", "1") != "0":
             raw_attempted = True
             raw_tps = run_raw()
-            import gc
-
             gc.collect()
         bench_max_tokens = int(os.environ.get("BENCH_MAX_TOKENS", "256"))
         if os.environ.get("BENCH_SERVE", "1") != "0":
@@ -448,8 +462,6 @@ def main() -> None:
                     + ("; retrying" if attempt == 1 else "; falling back to raw"),
                     flush=True,
                 )
-                import gc
-
                 gc.collect()
         if serve:
             # A window can "succeed" at a plausible rate with decode 100%
