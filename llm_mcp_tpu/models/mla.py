@@ -42,8 +42,20 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from ..ops.norms import rms_norm as _rms_norm
 from ..ops.rope import apply_rope, rope_frequencies
 from .configs import ModelConfig
+from .quant import qdot
+
+# llama.py imports this module only lazily inside its dispatch functions, so
+# pulling the shared decoder helpers in at module level is cycle-free
+from .llama import (
+    _embed_in,
+    _ffn_residual,
+    _logits,
+    _norm,
+    quantize_kv,
+)
 
 Params = Any
 
@@ -62,7 +74,7 @@ def init_mla_params(
 ) -> Params:
     """Random-init MLA decoder weights (dense-q variant: q_lora_rank == 0
     projects queries directly, as DeepSeek-V2-Lite does)."""
-    from .llama import init_llama_params  # shared embed/ffn/norm structure
+    from .llama import init_llama_params  # local: dispatch entry point
 
     if cfg.q_lora_rank:
         raise ValueError(
@@ -133,9 +145,6 @@ def init_mla_cache(
 
 def _latents(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
     """x [..., D] → (c_kv [..., R] normed, k_rope [..., dr] pre-rope)."""
-    from .llama import _rms_norm
-    from .quant import qdot
-
     R = cfg.kv_lora_rank
     ckr = qdot(x, lp["w_dkv"])  # [..., R + dr]
     c = _rms_norm(ckr[..., :R], lp["kv_norm"], cfg.norm_eps)
@@ -144,8 +153,6 @@ def _latents(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
 
 def _queries(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
     """x [..., D] → (q_nope [..., H, dn], q_rope [..., H, dr])."""
-    from .quant import qdot
-
     H, dn, dr, _ = _dims(cfg)
     q = qdot(x, lp["wq_mla"]).reshape(*x.shape[:-1], H, dn + dr)
     return q[..., :dn], q[..., dn:]
@@ -168,9 +175,6 @@ def mla_prefill(
     Returns (last_logits [B, V] f32, latents [L, B, 1, S, R], rope_keys
     [L, B, 1, S, dr]) — the cache rows to insert at the request's slot
     (post-rope, decode-ready)."""
-    from .llama import _embed_in, _ffn_residual, _logits, _norm
-    from .quant import qdot
-
     H, dn, dr, dv = _dims(cfg)
     B, S = tokens.shape
     scale = mla_scale(cfg)
@@ -220,8 +224,6 @@ def mla_prefill(
         if quant_kv:
             # quantize INSIDE the scan: the stacked bf16 latents of a long
             # admission never materialize (llama_prefill's same trick)
-            from .llama import quantize_kv
-
             return h, (quantize_kv(c), quantize_kv(kr))
         return h, (c, kr)
 
@@ -260,9 +262,6 @@ def mla_decode_step(
     only the attended [H, R] context. The caches follow the llama xla-path
     structure (scan carry, in-place scatter at `lengths`, OOB rows
     dropped → parked-slot invariant preserved)."""
-    from .llama import _embed_in, _ffn_residual, _logits, _norm, quantize_kv
-    from .quant import qdot
-
     H, dn, dr, dv = _dims(cfg)
     quantized = isinstance(cache_c, dict)
     L, B, _, S, R = (cache_c["q"] if quantized else cache_c).shape
@@ -318,8 +317,8 @@ def mla_decode_step(
         w_ukv = lp["w_ukv"]
         if isinstance(w_ukv, dict):  # int8 weights: dequant once per step
             w_ukv = w_ukv["q"].astype(h.dtype) * w_ukv["s"].astype(h.dtype)
-        w_uk = w_ukv.reshape(R, H, dn + dv)[:, :, :dn]  # [R, H, dn]
-        w_uv = w_ukv.reshape(R, H, dn + dv)[:, :, dn:]  # [R, H, dv]
+        w_ukv = w_ukv.reshape(R, H, dn + dv)
+        w_uk, w_uv = w_ukv[:, :, :dn], w_ukv[:, :, dn:]  # [R, H, dn] / [R, H, dv]
         qt = jnp.einsum("bhd,rhd->bhr", qn, w_uk)
 
         def sel(x):
