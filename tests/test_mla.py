@@ -242,3 +242,36 @@ def test_engine_serves_mla_int8_latents():
         assert a["usage"]["completion_tokens"] >= 1
     finally:
         eng.shutdown()
+
+
+def test_mla_soak_churn_parity():
+    """MLA variant of the churn soak: concurrent mixed prompts through
+    whole-prompt prefill + compaction + int8 latents must match a one-slot
+    sequential MLA engine token-for-token."""
+    import concurrent.futures as cf
+
+    full = GenerationEngine(
+        "tiny-mla", max_slots=16, max_seq_len=192, dtype=jnp.float32,
+        decode_chunk=4, kv_quant="int8", decode_compact="on",
+        admit_batch=4, seed=11,
+    ).start()
+    plain = GenerationEngine(
+        "tiny-mla", max_slots=1, max_seq_len=192, dtype=jnp.float32,
+        decode_chunk=4, kv_quant="int8", decode_compact="off", seed=11,
+    ).start()
+    try:
+        cases = [(f"mla churn {i} " * (1 + i % 5), 2 + i % 5) for i in range(24)]
+
+        def run_one(i):
+            p, n = cases[i]
+            return full.generate(p, max_tokens=n, temperature=0.0)["text"]
+
+        with cf.ThreadPoolExecutor(max_workers=len(cases)) as ex:
+            got = list(ex.map(run_one, range(len(cases))))
+        for i, (p, n) in enumerate(cases):
+            want = plain.generate(p, max_tokens=n, temperature=0.0)["text"]
+            assert got[i] == want, (i, p[:30])
+        assert full.total_errors == 0
+    finally:
+        full.shutdown()
+        plain.shutdown()
