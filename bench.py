@@ -406,7 +406,8 @@ def main() -> None:
                 # 2 B x 32 layers) beside ~9.3 GB of int8 weights — 14 GB
                 # on the 16 GB chip. The GQA 8B config's bf16 KV at the
                 # same (B, S) would be ~8.6 GB (3.6x the values); its int8
-                # KV ~4.4 GB (MLA latents are bf16 until int8 latents land).
+                # KV ~4.4 GB. (int8 latents exist too — kv_quant=int8 —
+                # trading a dequant-then-dot for another 2x capacity.)
                 try:
                     mt = round(
                         raw_decode_tps("mla-8b", 4, 32_768, 32, rounds=2), 1

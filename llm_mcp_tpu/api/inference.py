@@ -78,31 +78,78 @@ class InferenceAPI:
     def _local_embed(self, model: str) -> EmbeddingEngine | None:
         return self.embed_engines.get(model)
 
-    def _select_model_smart(self, category: str = "chat") -> str:
-        """model=="" → best model by rankings score × success rate − cost
-        factor (`handlers.go:3040-3144`, simplified to the same shape)."""
+    # accuracy level → (accuracy weight, cost factor), handlers.go:3051-3061
+    _ACCURACY_WEIGHTS = {
+        "low": (0.3, 3.0),
+        "medium": (0.6, 1.5),
+        "high": (0.9, 0.5),
+        "critical": (1.0, 0.0),
+    }
+
+    def _select_model_smart(
+        self,
+        category: str = "general",
+        accuracy: str = "medium",
+        max_cost_usd: float = 0.0,
+        messages: list | None = None,
+    ) -> str:
+        """model=="" → best ranked model by category score × accuracy weight
+        − cost factor × log-price tier (`handlers.go:3040-3144`): candidates
+        failing the context fit or the caller's cost cap are skipped; a model
+        unranked in the requested category falls back to its average score
+        across categories, then to 50."""
+        import math
+
+        # estimated input tokens ≈ chars/4 (handlers.go:3042-3048)
+        total_chars = 0
+        for m in messages or []:
+            c = m.get("content") if isinstance(m, dict) else None
+            if isinstance(c, str):
+                total_chars += len(c)
+        est_tokens = total_chars / 4.0
+
+        acc_weight, cost_factor = self._ACCURACY_WEIGHTS.get(
+            accuracy, self._ACCURACY_WEIGHTS["medium"]
+        )
         rows = self.catalog.db.query(
             """
-            SELECT r.model_id, r.score,
+            SELECT r.model_id,
+                   MAX(CASE WHEN r.category = ? THEN r.score END) AS cat_score,
+                   AVG(r.score) AS avg_score,
+                   COALESCE(m.context_k, 0) AS context_k,
+                   COALESCE(p.input_per_1m, 0) AS price_in,
+                   COALESCE(p.output_per_1m, 0) AS price_out,
                    COALESCE(s.requests, 0) AS requests,
-                   COALESCE(s.errors, 0) AS errors,
-                   COALESCE(p.output_per_1m, 0) AS out_price
+                   COALESCE(s.errors, 0) AS errors
             FROM model_rankings r
-            LEFT JOIN model_stats s ON s.model_id = r.model_id
+            LEFT JOIN models m ON m.id = r.model_id
             LEFT JOIN model_pricing p ON p.model_id = r.model_id
-            WHERE r.category = ?
-            ORDER BY r.score DESC
+            LEFT JOIN model_stats s ON s.model_id = r.model_id
+            GROUP BY r.model_id
             """,
             (category,),
         )
         best, best_score = "", -1e9
-        import math
-
         for r in rows:
-            req = r["requests"] or 0
-            success = (req - (r["errors"] or 0)) / req if req else 1.0
-            cost_factor = math.log1p(r["out_price"] or 0.0) * 0.1
-            score = r["score"] * success - cost_factor
+            ctx_k = r["context_k"] or 0
+            if ctx_k > 0 and est_tokens > ctx_k * 1000:
+                continue  # prompt won't fit the model's context
+            est_cost = (est_tokens / 1e6) * ((r["price_in"] or 0) + (r["price_out"] or 0))
+            if max_cost_usd > 0 and est_cost > max_cost_usd:
+                continue
+            cat_score = r["cat_score"]  # NULL (not 0.0) means unranked here
+            if cat_score is None:
+                cat_score = r["avg_score"] if r["avg_score"] is not None else 50.0
+            # log-scaled input-price tier: cheap models win at low accuracy
+            # regardless of prompt length (handlers.go:3115-3122)
+            price_in = r["price_in"] or 0.0
+            price_tier = math.log10(price_in * 1000 + 1) * 10 if price_in > 0 else 0.0
+            # observed success rate multiplies the quality term — beyond the
+            # reference formula: a model whose backend is failing most
+            # requests must shed smart-routed traffic even if well ranked
+            reqs = r["requests"] or 0
+            success = (reqs - (r["errors"] or 0)) / reqs if reqs else 1.0
+            score = cat_score * acc_weight * success - cost_factor * price_tier
             if score > best_score:
                 best, best_score = r["model_id"], score
         if best:
@@ -144,10 +191,36 @@ class InferenceAPI:
             stop = [stop]
 
         if not model:
-            model = self._select_model_smart("chat")
+            # smart selection surface: headers override body fields
+            # (handlers.go:2122-2152); the chosen model is echoed back in
+            # X-Selected-Model
+            task_type = (
+                req.headers.get("X-Task-Type")
+                or str(body.get("task_type") or "")
+                or "general"
+            )
+            accuracy = (
+                req.headers.get("X-Accuracy")
+                or str(body.get("accuracy") or "")
+                or "medium"
+            )
+            try:
+                max_cost = float(
+                    req.headers.get("X-Max-Cost")
+                    or body.get("max_cost_usd")
+                    or 0.0
+                )
+            except (TypeError, ValueError):
+                max_cost = 0.0
+            model = self._select_model_smart(task_type, accuracy, max_cost, messages)
             if not model:
                 resp.write_error("no model available", 503)
                 return
+            resp.extra_headers["X-Selected-Model"] = model
+            # the proxy path forwards `body` — carry the selection so a
+            # remote device serves exactly the advertised model instead of
+            # re-selecting under its own defaults (handlers.go:2154-2159)
+            body["model"] = model
 
         if "/" in model:  # cloud namespace, e.g. "meta-llama/..." via OpenRouter
             self._chat_cloud(req, resp, body, model, stream)

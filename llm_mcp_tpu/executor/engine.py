@@ -562,6 +562,14 @@ class GenerationEngine:
             os.environ.get("TPU_STALL_TIMEOUT_S", "600") or 0
         )
         self.stalled = False
+        # First-time executable shapes (a new compact-decode bucket, a new
+        # chunked-prefill (bucket, skey), a new admit bucket) legitimately
+        # compile — minutes on a cold cache over a slow link. Dispatching a
+        # never-seen shape extends a grace window so the watchdog doesn't
+        # shed a healthy engine mid-compile; the cost is that a real wedge
+        # during that window is detected one timeout later.
+        self._seen_exec_shapes: set[tuple] = set()
+        self._compile_grace_until = 0.0
         if self.stall_timeout_s > 0:
             threading.Thread(
                 target=self._watchdog, name="engine-watchdog", daemon=True
@@ -644,7 +652,10 @@ class GenerationEngine:
     def stall_seconds(self) -> float:
         """Age of the engine loop's last progress stamp. Large values with
         in-flight work mean the thread is wedged inside an uninterruptible
-        device call (serving layer: flip the device offline, fail over)."""
+        device call (serving layer: flip the device offline, fail over).
+        Zero while a first-time executable shape may still be compiling."""
+        if time.time() < self._compile_grace_until:
+            return 0.0
         return max(0.0, time.time() - self.last_progress)
 
     def _watchdog(self) -> None:
@@ -668,8 +679,7 @@ class GenerationEngine:
                         req = self._admit.get_nowait()
                     except queue.Empty:
                         break
-                    with self.stats_lock:
-                        self.total_errors += 1
+                    self._count_error()
                     req.out.put(
                         {"type": "error",
                          "error": "engine stalled: accelerator unresponsive"}
@@ -690,8 +700,7 @@ class GenerationEngine:
                         and self.stall_seconds() > self.stall_timeout_s
                     ):
                         s.aborted = True
-                        with self.stats_lock:
-                            self.total_errors += 1
+                        self._count_error()
                         s.req.out.put(
                             {"type": "error",
                              "error": "engine stalled: accelerator unresponsive"}
@@ -703,8 +712,7 @@ class GenerationEngine:
                         and self.stall_seconds() > self.stall_timeout_s
                     ):
                         st.aborted = True
-                        with self.stats_lock:
-                            self.total_errors += 1
+                        self._count_error()
                         st.req.out.put(
                             {"type": "error",
                              "error": "engine stalled: accelerator unresponsive"}
@@ -759,8 +767,7 @@ class GenerationEngine:
         if self.stalled:
             # fail fast instead of queueing behind a wedged device call —
             # the router sees the device offline and falls back to cloud
-            with self.stats_lock:
-                self.total_errors += 1
+            self._count_error()
             req.out.put(
                 {"type": "error", "error": "engine stalled: accelerator unresponsive"}
             )
@@ -892,6 +899,22 @@ class GenerationEngine:
         self._cv = cache["v"]
         return True
 
+    def _count_error(self, n: int = 1) -> None:
+        """All total_errors bumps go through here: the counter is read as
+        deltas by bench.py's degenerate-window gate and written from both the
+        engine and watchdog threads, so it must always be under stats_lock."""
+        with self.stats_lock:
+            self.total_errors += n
+
+    def _note_exec_shape(self, *key) -> None:
+        """Record a dispatch shape; first sighting opens a compile-grace
+        window equal to the stall timeout (see __init__)."""
+        if key not in self._seen_exec_shapes:
+            self._seen_exec_shapes.add(key)
+            self._compile_grace_until = max(
+                self._compile_grace_until, time.time() + self.stall_timeout_s
+            )
+
     def _abort_all(self, error: str) -> None:
         """Fail every in-flight request — decoding slots AND mid-prefill
         reservations. Called when the KV cache had to be re-allocated: all
@@ -899,14 +922,14 @@ class GenerationEngine:
         for i, s in enumerate(self._slots):
             if s is not None:
                 s.aborted = True
-                self.total_errors += 1
+                self._count_error()
                 s.req.out.put({"type": "error", "error": error})
                 s.req.out.put(_DONE)
                 self._slots[i] = None
                 self._lengths[i] = self.max_seq_len  # park (see __init__)
         for slot in list(self._prefills):
             st = self._prefills.pop(slot)
-            self.total_errors += 1
+            self._count_error()
             st.req.out.put({"type": "error", "error": error})
             st.req.out.put(_DONE)
         self._prefill_q.clear()
@@ -991,7 +1014,7 @@ class GenerationEngine:
             s = self._slots[b]
             if s is not None:
                 s.aborted = True
-                self.total_errors += 1
+                self._count_error()
                 s.req.out.put({"type": "error", "error": str(e)})
                 s.req.out.put(_DONE)
                 self._slots[b] = None
@@ -1068,7 +1091,7 @@ class GenerationEngine:
                             self._prefill_q.remove(slot)
                         except ValueError:
                             pass
-                        self.total_errors += 1
+                        self._count_error()
                         req.out.put({"type": "error", "error": str(e)})
                         req.out.put(_DONE)
                     if self._recover_cache():
@@ -1089,7 +1112,7 @@ class GenerationEngine:
                     if s is not None and s.req is req:
                         self._slots[slot] = None
                         self._lengths[slot] = self.max_seq_len  # park
-                    self.total_errors += 1
+                    self._count_error()
                     req.out.put({"type": "error", "error": str(e)})
                     req.out.put(_DONE)
                 if self._recover_cache():
@@ -1219,6 +1242,7 @@ class GenerationEngine:
         ipack[3 * Ab + 1] = self._next_counter()
         # ONE fused dispatch: prefill + cache inserts + device sampling-param
         # rows + first-token sample (see admit_fn)
+        self._note_exec_shape("admit", Ab, bucket)
         self._ck, self._cv, self._d_temp, self._d_topk, self._d_topp, toks0 = (
             self._admit_fn(
                 self.params, self._ck, self._cv,
@@ -1335,6 +1359,7 @@ class GenerationEngine:
                 starts_arr[i] = starts_arr[0]
                 nv_arr[i] = nv_arr[0]
             maybe_fail("engine.prefill", f"slots={group}")
+            self._note_exec_shape("chunk", Ab, f_bucket, f_skey)
             logits, self._ck, self._cv = self._prefill_chunk_fn(
                 self.params, self._ck, self._cv, tokens,
                 slots_arr, starts_arr, nv_arr, f_skey,
@@ -1385,7 +1410,7 @@ class GenerationEngine:
                         self._slots[slot] = None
                         self._lengths[slot] = self.max_seq_len  # park
                     if not st.aborted:  # watchdog may have terminated it already
-                        self.total_errors += 1
+                        self._count_error()
                         st.req.out.put({"type": "error", "error": str(e)})
                         st.req.out.put(_DONE)
             if self._recover_cache():
@@ -1438,6 +1463,7 @@ class GenerationEngine:
             packed = np.concatenate(
                 [self._last_tok, self._lengths, [self._next_counter()]]
             ).astype(np.int32)
+        self._note_exec_shape("decode", Ba, compact)
         out, self._ck, self._cv = self._decode_fn(
             self.params,
             self._ck,

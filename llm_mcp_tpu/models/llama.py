@@ -134,8 +134,9 @@ def init_kv_cache(
     `llama_decode_step` (jit treats them as pytrees).
 
     MLA configs store latents instead (models/mla.py:init_mla_cache) in the
-    same (k, v) pair convention; the int8 form is unnecessary there (the
-    latent cache is already ~3.6x smaller than GQA K/V) and unsupported."""
+    same (k, v) pair convention; quantized=True there stores int8 latents
+    (a further capacity trade on top of the latent cache's ~3.6x size
+    advantage; decode pays a dequant-then-dot on the XLA path)."""
     if cfg.kv_lora_rank:
         from .mla import init_mla_cache
 

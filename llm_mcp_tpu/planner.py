@@ -151,9 +151,12 @@ class Planner:
                 # window (10 s) — recording would pair an old burst's
                 # latency with 0 tok/s and poison throughput ranking
                 continue
+            # tokens_out carries the TTFT sample count: the router requires
+            # a minimum n before a serve snapshot displaces a full synthetic
+            # benchmark row (routing/router.py select_device).
             self.catalog.record_benchmark(
                 self.device_id, model, "serve",
-                latency_ms=p50, p95_ms=p95, tps=tps,
+                latency_ms=p50, p95_ms=p95, tps=tps, tokens_out=n,
             )
             recorded += 1
         return recorded

@@ -159,10 +159,15 @@ class Router:
             return None
         # Generation routing also consumes 'serve' rows — REAL client-observed
         # TTFT/tps snapshots the planner records from live engines
-        # (planner.record_serve_ttft). The freshest row wins (SQLite's
-        # bare-column-with-MAX picks that row), so during live traffic the
-        # measured serving numbers displace stale synthetic benchmarks.
+        # (planner.record_serve_ttft). The freshest row per (device, model)
+        # wins (explicit ROW_NUMBER window, not SQLite's nonstandard
+        # bare-column-with-MAX), so during live traffic the measured serving
+        # numbers displace stale synthetic benchmarks — but only once the
+        # snapshot aggregates enough requests (tokens_out carries the TTFT
+        # sample count n): a 10-second tps window over one or two requests
+        # must not unseat a full synthetic benchmark.
         alt_type = "serve" if task_type == "generate" else task_type
+        min_serve_n = int(getenv("SERVE_BENCH_MIN_N", "3") or 0)
         rows = self.db.query(
             """
             SELECT d.id, d.name, d.addr, d.tags, d.last_seen,
@@ -171,17 +176,23 @@ class Router:
             FROM devices d
             JOIN device_models dm ON dm.device_id = d.id AND dm.available = 1
             LEFT JOIN (
-                SELECT device_id, model_id, tps, latency_ms, p95_ms,
-                       MAX(created_at)
-                FROM benchmarks WHERE task_type IN (?, ?)
-                GROUP BY device_id, model_id
+                SELECT device_id, model_id, tps, latency_ms, p95_ms FROM (
+                    SELECT device_id, model_id, tps, latency_ms, p95_ms,
+                           ROW_NUMBER() OVER (
+                               PARTITION BY device_id, model_id
+                               ORDER BY created_at DESC, id DESC
+                           ) AS rn
+                    FROM benchmarks
+                    WHERE task_type IN (?, ?)
+                      AND (task_type != 'serve' OR tokens_out >= ?)
+                ) WHERE rn = 1
             ) b ON b.device_id = d.id AND b.model_id = dm.model_id
             WHERE d.online = 1 AND dm.model_id = ?
             ORDER BY COALESCE(b.tps, 0) DESC,
                      COALESCE(b.latency_ms, 1e12) ASC,
                      d.last_seen DESC
             """,
-            (task_type, alt_type, model),
+            (task_type, alt_type, min_serve_n, model),
         )
         model_row = self.catalog.get_model(model) if self.catalog else None
         ctx_k = int(model_row["context_k"]) if model_row else 0

@@ -306,3 +306,80 @@ def test_smart_model_selection_empty_model(base, server):
     )
     assert r.status_code == 200
     assert r.json()["model"] == "tiny-llm"
+    assert r.headers.get("X-Selected-Model") == "tiny-llm"
+
+
+def test_smart_selection_accuracy_weighting(base, server):
+    """Reference scoring (`handlers.go:3040-3144`): category score × accuracy
+    weight − cost factor × log10 price tier; low accuracy prefers the cheap
+    model, critical accuracy ignores price entirely. Context-unfit models are
+    skipped. Headers override body fields."""
+    cat = server.catalog
+    try:
+        _smart_selection_accuracy_body(base, cat)
+    finally:
+        # module-scoped server: don't leak rankings into later tests
+        for mid in ("premium-llm", "tiny-ctx"):
+            cat.db.execute("DELETE FROM model_rankings WHERE model_id = ?", (mid,))
+            cat.db.execute("DELETE FROM model_pricing WHERE model_id = ?", (mid,))
+            cat.db.execute("DELETE FROM models WHERE id = ?", (mid,))
+        cat.db.execute(
+            "DELETE FROM model_rankings WHERE model_id='tiny-llm' AND category='code'"
+        )
+        cat.db.execute("DELETE FROM model_pricing WHERE model_id='tiny-llm'")
+
+
+def _smart_selection_accuracy_body(base, cat):
+    # an expensive high-scorer and a cheap mid-scorer, both rankable
+    cat.set_ranking("tiny-llm", "code", 60.0)
+    cat.set_pricing("tiny-llm", 0.05, 0.1)  # cheap
+    cat.upsert_model("premium-llm", name="premium", kind="llm", context_k=128)
+    cat.set_ranking("premium-llm", "code", 90.0)
+    cat.set_pricing("premium-llm", 15.0, 60.0)  # log10(15000+1)*10 ≈ 42 tier
+
+    def pick(**kw):
+        r = httpx.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "write code"}],
+                "max_tokens": 4,
+                **kw.pop("body", {}),
+            },
+            timeout=120.0,
+            **kw,
+        )
+        return r.headers.get("X-Selected-Model")
+
+    # low accuracy: 60*0.3 − 3*~2.4(tier) ≈ 10.8 beats 90*0.3 − 3*42 ≈ −99
+    assert pick(body={"task_type": "code", "accuracy": "low"}) == "tiny-llm"
+    # critical accuracy: price ignored → the 90-scorer wins
+    assert pick(body={"task_type": "code", "accuracy": "critical"}) == "premium-llm"
+    # headers override body (handlers.go:2124-2144)
+    assert (
+        pick(
+            body={"task_type": "code", "accuracy": "critical"},
+            headers={"X-Accuracy": "low"},
+        )
+        == "tiny-llm"
+    )
+    # cost cap excludes the expensive model even at critical accuracy
+    assert (
+        pick(body={"task_type": "code", "accuracy": "critical",
+                   "max_cost_usd": 0.0000001})
+        == "tiny-llm"
+    )
+    # context fit: a model whose context can't hold the prompt is skipped
+    cat.upsert_model("tiny-ctx", name="tiny-ctx", kind="llm", context_k=1)
+    cat.set_ranking("tiny-ctx", "code", 99.0)
+    long_prompt = "x" * 5000  # ≈1250 tokens > 1k context
+    r = httpx.post(
+        f"{base}/v1/chat/completions",
+        json={
+            "messages": [{"role": "user", "content": long_prompt}],
+            "max_tokens": 4,
+            "task_type": "code",
+            "accuracy": "critical",
+        },
+        timeout=120.0,
+    )
+    assert r.headers.get("X-Selected-Model") == "premium-llm"
