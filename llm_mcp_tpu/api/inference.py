@@ -92,6 +92,7 @@ class InferenceAPI:
         accuracy: str = "medium",
         max_cost_usd: float = 0.0,
         messages: list | None = None,
+        max_tokens: int = 512,
     ) -> str:
         """model=="" → best ranked model by category score × accuracy weight
         − cost factor × log-price tier (`handlers.go:3040-3144`): candidates
@@ -134,7 +135,12 @@ class InferenceAPI:
             ctx_k = r["context_k"] or 0
             if ctx_k > 0 and est_tokens > ctx_k * 1000:
                 continue  # prompt won't fit the model's context
-            est_cost = (est_tokens / 1e6) * ((r["price_in"] or 0) + (r["price_out"] or 0))
+            # output side priced at the request's max_tokens (the reference
+            # reuses the input estimate for both sides, handlers.go:3096 —
+            # which underprices output-heavy requests by orders of magnitude)
+            est_cost = (est_tokens / 1e6) * (r["price_in"] or 0) + (
+                max(max_tokens, 0) / 1e6
+            ) * (r["price_out"] or 0)
             if max_cost_usd > 0 and est_cost > max_cost_usd:
                 continue
             cat_score = r["cat_score"]  # NULL (not 0.0) means unranked here
@@ -154,7 +160,12 @@ class InferenceAPI:
                 best, best_score = r["model_id"], score
         if best:
             return best
-        # no rankings: any local llm from the catalog
+        if rows:
+            # ranked models existed but every one failed the caller's
+            # explicit context/cost constraints — surface that (503), don't
+            # silently hand back a model that violates them
+            return ""
+        # no rankings at all: any local llm from the catalog
         models = self.catalog.list_models(kind="llm")
         for m in models:
             if self._local_gen(m["id"]) is not None:
@@ -212,7 +223,9 @@ class InferenceAPI:
                 )
             except (TypeError, ValueError):
                 max_cost = 0.0
-            model = self._select_model_smart(task_type, accuracy, max_cost, messages)
+            model = self._select_model_smart(
+                task_type, accuracy, max_cost, messages, max_tokens
+            )
             if not model:
                 resp.write_error("no model available", 503)
                 return

@@ -167,7 +167,10 @@ class Router:
         # sample count n): a 10-second tps window over one or two requests
         # must not unseat a full synthetic benchmark.
         alt_type = "serve" if task_type == "generate" else task_type
-        min_serve_n = int(getenv("SERVE_BENCH_MIN_N", "3") or 0)
+        try:
+            min_serve_n = int(getenv("SERVE_BENCH_MIN_N", "3") or 0)
+        except ValueError:
+            min_serve_n = 3
         rows = self.db.query(
             """
             SELECT d.id, d.name, d.addr, d.tags, d.last_seen,

@@ -362,12 +362,24 @@ def _smart_selection_accuracy_body(base, cat):
         )
         == "tiny-llm"
     )
-    # cost cap excludes the expensive model even at critical accuracy
+    # cost cap excludes the expensive model even at critical accuracy:
+    # pricey's output side alone (4 tok × $60/M ≈ 2.4e-4) busts a 1e-5 cap
+    # that tiny-llm (≈9e-7) passes
     assert (
         pick(body={"task_type": "code", "accuracy": "critical",
-                   "max_cost_usd": 0.0000001})
+                   "max_cost_usd": 0.00001})
         == "tiny-llm"
     )
+    # every ranked model over the cap → 503, NOT a silent fallback model
+    r = httpx.post(
+        f"{base}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": "write code"}],
+              "max_tokens": 4, "task_type": "code", "accuracy": "critical",
+              "max_cost_usd": 1e-9},
+        timeout=120.0,
+    )
+    assert r.status_code == 503, r.text
+    assert "X-Selected-Model" not in r.headers
     # context fit: a model whose context can't hold the prompt is skipped
     cat.upsert_model("tiny-ctx", name="tiny-ctx", kind="llm", context_k=1)
     cat.set_ranking("tiny-ctx", "code", 99.0)
