@@ -161,9 +161,11 @@ class InferenceAPI:
         if best:
             return best
         if rows:
-            # ranked models existed but every one failed the caller's
-            # explicit context/cost constraints — surface that (503), don't
-            # silently hand back a model that violates them
+            # ranked models existed but every one was filtered (context fit
+            # or the caller's cost cap) — fail the selection like the
+            # reference does ("no suitable model found",
+            # handlers.go:3130-3132) rather than silently handing back a
+            # model that violates the filters
             return ""
         # no rankings at all: any local llm from the catalog
         models = self.catalog.list_models(kind="llm")

@@ -60,6 +60,26 @@ class ModelConfig:
     qk_rope_head_dim: int = 0  # per-head rope dims (shared key)
     qk_nope_head_dim: int = 0  # per-head non-rope dims
     v_head_dim: int = 0  # per-head value dims
+    # yarn rope scaling (DeepSeek-V2 long context): factor > 1 switches
+    # `ops/rope.py:rope_tables` to yarn-corrected frequencies, and
+    # yarn_mscale_all_dim scales attention scores (attn_scale/mla_scale)
+    rope_factor: float = 1.0
+    rope_orig_max: int = 0  # original_max_position_embeddings pre-scaling
+    yarn_beta_fast: float = 32.0
+    yarn_beta_slow: float = 1.0
+    yarn_mscale: float = 0.0
+    yarn_mscale_all_dim: float = 0.0
+    # DeepSeek-MoE structure (beyond the Mixtral-style all-MoE fields above):
+    # `n_shared_experts` dense always-on experts added to the routed output;
+    # routed experts use `moe_ffn_hidden` (0 → ffn_hidden); the first
+    # `first_dense_layers` decoder layers keep a dense FFN (V2-Lite: 1);
+    # norm_topk_prob=False keeps raw softmax gates (scaled by
+    # routed_scaling_factor) instead of renormalizing the top-k
+    n_shared_experts: int = 0
+    moe_ffn_hidden: int = 0
+    first_dense_layers: int = 0
+    norm_topk_prob: bool = True
+    routed_scaling_factor: float = 1.0
     # serving metadata
     params_b: float = 0.0
     tie_embeddings: bool = False
@@ -69,15 +89,34 @@ class ModelConfig:
         return self.head_dim or self.dim // self.n_heads
 
     @property
+    def yarn_attn_mscale(self) -> float:
+        """Yarn's score-scale correction: (0.1·m·ln(factor)+1)² when
+        mscale_all_dim is set (DeepSeek-V2), else 1."""
+        if self.rope_factor > 1.0 and self.yarn_mscale_all_dim:
+            import math
+
+            m = 0.1 * self.yarn_mscale_all_dim * math.log(self.rope_factor) + 1.0
+            return m * m
+        return 1.0
+
+    @property
     def attn_scale(self) -> float:
-        return (self.query_pre_attn_scalar or self.resolved_head_dim) ** -0.5
+        return (
+            self.query_pre_attn_scalar or self.resolved_head_dim
+        ) ** -0.5 * self.yarn_attn_mscale
 
     def param_count(self) -> int:
         """Approximate parameter count (embedding + layers + head)."""
         hd = self.resolved_head_dim
         ffn = 3 * self.dim * self.ffn_hidden
+        ffn_total = self.n_layers * ffn
         if self.n_experts:
-            ffn = self.n_experts * ffn + self.dim * self.n_experts  # experts + router
+            moe_f = self.moe_ffn_hidden or self.ffn_hidden
+            routed = 3 * self.dim * moe_f * self.n_experts
+            shared = 3 * self.dim * moe_f * self.n_shared_experts
+            moe_layer = routed + shared + self.dim * self.n_experts  # + router
+            k = self.first_dense_layers
+            ffn_total = k * ffn + (self.n_layers - k) * moe_layer
         if self.kv_lora_rank:  # MLA factorized attention
             dn, dr, dv = self.qk_nope_head_dim, self.qk_rope_head_dim, self.v_head_dim
             attn = (
@@ -92,10 +131,10 @@ class ModelConfig:
                 + 2 * self.dim * self.n_kv_heads * hd  # wk, wv
                 + self.n_heads * hd * self.dim  # wo
             )
-        per_layer = attn + ffn + 2 * self.dim  # + norms
+        per_layer_rest = attn + 2 * self.dim  # + norms
         embed = self.vocab_size * self.dim
         head = 0 if self.tie_embeddings or self.arch == "encoder" else self.vocab_size * self.dim
-        return embed + self.n_layers * per_layer + head + self.dim
+        return embed + self.n_layers * per_layer_rest + ffn_total + head + self.dim
 
 
 # Canonical architectures. Llama-3.1-8B per the published architecture
@@ -148,6 +187,74 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         qk_nope_head_dim=128,
         v_head_dim=128,
         params_b=9.2,
+    ),
+    # DeepSeek-V2-Lite — a PUBLISHED MLA+MoE checkpoint (HF
+    # deepseek-ai/DeepSeek-V2-Lite config.json): dense layer 0, 26 MoE
+    # layers of 64 routed + 2 shared experts, yarn rope 4k→160k. Loads via
+    # models/weights.py (kv_a_proj_with_mqa / kv_b_proj / mlp.experts.* /
+    # mlp.shared_experts.* mapping incl. the rope-dim de-interleave).
+    "deepseek-v2-lite": ModelConfig(
+        name="deepseek-v2-lite",
+        arch="mla",
+        vocab_size=102_400,
+        dim=2048,
+        n_layers=27,
+        n_heads=16,
+        n_kv_heads=1,
+        ffn_hidden=10_944,
+        norm_eps=1e-6,
+        rope_theta=10_000.0,
+        max_seq_len=163_840,
+        kv_lora_rank=512,
+        qk_rope_head_dim=64,
+        qk_nope_head_dim=128,
+        v_head_dim=128,
+        n_experts=64,
+        experts_per_tok=6,
+        n_shared_experts=2,
+        moe_ffn_hidden=1408,
+        first_dense_layers=1,
+        norm_topk_prob=False,
+        routed_scaling_factor=1.0,
+        rope_factor=40.0,
+        rope_orig_max=4096,
+        yarn_beta_fast=32.0,
+        yarn_beta_slow=1.0,
+        yarn_mscale=0.707,
+        yarn_mscale_all_dim=0.707,
+        params_b=15.7,
+    ),
+    # tiny V2-structure config for tests: dense layer 0 + MoE layers with
+    # shared experts + yarn rope — every DeepSeek-V2 mechanism at toy size.
+    "tiny-v2": ModelConfig(
+        name="tiny-v2",
+        arch="mla",
+        vocab_size=512,
+        dim=128,
+        n_layers=3,
+        n_heads=4,
+        n_kv_heads=1,
+        ffn_hidden=256,
+        norm_eps=1e-6,
+        rope_theta=10_000.0,
+        max_seq_len=512,
+        kv_lora_rank=32,
+        qk_rope_head_dim=16,
+        qk_nope_head_dim=32,
+        v_head_dim=32,
+        n_experts=4,
+        experts_per_tok=2,
+        n_shared_experts=2,
+        moe_ffn_hidden=64,
+        first_dense_layers=1,
+        norm_topk_prob=False,
+        routed_scaling_factor=1.0,
+        rope_factor=4.0,
+        rope_orig_max=64,
+        yarn_mscale=0.707,
+        yarn_mscale_all_dim=0.707,
+        tie_embeddings=True,
+        params_b=0.002,
     ),
     "tiny-mla": ModelConfig(
         name="tiny-mla",
@@ -440,6 +547,8 @@ def get_config(name: str) -> ModelConfig:
         cc = _compact(cname)
         if cc == ck or cc in ck:
             return cfg
+    if ("deepseek-v2" in key or "deepseek_v2" in key) and "lite" in key:
+        return MODEL_CONFIGS["deepseek-v2-lite"]
     if "deepseek-r1" in key or "deepseek_r1" in key or "deepscaler" in key or "deepcoder" in key:
         # Ollama-style "deepseek-r1:1.5b" etc (reference tier seeds). Size
         # decides the BASE ARCHITECTURE: 1.5b/7b are Qwen2.5 distills, 8b

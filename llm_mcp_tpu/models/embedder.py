@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.norms import rms_norm as _rms_norm
-from ..ops.rope import rope_frequencies, apply_rope
+from ..ops.rope import rope_tables, apply_rope
 from .configs import ModelConfig
 
 Params = dict[str, Any]
@@ -66,7 +66,7 @@ def embed_forward(
 
     h = params["embed"][tokens]
     positions = jnp.arange(S, dtype=jnp.int32)[None, :]
-    cos, sin = rope_frequencies(hd, cfg.rope_theta, positions)
+    cos, sin = rope_tables(cfg, hd, positions)
 
     valid = jnp.arange(S)[None, :] < lengths[:, None]  # [B, S]
     mask = valid[:, None, :]  # [B, 1(q), S(k)] — bidirectional, pad-masked

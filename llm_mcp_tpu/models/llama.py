@@ -39,7 +39,7 @@ from ..kernels.attention import (
     flash_prefill_attention,
 )
 from ..ops.norms import rms_norm as _rms_norm
-from ..ops.rope import rope_frequencies, apply_rope
+from ..ops.rope import rope_tables, apply_rope
 from .configs import ModelConfig
 from .moe import init_moe_layer_params, moe_ffn
 from .quant import embed_lookup, logits_head, qdot
@@ -212,19 +212,27 @@ def _attn_residual(cfg: ModelConfig, lp: Params, ctx: jnp.ndarray, h: jnp.ndarra
 
 
 def _ffn_residual(
-    cfg: ModelConfig, lp: Params, h: jnp.ndarray, moe_capacity: int = 0
+    cfg: ModelConfig,
+    lp: Params,
+    h: jnp.ndarray,
+    moe_capacity: int = 0,
+    moe_valid: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """The FFN half of a decoder layer (pre-norm, MoE or gated-MLP, optional
     post-norm, residual add) on [..., D] activations — shared by prefill,
     chunked prefill, and decode so layer semantics live in one place."""
     x = _norm(cfg, h, lp["ffn_norm"])
-    if cfg.n_experts:
+    # dispatch on THIS LAYER's params, not cfg: DeepSeek-style models carry
+    # a dense prologue (params["dense_layers"], cfg.first_dense_layers)
+    # through the same layer function as their MoE stack
+    if "router" in lp:
         lead = x.shape[:-1]
         flat = x.reshape(-1, x.shape[-1])
+        fvalid = moe_valid.reshape(-1) if moe_valid is not None else None
         out = (
-            moe_ffn(cfg, lp, flat, capacity=moe_capacity)
+            moe_ffn(cfg, lp, flat, capacity=moe_capacity, valid=fvalid)
             if moe_capacity
-            else moe_ffn(cfg, lp, flat)
+            else moe_ffn(cfg, lp, flat, valid=fvalid)
         )
         out = out.reshape(*lead, -1)
     else:
@@ -267,7 +275,7 @@ def prefill_masks(
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """(cos [1,S,hd/2], sin, mask [B,S,S]) shared by all prefill layers."""
     positions = jnp.arange(S, dtype=jnp.int32)[None, :]  # [1, S]
-    cos, sin = rope_frequencies(cfg.resolved_head_dim, cfg.rope_theta, positions)
+    cos, sin = rope_tables(cfg, cfg.resolved_head_dim, positions)
     # Causal + padding mask, computed once: [B, S, S] would be big at long S,
     # so use [1, S, S] causal and fold padding via key-validity [B, 1, S].
     causal = jnp.tril(jnp.ones((S, S), dtype=bool))[None]  # [1, S, S]
@@ -332,7 +340,10 @@ def prefill_layer(
         probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
         ctx = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(B, S, H * hd)
     h = _attn_residual(cfg, lp, ctx, h)
-    h = _ffn_residual(cfg, lp, h)
+    h = _ffn_residual(
+        cfg, lp, h,
+        moe_valid=jnp.arange(S, dtype=jnp.int32)[None, :] < lengths[:, None],
+    )
     return h, (kh, vh)
 
 
@@ -412,7 +423,7 @@ def _decode_step_q8(
     Ba = tokens.shape[0]
     H = cfg.n_heads
     h = _embed_in(cfg, params, tokens)  # [Ba, D]
-    cos, sin = rope_frequencies(hd, cfg.rope_theta, lengths)  # [Ba, hd/2]
+    cos, sin = rope_tables(cfg, hd, lengths)  # [Ba, hd/2]
 
     def layer(carry, xs):
         lp, win = xs
@@ -492,7 +503,7 @@ def llama_prefill_chunk_batch(
 
     h = _embed_in(cfg, params, tokens)  # [A, C, D]
     q_pos = starts[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]  # [A, C]
-    cos, sin = rope_frequencies(hd, cfg.rope_theta, q_pos)  # [A, C, hd/2]
+    cos, sin = rope_tables(cfg, hd, q_pos)  # [A, C, hd/2]
     key_pos = jnp.arange(Sk, dtype=jnp.int32)  # [Sk]
     # past segment: cache rows strictly before each chunk's start
     past_mask = key_pos[None, None, :] < starts[:, None, None]  # [A, 1|C, Sk]
@@ -593,7 +604,9 @@ def llama_prefill_chunk_batch(
         ) + jnp.einsum("ahgct,ahtd->achgd", p_self.astype(h.dtype), vh)
         ctx = ctx.reshape(A, C, H * hd)
         h = _attn_residual(cfg, lp, ctx, h)
-        h = _ffn_residual(cfg, lp, h)
+        h = _ffn_residual(
+            cfg, lp, h, moe_valid=c_idx[None, :] < nvalid[:, None]
+        )
 
         # ---- writes last: in-place (write-after-read) ----
         if quantized:
@@ -729,7 +742,7 @@ def llama_decode_step(
         )
 
     h = _embed_in(cfg, params, tokens)  # [Ba, D]
-    cos, sin = rope_frequencies(hd, cfg.rope_theta, lengths)  # [Ba, hd/2]
+    cos, sin = rope_tables(cfg, hd, lengths)  # [Ba, hd/2]
 
     # row i of the compact batch scatters/gathers cache row rows[i]
     rows = jnp.arange(B, dtype=jnp.int32) if slot_ids is None else slot_ids

@@ -43,7 +43,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.norms import rms_norm as _rms_norm
-from ..ops.rope import apply_rope, rope_frequencies
+from ..ops.rope import apply_rope, rope_tables
 from .configs import ModelConfig
 from .quant import qdot
 
@@ -66,14 +66,50 @@ def _dims(cfg: ModelConfig) -> tuple[int, int, int, int]:
 
 
 def mla_scale(cfg: ModelConfig) -> float:
-    return (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
+    # yarn_attn_mscale folds DeepSeek-V2's yarn magnitude correction
+    # ((0.1·mscale_all_dim·ln(factor)+1)²) into the softmax scale
+    return (
+        cfg.qk_nope_head_dim + cfg.qk_rope_head_dim
+    ) ** -0.5 * cfg.yarn_attn_mscale
+
+
+def _mla_attn_weights(
+    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype, L: int
+) -> Params:
+    """Stacked [L, ...] MLA attention weights (dense-q factorization)."""
+    H, dn, dr, dv = _dims(cfg)
+    D, R = cfg.dim, cfg.kv_lora_rank
+
+    def w(k, shape, fan_in):
+        return (
+            jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in**-0.5)
+        ).astype(dtype)
+
+    kq = jax.random.split(key, 4)
+    return {
+        "wq_mla": w(kq[0], (L, D, H * (dn + dr)), D),
+        # one matmul produces (latent c_kv | shared rope key), HF
+        # kv_a_proj_with_mqa layout
+        "w_dkv": w(kq[1], (L, D, R + dr), D),
+        "kv_norm": jnp.ones((L, R), dtype=dtype),  # kv_a_layernorm
+        # up-projection from the latent to per-head (k_nope | v)
+        "w_ukv": w(kq[2], (L, R, H * (dn + dv)), R),
+        "wo_mla": w(kq[3], (L, H * dv, D), H * dv),
+    }
 
 
 def init_mla_params(
     cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
 ) -> Params:
     """Random-init MLA decoder weights (dense-q variant: q_lora_rank == 0
-    projects queries directly, as DeepSeek-V2-Lite does)."""
+    projects queries directly, as DeepSeek-V2-Lite does).
+
+    With cfg.first_dense_layers > 0 (DeepSeek-V2 MoE), the layer stack
+    splits into params["dense_layers"] (layers 0..k-1, dense FFN at
+    ffn_hidden) and params["layers"] (the MoE stack) — two uniform scans
+    instead of one, since the FFN weight shapes differ."""
+    import dataclasses
+
     from .llama import init_llama_params  # local: dispatch entry point
 
     if cfg.q_lora_rank:
@@ -81,30 +117,30 @@ def init_mla_params(
             "q_lora_rank > 0 (low-rank query path) is not implemented; use "
             "the dense-q MLA variant (q_lora_rank=0, V2-Lite style)"
         )
-    H, dn, dr, dv = _dims(cfg)
-    L, D, R = cfg.n_layers, cfg.dim, cfg.kv_lora_rank
+    k_dense = cfg.first_dense_layers if cfg.n_experts else 0
+    L_main = cfg.n_layers - k_dense
     # the base init skips wq/wk/wv/wo for MLA configs (they would be
     # built at full GQA size only to be discarded — a ~4 GB transient at
     # 8B-class shapes)
-    base = init_llama_params(cfg, key, dtype=dtype, _dispatch=False)
+    cfg_main = (
+        dataclasses.replace(cfg, n_layers=L_main) if k_dense else cfg
+    )
+    base = init_llama_params(cfg_main, key, dtype=dtype, _dispatch=False)
     layers = base["layers"]
     for k in ("wq", "wk", "wv", "wo", "bq", "bk", "bv"):
         layers.pop(k, None)
-
-    def w(k, shape, fan_in):
-        return (
-            jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in**-0.5)
-        ).astype(dtype)
-
-    kq = jax.random.split(jax.random.fold_in(key, 7), 4)
-    layers["wq_mla"] = w(kq[0], (L, D, H * (dn + dr)), D)
-    # one matmul produces (latent c_kv | shared rope key), HF
-    # kv_a_proj_with_mqa layout
-    layers["w_dkv"] = w(kq[1], (L, D, R + dr), D)
-    layers["kv_norm"] = jnp.ones((L, R), dtype=dtype)  # kv_a_layernorm
-    # up-projection from the latent to per-head (k_nope | v)
-    layers["w_ukv"] = w(kq[2], (L, R, H * (dn + dv)), R)
-    layers["wo_mla"] = w(kq[3], (L, H * dv, D), H * dv)
+    layers.update(_mla_attn_weights(cfg, jax.random.fold_in(key, 7), dtype, L_main))
+    if k_dense:
+        cfg_dense = dataclasses.replace(cfg, n_layers=k_dense, n_experts=0)
+        dense = init_llama_params(
+            cfg_dense, jax.random.fold_in(key, 11), dtype=dtype, _dispatch=False
+        )["layers"]
+        for k in ("wq", "wk", "wv", "wo", "bq", "bk", "bv"):
+            dense.pop(k, None)
+        dense.update(
+            _mla_attn_weights(cfg, jax.random.fold_in(key, 13), dtype, k_dense)
+        )
+        base["dense_layers"] = dense
     return base
 
 
@@ -180,7 +216,7 @@ def mla_prefill(
     scale = mla_scale(cfg)
     h = _embed_in(cfg, params, tokens)  # [B, S, D]
     positions = jnp.arange(S, dtype=jnp.int32)[None, :]
-    cos, sin = rope_frequencies(dr, cfg.rope_theta, positions)  # [1, S, dr/2]
+    cos, sin = rope_tables(cfg, dr, positions)  # [1, S, dr/2]
     key_pos = jnp.arange(S, dtype=jnp.int32)
     valid_k = key_pos[None, :] < lengths[:, None]  # [B, S]
     neg = jnp.float32(-1e30)
@@ -220,7 +256,7 @@ def mla_prefill(
         _, ctx_b = jax.lax.scan(qblock, None, (qn_b, qr_b, pos_b))
         ctx = ctx_b.transpose(1, 0, 2, 3, 4).reshape(B, S, H * dv)
         h = h + qdot(ctx, lp["wo_mla"])
-        h = _ffn_residual(cfg, lp, h)
+        h = _ffn_residual(cfg, lp, h, moe_valid=valid_k)
         if quant_kv:
             # quantize INSIDE the scan: the stacked bf16 latents of a long
             # admission never materialize (llama_prefill's same trick)
@@ -232,7 +268,14 @@ def mla_prefill(
         h, (c, kr) = layer(h, lp)
         return h, (c, kr)
 
+    if "dense_layers" in params:
+        # DeepSeek first-dense prologue (layers 0..k-1): same layer fn, the
+        # FFN shape difference lives in the params (see _ffn_residual)
+        h, (cs_d, krs_d) = jax.lax.scan(scan_layer, h, params["dense_layers"])
     h, (cs, krs) = jax.lax.scan(scan_layer, h, params["layers"])
+    if "dense_layers" in params:
+        cs = jax.tree.map(lambda a, b: jnp.concatenate([a, b], axis=0), cs_d, cs)
+        krs = jax.tree.map(lambda a, b: jnp.concatenate([a, b], axis=0), krs_d, krs)
     last = jnp.clip(lengths - 1, 0, S - 1)
     h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]
     logits = _logits(cfg, params, h_last)
@@ -268,7 +311,7 @@ def mla_decode_step(
     Ba = tokens.shape[0]
     scale = mla_scale(cfg)
     h = _embed_in(cfg, params, tokens)  # [Ba, D]
-    cos, sin = rope_frequencies(dr, cfg.rope_theta, lengths)  # [Ba, dr/2]
+    cos, sin = rope_tables(cfg, dr, lengths)  # [Ba, dr/2]
 
     rows = jnp.arange(B, dtype=jnp.int32) if slot_ids is None else slot_ids
     b_idx = rows[:, None]  # [Ba, 1] scatter rows
@@ -357,10 +400,13 @@ def mla_decode_step(
             ctx_lat = jnp.einsum("bhs,bsr->bhr", probs, lat.astype(probs.dtype))
         ctx = jnp.einsum("bhr,rhd->bhd", ctx_lat, w_uv).reshape(Ba, H * dv)
         h = h + qdot(ctx, lp["wo_mla"])
-        h = _ffn_residual(cfg, lp, h)
+        h = _ffn_residual(cfg, lp, h, moe_capacity=Ba)  # dropless at decode
         return (h, cc_all, cr_all, li + 1), None
 
-    (h, cache_c, cache_r, _), _ = jax.lax.scan(
-        layer, (h, cache_c, cache_r, jnp.int32(0)), params["layers"]
-    )
+    carry = (h, cache_c, cache_r, jnp.int32(0))
+    if "dense_layers" in params:
+        # dense prologue first — the carried layer index li keeps the cache
+        # rows aligned with absolute layer position
+        carry, _ = jax.lax.scan(layer, carry, params["dense_layers"])
+    (h, cache_c, cache_r, _), _ = jax.lax.scan(layer, carry, params["layers"])
     return _logits(cfg, params, h), cache_c, cache_r

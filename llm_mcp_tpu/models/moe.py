@@ -40,25 +40,39 @@ def expert_capacity(cfg: ModelConfig, n_tokens: int) -> int:
 
 
 def moe_dispatch(
-    cfg: ModelConfig, router_logits: jnp.ndarray, capacity: int
+    cfg: ModelConfig,
+    router_logits: jnp.ndarray,
+    capacity: int,
+    valid: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Build (dispatch [T, E, C] model-dtype 0/1, combine [T, E, C] f32 gates).
 
     Top-k routing with normalized gates; position-in-expert assigned by
     cumulative count with slot-0 priority (GShard), tokens beyond capacity
     dropped.
+
+    `valid` ([T] bool) excludes rows from routing entirely: bucket-padding
+    tokens must not consume expert capacity ahead of real tokens (the
+    cumsum priority is positional, so garbage rows earlier in the flattened
+    batch would otherwise steal slots and change real tokens' outputs).
     """
     T, E = router_logits.shape
     k = cfg.experts_per_tok
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)  # [T, E]
     top_g, top_i = jax.lax.top_k(probs, k)  # [T, k]
-    top_g = top_g / jnp.sum(top_g, axis=-1, keepdims=True)  # renormalize gates
+    if cfg.norm_topk_prob and k > 1:
+        top_g = top_g / jnp.sum(top_g, axis=-1, keepdims=True)  # renormalize
+    elif cfg.routed_scaling_factor != 1.0:
+        # DeepSeek-V2 gate convention: raw softmax mass, scaled
+        top_g = top_g * cfg.routed_scaling_factor
 
     dispatch = jnp.zeros((T, E, capacity), dtype=jnp.float32)
     combine = jnp.zeros((T, E, capacity), dtype=jnp.float32)
     prev_count = jnp.zeros((E,), dtype=jnp.int32)
     for j in range(k):  # k is tiny and static (1-2 typically)
         mask_j = jax.nn.one_hot(top_i[:, j], E, dtype=jnp.int32)  # [T, E]
+        if valid is not None:
+            mask_j = mask_j * valid.astype(jnp.int32)[:, None]
         pos_j = jnp.cumsum(mask_j, axis=0) - 1 + prev_count[None, :]  # [T, E]
         prev_count = prev_count + jnp.sum(mask_j, axis=0)
         keep = (pos_j < capacity) & (mask_j > 0)  # [T, E]
@@ -70,7 +84,11 @@ def moe_dispatch(
 
 
 def moe_ffn(
-    cfg: ModelConfig, lp: dict[str, Any], x: jnp.ndarray, capacity: int | None = None
+    cfg: ModelConfig,
+    lp: dict[str, Any],
+    x: jnp.ndarray,
+    capacity: int | None = None,
+    valid: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Sparse FFN over flattened tokens x: [T, D] → [T, D].
 
@@ -87,31 +105,52 @@ def moe_ffn(
     T, D = x.shape
     C = capacity if capacity is not None else expert_capacity(cfg, T)
     logits = jnp.einsum("td,de->te", x, lp["router"])  # router in f32 below
-    dispatch, combine = moe_dispatch(cfg, logits, C)
+    dispatch, combine = moe_dispatch(cfg, logits, C, valid=valid)
 
     xe = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)  # [E, C, D]
     gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, lp["w1e"]))
     up = jnp.einsum("ecd,edf->ecf", xe, lp["w3e"])
     ye = jnp.einsum("ecf,efd->ecd", gate * up, lp["w2e"])  # [E, C, D]
     y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), ye)  # [T, D]
+    if "w1s" in lp:
+        # DeepSeek shared experts: a dense always-on gated MLP added to the
+        # routed output (never dropped, no dispatch). qdot so int8-quantized
+        # shared weights flow through like any dense linear.
+        from .quant import qdot
+
+        sg = jax.nn.silu(qdot(x, lp["w1s"]))
+        y = y + qdot(sg * qdot(x, lp["w3s"]), lp["w2s"])
     return y
 
 
 def init_moe_layer_params(
-    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype
+    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype, n_layers: int | None = None
 ) -> dict[str, jnp.ndarray]:
-    """Stacked [L, ...] MoE weights for every layer (Mixtral-style all-MoE)."""
-    L, D, E, F = cfg.n_layers, cfg.dim, cfg.n_experts, cfg.ffn_hidden
-    keys = jax.random.split(key, 4)
+    """Stacked [L, ...] MoE weights (Mixtral-style all-MoE, or the MoE block
+    of a DeepSeek first-dense split — `n_layers` overrides the stack depth).
+
+    Routed experts use cfg.moe_ffn_hidden when set (DeepSeek's routed width
+    is far narrower than its dense layer-0 FFN); `n_shared_experts` adds the
+    always-on shared gated MLP (hidden = n_shared x moe width)."""
+    L = cfg.n_layers if n_layers is None else n_layers
+    D, E = cfg.dim, cfg.n_experts
+    F = cfg.moe_ffn_hidden or cfg.ffn_hidden
+    keys = jax.random.split(key, 7)
 
     def w(k, shape, fan_in):
         return (
             jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in**-0.5)
         ).astype(dtype)
 
-    return {
+    out = {
         "router": w(keys[0], (L, D, E), D),
         "w1e": w(keys[1], (L, E, D, F), D),
         "w3e": w(keys[2], (L, E, D, F), D),
         "w2e": w(keys[3], (L, E, F, D), F),
     }
+    if cfg.n_shared_experts:
+        Fs = cfg.n_shared_experts * F
+        out["w1s"] = w(keys[4], (L, D, Fs), D)
+        out["w3s"] = w(keys[5], (L, D, Fs), D)
+        out["w2s"] = w(keys[6], (L, Fs, D), Fs)
+    return out

@@ -33,6 +33,9 @@ LAYER_QUANT_KEYS = (
     # MLA factorization (models/mla.py): qdot consumes these transparently;
     # the absorbed decode dequantizes w_ukv once per step
     "wq_mla", "w_dkv", "w_ukv", "wo_mla",
+    # DeepSeek shared experts — dense always-on linears (models/moe.py
+    # routes them through qdot); the ROUTED expert banks stay unquantized
+    "w1s", "w3s", "w2s",
 )
 
 
@@ -133,12 +136,17 @@ def quantize_params(params: Params) -> Params:
     precision-sensitive); MoE expert banks stay unquantized (their dispatch
     einsums in models/moe.py have their own path) — on MoE models only the
     attention linears and embedding quantize."""
-    layers = dict(params["layers"])
-    for k in LAYER_QUANT_KEYS:
-        if k in layers and not is_quantized(layers[k]):
-            layers[k] = quantize_weight(layers[k])
+    def quant_block(block: Params) -> Params:
+        b = dict(block)
+        for k in LAYER_QUANT_KEYS:
+            if k in b and not is_quantized(b[k]):
+                b[k] = quantize_weight(b[k])
+        return b
+
     out: Params = dict(params)
-    out["layers"] = layers
+    out["layers"] = quant_block(params["layers"])
+    if "dense_layers" in params:  # DeepSeek first-dense prologue stack
+        out["dense_layers"] = quant_block(params["dense_layers"])
     if not is_quantized(params["embed"]):
         # per-row (vocab) scales: contraction axis for the tied head is D,
         # but the LOOKUP needs row scales; per-row also equals per-output-
@@ -175,7 +183,15 @@ def init_llama_params_quantized(
         cfg.ffn_hidden,
         cfg.vocab_size,
     )
-    keys = jax.random.split(key, 16)
+    # DeepSeek first-dense split (see models/mla.py:init_mla_params): the
+    # main stack holds L - k layers; a dense_layers prologue holds the rest
+    k_dense = (
+        cfg.first_dense_layers
+        if (cfg.n_experts and getattr(cfg, "kv_lora_rank", 0))
+        else 0
+    )
+    L = L - k_dense
+    keys = jax.random.split(key, 24)
     kit = iter(keys)
 
     def qw(shape, fan_in, scale_axes):
@@ -186,6 +202,19 @@ def init_llama_params_quantized(
 
     norm_init = jnp.full((L, D), 1.0 - cfg.norm_weight_offset, dtype=scale_dtype)
     layers: Params = {"attn_norm": norm_init, "ffn_norm": norm_init}
+    def mla_attn_q(depth: int) -> Params:
+        # the quantized analog of mla.py:_mla_attn_weights, depth-
+        # parameterized so the main stack and the dense prologue share it
+        dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+        R = cfg.kv_lora_rank
+        return {
+            "wq_mla": qw((depth, D, H * (dn + dr)), D, (depth, H * (dn + dr))),
+            "w_dkv": qw((depth, D, R + dr), D, (depth, R + dr)),
+            "kv_norm": jnp.ones((depth, R), dtype=scale_dtype),
+            "w_ukv": qw((depth, R, H * (dn + dv)), R, (depth, H * (dn + dv))),
+            "wo_mla": qw((depth, H * dv, D), H * dv, (depth, D)),
+        }
+
     if getattr(cfg, "kv_lora_rank", 0):
         # MLA factorized attention (models/mla.py), direct-int8 — the
         # latent down-projection's RMSNorm weight stays full precision
@@ -196,17 +225,7 @@ def init_llama_params_quantized(
                 "q_lora_rank > 0 (low-rank query path) is not implemented; "
                 "use the dense-q MLA variant (q_lora_rank=0, V2-Lite style)"
             )
-        dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
-        R = cfg.kv_lora_rank
-        layers.update(
-            {
-                "wq_mla": qw((L, D, H * (dn + dr)), D, (L, H * (dn + dr))),
-                "w_dkv": qw((L, D, R + dr), D, (L, R + dr)),
-                "kv_norm": jnp.ones((L, R), dtype=scale_dtype),
-                "w_ukv": qw((L, R, H * (dn + dv)), R, (L, H * (dn + dv))),
-                "wo_mla": qw((L, H * dv, D), H * dv, (L, D)),
-            }
-        )
+        layers.update(mla_attn_q(L))
     else:
         layers.update(
             {
@@ -224,10 +243,15 @@ def init_llama_params_quantized(
         layers["post_attn_norm"] = norm_init
         layers["post_ffn_norm"] = norm_init
     if cfg.n_experts:
-        # expert banks stay unquantized (quantize_params parity); init small
+        # routed expert banks stay unquantized (quantize_params parity);
+        # shared experts are dense linears and quantize like any other
         from .llama import init_moe_layer_params
 
-        layers.update(init_moe_layer_params(cfg, next(kit), scale_dtype))
+        moe_p = init_moe_layer_params(cfg, next(kit), scale_dtype, n_layers=L)
+        for sk in ("w1s", "w3s", "w2s"):
+            if sk in moe_p:
+                moe_p[sk] = quantize_weight(moe_p[sk])
+        layers.update(moe_p)
     else:
         layers.update(
             {
@@ -244,6 +268,16 @@ def init_llama_params_quantized(
         "layers": layers,
         "final_norm": jnp.full((D,), 1.0 - cfg.norm_weight_offset, dtype=scale_dtype),
     }
+    if k_dense:
+        dnorm = jnp.full((k_dense, D), 1.0 - cfg.norm_weight_offset, dtype=scale_dtype)
+        params["dense_layers"] = {
+            "attn_norm": dnorm,
+            "ffn_norm": dnorm,
+            **mla_attn_q(k_dense),
+            "w1": qw((k_dense, D, F), D, (k_dense, F)),
+            "w3": qw((k_dense, D, F), D, (k_dense, F)),
+            "w2": qw((k_dense, F, D), F, (k_dense, D)),
+        }
     if not cfg.tie_embeddings:
         params["lm_head"] = qw((D, V), D, (V,))
     return params
@@ -263,12 +297,17 @@ def quantized_specs(specs: Params) -> Params:
         del t[axis]
         return P(*t)
 
-    layers = dict(specs["layers"])
-    for k in LAYER_QUANT_KEYS:
-        if k in layers:
-            layers[k] = {"q": layers[k], "s": drop(layers[k], -2)}
+    def quant_block_specs(block):
+        b = dict(block)
+        for k in LAYER_QUANT_KEYS:
+            if k in b:
+                b[k] = {"q": b[k], "s": drop(b[k], -2)}
+        return b
+
     out: Params = dict(specs)
-    out["layers"] = layers
+    out["layers"] = quant_block_specs(specs["layers"])
+    if "dense_layers" in specs:
+        out["dense_layers"] = quant_block_specs(specs["dense_layers"])
     out["embed"] = {"q": specs["embed"], "s": drop(specs["embed"], -1)}
     if "lm_head" in specs:
         out["lm_head"] = {"q": specs["lm_head"], "s": drop(specs["lm_head"], -2)}

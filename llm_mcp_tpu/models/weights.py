@@ -176,6 +176,115 @@ def _moe_suffix(e: int, w: str) -> str:
     return f"block_sparse_moe.experts.{e}.{w}.weight"
 
 
+# ---------------------------------------------------------------------------
+# DeepSeek-V2 (MLA + shared-expert MoE) name mapping
+# ---------------------------------------------------------------------------
+
+
+def _rope_perm(dr: int, inverse: bool = False) -> np.ndarray:
+    """HF DeepseekV2 checkpoints store the rope dims INTERLEAVED (the
+    modeling code de-interleaves q_pe/k_pe at runtime via
+    view(d//2, 2).transpose); this framework's apply_rope is split-half, so
+    the permutation is baked into the weight columns at load time."""
+    perm = np.empty(dr, dtype=np.int64)
+    perm[: dr // 2] = np.arange(0, dr, 2)
+    perm[dr // 2 :] = np.arange(1, dr, 2)
+    if inverse:
+        inv = np.empty_like(perm)
+        inv[perm] = np.arange(dr)
+        return inv
+    return perm
+
+
+def _hf_to_mla_layer(
+    cfg: ModelConfig, get, prefix: str, i: int
+) -> dict[str, np.ndarray]:
+    """One DeepSeek-V2 layer's attention + norms from HF tensors into this
+    framework's [in, out] orientation (HF linears are [out, in])."""
+    H = cfg.n_heads
+    dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    R = cfg.kv_lora_rank
+    perm = _rope_perm(dr)
+    base = f"{prefix}layers.{i}."
+
+    q = get(base + "self_attn.q_proj.weight").T  # [D, H*(dn+dr)]
+    q = q.reshape(-1, H, dn + dr)
+    q = np.concatenate([q[..., :dn], q[..., dn:][..., perm]], axis=-1)
+    dkv = get(base + "self_attn.kv_a_proj_with_mqa.weight").T  # [D, R+dr]
+    dkv = np.concatenate([dkv[..., :R], dkv[..., R:][..., perm]], axis=-1)
+    return {
+        "attn_norm": get(base + "input_layernorm.weight"),
+        "ffn_norm": get(base + "post_attention_layernorm.weight"),
+        "wq_mla": q.reshape(-1, H * (dn + dr)),
+        "w_dkv": dkv,
+        "kv_norm": get(base + "self_attn.kv_a_layernorm.weight"),
+        "w_ukv": get(base + "self_attn.kv_b_proj.weight").T,  # [R, H*(dn+dv)]
+        "wo_mla": get(base + "self_attn.o_proj.weight").T,  # [H*dv, D]
+    }
+
+
+def _hf_to_mla_params(
+    cfg: ModelConfig, get, prefix: str
+) -> dict[str, Any]:
+    """DeepSeek-V2 layout: dense FFN on layers [0, first_dense_layers),
+    shared-expert MoE (mlp.gate / mlp.experts.* / mlp.shared_experts.*) on
+    the rest — stacked into the dense_layers/layers split that
+    models/mla.py scans (reference analog: the name-only deepseek entries
+    `discovery.go:510`; here the architecture actually executes)."""
+    k_dense = cfg.first_dense_layers if cfg.n_experts else 0
+
+    def dense_ffn(i: int) -> dict[str, np.ndarray]:
+        base = f"{prefix}layers.{i}.mlp."
+        return {
+            "w1": get(base + "gate_proj.weight").T,
+            "w3": get(base + "up_proj.weight").T,
+            "w2": get(base + "down_proj.weight").T,
+        }
+
+    def moe_ffn_block(i: int) -> dict[str, np.ndarray]:
+        base = f"{prefix}layers.{i}.mlp."
+        out = {
+            "router": get(base + "gate.weight").T,  # [D, E]
+            "w1e": np.stack(
+                [get(f"{base}experts.{e}.gate_proj.weight").T for e in range(cfg.n_experts)]
+            ),
+            "w3e": np.stack(
+                [get(f"{base}experts.{e}.up_proj.weight").T for e in range(cfg.n_experts)]
+            ),
+            "w2e": np.stack(
+                [get(f"{base}experts.{e}.down_proj.weight").T for e in range(cfg.n_experts)]
+            ),
+        }
+        if cfg.n_shared_experts:
+            out["w1s"] = get(base + "shared_experts.gate_proj.weight").T
+            out["w3s"] = get(base + "shared_experts.up_proj.weight").T
+            out["w2s"] = get(base + "shared_experts.down_proj.weight").T
+        return out
+
+    def stack(dicts: list[dict[str, np.ndarray]]) -> dict[str, np.ndarray]:
+        return {k: np.stack([d[k] for d in dicts], axis=0) for k in dicts[0]}
+
+    main: list[dict[str, np.ndarray]] = []
+    dense: list[dict[str, np.ndarray]] = []
+    for i in range(cfg.n_layers):
+        lp = _hf_to_mla_layer(cfg, get, prefix, i)
+        if i < k_dense:
+            lp.update(dense_ffn(i))
+            dense.append(lp)
+        else:
+            lp.update(moe_ffn_block(i) if cfg.n_experts else dense_ffn(i))
+            main.append(lp)
+
+    params: dict[str, Any] = {
+        "embed": get(f"{prefix}embed_tokens.weight"),
+        "layers": stack(main),
+        "final_norm": get(f"{prefix}norm.weight"),
+    }
+    if dense:
+        params["dense_layers"] = stack(dense)
+    return params  # lm_head filled by the caller's shared fallback logic
+
+
 def hf_to_llama_params(
     cfg: ModelConfig,
     tensors: dict[str, np.ndarray],
@@ -194,6 +303,13 @@ def hf_to_llama_params(
         if name not in tensors:
             raise KeyError(f"checkpoint missing tensor {name!r}")
         return tensors[name]
+
+    if cfg.kv_lora_rank:  # DeepSeek-V2 MLA family
+        params = _hf_to_mla_params(cfg, get, prefix)
+        if not cfg.tie_embeddings:
+            lm = tensors.get("lm_head.weight")
+            params["lm_head"] = (lm if lm is not None else params["embed"]).T
+        return params
 
     L = cfg.n_layers
     layer_map = _layer_map(cfg)
@@ -236,10 +352,63 @@ def hf_to_llama_params(
     return params
 
 
+def _mla_to_hf_tensors(
+    cfg: ModelConfig, params: dict[str, Any], *, prefix: str = "model."
+) -> dict[str, np.ndarray]:
+    """Inverse of `_hf_to_mla_params` — re-interleaves the rope columns."""
+    H = cfg.n_heads
+    dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    R = cfg.kv_lora_rank
+    inv = _rope_perm(dr, inverse=True)
+    k_dense = cfg.first_dense_layers if cfg.n_experts else 0
+    out: dict[str, np.ndarray] = {
+        f"{prefix}embed_tokens.weight": np.asarray(params["embed"]),
+        f"{prefix}norm.weight": np.asarray(params["final_norm"]),
+    }
+    if not cfg.tie_embeddings and "lm_head" in params:
+        out["lm_head.weight"] = np.asarray(params["lm_head"]).T
+
+    def emit_layer(i: int, block: dict[str, Any], j: int) -> None:
+        base = f"{prefix}layers.{i}."
+        q = np.asarray(block["wq_mla"][j]).reshape(-1, H, dn + dr)
+        q = np.concatenate([q[..., :dn], q[..., dn:][..., inv]], axis=-1)
+        dkv = np.asarray(block["w_dkv"][j])
+        dkv = np.concatenate([dkv[..., :R], dkv[..., R:][..., inv]], axis=-1)
+        out[base + "input_layernorm.weight"] = np.asarray(block["attn_norm"][j])
+        out[base + "post_attention_layernorm.weight"] = np.asarray(block["ffn_norm"][j])
+        out[base + "self_attn.q_proj.weight"] = q.reshape(-1, H * (dn + dr)).T
+        out[base + "self_attn.kv_a_proj_with_mqa.weight"] = dkv.T
+        out[base + "self_attn.kv_a_layernorm.weight"] = np.asarray(block["kv_norm"][j])
+        out[base + "self_attn.kv_b_proj.weight"] = np.asarray(block["w_ukv"][j]).T
+        out[base + "self_attn.o_proj.weight"] = np.asarray(block["wo_mla"][j]).T
+        if "router" in block:
+            out[base + "mlp.gate.weight"] = np.asarray(block["router"][j]).T
+            for e in range(cfg.n_experts):
+                out[f"{base}mlp.experts.{e}.gate_proj.weight"] = np.asarray(block["w1e"][j, e]).T
+                out[f"{base}mlp.experts.{e}.up_proj.weight"] = np.asarray(block["w3e"][j, e]).T
+                out[f"{base}mlp.experts.{e}.down_proj.weight"] = np.asarray(block["w2e"][j, e]).T
+            if "w1s" in block:
+                out[base + "mlp.shared_experts.gate_proj.weight"] = np.asarray(block["w1s"][j]).T
+                out[base + "mlp.shared_experts.up_proj.weight"] = np.asarray(block["w3s"][j]).T
+                out[base + "mlp.shared_experts.down_proj.weight"] = np.asarray(block["w2s"][j]).T
+        else:
+            out[base + "mlp.gate_proj.weight"] = np.asarray(block["w1"][j]).T
+            out[base + "mlp.up_proj.weight"] = np.asarray(block["w3"][j]).T
+            out[base + "mlp.down_proj.weight"] = np.asarray(block["w2"][j]).T
+
+    for j in range(k_dense):
+        emit_layer(j, params["dense_layers"], j)
+    for j in range(cfg.n_layers - k_dense):
+        emit_layer(k_dense + j, params["layers"], j)
+    return out
+
+
 def llama_to_hf_tensors(
     cfg: ModelConfig, params: dict[str, Any], *, prefix: str = "model."
 ) -> dict[str, np.ndarray]:
     """Inverse of `hf_to_llama_params` (for re-export / roundtrip tests)."""
+    if cfg.kv_lora_rank:
+        return _mla_to_hf_tensors(cfg, params, prefix=prefix)
     out: dict[str, np.ndarray] = {
         f"{prefix}embed_tokens.weight": np.asarray(params["embed"]),
         f"{prefix}norm.weight": np.asarray(params["final_norm"]),

@@ -1,11 +1,14 @@
-"""Rotary position embeddings (RoPE).
+"""Rotary position embeddings (RoPE), plain and yarn-scaled.
 
 TPU-first notes: frequencies are computed inside the jitted graph from static
-config (no host round-trips); rotation is pure elementwise VPU work that XLA
+config (no host round-trips) — the yarn correction is pure static math that
+folds into the same constants; rotation is elementwise VPU work that XLA
 fuses into the surrounding matmuls. Split-half convention (as in Llama).
 """
 
 from __future__ import annotations
+
+import math
 
 import jax.numpy as jnp
 
@@ -19,6 +22,72 @@ def rope_frequencies(head_dim: int, theta: float, positions: jnp.ndarray) -> tup
     inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
     angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., half]
     return jnp.cos(angles), jnp.sin(angles)
+
+
+def _yarn_get_mscale(scale: float, mscale: float) -> float:
+    if scale <= 1.0 or not mscale:
+        return 1.0
+    return 0.1 * mscale * math.log(scale) + 1.0
+
+
+def yarn_rope_frequencies(
+    head_dim: int,
+    theta: float,
+    positions: jnp.ndarray,
+    *,
+    factor: float,
+    orig_max: int,
+    beta_fast: float = 32.0,
+    beta_slow: float = 1.0,
+    mscale: float = 0.0,
+    mscale_all_dim: float = 0.0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Yarn-corrected cos/sin tables (DeepSeek-V2 long-context rope).
+
+    Per-frequency blend between extrapolation (original inv_freq — kept for
+    the high-frequency dims whose wavelength fits inside the original
+    context) and interpolation (inv_freq / factor — for the low-frequency
+    dims that would otherwise see out-of-distribution angles), with a linear
+    ramp between the beta_fast/beta_slow correction dims, and the yarn
+    attention-magnitude correction folded into cos/sin.
+    """
+    half = head_dim // 2
+    idx = jnp.arange(0, half, dtype=jnp.float32)
+    freq_extra = 1.0 / (theta ** (idx / half))
+    freq_inter = freq_extra / factor
+
+    def corr_dim(n_rot: float) -> float:
+        return (head_dim * math.log(orig_max / (n_rot * 2 * math.pi))) / (
+            2 * math.log(theta)
+        )
+
+    low = max(math.floor(corr_dim(beta_fast)), 0)
+    high = min(math.ceil(corr_dim(beta_slow)), head_dim - 1)
+    ramp = jnp.clip((idx - low) / max(high - low, 1e-3), 0.0, 1.0)
+    extra_mask = 1.0 - ramp  # 1 → keep original (extrapolate), 0 → interpolate
+    inv_freq = freq_inter * ramp + freq_extra * extra_mask
+
+    m = _yarn_get_mscale(factor, mscale) / _yarn_get_mscale(factor, mscale_all_dim)
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles) * m, jnp.sin(angles) * m
+
+
+def rope_tables(cfg, head_dim: int, positions: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Config-dispatched rope tables: yarn when cfg.rope_factor > 1, plain
+    otherwise. The single entry point every forward path uses."""
+    if cfg.rope_factor > 1.0 and cfg.rope_orig_max:
+        return yarn_rope_frequencies(
+            head_dim,
+            cfg.rope_theta,
+            positions,
+            factor=cfg.rope_factor,
+            orig_max=cfg.rope_orig_max,
+            beta_fast=cfg.yarn_beta_fast,
+            beta_slow=cfg.yarn_beta_slow,
+            mscale=cfg.yarn_mscale,
+            mscale_all_dim=cfg.yarn_mscale_all_dim,
+        )
+    return rope_frequencies(head_dim, cfg.rope_theta, positions)
 
 
 def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:

@@ -39,7 +39,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.configs import ModelConfig
-from ..ops.rope import rope_frequencies, apply_rope
+from ..ops.rope import rope_tables, apply_rope
 
 NEG_INF = float(-1e30)
 
@@ -322,7 +322,7 @@ def llama_prefill_sp(
             h = h * jnp.asarray(cfg.dim**0.5, dtype=h.dtype)
 
         positions = (s0 + jnp.arange(Sl, dtype=jnp.int32))[None, :]
-        cos, sin = rope_frequencies(hd, cfg.rope_theta, positions)
+        cos, sin = rope_tables(cfg, hd, positions)
 
         def layer(h, xs):
             lp, win = xs

@@ -31,7 +31,7 @@ def llama_param_specs(cfg: ModelConfig) -> dict[str, Any]:
         # projections — tp shards the head-packed output axes; the shared
         # latent down-projection and its norm replicate (the latent is
         # per-token global state every head reads).
-        layers: dict[str, Any] = {
+        attn: dict[str, Any] = {
             "attn_norm": P(None, None),
             "wq_mla": P(None, None, "tp"),
             "w_dkv": P(None, None, None),
@@ -39,15 +39,36 @@ def llama_param_specs(cfg: ModelConfig) -> dict[str, Any]:
             "w_ukv": P(None, None, "tp"),
             "wo_mla": P(None, "tp", None),
             "ffn_norm": P(None, None),
+        }
+        dense_ffn = {
             "w1": P(None, None, "tp"),
             "w3": P(None, None, "tp"),
             "w2": P(None, "tp", None),
         }
+        if cfg.n_experts:
+            ffn: dict[str, Any] = {
+                "router": P(None, None, None),
+                "w1e": P(None, "ep", None, "tp"),
+                "w3e": P(None, "ep", None, "tp"),
+                "w2e": P(None, "ep", "tp", None),
+            }
+            if cfg.n_shared_experts:
+                ffn.update(
+                    {
+                        "w1s": P(None, None, "tp"),
+                        "w3s": P(None, None, "tp"),
+                        "w2s": P(None, "tp", None),
+                    }
+                )
+        else:
+            ffn = dense_ffn
         specs: dict[str, Any] = {
             "embed": P("tp", None),
-            "layers": layers,
+            "layers": {**attn, **ffn},
             "final_norm": P(None),
         }
+        if cfg.n_experts and cfg.first_dense_layers:
+            specs["dense_layers"] = {**attn, **dense_ffn}
         if not cfg.tie_embeddings:
             specs["lm_head"] = P(None, "tp")
         return specs
@@ -77,6 +98,14 @@ def llama_param_specs(cfg: ModelConfig) -> dict[str, Any]:
                 "w2e": P(None, "ep", "tp", None),
             }
         )
+        if cfg.n_shared_experts:
+            layers.update(
+                {
+                    "w1s": P(None, None, "tp"),
+                    "w3s": P(None, None, "tp"),
+                    "w2s": P(None, "tp", None),
+                }
+            )
     else:
         layers.update(
             {

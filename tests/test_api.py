@@ -380,6 +380,20 @@ def _smart_selection_accuracy_body(base, cat):
     )
     assert r.status_code == 503, r.text
     assert "X-Selected-Model" not in r.headers
+    # same when every ranked model fails CONTEXT fit (reference behavior:
+    # "no suitable model found", handlers.go:3130) — tiny-llm's 8k context
+    # can't hold a ~12.5k-token prompt, premium-llm is shrunk below it too
+    cat.upsert_model("premium-llm", context_k=1)
+    cat.upsert_model("tiny-llm", context_k=1)
+    r = httpx.post(
+        f"{base}/v1/chat/completions",
+        json={"messages": [{"role": "user", "content": "y" * 50_000}],
+              "max_tokens": 4, "task_type": "code"},
+        timeout=120.0,
+    )
+    cat.upsert_model("tiny-llm", context_k=8)  # restore both
+    cat.upsert_model("premium-llm", context_k=128)
+    assert r.status_code == 503, r.text
     # context fit: a model whose context can't hold the prompt is skipped
     cat.upsert_model("tiny-ctx", name="tiny-ctx", kind="llm", context_k=1)
     cat.set_ranking("tiny-ctx", "code", 99.0)
