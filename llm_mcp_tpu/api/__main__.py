@@ -65,6 +65,7 @@ def main() -> None:
             max_seq_len=min(cfg.tpu_max_seq_len, 8192),
             dtype=jnp.bfloat16,
             weights_dir=cfg.tpu_weights_dir,
+            quant=cfg.tpu_embed_quant,
         )
 
     host, _, port = cfg.http_addr.rpartition(":")

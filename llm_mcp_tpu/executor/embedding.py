@@ -36,6 +36,7 @@ class EmbeddingEngine:
         dtype: Any = jnp.bfloat16,
         seed: int = 0,
         weights_dir: str = "",
+        quant: str = "",
     ):
         self.cfg = get_config(model) if isinstance(model, str) else model
         self.mesh = mesh
@@ -44,9 +45,31 @@ class EmbeddingEngine:
         self.tokenizer: Tokenizer = tokenizer or load_tokenizer(weights_dir)
 
         if params is None:
-            params = init_embedder_params(self.cfg, jax.random.PRNGKey(seed), dtype=dtype)
+            if quant == "int8":
+                # direct int8 init: an 8B-class embedder's bf16 tree
+                # (~15 GB) never fits beside activations on a 16 GB chip
+                from ..models.embedder import init_embedder_params_quantized
+
+                params = init_embedder_params_quantized(
+                    self.cfg, jax.random.PRNGKey(seed), scale_dtype=dtype
+                )
+            else:
+                params = init_embedder_params(
+                    self.cfg, jax.random.PRNGKey(seed), dtype=dtype
+                )
+        elif quant == "int8":
+            from ..models.quant import quantize_params
+
+            params = quantize_params(params)
         if mesh is not None:
-            params = shard_pytree(params, embedder_param_specs(self.cfg), mesh)
+            specs = embedder_param_specs(self.cfg)
+            if quant == "int8":
+                # {"q","s"} leaves need the quantized spec shape (the same
+                # step GenerationEngine takes before sharding int8 trees)
+                from ..models.quant import quantized_specs
+
+                specs = quantized_specs(specs)
+            params = shard_pytree(params, specs, mesh)
         self.params = params
 
         cfg = self.cfg

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from ..ops.norms import rms_norm as _rms_norm
 from ..ops.rope import rope_tables, apply_rope
 from .configs import ModelConfig
+from .quant import embed_lookup, qdot
 
 Params = dict[str, Any]
 
@@ -64,7 +65,7 @@ def embed_forward(
     hd = cfg.resolved_head_dim
     H = cfg.n_heads
 
-    h = params["embed"][tokens]
+    h = embed_lookup(params["embed"], tokens)
     positions = jnp.arange(S, dtype=jnp.int32)[None, :]
     cos, sin = rope_tables(cfg, hd, positions)
 
@@ -73,10 +74,12 @@ def embed_forward(
     neg = jnp.float32(-1e30)
 
     def layer(h, lp):
+        # qdot keeps int8 weight trees transparent (w8a8 on the MXU) — the
+        # 8B-class embedder only fits a 16 GB chip quantized
         x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bsd,de->bse", x, lp["wq"]).reshape(B, S, H, hd)
-        k = jnp.einsum("bsd,de->bse", x, lp["wk"]).reshape(B, S, H, hd)
-        v = jnp.einsum("bsd,de->bse", x, lp["wv"]).reshape(B, S, H, hd)
+        q = qdot(x, lp["wq"]).reshape(B, S, H, hd)
+        k = qdot(x, lp["wk"]).reshape(B, S, H, hd)
+        v = qdot(x, lp["wv"]).reshape(B, S, H, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
@@ -84,12 +87,12 @@ def embed_forward(
         scores = jnp.where(mask[:, None, :, :], scores, neg)
         probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H * hd)
-        h = h + jnp.einsum("bse,ed->bsd", ctx, lp["wo"])
+        h = h + qdot(ctx, lp["wo"])
 
         x = _rms_norm(h, lp["ffn_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", x, lp["w1"]))
-        up = jnp.einsum("bsd,df->bsf", x, lp["w3"])
-        h = h + jnp.einsum("bsf,fd->bsd", gate * up, lp["w2"])
+        gate = jax.nn.silu(qdot(x, lp["w1"]))
+        up = qdot(x, lp["w3"])
+        h = h + qdot(gate * up, lp["w2"])
         return h, None
 
     h, _ = jax.lax.scan(layer, h, params["layers"])
@@ -102,3 +105,37 @@ def embed_forward(
         pooled = (h * w).sum(axis=1) / jnp.maximum(w.sum(axis=1), 1.0)
 
     return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
+
+
+def init_embedder_params_quantized(
+    cfg: ModelConfig, key: jax.Array, scale_dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    """Random-init the encoder tree DIRECTLY in int8-quantized form — the
+    bf16 tree of an 8B-class embedder (~15 GB) never materializes on a
+    16 GB chip (same scheme as quant.py:init_llama_params_quantized:
+    uniform int8 payloads, fan_in**-0.5 / 73.3 per-output-channel scales)."""
+    from .quant import qw_random
+
+    hd = cfg.resolved_head_dim
+    L, D, H, F, V = cfg.n_layers, cfg.dim, cfg.n_heads, cfg.ffn_hidden, cfg.vocab_size
+    keys = jax.random.split(key, 16)
+    kit = iter(keys)
+
+    def qw(shape, fan_in, scale_axes):
+        return qw_random(next(kit), shape, fan_in, scale_axes, scale_dtype)
+
+    return {
+        "embed": qw((V, D), D, (V,)),  # per-row scales (embed_lookup contract)
+        "layers": {
+            "attn_norm": jnp.ones((L, D), dtype=scale_dtype),
+            "wq": qw((L, D, H * hd), D, (L, H * hd)),
+            "wk": qw((L, D, H * hd), D, (L, H * hd)),
+            "wv": qw((L, D, H * hd), D, (L, H * hd)),
+            "wo": qw((L, H * hd, D), H * hd, (L, D)),
+            "ffn_norm": jnp.ones((L, D), dtype=scale_dtype),
+            "w1": qw((L, D, F), D, (L, F)),
+            "w3": qw((L, D, F), D, (L, F)),
+            "w2": qw((L, F, D), F, (L, D)),
+        },
+        "final_norm": jnp.ones((D,), dtype=scale_dtype),
+    }

@@ -158,6 +158,17 @@ def quantize_params(params: Params) -> Params:
     return out
 
 
+def qw_random(key, shape, fan_in, scale_axes, scale_dtype) -> dict:
+    """Direct-int8 random weight: uniform int8 payload + constant
+    per-output-channel scales. Uniform int8 draws have std ≈ 73.3, so
+    fan_in**-0.5 / 73.3 matches the fan-in-scaled normal init's magnitude.
+    Single source of truth for every direct-quantized init (the llama tree
+    below, models/embedder.py's encoder tree)."""
+    q = jax.random.randint(key, shape, -127, 128, dtype=jnp.int8)
+    s = jnp.full(scale_axes, (fan_in**-0.5) / 73.3, dtype=scale_dtype)
+    return {"q": q, "s": s}
+
+
 def init_llama_params_quantized(
     cfg, key: jax.Array, scale_dtype: jnp.dtype = jnp.bfloat16
 ) -> Params:
@@ -195,10 +206,7 @@ def init_llama_params_quantized(
     kit = iter(keys)
 
     def qw(shape, fan_in, scale_axes):
-        # int8 payload + constant per-output-channel scales on device
-        q = jax.random.randint(next(kit), shape, -127, 128, dtype=jnp.int8)
-        s = jnp.full(scale_axes, (fan_in**-0.5) / 73.3, dtype=scale_dtype)
-        return {"q": q, "s": s}
+        return qw_random(next(kit), shape, fan_in, scale_axes, scale_dtype)
 
     norm_init = jnp.full((L, D), 1.0 - cfg.norm_weight_offset, dtype=scale_dtype)
     layers: Params = {"attn_norm": norm_init, "ffn_norm": norm_init}

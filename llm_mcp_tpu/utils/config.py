@@ -106,6 +106,8 @@ class Config:
     # TPU executor
     tpu_model: str = field(default_factory=lambda: getenv("TPU_MODEL", "llama-3.1-8b"))
     tpu_embed_model: str = field(default_factory=lambda: getenv("TPU_EMBED_MODEL", "nomic-embed-text"))
+    # "" | int8 — 8B-class embedders (qwen3-embedding-8b) only fit 16 GB int8
+    tpu_embed_quant: str = field(default_factory=lambda: getenv("TPU_EMBED_QUANT", ""))
     tpu_weights_dir: str = field(default_factory=lambda: getenv("TPU_WEIGHTS_DIR", ""))
     # 32 fits the default llama-3.1-8b KV cache alongside its weights on one
     # chip; for 1B-class models TPU_MAX_SLOTS=64 is the measured throughput

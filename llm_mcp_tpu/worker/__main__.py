@@ -60,6 +60,7 @@ def main() -> None:
             max_seq_len=min(cfg.tpu_max_seq_len, 8192),
             dtype=jnp.bfloat16,
             weights_dir=cfg.tpu_weights_dir,
+            quant=cfg.tpu_embed_quant,
         )
 
     cloud = CloudClient(cfg) if (cfg.has_openrouter() or cfg.has_openai()) else None

@@ -154,6 +154,42 @@ def test_embedding_engine_basic():
     np.testing.assert_allclose(np.linalg.norm(vecs, axis=1), 1.0, rtol=1e-4)
 
 
+def test_embedding_engine_int8_matches_float():
+    """quant="int8" quantizes a supplied tree; vectors must stay directionally
+    faithful to the float engine (the 8B-class embedder only fits a 16 GB
+    chip quantized — BASELINE config #4)."""
+    from llm_mcp_tpu.models.embedder import init_embedder_params
+
+    import jax
+
+    from llm_mcp_tpu.models import get_config
+
+    cfg = get_config("tiny-embed")
+    params = init_embedder_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    f_eng = EmbeddingEngine(cfg, params=params, max_batch=4, max_seq_len=64,
+                            dtype=jnp.float32)
+    q_eng = EmbeddingEngine(cfg, params=params, max_batch=4, max_seq_len=64,
+                            dtype=jnp.float32, quant="int8")
+    texts = ["int8 embedder parity", "second probe text"]
+    fv, _ = f_eng.embed(texts)
+    qv, _ = q_eng.embed(texts)
+    for a, b in zip(fv, qv):
+        cos = float(np.dot(a, b))
+        assert cos > 0.99, cos
+
+
+def test_embedding_engine_direct_int8_init():
+    """quant="int8" with no params: the direct-quantized init path (no bf16
+    tree ever materializes) produces unit-norm finite vectors."""
+    eng = EmbeddingEngine("tiny-embed", max_batch=4, max_seq_len=64,
+                          dtype=jnp.float32, quant="int8")
+    vecs, tokens = eng.embed(["direct int8 init", "another"])
+    assert len(vecs) == 2 and tokens > 0
+    arr = np.asarray(vecs)
+    assert np.isfinite(arr).all()
+    np.testing.assert_allclose(np.linalg.norm(arr, axis=1), 1.0, rtol=1e-4)
+
+
 def test_embedding_matryoshka_dimensions():
     eng = EmbeddingEngine("tiny-embed", max_batch=4, max_seq_len=64, dtype=jnp.float32)
     full, _ = eng.embed(["same input"])
