@@ -237,7 +237,12 @@ class InferenceAPI:
             # re-selecting under its own defaults (handlers.go:2154-2159)
             body["model"] = model
 
-        if "/" in model:  # cloud namespace, e.g. "meta-llama/..." via OpenRouter
+        # slash names are the cloud namespace ("meta-llama/..." via
+        # OpenRouter) — but only when no LOCAL engine carries the name: an
+        # HF-style org/name id served from a local checkpoint dir
+        # (models/configs.py:resolve_config) must not be shadowed by the
+        # cloud heuristic
+        if "/" in model and self._local_gen(model) is None:
             self._chat_cloud(req, resp, body, model, stream)
             return
 
@@ -445,7 +450,9 @@ class InferenceAPI:
             resp.write_error("no embedding model available", 503)
             return
 
-        if "/" in model:
+        # same local-first rule as chat: a slash name only means "cloud"
+        # when no local embedding engine carries it
+        if "/" in model and self._local_embed(model) is None:
             self._embed_cloud(resp, model, texts, dimensions)
             return
 

@@ -38,6 +38,10 @@ class EmbeddingEngine:
         weights_dir: str = "",
         quant: str = "",
     ):
+        # catalog-only resolution: config_from_hf infers DECODER families;
+        # encoder checkpoints (nomic_bert, qwen3 embedders) would either
+        # warn-spam or silently get a decoder config — until encoder
+        # inference exists, the name catalog is the single source of truth
         self.cfg = get_config(model) if isinstance(model, str) else model
         self.mesh = mesh
         self.max_batch = max_batch

@@ -47,7 +47,7 @@ import numpy as np
 
 from ..kernels.attention import pallas_supported, resolve_attn_impl, resolve_decode_impl
 from ..utils.faults import maybe_fail
-from ..models.configs import ModelConfig, get_config
+from ..models.configs import ModelConfig, resolve_config
 from ..models.weights import load_llama_checkpoint
 from ..models.llama import (
     init_llama_params,
@@ -159,7 +159,11 @@ class GenerationEngine:
         decode_compact: str = "auto",
         prompt_cache_mb: int = 256,
     ):
-        self.cfg = get_config(model) if isinstance(model, str) else model
+        # a config.json beside the weights is authoritative: any supported-
+        # family checkpoint serves without a catalog entry (models/configs.py
+        # resolve_config — the reference's serve-any-name parity,
+        # discovery.go:482-560)
+        self.cfg = resolve_config(model, weights_dir)
         self.mesh = mesh
         self.dtype = dtype
         self.max_slots = max_slots

@@ -1,4 +1,4 @@
-from .configs import ModelConfig, MODEL_CONFIGS, get_config
+from .configs import config_from_hf, config_from_hf_dir, resolve_config, ModelConfig, MODEL_CONFIGS, get_config
 from .llama import init_llama_params, llama_prefill, llama_decode_step, init_kv_cache
 from .embedder import init_embedder_params, embed_forward
 from .weights import (
@@ -26,6 +26,9 @@ __all__ = [
     "ModelConfig",
     "MODEL_CONFIGS",
     "get_config",
+    "config_from_hf",
+    "config_from_hf_dir",
+    "resolve_config",
     "init_llama_params",
     "llama_prefill",
     "llama_decode_step",

@@ -60,11 +60,16 @@ class ModelConfig:
     qk_rope_head_dim: int = 0  # per-head rope dims (shared key)
     qk_nope_head_dim: int = 0  # per-head non-rope dims
     v_head_dim: int = 0  # per-head value dims
-    # yarn rope scaling (DeepSeek-V2 long context): factor > 1 switches
-    # `ops/rope.py:rope_tables` to yarn-corrected frequencies, and
-    # yarn_mscale_all_dim scales attention scores (attn_scale/mla_scale)
+    # rope scaling for long context: factor > 1 switches
+    # `ops/rope.py:rope_tables` to the family's corrected frequencies —
+    # rope_type "yarn" (DeepSeek-V2; yarn_mscale_all_dim also scales
+    # attention scores via attn_scale/mla_scale) or "llama3" (Llama-3.x
+    # wavelength-banded scaling)
+    rope_type: str = "yarn"
     rope_factor: float = 1.0
     rope_orig_max: int = 0  # original_max_position_embeddings pre-scaling
+    llama3_low_freq_factor: float = 1.0
+    llama3_high_freq_factor: float = 4.0
     yarn_beta_fast: float = 32.0
     yarn_beta_slow: float = 1.0
     yarn_mscale: float = 0.0
@@ -144,6 +149,9 @@ class ModelConfig:
 MODEL_CONFIGS: dict[str, ModelConfig] = {
     "llama-3.1-8b": ModelConfig(
         name="llama-3.1-8b",
+        rope_type="llama3",
+        rope_factor=8.0,
+        rope_orig_max=8192,
         vocab_size=128_256,
         dim=4096,
         n_layers=32,
@@ -156,6 +164,9 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
     ),
     "llama-3.2-1b": ModelConfig(
         name="llama-3.2-1b",
+        rope_type="llama3",
+        rope_factor=32.0,
+        rope_orig_max=8192,
         vocab_size=128_256,
         dim=2048,
         n_layers=16,
@@ -534,6 +545,140 @@ def _compact(s: str) -> str:
     """Strip separators so "llama3.1:8b", "Llama-3.1-8B" and "llama_3.1_8b"
     all compare equal."""
     return re.sub(r"[-_.:\s]", "", s.lower())
+
+
+def config_from_hf(doc: dict, name: str = "") -> ModelConfig:
+    """Build a ModelConfig from an HF checkpoint's config.json dict.
+
+    The reference serves ANY model name its Ollama hosts carry, inferring
+    catalog metadata for names it has never seen
+    (`discovery.go:482-560`); this is the in-process analog — an arbitrary
+    checkpoint directory becomes servable without a hand-written entry in
+    MODEL_CONFIGS. Covers the implemented decoder families; anything else
+    raises ValueError (a silently-wrong architecture would produce garbage
+    weights-load "successes")."""
+    import dataclasses
+
+    mt = str(doc.get("model_type", "")).lower()
+    n_heads = int(doc.get("num_attention_heads", 32))
+    kw: dict = dict(
+        name=name or str(doc.get("_name_or_path") or mt or "hf-model"),
+        vocab_size=int(doc["vocab_size"]),
+        dim=int(doc["hidden_size"]),
+        n_layers=int(doc["num_hidden_layers"]),
+        n_heads=n_heads,
+        n_kv_heads=int(doc.get("num_key_value_heads") or n_heads),
+        ffn_hidden=int(doc["intermediate_size"]),
+        head_dim=int(doc.get("head_dim") or 0),
+        rope_theta=float(doc.get("rope_theta") or 10_000.0),
+        norm_eps=float(doc.get("rms_norm_eps") or 1e-5),
+        max_seq_len=int(doc.get("max_position_embeddings") or 8192),
+        tie_embeddings=bool(doc.get("tie_word_embeddings", False)),
+    )
+    rs = doc.get("rope_scaling") or {}
+    rs = rs if isinstance(rs, dict) else {}
+    rs_type = str(rs.get("rope_type") or rs.get("type") or "").lower()
+    if mt == "llama":
+        if rs_type == "llama3":
+            kw.update(
+                rope_type="llama3",
+                rope_factor=float(rs.get("factor") or 1.0),
+                rope_orig_max=int(rs.get("original_max_position_embeddings") or 0),
+                llama3_low_freq_factor=float(rs.get("low_freq_factor") or 1.0),
+                llama3_high_freq_factor=float(rs.get("high_freq_factor") or 4.0),
+            )
+    elif mt == "qwen2":
+        kw["qkv_bias"] = True
+    elif mt == "mistral":
+        kw["sliding_window"] = int(doc.get("sliding_window") or 0)
+        kw["sliding_pattern"] = 1
+    elif mt == "mixtral":
+        kw["n_experts"] = int(doc["num_local_experts"])
+        kw["experts_per_tok"] = int(doc.get("num_experts_per_tok") or 2)
+    elif mt == "gemma2":
+        kw.update(
+            act="gelu",
+            norm_weight_offset=1.0,
+            embed_scale=True,
+            logit_softcap=float(doc.get("final_logit_softcapping") or 0.0),
+            attn_softcap=float(doc.get("attn_logit_softcapping") or 0.0),
+            sliding_window=int(doc.get("sliding_window") or 0),
+            sliding_pattern=2,
+            query_pre_attn_scalar=float(doc.get("query_pre_attn_scalar") or 0.0),
+            post_norms=True,
+            tie_embeddings=True,
+        )
+    elif mt == "deepseek_v2":
+        kw.update(
+            arch="mla",
+            n_kv_heads=1,  # latent cache poses as one KV head (models/mla.py)
+            q_lora_rank=int(doc.get("q_lora_rank") or 0),
+            kv_lora_rank=int(doc["kv_lora_rank"]),
+            qk_rope_head_dim=int(doc["qk_rope_head_dim"]),
+            qk_nope_head_dim=int(doc["qk_nope_head_dim"]),
+            v_head_dim=int(doc["v_head_dim"]),
+            n_experts=int(doc.get("n_routed_experts") or 0),
+            experts_per_tok=int(doc.get("num_experts_per_tok") or 2),
+            n_shared_experts=int(doc.get("n_shared_experts") or 0),
+            moe_ffn_hidden=int(doc.get("moe_intermediate_size") or 0),
+            first_dense_layers=int(doc.get("first_k_dense_replace") or 0),
+            # HF DeepseekV2Config default is False (raw softmax gates)
+            norm_topk_prob=bool(doc.get("norm_topk_prob", False)),
+            routed_scaling_factor=float(doc.get("routed_scaling_factor") or 1.0),
+        )
+        if rs_type == "yarn":
+            kw.update(
+                rope_type="yarn",
+                rope_factor=float(rs.get("factor") or 1.0),
+                rope_orig_max=int(rs.get("original_max_position_embeddings") or 0),
+                yarn_beta_fast=float(rs.get("beta_fast") or 32.0),
+                yarn_beta_slow=float(rs.get("beta_slow") or 1.0),
+                yarn_mscale=float(rs.get("mscale") or 0.0),
+                yarn_mscale_all_dim=float(rs.get("mscale_all_dim") or 0.0),
+            )
+    else:
+        raise ValueError(
+            f"unsupported HF model_type {mt!r} "
+            "(supported: llama, qwen2, mistral, mixtral, gemma2, deepseek_v2)"
+        )
+    if rs_type and kw.get("rope_factor", 1.0) <= 1.0 and rs_type not in (
+        "default", "linear"
+    ):
+        # a scaling recipe we did not apply: serving it with plain rope
+        # would silently degrade past the original context window
+        raise ValueError(f"unsupported rope_scaling type {rs_type!r} for {mt!r}")
+    cfg = ModelConfig(**kw)
+    return dataclasses.replace(cfg, params_b=round(cfg.param_count() / 1e9, 3))
+
+
+def config_from_hf_dir(path: str, name: str = "") -> ModelConfig:
+    """`config_from_hf` over a checkpoint directory's config.json."""
+    import json as _json
+    import os as _os
+
+    with open(_os.path.join(path, "config.json")) as f:
+        return config_from_hf(_json.load(f), name=name)
+
+
+def resolve_config(model, weights_dir: str = "") -> ModelConfig:
+    """Config for a model name + optional checkpoint dir. A config.json in
+    the checkpoint dir is AUTHORITATIVE (it describes the actual weights);
+    the name-based catalog is the fallback — so any supported-family
+    checkpoint serves without a hand-written MODEL_CONFIGS entry."""
+    import logging
+    import os as _os
+
+    if not isinstance(model, str):
+        return model
+    if weights_dir and _os.path.isfile(_os.path.join(weights_dir, "config.json")):
+        try:
+            return config_from_hf_dir(weights_dir, name=model)
+        except Exception as e:  # any malformed config.json → catalog fallback
+            logging.getLogger("models").warning(
+                "config.json in %s not usable (%s); falling back to catalog "
+                "entry for %r", weights_dir, e, model,
+            )
+    return get_config(model)
 
 
 def get_config(name: str) -> ModelConfig:

@@ -72,10 +72,56 @@ def yarn_rope_frequencies(
     return jnp.cos(angles) * m, jnp.sin(angles) * m
 
 
+def llama3_rope_frequencies(
+    head_dim: int,
+    theta: float,
+    positions: jnp.ndarray,
+    *,
+    factor: float,
+    orig_max: int,
+    low_freq_factor: float = 1.0,
+    high_freq_factor: float = 4.0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Llama-3.1-style rope scaling (HF rope_type "llama3"): wavelengths
+    shorter than orig_max/high_freq_factor keep the original frequency,
+    longer than orig_max/low_freq_factor divide by `factor`, and the band
+    between interpolates smoothly. No magnitude correction (unlike yarn)."""
+    half = head_dim // 2
+    idx = jnp.arange(0, half, dtype=jnp.float32)
+    inv_freq = 1.0 / (theta ** (idx / half))
+    wavelen = 2.0 * math.pi / inv_freq
+    low_wl = orig_max / low_freq_factor
+    high_wl = orig_max / high_freq_factor
+    smooth = jnp.clip(
+        (orig_max / wavelen - low_freq_factor)
+        / max(high_freq_factor - low_freq_factor, 1e-3),
+        0.0,
+        1.0,
+    )
+    blended = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    inv_freq = jnp.where(
+        wavelen < high_wl, inv_freq,
+        jnp.where(wavelen > low_wl, inv_freq / factor, blended),
+    )
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
 def rope_tables(cfg, head_dim: int, positions: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Config-dispatched rope tables: yarn when cfg.rope_factor > 1, plain
-    otherwise. The single entry point every forward path uses."""
+    """Config-dispatched rope tables: yarn (DeepSeek-V2) or llama3
+    (Llama-3.x long context) when configured, plain otherwise. The single
+    entry point every forward path uses."""
     if cfg.rope_factor > 1.0 and cfg.rope_orig_max:
+        if cfg.rope_type == "llama3":
+            return llama3_rope_frequencies(
+                head_dim,
+                cfg.rope_theta,
+                positions,
+                factor=cfg.rope_factor,
+                orig_max=cfg.rope_orig_max,
+                low_freq_factor=cfg.llama3_low_freq_factor,
+                high_freq_factor=cfg.llama3_high_freq_factor,
+            )
         return yarn_rope_frequencies(
             head_dim,
             cfg.rope_theta,

@@ -1,0 +1,222 @@
+"""Arbitrary-checkpoint serving: ModelConfig inferred from a checkpoint's
+own config.json (models/configs.py:config_from_hf). The reference serves
+any model name its Ollama hosts carry by inferring catalog metadata
+(`discovery.go:482-560`); here an unseen checkpoint directory becomes
+servable the same way — config.json is authoritative over the name catalog.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_mcp_tpu.models import (
+    config_from_hf,
+    get_config,
+    init_llama_params,
+    resolve_config,
+)
+from llm_mcp_tpu.models.weights import llama_to_hf_tensors, write_safetensors
+
+
+def test_llama_fields():
+    cfg = config_from_hf(
+        {
+            "model_type": "llama",
+            "vocab_size": 4096,
+            "hidden_size": 512,
+            "num_hidden_layers": 6,
+            "num_attention_heads": 8,
+            "num_key_value_heads": 2,
+            "intermediate_size": 1024,
+            "rope_theta": 500000.0,
+            "rms_norm_eps": 1e-5,
+            "max_position_embeddings": 8192,
+            "tie_word_embeddings": True,
+        },
+        name="my-custom-llama",
+    )
+    assert cfg.name == "my-custom-llama"
+    assert (cfg.dim, cfg.n_layers, cfg.n_kv_heads) == (512, 6, 2)
+    assert cfg.tie_embeddings and cfg.rope_theta == 500000.0
+    assert cfg.params_b > 0
+
+
+def test_deepseek_v2_fields_with_yarn():
+    # the published DeepSeek-V2-Lite config.json shape
+    cfg = config_from_hf(
+        {
+            "model_type": "deepseek_v2",
+            "vocab_size": 102400,
+            "hidden_size": 2048,
+            "num_hidden_layers": 27,
+            "num_attention_heads": 16,
+            "num_key_value_heads": 16,
+            "intermediate_size": 10944,
+            "moe_intermediate_size": 1408,
+            "n_routed_experts": 64,
+            "n_shared_experts": 2,
+            "num_experts_per_tok": 6,
+            "first_k_dense_replace": 1,
+            "norm_topk_prob": False,
+            "routed_scaling_factor": 1.0,
+            "kv_lora_rank": 512,
+            "q_lora_rank": None,
+            "qk_rope_head_dim": 64,
+            "qk_nope_head_dim": 128,
+            "v_head_dim": 128,
+            "rope_theta": 10000,
+            "rms_norm_eps": 1e-6,
+            "max_position_embeddings": 163840,
+            "rope_scaling": {
+                "type": "yarn",
+                "factor": 40,
+                "original_max_position_embeddings": 4096,
+                "beta_fast": 32,
+                "beta_slow": 1,
+                "mscale": 0.707,
+                "mscale_all_dim": 0.707,
+            },
+        }
+    )
+    ref = get_config("deepseek-v2-lite")
+    for f in (
+        "arch", "dim", "n_layers", "kv_lora_rank", "qk_rope_head_dim",
+        "qk_nope_head_dim", "v_head_dim", "n_experts", "experts_per_tok",
+        "n_shared_experts", "moe_ffn_hidden", "first_dense_layers",
+        "norm_topk_prob", "rope_factor", "rope_orig_max", "yarn_mscale",
+    ):
+        assert getattr(cfg, f) == getattr(ref, f), f
+    assert abs(cfg.params_b - ref.params_b) / ref.params_b < 0.05
+
+
+def test_llama3_rope_scaling_matches_reference_formula():
+    """The flagship llama-3.1 configs now carry their published "llama3"
+    rope scaling; rope_tables must reproduce the HF recipe (wavelength
+    bands: keep / divide-by-factor / smooth blend)."""
+    import math
+
+    from llm_mcp_tpu.ops.rope import rope_tables
+
+    cfg = get_config("llama-3.1-8b")
+    assert cfg.rope_type == "llama3" and cfg.rope_factor == 8.0
+    hd = cfg.resolved_head_dim
+    pos = np.arange(0, 64, 7, dtype=np.int32)
+    cos, sin = rope_tables(cfg, hd, jnp.asarray(pos))
+
+    half = hd // 2
+    inv = 1.0 / (cfg.rope_theta ** (np.arange(half) / half))
+    wavelen = 2 * math.pi / inv
+    low_wl = cfg.rope_orig_max / cfg.llama3_low_freq_factor
+    high_wl = cfg.rope_orig_max / cfg.llama3_high_freq_factor
+    smooth = np.clip(
+        (cfg.rope_orig_max / wavelen - cfg.llama3_low_freq_factor)
+        / (cfg.llama3_high_freq_factor - cfg.llama3_low_freq_factor),
+        0, 1,
+    )
+    blended = (1 - smooth) * inv / cfg.rope_factor + smooth * inv
+    ref = np.where(wavelen < high_wl, inv,
+                   np.where(wavelen > low_wl, inv / cfg.rope_factor, blended))
+    ang = pos[:, None].astype(np.float64) * ref[None, :]
+    np.testing.assert_allclose(np.asarray(cos), np.cos(ang), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(sin), np.sin(ang), rtol=1e-5, atol=1e-5)
+    # all three bands are actually exercised at these shapes
+    assert (wavelen < high_wl).any() and (wavelen > low_wl).any()
+    assert ((wavelen >= high_wl) & (wavelen <= low_wl)).any()
+
+
+def test_hf_llama3_rope_fields_inferred():
+    doc = {
+        "model_type": "llama", "vocab_size": 512, "hidden_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "intermediate_size": 256,
+        "rope_theta": 500000.0, "rms_norm_eps": 1e-5,
+        "max_position_embeddings": 131072, "tie_word_embeddings": True,
+        "rope_scaling": {
+            "rope_type": "llama3", "factor": 8.0,
+            "original_max_position_embeddings": 8192,
+            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+        },
+    }
+    cfg = config_from_hf(doc)
+    assert cfg.rope_type == "llama3" and cfg.rope_factor == 8.0
+    assert cfg.rope_orig_max == 8192
+    # an unimplemented scaling type fails loud instead of silently serving
+    # degraded long context
+    doc["rope_scaling"] = {"rope_type": "longrope", "factor": 4.0}
+    with pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf(doc)
+
+
+def test_unsupported_model_type_raises():
+    with pytest.raises(ValueError, match="unsupported HF model_type"):
+        config_from_hf({"model_type": "rwkv", "vocab_size": 1, "hidden_size": 1,
+                        "num_hidden_layers": 1, "intermediate_size": 1})
+
+
+def test_resolve_config_prefers_checkpoint_config(tmp_path):
+    """A checkpoint dir with config.json serves under an UNSEEN name; a dir
+    without one falls back to the name catalog."""
+    doc = {
+        "model_type": "llama",
+        "vocab_size": 512,
+        "hidden_size": 128,
+        "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "intermediate_size": 256,
+        "rope_theta": 10000.0,
+        "rms_norm_eps": 1e-5,
+        "max_position_embeddings": 512,
+        "tie_word_embeddings": True,
+    }
+    (tmp_path / "config.json").write_text(json.dumps(doc))
+    cfg = resolve_config("totally-unseen-model-name", str(tmp_path))
+    assert cfg.name == "totally-unseen-model-name"
+    assert (cfg.dim, cfg.n_layers) == (128, 2)
+    # no config.json → catalog fallback
+    assert resolve_config("tiny-llm", "/nonexistent").name == "tiny-llm"
+    # unusable config.json → catalog fallback, not a crash
+    (tmp_path / "config.json").write_text(json.dumps({"model_type": "rwkv"}))
+    assert resolve_config("tiny-llm", str(tmp_path)).name == "tiny-llm"
+
+
+def test_engine_serves_unseen_checkpoint(tmp_path):
+    """End to end: an HF checkpoint dir (config.json + safetensors) under a
+    name the catalog has never heard of boots and generates."""
+    from llm_mcp_tpu.executor import GenerationEngine
+
+    doc = {
+        "model_type": "llama",
+        "vocab_size": 512,
+        "hidden_size": 128,
+        "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "intermediate_size": 256,
+        "rope_theta": 10000.0,
+        "rms_norm_eps": 1e-5,
+        "max_position_embeddings": 512,
+        "tie_word_embeddings": True,
+    }
+    from llm_mcp_tpu.models import config_from_hf as _c
+
+    cfg = _c(doc, name="never-seen-7b")
+    params = init_llama_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    (tmp_path / "config.json").write_text(json.dumps(doc))
+    write_safetensors(
+        str(tmp_path / "model.safetensors"),
+        {k: np.asarray(v) for k, v in llama_to_hf_tensors(cfg, params).items()},
+    )
+    eng = GenerationEngine(
+        "never-seen-7b", max_slots=2, max_seq_len=64, dtype=jnp.float32,
+        weights_dir=str(tmp_path), decode_chunk=4,
+    ).start()
+    try:
+        assert eng.cfg.name == "never-seen-7b" and eng.cfg.dim == 128
+        out = eng.generate("arbitrary checkpoint", max_tokens=4, temperature=0.0)
+        assert out["finish_reason"] in ("length", "stop")
+    finally:
+        eng.shutdown()
