@@ -515,6 +515,20 @@ def main() -> None:
                     print(f"# mla long-context sweep failed: {e!r}", flush=True)
                     secondary["raw_mla_error"] = 0.0
                 gc.collect()
+                # int8 LATENTS at serving shapes: S=2048 fits the whole-S
+                # s8-MXU MLA kernel (decode_attend_q8_mla) — this sweep is
+                # its on-hardware evidence (the 32k sweep above exceeds the
+                # kernel's VMEM budget and stays on the XLA path)
+                try:
+                    mk = round(
+                        raw_decode_tps("mla-8b", 32, 2048, 32, rounds=2,
+                                       kv_int8=True), 1
+                    )
+                    secondary[f"raw_decode_tok_per_s_mla-8b-int8_kv8_b32_s2048_{platform}"] = mk
+                except Exception as e:
+                    print(f"# mla kv8 kernel sweep failed: {e!r}", flush=True)
+                    secondary["raw_mla_kv8_error"] = 0.0
+                gc.collect()
             return tps
 
         # raw loop FIRST: it frees cleanly on return, while the serve run's

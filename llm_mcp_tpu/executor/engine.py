@@ -201,14 +201,16 @@ class GenerationEngine:
             # MLA (models/mla.py): the chunked-prefill kernel is
             # llama-shaped, so MLA prefills whole prompts (query-blocked —
             # linear memory in S; the admission weight pass dominates
-            # anyway). int8 latents (kv_quant=int8) are a CAPACITY trade:
-            # ~7x fewer cache bytes than bf16 GQA K/V, but the XLA path
-            # dequantizes each layer's latent row before the dot (no s8-MXU
-            # kernel for MLA yet) — expect slower steps than bf16 latents.
+            # anyway). int8 latents (kv_quant=int8): ~7x fewer cache bytes
+            # than bf16 GQA K/V; at serving context lengths decode runs the
+            # s8-MXU kernel (kernels/attention.py:decode_attend_q8_mla),
+            # while long contexts past its whole-S VMEM budget fall back to
+            # the XLA dequant-then-dot path (capacity trade there).
             if self.kv_quant:
                 log.info(
-                    "MLA int8 latents: ~2x context capacity vs bf16 latents; "
-                    "step time may regress (dequant-then-dot XLA path)"
+                    "MLA int8 latents: ~2x context capacity vs bf16 "
+                    "latents; s8-MXU decode kernel at serving context "
+                    "lengths, XLA dequant path beyond its VMEM budget"
                 )
             prefill_chunk = 0
         self.decode_impl = resolve_decode_impl(

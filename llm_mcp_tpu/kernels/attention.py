@@ -780,6 +780,223 @@ def decode_attend_q8(
     )
 
 
+def _attend_q8_mla_kernel(
+    li_ref,  # [1] int32 (scalar prefetch) — layer index
+    ids_ref,  # [Ba] int32 (scalar prefetch) — cache row per batch position
+    lengths_ref,  # [Ba] int32 (scalar prefetch) — this step's position per row
+    qt_ref,  # [1, H, R] — absorbed queries (latent space)
+    qr_ref,  # [1, H, dr] — rope queries
+    nc_ref,  # [1, 1, R] — this step's exact latent
+    nr_ref,  # [1, 1, dr] — this step's exact rope key
+    lat_ref,  # [1, 1, 1, S, R] int8 — latent payload (cache row ids[b])
+    lats_ref,  # [1, 1, 1, S] — latent scales
+    rop_ref,  # [1, 1, 1, S, dr] int8 — rope-key payload
+    rops_ref,  # [1, 1, 1, S] — rope-key scales
+    o_ref,  # [1, H, R] — context in latent space
+    *,
+    scale: float,
+):
+    """Absorbed MLA decode attention over the int8 latent cache — one grid
+    cell per batch row.
+
+    The absorbed form is MQA-shaped (one shared latent row serves every
+    head), so this mirrors `_attend_q8_kernel` at Hkv=1/G=H/hd=R with one
+    structural difference: scores take a SECOND additive term from the
+    shared rope keys. The latent side (R = 512 at DeepSeek shapes — the
+    bulk of the HBM traffic) runs s8 x s8 -> s32 on the MXU with post-dot
+    scale folding; the rope side (dr = 64, ~1/9 of the bytes and below the
+    128-lane int8 tile width) dequantizes on the VPU and dots in f32.
+    Position w's score and value come from the exact unquantized vectors,
+    so the current token is attended at full precision whether or not the
+    quantized row has been scattered yet.
+    """
+    b = pl.program_id(0)
+    w = lengths_ref[b]
+    S = lat_ref.shape[3]
+
+    qt = qt_ref[0].astype(jnp.float32)  # [H, R]
+    qr = qr_ref[0].astype(jnp.float32)  # [H, dr]
+    nc = nc_ref[0, 0].astype(jnp.float32)  # [R]
+    nr = nr_ref[0, 0].astype(jnp.float32)  # [dr]
+    lats = lats_ref[0, 0, 0].astype(jnp.float32)  # [S]
+    rops = rops_ref[0, 0, 0].astype(jnp.float32)  # [S]
+
+    # latent scores on the MXU: quantize q̃ per head, fold scale post-dot
+    qa = jnp.max(jnp.abs(qt), axis=-1)  # [H]
+    qsc = jnp.maximum(qa / 127.0, 1e-30)
+    qt8 = jnp.round(qt / qsc[:, None]).astype(jnp.int8)
+    s_lat_i = jax.lax.dot_general(
+        qt8,
+        lat_ref[0, 0, 0],
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [H, S]
+    s = s_lat_i.astype(jnp.float32) * (scale * qsc)[:, None] * lats[None, :]
+
+    # rope scores: S x dr is tiny — dequant on the VPU, f32 dot
+    rop = rop_ref[0, 0, 0].astype(jnp.float32) * rops[:, None]  # [S, dr]
+    s = s + jax.lax.dot_general(
+        qr, rop, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+
+    pos = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+    s_new = (
+        jnp.sum(qt * nc[None, :], axis=-1) + jnp.sum(qr * nr[None, :], axis=-1)
+    ) * scale  # [H]
+    s = jnp.where(pos == w, s_new[:, None], s)
+    s = jnp.where(pos <= w, s, NEG_INF)
+
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    p_w = jnp.sum(jnp.where(pos == w, p, 0.0), axis=-1, keepdims=True)  # [H, 1]
+    # fold the latent dequant scales into the probs, quantize the prob rows,
+    # and run the PV dot s8 x s8 too
+    pv = jnp.where(pos == w, 0.0, p * lats[None, :])  # [H, S]
+    pa = jnp.max(pv, axis=-1)
+    psc = jnp.maximum(pa / 127.0, 1e-30)
+    p8 = jnp.round(pv / psc[:, None]).astype(jnp.int8)
+    ctx_i = jax.lax.dot_general(
+        p8,
+        lat_ref[0, 0, 0],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [H, R]
+    ctx = ctx_i.astype(jnp.float32) * psc[:, None] + p_w * nc[None, :]
+    o_ref[0] = (ctx / l).astype(o_ref.dtype)
+
+
+def _decode_attend_q8_mla_fallback(
+    qt, qr, new_c, new_r, cache_c, cache_r, layer, lengths, scale, slot_ids
+):
+    """Exact f32 math of the MLA kernel (CPU / unfit shapes): pre-append
+    semantics with the current position overridden by the exact vectors."""
+    Ba = qt.shape[0]
+
+    def rowsel(x):
+        return x if slot_ids is None else jnp.take(x, slot_ids, axis=0)
+
+    def sel(entry):
+        return rowsel(
+            jax.lax.dynamic_index_in_dim(entry, layer, 0, keepdims=False)[:, 0]
+        )
+
+    lat = sel(cache_c["q"]).astype(jnp.float32)  # [Ba, S, R]
+    rop = sel(cache_r["q"]).astype(jnp.float32)  # [Ba, S, dr]
+    ls = sel(cache_c["s"]).astype(jnp.float32)  # [Ba, S]
+    rs = sel(cache_r["s"]).astype(jnp.float32)
+    S = lat.shape[1]
+    qtf = qt.astype(jnp.float32)
+    qrf = qr.astype(jnp.float32)
+    s = (
+        jnp.einsum("bhr,bsr->bhs", qtf, lat) * ls[:, None, :]
+        + jnp.einsum("bhd,bsd->bhs", qrf, rop) * rs[:, None, :]
+    ) * scale
+    pos = jnp.arange(S)[None, None, :]
+    w = lengths[:, None, None]
+    s_new = (
+        jnp.einsum("bhr,br->bh", qtf, new_c.astype(jnp.float32))
+        + jnp.einsum("bhd,bd->bh", qrf, new_r.astype(jnp.float32))
+    ) * scale
+    s = jnp.where(pos == w, s_new[..., None], s)
+    s = jnp.where(pos <= w, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p_w = jnp.sum(jnp.where(pos == w, p, 0.0), axis=-1)  # [Ba, H]
+    pl_ = jnp.where(pos == w, 0.0, p * ls[:, None, :])
+    ctx = jnp.einsum("bhs,bsr->bhr", pl_, lat) + p_w[..., None] * new_c.astype(
+        jnp.float32
+    )[:, None, :]
+    return ctx.astype(qt.dtype)
+
+
+def decode_attend_q8_mla(
+    qt: jnp.ndarray,  # [Ba, H, R] — absorbed queries (latent space)
+    qr: jnp.ndarray,  # [Ba, H, dr] — rope queries
+    new_c: jnp.ndarray,  # [Ba, R] — this step's exact latent
+    new_r: jnp.ndarray,  # [Ba, dr] — this step's exact rope key
+    cache_c: dict,  # {"q": int8 [L,B,1,S,R], "s": [L,B,1,S]}
+    cache_r: dict,  # {"q": int8 [L,B,1,S,dr], "s": [L,B,1,S]}
+    layer: jnp.ndarray,  # scalar int32
+    lengths: jnp.ndarray,  # [Ba] int32 — this step's position per row
+    *,
+    slot_ids: jnp.ndarray | None = None,
+    scale: float,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Absorbed MLA decode attention over the int8 latent cache for one
+    layer — the s8-MXU replacement for the XLA dequant-then-dot path
+    (models/mla.py). Returns ctx in latent space [Ba, H, R]; the caller
+    owns the cache append (the kernel overrides position w exactly).
+
+    Falls back to exact f32 math off-TPU, when R isn't a 128-lane multiple
+    (tiny test configs), or when the whole-S row won't fit VMEM (MLA long
+    context keeps the XLA path until a blocked variant lands)."""
+    Ba, H, R = qt.shape
+    dr = qr.shape[-1]
+    S = cache_c["q"].shape[3]
+    interp = _interpret() if interpret is None else interpret
+    # whole-S VMEM budget: int8 payloads + the f32 working set — three
+    # [H, S] score/prob arrays, the [S, dr] dequantized rope block, and the
+    # [H, R]-class query/context tiles — under ~8 MB headroom
+    fits = (
+        S * (R + dr)
+        + 4 * S * (3 * H + dr)
+        + 4 * H * (2 * R + dr)
+    ) <= 8 * 1024 * 1024
+    if not _HAS_PLTPU or (not interp and (R % 128 != 0 or not fits)):
+        return _decode_attend_q8_mla_fallback(
+            qt, qr, new_c, new_r, cache_c, cache_r, layer, lengths, scale, slot_ids
+        )
+
+    kernel = functools.partial(_attend_q8_mla_kernel, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # layer [1], slot ids [Ba], lengths [Ba]
+        grid=(Ba,),
+        in_specs=[
+            pl.BlockSpec((1, H, R), lambda b, li, ids, lens: (b, 0, 0)),
+            pl.BlockSpec((1, H, dr), lambda b, li, ids, lens: (b, 0, 0)),
+            pl.BlockSpec((1, 1, R), lambda b, li, ids, lens: (b, 0, 0)),
+            pl.BlockSpec((1, 1, dr), lambda b, li, ids, lens: (b, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, 1, S, R), lambda b, li, ids, lens: (li[0], ids[b], 0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, 1, S), lambda b, li, ids, lens: (li[0], ids[b], 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, 1, S, dr), lambda b, li, ids, lens: (li[0], ids[b], 0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, 1, S), lambda b, li, ids, lens: (li[0], ids[b], 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, H, R), lambda b, li, ids, lens: (b, 0, 0)),
+    )
+    ids = (
+        jnp.arange(Ba, dtype=jnp.int32)
+        if slot_ids is None
+        else slot_ids.astype(jnp.int32)
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((Ba, H, R), qt.dtype),
+        interpret=interp,
+    )(
+        jnp.reshape(layer, (1,)).astype(jnp.int32),
+        ids,
+        lengths.astype(jnp.int32),
+        qt,
+        qr,
+        new_c.reshape(Ba, 1, R),
+        new_r.reshape(Ba, 1, dr),
+        cache_c["q"],
+        cache_c["s"],
+        cache_r["q"],
+        cache_r["s"],
+    )
+
+
 def _append_q8_kernel(
     lengths_ref,  # [Ba] int32 (scalar prefetch) — this step's position per row
     ids_ref,  # [Ba] int32 (scalar prefetch) — cache row per batch position

@@ -578,6 +578,10 @@ def config_from_hf(doc: dict, name: str = "") -> ModelConfig:
     rs = doc.get("rope_scaling") or {}
     rs = rs if isinstance(rs, dict) else {}
     rs_type = str(rs.get("rope_type") or rs.get("type") or "").lower()
+    if rs_type == "linear":
+        # position interpolation (LongChat-style): uniform frequency divide
+        kw.update(rope_type="linear", rope_factor=float(rs.get("factor") or 1.0),
+                  rope_orig_max=int(rs.get("original_max_position_embeddings") or 1))
     if mt == "llama":
         if rs_type == "llama3":
             kw.update(
@@ -641,9 +645,7 @@ def config_from_hf(doc: dict, name: str = "") -> ModelConfig:
             f"unsupported HF model_type {mt!r} "
             "(supported: llama, qwen2, mistral, mixtral, gemma2, deepseek_v2)"
         )
-    if rs_type and kw.get("rope_factor", 1.0) <= 1.0 and rs_type not in (
-        "default", "linear"
-    ):
+    if rs_type and kw.get("rope_factor", 1.0) <= 1.0 and rs_type != "default":
         # a scaling recipe we did not apply: serving it with plain rope
         # would silently degrade past the original context window
         raise ValueError(f"unsupported rope_scaling type {rs_type!r} for {mt!r}")

@@ -707,7 +707,8 @@ def llama_decode_step(
         from .mla import mla_decode_step
 
         return mla_decode_step(
-            cfg, params, cache_k, cache_v, tokens, lengths, slot_ids=slot_ids
+            cfg, params, cache_k, cache_v, tokens, lengths,
+            slot_ids=slot_ids, attn_impl=attn_impl,
         )
     quantized = isinstance(cache_k, dict)
     L, B, Hkv, S, hd = _cache_shape(cache_k)

@@ -289,6 +289,16 @@ def mla_prefill(
     return logits, to_engine_layout(cs), to_engine_layout(krs)
 
 
+def _absorbed_w(lp, h_dtype, R, H, dn, dv):
+    """(W_uk [R,H,dn], W_uv [R,H,dv]) from this layer's (possibly int8)
+    up-projection — dequantized once per step."""
+    w_ukv = lp["w_ukv"]
+    if isinstance(w_ukv, dict):
+        w_ukv = w_ukv["q"].astype(h_dtype) * w_ukv["s"].astype(h_dtype)
+    w_ukv = w_ukv.reshape(R, H, dn + dv)
+    return w_ukv[:, :, :dn], w_ukv[:, :, dn:]
+
+
 def mla_decode_step(
     cfg: ModelConfig,
     params: Params,
@@ -297,6 +307,7 @@ def mla_decode_step(
     tokens: jnp.ndarray,  # [Ba] int32
     lengths: jnp.ndarray,  # [Ba] int32 — write position per row
     slot_ids: jnp.ndarray | None = None,  # [Ba] compaction indirection
+    attn_impl: str = "xla",
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One absorbed-attention decode step for all slots.
 
@@ -304,7 +315,14 @@ def mla_decode_step(
     per-head queries against the shared latents; the value side re-expands
     only the attended [H, R] context. The caches follow the llama xla-path
     structure (scan carry, in-place scatter at `lengths`, OOB rows
-    dropped → parked-slot invariant preserved)."""
+    dropped → parked-slot invariant preserved).
+
+    With an int8 latent cache and attn_impl="pallas", attention runs the
+    s8-MXU kernel (kernels/attention.py:decode_attend_q8_mla) against the
+    PRE-append cache (the kernel overrides position w with the exact
+    vectors), and the appends defer to ONE batched scatter per cache after
+    the layer scan — instead of L per-layer scatters, each of which XLA
+    turns into a full-cache copy."""
     H, dn, dr, dv = _dims(cfg)
     quantized = isinstance(cache_c, dict)
     L, B, _, S, R = (cache_c["q"] if quantized else cache_c).shape
@@ -357,11 +375,7 @@ def mla_decode_step(
                 kr[:, None].astype(cr_all.dtype)
             )
         # absorbed queries: q̃[h] = q_nope[h] @ W_uk[:, h]  → [Ba, H, R]
-        w_ukv = lp["w_ukv"]
-        if isinstance(w_ukv, dict):  # int8 weights: dequant once per step
-            w_ukv = w_ukv["q"].astype(h.dtype) * w_ukv["s"].astype(h.dtype)
-        w_ukv = w_ukv.reshape(R, H, dn + dv)
-        w_uk, w_uv = w_ukv[:, :, :dn], w_ukv[:, :, dn:]  # [R, H, dn] / [R, H, dv]
+        w_uk, w_uv = _absorbed_w(lp, h.dtype, R, H, dn, dv)
         qt = jnp.einsum("bhd,rhd->bhr", qn, w_uk)
 
         def sel(x):
@@ -402,6 +416,56 @@ def mla_decode_step(
         h = h + qdot(ctx, lp["wo_mla"])
         h = _ffn_residual(cfg, lp, h, moe_capacity=Ba)  # dropless at decode
         return (h, cc_all, cr_all, li + 1), None
+
+    if quantized and attn_impl == "pallas":
+        from ..kernels.attention import decode_attend_q8_mla
+
+        def layer_k(carry, lp):
+            h, li = carry
+            x = _norm(cfg, h, lp["attn_norm"])
+            qn, qr = _queries(cfg, lp, x)
+            qr = apply_rope(qr, cos, sin)
+            c, kr = _latents(cfg, lp, x)
+            kr = apply_rope(kr[:, None], cos, sin)[:, 0]
+            w_uk, w_uv = _absorbed_w(lp, h.dtype, R, H, dn, dv)
+            qt = jnp.einsum("bhd,rhd->bhr", qn, w_uk)
+            ctx_lat = decode_attend_q8_mla(
+                qt, qr, c, kr, cache_c, cache_r, li, lengths,
+                slot_ids=slot_ids, scale=scale,
+            )
+            ctx = jnp.einsum("bhr,rhd->bhd", ctx_lat.astype(h.dtype), w_uv)
+            h = h + qdot(ctx.reshape(Ba, H * dv), lp["wo_mla"])
+            h = _ffn_residual(cfg, lp, h, moe_capacity=Ba)
+            return (h, li + 1), (c, kr)
+
+        carry = (h, jnp.int32(0))
+        cs_d = krs_d = None
+        if "dense_layers" in params:
+            carry, (cs_d, krs_d) = jax.lax.scan(
+                layer_k, carry, params["dense_layers"]
+            )
+        (h, _), (cs, krs) = jax.lax.scan(layer_k, carry, params["layers"])
+        if cs_d is not None:
+            cs = jnp.concatenate([cs_d, cs], axis=0)
+            krs = jnp.concatenate([krs_d, krs], axis=0)
+        # ONE batched append per cache for all layers (OOB/parked rows drop)
+        cq, rq = quantize_kv(cs), quantize_kv(krs)
+        l_idx = jnp.arange(L)[:, None]
+        bb = rows[None, :]
+        ww = lengths[None, :]
+        cache_c = {
+            "q": cache_c["q"].at[l_idx, bb, 0, ww].set(cq["q"]),
+            "s": cache_c["s"].at[l_idx, bb, 0, ww].set(
+                cq["s"].astype(cache_c["s"].dtype)
+            ),
+        }
+        cache_r = {
+            "q": cache_r["q"].at[l_idx, bb, 0, ww].set(rq["q"]),
+            "s": cache_r["s"].at[l_idx, bb, 0, ww].set(
+                rq["s"].astype(cache_r["s"].dtype)
+            ),
+        }
+        return _logits(cfg, params, h), cache_c, cache_r
 
     carry = (h, cache_c, cache_r, jnp.int32(0))
     if "dense_layers" in params:

@@ -111,6 +111,14 @@ def rope_tables(cfg, head_dim: int, positions: jnp.ndarray) -> tuple[jnp.ndarray
     """Config-dispatched rope tables: yarn (DeepSeek-V2) or llama3
     (Llama-3.x long context) when configured, plain otherwise. The single
     entry point every forward path uses."""
+    if cfg.rope_factor > 1.0 and cfg.rope_type == "linear":
+        # position interpolation: every frequency divides by the factor
+        # (orig_max not needed — the scaling is uniform)
+        cos, sin = rope_frequencies(
+            head_dim, cfg.rope_theta,
+            positions.astype(jnp.float32) / cfg.rope_factor,
+        )
+        return cos, sin
     if cfg.rope_factor > 1.0 and cfg.rope_orig_max:
         if cfg.rope_type == "llama3":
             return llama3_rope_frequencies(

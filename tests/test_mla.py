@@ -210,6 +210,92 @@ def test_int8_latent_cache_matches_bf16(setup):
         lens = lens + 1
 
 
+def test_mla_s8_kernel_matches_xla_path(setup):
+    """decode_attend_q8_mla (absorbed s8-MXU attention, interpret mode on
+    CPU) against the XLA dequant-then-dot path: identical greedy tokens,
+    tightly correlated logits, and byte-identical cache appends — including
+    compaction indirection and a parked row."""
+    cfg, params = setup
+    B, S = 4, 32
+    qcache = init_kv_cache(cfg, B, S, dtype=jnp.float32, quantized=True)
+    rng = np.random.default_rng(3)
+    qck = {
+        "k": {"q": jnp.asarray(rng.integers(-127, 128, qcache["k"]["q"].shape), jnp.int8),
+              "s": jnp.asarray(rng.random(qcache["k"]["s"].shape, np.float32) * 0.01)},
+        "v": {"q": jnp.asarray(rng.integers(-127, 128, qcache["v"]["q"].shape), jnp.int8),
+              "s": jnp.asarray(rng.random(qcache["v"]["s"].shape, np.float32) * 0.01)},
+    }
+    # compact dispatch: rows 2 and 0 active, row 1 parked in the full form
+    toks_c = jnp.asarray([3, 4], jnp.int32)
+    lens_c = jnp.asarray([5, 9], jnp.int32)
+    ids = jnp.asarray([2, 0], jnp.int32)
+    l_x, ckx, cvx = llama_decode_step(
+        cfg, params, qck["k"], qck["v"], toks_c, lens_c,
+        slot_ids=ids, attn_impl="xla",
+    )
+    l_p, ckp, cvp = llama_decode_step(
+        cfg, params, qck["k"], qck["v"], toks_c, lens_c,
+        slot_ids=ids, attn_impl="pallas",
+    )
+    assert (np.argmax(np.asarray(l_x), -1) == np.argmax(np.asarray(l_p), -1)).all()
+    corr = np.corrcoef(np.asarray(l_x).ravel(), np.asarray(l_p).ravel())[0, 1]
+    assert corr > 0.999, corr
+    # appended rows agree after dequant (±1 LSB payload differences are
+    # expected: the two attention impls round differently, so downstream
+    # layers' latents differ at f32 epsilon before quantization)
+    for a, b in ((ckx, ckp), (cvx, cvp)):
+        da = np.asarray(a["q"], np.float32) * np.asarray(a["s"])[..., None]
+        db = np.asarray(b["q"], np.float32) * np.asarray(b["s"])[..., None]
+        denom = max(np.abs(da).max(), 1e-9)
+        assert np.abs(da - db).max() / denom < 0.02
+    # parked row (w >= S) writes nothing on either path
+    toks_f = jnp.asarray([1, 0, 2, 0], jnp.int32)
+    lens_f = jnp.asarray([4, S, 7, S], jnp.int32)  # rows 1,3 parked
+    _, ckx2, _ = llama_decode_step(
+        cfg, params, qck["k"], qck["v"], toks_f, lens_f, attn_impl="xla"
+    )
+    _, ckp2, _ = llama_decode_step(
+        cfg, params, qck["k"], qck["v"], toks_f, lens_f, attn_impl="pallas"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ckx2["q"])[:, 1], np.asarray(qck["k"]["q"])[:, 1]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ckp2["q"])[:, 1], np.asarray(qck["k"]["q"])[:, 1]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ckp2["q"])[:, 3], np.asarray(qck["k"]["q"])[:, 3]
+    )
+
+
+def test_mla_s8_kernel_v2_structure():
+    """The kernel path composes with the DeepSeek-V2 structure: dense
+    prologue + shared-expert MoE layers through the same scan."""
+    cfg = get_config("tiny-v2")
+    params = init_llama_params(cfg, jax.random.PRNGKey(2), dtype=jnp.float32)
+    B, S = 2, 32
+    qc = init_kv_cache(cfg, B, S, dtype=jnp.float32, quantized=True)
+    t = jnp.asarray([3, 5], jnp.int32)
+    lens = jnp.zeros((B,), jnp.int32)
+    lx, lp_, = None, None
+    ck_x, cv_x = qc["k"], qc["v"]
+    ck_p, cv_p = qc["k"], qc["v"]
+    for _ in range(3):
+        lx, ck_x, cv_x = llama_decode_step(
+            cfg, params, ck_x, cv_x, t, lens, attn_impl="xla"
+        )
+        lp_, ck_p, cv_p = llama_decode_step(
+            cfg, params, ck_p, cv_p, t, lens, attn_impl="pallas"
+        )
+        ta = np.argmax(np.asarray(lx), -1)
+        assert (ta == np.argmax(np.asarray(lp_), -1)).all()
+        t = jnp.asarray(ta)
+        lens = lens + 1
+    da = np.asarray(ck_x["q"], np.float32) * np.asarray(ck_x["s"])[..., None]
+    db = np.asarray(ck_p["q"], np.float32) * np.asarray(ck_p["s"])[..., None]
+    assert np.abs(da - db).max() / max(np.abs(da).max(), 1e-9) < 0.02
+
+
 def test_int8_latent_prefill_roundtrip(setup):
     """quant_kv prefill returns int8 latent dicts whose dequantized rows
     track the f32 prefill latents."""
