@@ -57,6 +57,7 @@ def main() -> None:
             prefill_chunk=cfg.tpu_prefill_chunk,
             decode_compact=cfg.tpu_decode_compact,
             prompt_cache_mb=cfg.tpu_prompt_cache_mb,
+            prefill_buckets=cfg.tpu_prefill_buckets,
         ).start()
         emodel = cfg.tpu_embed_model
         log.info("loading embedding engine: %s", emodel)

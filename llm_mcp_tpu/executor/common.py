@@ -13,3 +13,22 @@ def pow2_bucket(n: int, cap: int, floor: int = 32) -> int:
     while b < n:
         b *= 2
     return min(b, cap)
+
+
+def fine_bucket(n: int, cap: int, floor: int = 32) -> int:
+    """Smallest rung of the {pow2, 1.5x pow2} ladder ≥ n (min `floor`),
+    capped at `cap` — 32, 48, 64, 96, 128, 192, 256, ...
+
+    Prompt padding to pow2 buckets wastes ~25% of the prefill weight pass
+    on average (uniform lengths fill a pow2 bucket ~75%); the midpoint
+    rungs cut the mean waste to ~12% for one extra executable per octave.
+    Mosaic tiling keeps the midpoints MXU-friendly (every rung ≥ 48 is a
+    multiple of 16; sequence dims pad to lane tiles anyway).
+    """
+    b = floor
+    while b < n:
+        mid = b + b // 2
+        if n <= mid:
+            return min(mid, cap)
+        b *= 2
+    return min(b, cap)

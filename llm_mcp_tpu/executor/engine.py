@@ -59,7 +59,7 @@ from ..models.llama import (
 )
 from ..ops.sampling import sample_tokens
 from ..parallel.sharding import llama_param_specs, kv_cache_specs, shard_pytree
-from .common import pow2_bucket
+from .common import fine_bucket, pow2_bucket
 from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
 
 log = logging.getLogger("engine")
@@ -158,6 +158,7 @@ class GenerationEngine:
         admit_batch: int = 4,
         decode_compact: str = "auto",
         prompt_cache_mb: int = 256,
+        prefill_buckets: str = "fine",
     ):
         # a config.json beside the weights is authoritative: any supported-
         # family checkpoint serves without a catalog entry (models/configs.py
@@ -169,6 +170,11 @@ class GenerationEngine:
         self.max_slots = max_slots
         self.max_seq_len = max_seq_len
         self.decode_chunk = decode_chunk
+        # admission prompt buckets: "fine" adds 1.5x midpoint rungs between
+        # the pow2 sizes (common.py:fine_bucket) — ~12% mean pad waste in
+        # the prefill weight pass instead of ~25%, for one extra executable
+        # per octave ("pow2" opts out)
+        self.prefill_fine = (prefill_buckets or "fine").lower() != "pow2"
         self.tokenizer: Tokenizer = tokenizer or load_tokenizer(weights_dir)
 
         hd = self.cfg.resolved_head_dim
@@ -868,8 +874,19 @@ class GenerationEngine:
     # -- engine loop -------------------------------------------------------
 
     def _bucket(self, n: int) -> int:
-        # sp prefill shards the bucket over the sp axis — keep it divisible
-        # (both are powers of two, so clamping to >= sp suffices)
+        # sp prefill shards the bucket over the sp axis — keep it divisible;
+        # and on the pallas prefill path every rung must be a legal flash
+        # block shape (192 is not: S >= 128 needs S % 128 == 0, sub-128
+        # rungs must be pow2 — kernels/attention.py:pallas_supported).
+        # Midpoint rungs failing either rule fall back to the pow2 rung.
+        if self.prefill_fine:
+            b = fine_bucket(n, self.max_seq_len)
+            ok_sp = b % max(self.sp, 1) == 0
+            ok_impl = self.attn_impl != "pallas" or pallas_supported(
+                b, self.cfg.resolved_head_dim
+            )
+            if ok_sp and ok_impl:
+                return max(b, self.sp)
         return max(pow2_bucket(n, self.max_seq_len), self.sp)
 
     def _recover_cache(self) -> bool:

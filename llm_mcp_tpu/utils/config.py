@@ -121,6 +121,8 @@ class Config:
     tpu_prefill_chunk: int = field(default_factory=lambda: getenv_int("TPU_PREFILL_CHUNK", 512))
     # slot compaction: decode only active rows (auto | on | off)
     tpu_decode_compact: str = field(default_factory=lambda: getenv("TPU_DECODE_COMPACT", "auto"))
+    # admission prompt buckets: fine (pow2 + 1.5x midpoints) | pow2
+    tpu_prefill_buckets: str = field(default_factory=lambda: getenv("TPU_PREFILL_BUCKETS", "fine"))
     # prompt-prefix KV cache budget in MB (0 disables)
     tpu_prompt_cache_mb: int = field(default_factory=lambda: getenv_int("TPU_PROMPT_CACHE_MB", 256))
 

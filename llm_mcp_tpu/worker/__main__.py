@@ -54,6 +54,7 @@ def main() -> None:
             prefill_chunk=cfg.tpu_prefill_chunk,
             decode_compact=cfg.tpu_decode_compact,
             prompt_cache_mb=cfg.tpu_prompt_cache_mb,
+            prefill_buckets=cfg.tpu_prefill_buckets,
         ).start()
         embed_engines[cfg.tpu_embed_model] = EmbeddingEngine(
             cfg.tpu_embed_model,

@@ -145,6 +145,51 @@ def test_byte_tokenizer_stream_utf8():
     assert pending == b""
 
 
+def test_fine_prefill_buckets_parity():
+    """The fine (pow2 + 1.5x midpoint) admission-bucket ladder: rung values,
+    sp-divisibility fallback, and greedy parity with the pow2 ladder on a
+    prompt that lands in a midpoint rung."""
+    from llm_mcp_tpu.executor.common import fine_bucket
+
+    assert [fine_bucket(n, 2048) for n in (1, 33, 49, 65, 100, 200, 300, 600)] \
+        == [32, 48, 64, 96, 128, 256, 384, 768]
+    assert fine_bucket(5000, 2048) == 2048
+
+    ef = GenerationEngine("tiny-llm", max_slots=2, max_seq_len=512,
+                          dtype=jnp.float32, decode_chunk=4).start()
+    ep = GenerationEngine("tiny-llm", max_slots=2, max_seq_len=256,
+                          dtype=jnp.float32, decode_chunk=4,
+                          prefill_buckets="pow2").start()
+    try:
+        assert ef.prefill_fine and not ep.prefill_fine
+        assert ef._bucket(33) == 48 and ep._bucket(33) == 64
+        # pallas prefill gate: rungs that aren't legal flash block shapes
+        # (192; sub-128 non-pow2) fall back to the pow2 rung, while
+        # 128-multiple midpoints (384) stay fine
+        orig_impl = ef.attn_impl
+        ef.attn_impl = "pallas"
+        try:
+            assert ef._bucket(33) == 64  # 48 not pow2 below one block
+            assert ef._bucket(130) == 256  # 192 % 128 != 0
+            assert ef._bucket(260) == 384  # legal 128-multiple midpoint
+        finally:
+            ef.attn_impl = orig_impl
+        # sp-divisibility gate: a rung the sp axis can't divide falls back
+        orig_sp = ef.sp
+        ef.sp = 32
+        try:
+            assert ef._bucket(33) == 64  # 48 % 32 != 0 → pow2 rung
+        finally:
+            ef.sp = orig_sp
+        prompt = "x " * 40  # straddles the 48/96 midpoint rungs
+        a = ef.generate(prompt, max_tokens=6, temperature=0.0)
+        b = ep.generate(prompt, max_tokens=6, temperature=0.0)
+        assert a["text"] == b["text"]
+    finally:
+        ef.shutdown()
+        ep.shutdown()
+
+
 def test_embedding_engine_basic():
     eng = EmbeddingEngine("tiny-embed", max_batch=4, max_seq_len=64, dtype=jnp.float32)
     vecs, tokens = eng.embed(["hello world", "second text", "third"])
