@@ -93,6 +93,10 @@ def raw_decode_tps(
     return rounds * K * B / dt
 
 
+class _SkipDirect(Exception):
+    pass
+
+
 def serve_path_metrics(
     model: str,
     *,
@@ -107,6 +111,7 @@ def serve_path_metrics(
     admit_batch: int = 4,
     warmup_timeout_s: float = 900.0,
     decode_compact: str = "auto",
+    measure_direct: bool = True,
 ) -> dict[str, float]:
     """Steady-state tok/s and client-observed p50 TTFT through the REAL
     serving path — GenerationEngine behind CoreServer's /v1/chat/completions
@@ -240,6 +245,55 @@ def serve_path_metrics(
     time.sleep(min(8.0, max(1.0, measure_s)))
     for p in procs:
         p.terminate()
+    # ENGINE-DIRECT window on the same engine, same workload shape, no
+    # HTTP/SSE in the loop: quantifies the serving-layer tax as a ratio in
+    # every bench run (round-3 left it as two numbers measured hours apart).
+    direct_tps = 0.0
+    try:
+        if not measure_direct:
+            raise _SkipDirect
+        # drain: terminated clients leave up to max_slots requests mid-
+        # decode; their tokens must not leak into the direct window (and
+        # their slots would starve direct admissions)
+        drain_deadline = time.time() + 90.0
+        while eng.slots_in_use() > 0 and time.time() < drain_deadline:
+            time.sleep(0.25)
+
+        # suffix sized like client_proc's (~60 bytes) so direct prompts land
+        # in the SAME admission bucket the serve warmup compiled — a fresh
+        # bucket's first compile inside this short window would deflate it
+        def direct_prompt(i: int, r: int) -> str:
+            return prompt + f" direct client {i} round {r}, answer briefly now?"
+
+        stop_at = time.time() + max(8.0, measure_s / 3)
+
+        def direct_client(i: int) -> None:
+            r = 0
+            while time.time() < stop_at:
+                eng.generate(
+                    direct_prompt(i, r), max_tokens=max_tokens, temperature=0.8
+                )
+                r += 1
+
+        eng.generate(direct_prompt(0, -1), max_tokens=4, temperature=0.8)  # warm
+        with eng.stats_lock:
+            d_tok0 = eng.total_tokens
+        d_t0 = time.time()
+        dthreads = [
+            threading.Thread(target=direct_client, args=(i,), daemon=True)
+            for i in range(n_clients)
+        ]
+        for t in dthreads:
+            t.start()
+        for t in dthreads:
+            t.join(timeout=measure_s * 3 + 60)
+        with eng.stats_lock:
+            d_tok1 = eng.total_tokens
+        direct_tps = (d_tok1 - d_tok0) / max(time.time() - d_t0, 1e-6)
+    except _SkipDirect:
+        pass
+    except Exception as e:  # never lose the serve window to the extra probe
+        print(f"# engine-direct window failed: {e!r}", flush=True)
     with lock:
         ttfts = [
             (first - t0) * 1000.0
@@ -254,6 +308,8 @@ def serve_path_metrics(
     del eng, srv
     gc.collect()
     out = {"tok_per_s": (tok1 - tok0) / (m1 - m0)}
+    if direct_tps > 0:
+        out["engine_direct_tok_per_s"] = direct_tps
     # Degenerate-window evidence (a run where decode is broken still serves
     # prefill first-tokens at a plausible-looking rate — VERDICT r2 recorded
     # 26 tok/s of pure first-tokens as the metric of record):
@@ -621,6 +677,48 @@ def main() -> None:
                     serve.get("tok_per_s", 0.0), 1
                 )
                 serve = {}
+        if serve and os.environ.get("BENCH_TTFT_K16", "1") != "0" and not over_budget(
+            0.75, "K=16 TTFT sweep", "ttft_k16_skipped"
+        ):
+            # TTFT<1s trial (VERDICT r3 #5): a shorter decode chunk halves
+            # the worst-case wait from admission to first emitted token.
+            # Run a second, shorter serve window at decode_chunk=16 and
+            # record both throughput and TTFT so the trade is measured on
+            # hardware in the same bench run as the K=32 headline.
+            try:
+                s16 = serve_path_metrics(
+                    model,
+                    n_clients=B,
+                    max_tokens=bench_max_tokens,
+                    measure_s=min(
+                        20.0, float(os.environ.get("BENCH_MEASURE_S", "30"))
+                    ),
+                    max_slots=B,
+                    max_seq_len=S,
+                    decode_chunk=16,
+                    admit_batch=int(os.environ.get("BENCH_ADMIT_BATCH", "8")),
+                    decode_compact=os.environ.get("BENCH_DECODE_COMPACT", "auto"),
+                    measure_direct=False,
+                )
+                if s16.get("tok_per_s", 0.0) >= 1.0:
+                    secondary["serve_tok_per_s_k16"] = round(s16["tok_per_s"], 1)
+                    secondary["serve_p50_ttft_ms_k16"] = round(
+                        s16.get("p50_ttft_ms", -1.0), 1
+                    )
+                    secondary["serve_p95_ttft_ms_k16"] = round(
+                        s16.get("p95_ttft_ms", -1.0), 1
+                    )
+                else:
+                    # distinguish "ran but degenerate" from "never ran"
+                    secondary["ttft_k16_zero_window"] = round(
+                        s16.get("tok_per_s", 0.0), 1
+                    )
+                    print("# K=16 TTFT sweep window degenerate; not recorded",
+                          flush=True)
+            except Exception as e:
+                print(f"# K=16 TTFT sweep failed: {e!r}", flush=True)
+                secondary["ttft_k16_error"] = 0.0
+            gc.collect()
         if not serve and not raw_attempted:
             # serve disabled/failed and the raw sweep was never attempted:
             # it becomes the headline. (If it was attempted and FAILED, do
@@ -642,6 +740,11 @@ def main() -> None:
                     serve.get("mean_completion_tokens", -1.0), 1
                 ),
             }
+            if "engine_direct_tok_per_s" in serve:
+                # the serving-layer tax, measured in the SAME process/run
+                line["engine_direct_tok_per_s"] = round(
+                    serve["engine_direct_tok_per_s"], 1
+                )
             if secondary:
                 line["secondary"] = secondary
             print(json.dumps(line))
