@@ -114,6 +114,7 @@ class _DispatchedRound:
     entries: list  # [(b, _Slot, col)]
     base: Any  # np lengths snapshot at dispatch
     t0: float
+    rid: int = 0  # monotonic round id (slot-reuse cooling fence)
 
 
 @dataclass
@@ -204,21 +205,23 @@ class GenerationEngine:
                         self.kv_quant, jnp.dtype(dtype).name)
             self.kv_quant = ""
         if self.cfg.kv_lora_rank:
-            # MLA (models/mla.py): the chunked-prefill kernel is
-            # llama-shaped, so MLA prefills whole prompts (query-blocked —
-            # linear memory in S; the admission weight pass dominates
-            # anyway). int8 latents (kv_quant=int8): ~7x fewer cache bytes
-            # than bf16 GQA K/V; at serving context lengths decode runs the
-            # s8-MXU kernel (kernels/attention.py:decode_attend_q8_mla),
-            # while long contexts past its whole-S VMEM budget fall back to
-            # the XLA dequant-then-dot path (capacity trade there).
+            # MLA (models/mla.py): chunked prefill runs the absorbed form
+            # against the latent cache (models/mla.py:
+            # mla_prefill_chunk_batch) — long prompts interleave with decode
+            # rounds and the prompt-prefix KV cache applies, exactly as for
+            # the GQA families. int8 latents (kv_quant=int8): ~7x fewer
+            # cache bytes than bf16 GQA K/V; decode runs the s8-MXU kernel
+            # (kernels/attention.py:decode_attend_q8_mla) — whole-S tiles
+            # at serving context lengths, blocked HBM streaming with a
+            # dynamic trip count past its VMEM budget (S=32k included);
+            # the XLA dequant-then-dot path remains only for cache lengths
+            # no 128-multiple block divides.
             if self.kv_quant:
                 log.info(
                     "MLA int8 latents: ~2x context capacity vs bf16 "
-                    "latents; s8-MXU decode kernel at serving context "
-                    "lengths, XLA dequant path beyond its VMEM budget"
+                    "latents; s8-MXU decode kernel (whole-S at serving "
+                    "lengths, blocked streaming at long context)"
                 )
-            prefill_chunk = 0
         self.decode_impl = resolve_decode_impl(
             mesh,
             quantized=self.kv_quant == "int8",
@@ -440,8 +443,9 @@ class GenerationEngine:
         mask_ = self._allowed_mask
         base_key_ = self._base_key
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5))
-        def admit_fn(params, ck, cv, d_temp, d_topk, d_topp, tokens, ipack, fpack):
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
+        def admit_fn(params, ck, cv, d_temp, d_topk, d_topp, d_last, tokens,
+                     ipack, fpack):
             """Fused admission: prefill + cache insert + sampling-param
             update + first-token sample in ONE dispatch.
 
@@ -452,6 +456,12 @@ class GenerationEngine:
             dominated the serve loop (measured 56% of wall at 8B B=80).
             Fused: tokens + 2 packed arrays up, one dispatch, one [Ab]
             fetch.
+
+            The sampled first tokens also land in `d_last` (the
+            device-resident last-token ring the pipelined decode loop reads
+            its round inputs from): the device stream is in-order, so any
+            decode round dispatched after this admission sees tok0 without
+            the host ever staging it.
 
             ipack i32 [3*Ab+2]: slots, prompt lengths, top_k, A (live row
             count), rng counter. fpack f32 [2*Ab]: temperature, top_p.
@@ -491,7 +501,8 @@ class GenerationEngine:
                 logits = jnp.where(mask_, logits, -jnp.inf)
             key = jax.random.fold_in(base_key_, counter)
             toks0 = sample_tokens(logits, key, temps, topks, topps)
-            return ck, cv, d_temp, d_topk, d_topp, toks0
+            d_last = d_last.at[row].set(toks0)
+            return ck, cv, d_temp, d_topk, d_topp, d_last, toks0
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def insert_cached_fn(ck, cv, pk, pv, slots, live_n):
@@ -550,6 +561,36 @@ class GenerationEngine:
         self._d_temp = jnp.asarray(self._temp)
         self._d_topk = jnp.asarray(self._topk)
         self._d_topp = jnp.asarray(self._topp)
+        # device-resident last-token ring: decode rounds read their input
+        # tokens from it and write their final tokens back, admissions write
+        # first samples — so dispatching round N+1 never waits for round N's
+        # fetch (decode_chunk_fn docstring). Host mirror: self._last_tok
+        # (updated at fetch, for recovery after a poisoned dispatch).
+        self._d_last_tok = jnp.asarray(self._last_tok)
+        # Pipeline depth: how many decode rounds may be in flight before the
+        # oldest is fetched. Depth d hides a tunnel round-trip of up to
+        # (d-1) x round-compute behind the device chain (a remote-TPU
+        # tunnel's RTT was measured swinging 0.1-1.2 s between runs — at
+        # depth 1 every swing lands directly on tok/s). The cost: a slot
+        # that finishes decodes up to d-1 extra discarded rounds before the
+        # host sees the finish, and freed slots cool for the in-flight
+        # rounds that still reference them (_free_slot). Default: 2 on an
+        # accelerator, 1 on CPU (no tunnel to hide; sequential-generate
+        # tests would only pay the finished-slot waste).
+        depth_env = os.environ.get("TPU_PIPELINE_DEPTH", "")
+        if depth_env:
+            self.pipeline_depth = max(1, int(depth_env))
+        else:
+            try:
+                on_accel = jax.default_backend() != "cpu"
+            except Exception:  # pragma: no cover
+                on_accel = False
+            self.pipeline_depth = 2 if on_accel else 1
+        # round ids: fence for slot-reuse cooling (a freed slot may still be
+        # referenced by rounds dispatched before the free was observed)
+        self._rid_dispatched = 0
+        self._rid_fetched = 0
+        self._cooling: dict[int, int] = {}
 
         self._admit: "queue.Queue[GenRequest]" = queue.Queue()
         self._stop_evt = threading.Event()
@@ -615,30 +656,37 @@ class GenerationEngine:
         impl = self.decode_impl
         base_key = self._base_key
 
-        @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("compact",))
-        def decode_chunk_fn(params, ck, cv, packed, d_temp, d_topk, d_topp, compact):
+        @partial(jax.jit, donate_argnums=(1, 2, 7), static_argnames=("compact",))
+        def decode_chunk_fn(params, ck, cv, packed, d_temp, d_topk, d_topp,
+                            d_last, compact):
             """One decode round (K fused steps).
 
             All per-round host inputs ride ONE packed i32 transfer (on a
             remote-TPU tunnel every separate transfer/dispatch is tens of
-            ms): compact → [tokens | lengths | slot_ids | counter]
-            (3*Ba+1), full → [tokens | lengths | counter] (2*B+1). The RNG
-            key derives from the counter on device; sampling params are the
-            device-resident arrays, gathered by slot id on the compact path
-            (row i serves cache row slot_ids[i] — _dispatch_decode)."""
+            ms): compact → [lengths | slot_ids | counter] (2*Ba+1), full →
+            [lengths | counter] (B+1). The round's INPUT TOKENS never touch
+            the host: they come from `d_last`, the device-resident
+            last-token ring that this round (and admissions) write — so the
+            NEXT round can be dispatched before this one's output is ever
+            fetched, and the decode chain rides the device stream while the
+            host trails behind fetching outputs for emission (the pipelined
+            loop, _run). The RNG key derives from the counter on device;
+            sampling params are the device-resident arrays, gathered by
+            slot id on the compact path (row i serves cache row
+            slot_ids[i] — _dispatch_decode)."""
             if compact:
-                Ba = (packed.shape[0] - 1) // 3
-                tokens = packed[:Ba]
-                lengths = packed[Ba : 2 * Ba]
-                slot_ids = packed[2 * Ba : 3 * Ba]
+                Ba = (packed.shape[0] - 1) // 2
+                lengths = packed[:Ba]
+                slot_ids = packed[Ba : 2 * Ba]
+                tokens = d_last[slot_ids]
                 temp = d_temp[slot_ids]
                 topk = d_topk[slot_ids]
                 topp = d_topp[slot_ids]
             else:
-                Ba = (packed.shape[0] - 1) // 2
-                tokens = packed[:Ba]
-                lengths = packed[Ba : 2 * Ba]
+                Ba = packed.shape[0] - 1
+                lengths = packed[:Ba]
                 slot_ids = None
+                tokens = d_last
                 temp, topk, topp = d_temp, d_topk, d_topp
             rng = jax.random.fold_in(base_key, packed[-1])
 
@@ -654,10 +702,18 @@ class GenerationEngine:
                 new = sample_tokens(logits, sub, temp, topk, topp)
                 return (ck, cv, new, lens + 1, rng), new
 
-            (ck, cv, _, _, _), out = jax.lax.scan(
+            (ck, cv, last, _, _), out = jax.lax.scan(
                 step, (ck, cv, tokens, lengths, rng), None, length=K
             )
-            return out, ck, cv  # out: [K, Ba]
+            # write the round's final tokens back into the ring. Compact pad
+            # rows all target the same inactive row (duplicate-index set:
+            # last write wins on garbage) — harmless, admission overwrites
+            # on reuse and the device stream is in-order.
+            if compact:
+                d_last = d_last.at[slot_ids].set(last)
+            else:
+                d_last = last
+            return out, ck, cv, d_last  # out: [K, Ba]
 
         return decode_chunk_fn
 
@@ -896,18 +952,23 @@ class GenerationEngine:
         Returns True when a re-allocation happened (all slot KV was lost)."""
         try:
             leaves = jax.tree.leaves(
-                {"k": self._ck, "v": self._cv, "p": (self._d_temp, self._d_topk, self._d_topp)}
+                {"k": self._ck, "v": self._cv,
+                 "p": (self._d_temp, self._d_topk, self._d_topp,
+                       self._d_last_tok)}
             )
             deleted = any(x.is_deleted() for x in leaves)
         except AttributeError:
             deleted = False
         if not deleted:
             return False
-        # the device sampling rows are also donated (admit_fn); host mirrors
-        # are the source of truth, so rebuilding them is lossless
+        # the device sampling rows and token ring are also donated; host
+        # mirrors are the source of truth, so rebuilding them is lossless
+        # (the ring may lag by the in-flight rounds that were lost — their
+        # slots were failed/aborted, so no live stream reads the stale rows)
         self._d_temp = jnp.asarray(self._temp)
         self._d_topk = jnp.asarray(self._topk)
         self._d_topp = jnp.asarray(self._topp)
+        self._d_last_tok = jnp.asarray(self._last_tok)
         log.warning("KV cache buffers were donated into a failed dispatch; re-allocating")
         cache = init_kv_cache(
             self.cfg, self.max_slots, self.max_seq_len, dtype=self.dtype,
@@ -948,8 +1009,7 @@ class GenerationEngine:
                 self._count_error()
                 s.req.out.put({"type": "error", "error": error})
                 s.req.out.put(_DONE)
-                self._slots[i] = None
-                self._lengths[i] = self.max_seq_len  # park (see __init__)
+                self._free_now(i)
         for slot in list(self._prefills):
             st = self._prefills.pop(slot)
             self._count_error()
@@ -962,6 +1022,14 @@ class GenerationEngine:
             if s is None and i not in self._prefills and (
                 reserved is None or i not in reserved
             ):
+                fence = self._cooling.get(i)
+                if fence is not None:
+                    if fence > self._rid_fetched:
+                        # an in-flight round dispatched before this slot was
+                        # freed may still write its cache rows / token ring
+                        # entry — reuse only once every such round is fetched
+                        continue
+                    del self._cooling[i]
                 return i
         return None
 
@@ -985,6 +1053,26 @@ class GenerationEngine:
              iteration's step 2)
         """
         pending: _PendingRound | None = None
+        inflight: deque[_DispatchedRound] = deque()
+        K = self.decode_chunk
+        S = self.max_seq_len
+
+        def drain_failed(e: Exception, also: list[int] = ()) -> None:
+            # a poisoned round invalidates every LATER in-flight round too
+            # (they consumed the same donated buffer chain): fail all of
+            # their live slots — plus `also` (the active set of a dispatch
+            # that raised BEFORE entering the deque: without it those slots
+            # would stay active, re-dispatch, and re-raise forever while
+            # their consumers hang) — drop the rounds, recover the cache
+            slots: set[int] = {b for b in also if self._slots[b] is not None}
+            while inflight:
+                d = inflight.popleft()
+                slots.update(
+                    b for b, s, _ in d.entries if self._slots[b] is s
+                )
+            self._rid_fetched = self._rid_dispatched  # nothing left in flight
+            self._fail_round(sorted(slots), e)
+
         while not self._stop_evt.is_set():
             # watchdog stamp: idle loops iterate (the _wake wait times out),
             # so staleness only accrues while a device call blocks. A
@@ -995,20 +1083,28 @@ class GenerationEngine:
             if self.stalled:
                 self.stalled = False
                 log.warning("engine loop resumed; clearing stall flag")
-            active = [i for i, s in enumerate(self._slots) if s is not None]
-            disp: _DispatchedRound | None = None
+            # dispatchable = active rows whose next K writes still fit. Rows
+            # at the cap wait (un-dispatched) for their in-flight round's
+            # fetch, where the fast-scan cap rule finishes them.
+            active = [
+                i for i, s in enumerate(self._slots)
+                if s is not None and self._lengths[i] + K <= S
+            ]
             if active:
                 try:
-                    disp = self._dispatch_decode(active)
+                    # tokens come from the device ring, lengths advance
+                    # optimistically — this dispatch does NOT wait for any
+                    # earlier round's fetch (decode_chunk_fn docstring)
+                    inflight.append(self._dispatch_decode(active))
                 except Exception as e:  # a poisoned dispatch must not kill the loop
                     if pending is not None:
-                        # deliver round N-1's already-fetched tokens BEFORE
-                        # the error events — _fail_round marks these same
-                        # slot objects aborted, which would silently drop
-                        # up to K computed tokens per stream
+                        # deliver already-fetched tokens BEFORE the error
+                        # events — _fail_round marks these same slot objects
+                        # aborted, which would silently drop up to K
+                        # computed tokens per stream
                         self._emit_round(pending)
                         pending = None
-                    self._fail_round(active, e)
+                    drain_failed(e, also=active)
             if pending is not None:
                 self._emit_round(pending)
                 pending = None
@@ -1016,20 +1112,34 @@ class GenerationEngine:
             # One bounded prefill chunk per iteration: admission work
             # interleaves with decode rounds instead of stalling them.
             prefilled = self._prefill_round()
-            if disp is not None:
+            # fetch the OLDEST round only once the pipeline is full (or the
+            # batch went idle): up to pipeline_depth rounds chain on device
+            # without a host sync, so a slow tunnel fetch overlaps compute
+            # instead of serializing with it
+            if inflight and (
+                len(inflight) >= self.pipeline_depth or not active
+            ):
+                disp = inflight.popleft()
                 try:
                     pending = self._complete_round(disp)
                 except Exception as e:  # poisoned execution surfaces at fetch
-                    self._fail_round(
-                        [b for b, s, _ in disp.entries if self._slots[b] is s], e
-                    )
-            elif not (active or admitted or prefilled):
+                    inflight.appendleft(disp)  # drain fails its slots too
+                    drain_failed(e)
+            elif not (active or admitted or prefilled or inflight):
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
         if pending is not None:
             # flush the deferred emission: consumers of slots the fast-scan
             # already freed would otherwise never see their done event
             self._emit_round(pending)
+        while inflight:
+            # fetch + emit what was still in flight at shutdown: their
+            # consumers' streams end cleanly instead of hanging mid-queue
+            try:
+                self._emit_round(self._complete_round(inflight.popleft()))
+            except Exception:  # pragma: no cover — device died at shutdown
+                log.exception("in-flight round lost at shutdown")
+                break
 
     def _fail_round(self, slots: list[int], e: Exception) -> None:
         log.exception("decode round failed; failing %d active slots", len(slots))
@@ -1040,8 +1150,7 @@ class GenerationEngine:
                 self._count_error()
                 s.req.out.put({"type": "error", "error": str(e)})
                 s.req.out.put(_DONE)
-                self._slots[b] = None
-                self._lengths[b] = self.max_seq_len  # park
+                self._free_now(b)
         if self._recover_cache():
             # mid-prefill KV lives in the same buffers
             self._abort_all("kv cache lost in failed decode round")
@@ -1133,8 +1242,7 @@ class GenerationEngine:
                     # continuous batch doesn't decode into dead queues
                     s = self._slots[slot]
                     if s is not None and s.req is req:
-                        self._slots[slot] = None
-                        self._lengths[slot] = self.max_seq_len  # park
+                        self._free_now(slot)
                     self._count_error()
                     req.out.put({"type": "error", "error": str(e)})
                     req.out.put(_DONE)
@@ -1266,12 +1374,11 @@ class GenerationEngine:
         # ONE fused dispatch: prefill + cache inserts + device sampling-param
         # rows + first-token sample (see admit_fn)
         self._note_exec_shape("admit", Ab, bucket)
-        self._ck, self._cv, self._d_temp, self._d_topk, self._d_topp, toks0 = (
-            self._admit_fn(
-                self.params, self._ck, self._cv,
-                self._d_temp, self._d_topk, self._d_topp,
-                jnp.asarray(tokens), jnp.asarray(ipack), jnp.asarray(fpack),
-            )
+        (self._ck, self._cv, self._d_temp, self._d_topk, self._d_topp,
+         self._d_last_tok, toks0) = self._admit_fn(
+            self.params, self._ck, self._cv,
+            self._d_temp, self._d_topk, self._d_topp, self._d_last_tok,
+            jnp.asarray(tokens), jnp.asarray(ipack), jnp.asarray(fpack),
         )
         toks0 = np.asarray(toks0)
         for i, (slot, req, ids) in enumerate(batch):
@@ -1409,6 +1516,10 @@ class GenerationEngine:
                 self._d_temp = self._d_temp.at[slots_fin].set(jnp.asarray(temps))
                 self._d_topk = self._d_topk.at[slots_fin].set(jnp.asarray(topks))
                 self._d_topp = self._d_topp.at[slots_fin].set(jnp.asarray(topps))
+                # first tokens into the device ring (decode rounds read
+                # their inputs from it — decode_chunk_fn); toks0 is still
+                # on device here, so this costs no extra transfer
+                self._d_last_tok = self._d_last_tok.at[slots_fin].set(toks0)
                 toks0 = np.asarray(toks0)
                 for k, (_, slot, st) in enumerate(fin):
                     self._prefill_q.remove(slot)
@@ -1430,8 +1541,7 @@ class GenerationEngine:
                     # free the slot if activation partially completed
                     s = self._slots[slot]
                     if s is not None and s.req is st.req:
-                        self._slots[slot] = None
-                        self._lengths[slot] = self.max_seq_len  # park
+                        self._free_now(slot)
                     if not st.aborted:  # watchdog may have terminated it already
                         self._count_error()
                         st.req.out.put({"type": "error", "error": str(e)})
@@ -1441,7 +1551,12 @@ class GenerationEngine:
 
     def _dispatch_decode(self, active: list[int]) -> _DispatchedRound:
         """Phase 1: stage host inputs and dispatch one decode round (NO
-        fetch — the returned round is in flight on device)."""
+        fetch — the returned round is in flight on device). Input tokens
+        come from the device-resident ring (decode_chunk_fn), so this never
+        waits on an earlier round's output; host lengths advance
+        OPTIMISTICALLY here (+K per dispatched row — the device really does
+        advance them), which is what lets the next dispatch stage correct
+        write positions before this round is fetched."""
         # chaos site: a failed round must fail active slots with error
         # events, not hang callers (the poisoned-round guard in _run)
         maybe_fail("engine.decode", f"active={len(active)}")
@@ -1466,28 +1581,38 @@ class GenerationEngine:
             # attend kernel's discarded read) is trivially harmless. A
             # mid-prefill row is still value-safe (parked pads write back
             # byte-identical tiles; fallbacks drop OOB scatters) but only a
-            # last resort.
+            # last resort — as is an occupied-but-undispatchable row (at the
+            # context cap awaiting its fetch; possible only under the
+            # pipelined loop's dispatch filter): its pad cell reads the
+            # post-append tile (device stream is in-order) and writes it
+            # back unchanged. The one UNSAFE target is a row active in THIS
+            # dispatch (its real cell and the pad cell race within one
+            # kernel launch) — and compact (Ba < B ⇒ nact < B) guarantees a
+            # non-active row exists.
+            in_round = set(active)
             free = next(
                 (i for i in range(B)
                  if self._slots[i] is None and i not in self._prefills),
-                next(i for i in range(B) if self._slots[i] is None),
+                next(
+                    (i for i in range(B) if self._slots[i] is None),
+                    next(i for i in range(B) if i not in in_round),
+                ),
             )
             ids = np.full(Ba, free, dtype=np.int32)
             ids[:nact] = act
             lens_in = np.full(Ba, self.max_seq_len, dtype=np.int32)
             lens_in[:nact] = self._lengths[act]
-            toks = np.zeros(Ba, dtype=np.int32)
-            toks[:nact] = self._last_tok[act]
             # ONE packed transfer per round (see decode_chunk_fn docstring)
             packed = np.concatenate(
-                [toks, lens_in, ids, [self._next_counter()]]
+                [lens_in, ids, [self._next_counter()]]
             ).astype(np.int32)
         else:
             packed = np.concatenate(
-                [self._last_tok, self._lengths, [self._next_counter()]]
+                [self._lengths, [self._next_counter()]]
             ).astype(np.int32)
         self._note_exec_shape("decode", Ba, compact)
-        out, self._ck, self._cv = self._decode_fn(
+        base = self._lengths.copy()
+        out, self._ck, self._cv, self._d_last_tok = self._decode_fn(
             self.params,
             self._ck,
             self._cv,
@@ -1495,13 +1620,22 @@ class GenerationEngine:
             self._d_temp,
             self._d_topk,
             self._d_topp,
+            self._d_last_tok,
             compact=compact,
         )
         entries = [
             (b, self._slots[b], (i if compact else b)) for i, b in enumerate(active)
         ]
+        # optimistic advance: the device WILL move every dispatched row K
+        # steps; later dispatches must stage post-round positions without
+        # waiting for this round's fetch. Capped at S (parking invariant).
+        for b in active:
+            self._lengths[b] = min(int(base[b]) + self.decode_chunk,
+                                   self.max_seq_len)
+        self._rid_dispatched += 1
         return _DispatchedRound(
-            out=out, entries=entries, base=self._lengths.copy(), t0=round_t0
+            out=out, entries=entries, base=base, t0=round_t0,
+            rid=self._rid_dispatched,
         )
 
     def _complete_round(self, disp: _DispatchedRound) -> _PendingRound:
@@ -1538,8 +1672,7 @@ class GenerationEngine:
                 # stall watchdog already delivered this consumer's terminal
                 # error while the loop was wedged — reclaim the slot now
                 # instead of decoding garbage until the seq cap
-                self._slots[b] = None
-                self._lengths[b] = S  # park
+                self._free_now(b)
                 continue
             g = s.generated
             fin = False
@@ -1557,14 +1690,26 @@ class GenerationEngine:
                     break
             if fin:
                 # free NOW: the next dispatch must exclude this slot and
-                # admission may reuse it immediately; the deferred emission
-                # delivers its events from the pinned slot object
-                self._slots[b] = None
-                self._lengths[b] = S  # park
+                # admission may reuse it (after the cooling fence — rounds
+                # already in flight still reference the row); the deferred
+                # emission delivers its events from the pinned slot object
+                self._free_now(b)
             else:
-                self._lengths[b] = min(base_b + K, S)
+                # lengths were advanced optimistically at dispatch (the
+                # pipelined loop stages later rounds before this fetch) —
+                # only the recovery mirror updates here
                 self._last_tok[b] = out[-1, col]
+        self._rid_fetched = max(self._rid_fetched, disp.rid)
         return _PendingRound(out=out, entries=disp.entries, base=disp.base)
+
+    def _free_now(self, b: int) -> None:
+        """Park a slot and fence its reuse until every round currently in
+        flight (which may still write the row's cache tiles / token-ring
+        entry) has been fetched."""
+        self._slots[b] = None
+        self._lengths[b] = self.max_seq_len  # park
+        if self._rid_dispatched > self._rid_fetched:
+            self._cooling[b] = self._rid_dispatched
 
     def _emit_round(self, p: _PendingRound) -> None:
         """Phase 3 (deferred, overlapped with the next round's device time):
@@ -1691,5 +1836,4 @@ class GenerationEngine:
         # already, and admission may have re-filled it with a NEW
         # request whose slot state must not be clobbered
         if self._slots[slot_idx] is s:
-            self._slots[slot_idx] = None
-            self._lengths[slot_idx] = self.max_seq_len  # park (see __init__)
+            self._free_now(slot_idx)

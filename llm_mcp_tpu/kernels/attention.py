@@ -866,6 +866,153 @@ def _attend_q8_mla_kernel(
     o_ref[0] = (ctx / l).astype(o_ref.dtype)
 
 
+def mla_whole_s_fits(S: int, R: int, dr: int, H: int) -> bool:
+    """Whole-S VMEM budget for `_attend_q8_mla_kernel`: int8 payloads + the
+    f32 working set — three [H, S] score/prob arrays, the [S, dr]
+    dequantized rope block, and the [H, R]-class query/context tiles —
+    under ~8 MB headroom. Beyond it the BLOCKED variant streams from HBM."""
+    return (
+        S * (R + dr) + 4 * S * (3 * H + dr) + 4 * H * (2 * R + dr)
+    ) <= 8 * 1024 * 1024
+
+
+def _attend_q8_mla_blocked_kernel(
+    li_ref,  # [1] int32 (scalar prefetch) — layer index
+    ids_ref,  # [Ba] int32 (scalar prefetch) — cache row per batch position
+    lengths_ref,  # [Ba] int32 (scalar prefetch) — this step's position per row
+    qt_ref,  # [1, H, R] VMEM — absorbed queries (latent space)
+    qr_ref,  # [1, H, dr] VMEM — rope queries
+    nc_ref,  # [1, 1, R] VMEM — this step's exact latent
+    nr_ref,  # [1, 1, dr] VMEM — this step's exact rope key
+    lat_hbm,  # [L, B, 1, S, R] int8 — latent payload, stays in HBM (ANY)
+    lats_hbm,  # [L, B, 1, S] — latent scales
+    rop_hbm,  # [L, B, 1, S, dr] int8 — rope-key payload
+    rops_hbm,  # [L, B, 1, S] — rope-key scales
+    o_ref,  # [1, H, R] VMEM out — context in latent space
+    lat_buf,  # VMEM scratch [2, BS, R] int8 (double buffer)
+    lats_buf,  # [2, BS]
+    rop_buf,  # [2, BS, dr] int8
+    rops_buf,  # [2, BS]
+    sems,  # DMA semaphores [2, 4]
+    *,
+    scale: float,
+    block_s: int,
+    seq_len: int,
+):
+    """Long-context MLA decode attention: the blocked-DMA analog of
+    `_attend_q8_mla_kernel` (absorbed MQA-shaped form, second additive
+    rope-score term) with `_attend_q8_blocked_kernel`'s streaming structure
+    — the latent row stays in HBM and a double-buffered manual DMA loop
+    with a DYNAMIC trip count streams exactly the attended prefix [0, w],
+    flash-style online softmax accumulating the latent-space context across
+    blocks. No VMEM cliff at any S: this is what replaces the XLA
+    dequant-then-dot path at S=32k int8-latent serving."""
+    b = pl.program_id(0)
+    li = li_ref[0]
+    row = ids_ref[b]
+    w = lengths_ref[b]
+    BS = block_s
+    nblk_max = seq_len // BS
+    nblk = jnp.clip((w + BS) // BS, 1, nblk_max)
+    # parked/free rows (w >= S) produce discarded output: stream one block
+    nblk = jnp.where(w >= seq_len, 1, nblk)
+
+    def copies(j, slot):
+        return (
+            pltpu.make_async_copy(
+                lat_hbm.at[li, row, 0, pl.ds(j * BS, BS), :], lat_buf.at[slot],
+                sems.at[slot, 0],
+            ),
+            pltpu.make_async_copy(
+                lats_hbm.at[li, row, 0, pl.ds(j * BS, BS)], lats_buf.at[slot],
+                sems.at[slot, 1],
+            ),
+            pltpu.make_async_copy(
+                rop_hbm.at[li, row, 0, pl.ds(j * BS, BS), :], rop_buf.at[slot],
+                sems.at[slot, 2],
+            ),
+            pltpu.make_async_copy(
+                rops_hbm.at[li, row, 0, pl.ds(j * BS, BS)], rops_buf.at[slot],
+                sems.at[slot, 3],
+            ),
+        )
+
+    def start(j, slot):
+        for c in copies(j, slot):
+            c.start()
+
+    def wait(j, slot):
+        for c in copies(j, slot):
+            c.wait()
+
+    start(0, 0)
+
+    qt = qt_ref[0].astype(jnp.float32)  # [H, R]
+    qr = qr_ref[0].astype(jnp.float32)  # [H, dr]
+    nc = nc_ref[0, 0].astype(jnp.float32)  # [R]
+    nr = nr_ref[0, 0].astype(jnp.float32)  # [dr]
+    qa = jnp.max(jnp.abs(qt), axis=-1)
+    qsc = jnp.maximum(qa / 127.0, 1e-30)
+    qt8 = jnp.round(qt / qsc[:, None]).astype(jnp.int8)
+    s_new = (
+        jnp.sum(qt * nc[None, :], axis=-1) + jnp.sum(qr * nr[None, :], axis=-1)
+    )[:, None] * scale  # [H, 1]
+
+    H, R = qt.shape
+    acc0 = jnp.zeros((H, R), jnp.float32)
+    m0 = jnp.full((H, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((H, 1), jnp.float32)
+
+    def body(j, carry):
+        acc, m, l = carry
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nblk)
+        def _prefetch():
+            start(j + 1, 1 - slot)
+
+        wait(j, slot)
+        lat = lat_buf[slot]  # [BS, R] int8
+        lats = lats_buf[slot].astype(jnp.float32)  # [BS]
+        # latent scores: s8 x s8 -> s32 on the MXU, post-dot scale fold
+        s_i = jax.lax.dot_general(
+            qt8, lat, (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32
+        )  # [H, BS]
+        s = s_i.astype(jnp.float32) * (scale * qsc)[:, None] * lats[None, :]
+        # rope scores: BS x dr is tiny — dequant on the VPU, f32 dot
+        rop = rop_buf[slot].astype(jnp.float32) * rops_buf[slot].astype(
+            jnp.float32
+        )[:, None]  # [BS, dr]
+        s = s + jax.lax.dot_general(
+            qr, rop, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        pos = j * BS + jax.lax.broadcasted_iota(jnp.int32, (1, BS), 1)
+        s = jnp.where(pos == w, s_new, s)
+        s = jnp.where(pos <= w, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(pos <= w, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        p_w = jnp.sum(jnp.where(pos == w, p, 0.0), axis=-1, keepdims=True)
+        # fold latent dequant scales into the probs, requantize, PV on MXU
+        pv = jnp.where(pos == w, 0.0, p * lats[None, :])  # [H, BS]
+        pa = jnp.max(pv, axis=-1)
+        psc = jnp.maximum(pa / 127.0, 1e-30)
+        p8 = jnp.round(pv / psc[:, None]).astype(jnp.int8)
+        ctx_i = jax.lax.dot_general(
+            p8, lat, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+        )  # [H, R]
+        acc_new = (
+            acc * alpha + ctx_i.astype(jnp.float32) * psc[:, None]
+            + p_w * nc[None, :]
+        )
+        return acc_new, m_new, l_new
+
+    acc, m, l = jax.lax.fori_loop(0, nblk, body, (acc0, m0, l0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
 def _decode_attend_q8_mla_fallback(
     qt, qr, new_c, new_r, cache_c, cache_r, layer, lengths, scale, slot_ids
 ):
@@ -928,50 +1075,75 @@ def decode_attend_q8_mla(
     (models/mla.py). Returns ctx in latent space [Ba, H, R]; the caller
     owns the cache append (the kernel overrides position w exactly).
 
-    Falls back to exact f32 math off-TPU, when R isn't a 128-lane multiple
-    (tiny test configs), or when the whole-S row won't fit VMEM (MLA long
-    context keeps the XLA path until a blocked variant lands)."""
+    Falls back to exact f32 math off-TPU or when R isn't a 128-lane
+    multiple (tiny test configs). Past the whole-S kernel's VMEM budget,
+    the BLOCKED variant streams the latent row from HBM with a dynamic
+    trip count (`_attend_q8_mla_blocked_kernel`) — int8-latent long
+    context (S=32k) runs on the MXU too."""
     Ba, H, R = qt.shape
     dr = qr.shape[-1]
     S = cache_c["q"].shape[3]
     interp = _interpret() if interpret is None else interpret
-    # whole-S VMEM budget: int8 payloads + the f32 working set — three
-    # [H, S] score/prob arrays, the [S, dr] dequantized rope block, and the
-    # [H, R]-class query/context tiles — under ~8 MB headroom
-    fits = (
-        S * (R + dr)
-        + 4 * S * (3 * H + dr)
-        + 4 * H * (2 * R + dr)
-    ) <= 8 * 1024 * 1024
-    if not _HAS_PLTPU or (not interp and (R % 128 != 0 or not fits)):
+    fits = mla_whole_s_fits(S, R, dr, H)
+    # blocked path: BS must divide S (a floored trip count would drop the
+    # tail — including the current position)
+    BS = next((c for c in (512, 256, 128) if S % c == 0), 0)
+    if not _HAS_PLTPU or (not interp and (R % 128 != 0 or (not fits and BS == 0))):
         return _decode_attend_q8_mla_fallback(
             qt, qr, new_c, new_r, cache_c, cache_r, layer, lengths, scale, slot_ids
         )
 
-    kernel = functools.partial(_attend_q8_mla_kernel, scale=scale)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,  # layer [1], slot ids [Ba], lengths [Ba]
-        grid=(Ba,),
-        in_specs=[
-            pl.BlockSpec((1, H, R), lambda b, li, ids, lens: (b, 0, 0)),
-            pl.BlockSpec((1, H, dr), lambda b, li, ids, lens: (b, 0, 0)),
-            pl.BlockSpec((1, 1, R), lambda b, li, ids, lens: (b, 0, 0)),
-            pl.BlockSpec((1, 1, dr), lambda b, li, ids, lens: (b, 0, 0)),
-            pl.BlockSpec(
-                (1, 1, 1, S, R), lambda b, li, ids, lens: (li[0], ids[b], 0, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, 1, S), lambda b, li, ids, lens: (li[0], ids[b], 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, 1, S, dr), lambda b, li, ids, lens: (li[0], ids[b], 0, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, 1, S), lambda b, li, ids, lens: (li[0], ids[b], 0, 0)
-            ),
-        ],
-        out_specs=pl.BlockSpec((1, H, R), lambda b, li, ids, lens: (b, 0, 0)),
-    )
+    if fits:
+        kernel = functools.partial(_attend_q8_mla_kernel, scale=scale)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,  # layer [1], slot ids [Ba], lengths [Ba]
+            grid=(Ba,),
+            in_specs=[
+                pl.BlockSpec((1, H, R), lambda b, li, ids, lens: (b, 0, 0)),
+                pl.BlockSpec((1, H, dr), lambda b, li, ids, lens: (b, 0, 0)),
+                pl.BlockSpec((1, 1, R), lambda b, li, ids, lens: (b, 0, 0)),
+                pl.BlockSpec((1, 1, dr), lambda b, li, ids, lens: (b, 0, 0)),
+                pl.BlockSpec(
+                    (1, 1, 1, S, R), lambda b, li, ids, lens: (li[0], ids[b], 0, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, 1, S), lambda b, li, ids, lens: (li[0], ids[b], 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, 1, S, dr), lambda b, li, ids, lens: (li[0], ids[b], 0, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, 1, S), lambda b, li, ids, lens: (li[0], ids[b], 0, 0)
+                ),
+            ],
+            out_specs=pl.BlockSpec((1, H, R), lambda b, li, ids, lens: (b, 0, 0)),
+        )
+    else:
+        kernel = functools.partial(
+            _attend_q8_mla_blocked_kernel, scale=scale, block_s=BS, seq_len=S
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,  # layer [1], slot ids [Ba], lengths [Ba]
+            grid=(Ba,),
+            in_specs=[
+                pl.BlockSpec((1, H, R), lambda b, li, ids, lens: (b, 0, 0)),
+                pl.BlockSpec((1, H, dr), lambda b, li, ids, lens: (b, 0, 0)),
+                pl.BlockSpec((1, 1, R), lambda b, li, ids, lens: (b, 0, 0)),
+                pl.BlockSpec((1, 1, dr), lambda b, li, ids, lens: (b, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),  # latent payload
+                pl.BlockSpec(memory_space=pl.ANY),  # latent scales
+                pl.BlockSpec(memory_space=pl.ANY),  # rope payload
+                pl.BlockSpec(memory_space=pl.ANY),  # rope scales
+            ],
+            out_specs=pl.BlockSpec((1, H, R), lambda b, li, ids, lens: (b, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, BS, R), jnp.int8),
+                pltpu.VMEM((2, BS), cache_c["s"].dtype),
+                pltpu.VMEM((2, BS, dr), jnp.int8),
+                pltpu.VMEM((2, BS), cache_r["s"].dtype),
+                pltpu.SemaphoreType.DMA((2, 4)),
+            ],
+        )
     ids = (
         jnp.arange(Ba, dtype=jnp.int32)
         if slot_ids is None

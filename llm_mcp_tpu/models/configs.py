@@ -37,6 +37,7 @@ class ModelConfig:
     # family variation knobs (one shared decoder serves all families, the
     # way the reference's one Ollama runtime serves its whole catalog):
     qkv_bias: bool = False  # Qwen2: biases on q/k/v projections
+    qk_norm: bool = False  # Qwen3: per-head RMSNorm on q/k before rope
     act: str = "silu"  # FFN activation: silu (llama/qwen/mistral) | gelu (gemma)
     norm_weight_offset: float = 0.0  # Gemma: RMSNorm computes x * (1 + w)
     embed_scale: bool = False  # Gemma: hidden = embed * sqrt(dim)
@@ -350,6 +351,24 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         qkv_bias=True,
         params_b=7.6,
     ),
+    # Qwen3 per the published architecture (Qwen/Qwen3-8B config.json):
+    # biases gone, per-head q/k RMSNorm before rope, explicit head_dim,
+    # untied head at 8B.
+    "qwen3-8b": ModelConfig(
+        name="qwen3-8b",
+        vocab_size=151_936,
+        dim=4096,
+        n_layers=36,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_hidden=12_288,
+        head_dim=128,
+        rope_theta=1_000_000.0,
+        norm_eps=1e-6,
+        max_seq_len=32_768,
+        qk_norm=True,
+        params_b=8.2,
+    ),
     # DeepSeek-R1 distills — the local deepseek models the reference's
     # smart routing seeds and tier-infers (`db/migrations/04_smart_routing
     # .sql:20,35`, `discovery.go:510` thinking-model detection). They are
@@ -453,6 +472,21 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         rope_theta=10_000.0,
         max_seq_len=512,
         qkv_bias=True,
+        tie_embeddings=True,
+        params_b=0.001,
+    ),
+    "tiny-qwen3": ModelConfig(
+        name="tiny-qwen3",
+        vocab_size=512,
+        dim=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_hidden=256,
+        head_dim=64,  # explicit, != dim // n_heads = 32 (the qwen3 trap)
+        rope_theta=10_000.0,
+        max_seq_len=512,
+        qk_norm=True,
         tie_embeddings=True,
         params_b=0.001,
     ),
@@ -593,6 +627,10 @@ def config_from_hf(doc: dict, name: str = "") -> ModelConfig:
             )
     elif mt == "qwen2":
         kw["qkv_bias"] = True
+    elif mt == "qwen3":
+        # biases dropped in favor of per-head q/k RMSNorm; head_dim is
+        # explicit and decouples from dim // n_heads below 8B
+        kw["qk_norm"] = True
     elif mt == "mistral":
         kw["sliding_window"] = int(doc.get("sliding_window") or 0)
         kw["sliding_pattern"] = 1
@@ -643,7 +681,8 @@ def config_from_hf(doc: dict, name: str = "") -> ModelConfig:
     else:
         raise ValueError(
             f"unsupported HF model_type {mt!r} "
-            "(supported: llama, qwen2, mistral, mixtral, gemma2, deepseek_v2)"
+            "(supported: llama, qwen2, qwen3, mistral, mixtral, gemma2, "
+            "deepseek_v2)"
         )
     if rs_type and kw.get("rope_factor", 1.0) <= 1.0 and rs_type != "default":
         # a scaling recipe we did not apply: serving it with plain rope

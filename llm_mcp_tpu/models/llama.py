@@ -94,6 +94,10 @@ def init_llama_params(
         layers["bq"] = jnp.zeros((L, H * hd), dtype=dtype)
         layers["bk"] = jnp.zeros((L, Hkv * hd), dtype=dtype)
         layers["bv"] = jnp.zeros((L, Hkv * hd), dtype=dtype)
+    if cfg.qk_norm:
+        # Qwen3 per-head q/k RMSNorm: one [hd] weight vector per layer
+        layers["q_norm"] = jnp.ones((L, hd), dtype=dtype)
+        layers["k_norm"] = jnp.ones((L, hd), dtype=dtype)
     if cfg.post_norms:
         layers["post_attn_norm"] = norm_init
         layers["post_ffn_norm"] = norm_init
@@ -191,8 +195,11 @@ def _softcap(x: jnp.ndarray, cap: float) -> jnp.ndarray:
 
 
 def _qkv(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
-    """Q/K/V projections (+ family bias) on [..., D] activations; outputs stay
-    flat [..., H*hd] / [..., Hkv*hd] — callers reshape for their layout."""
+    """Q/K/V projections (+ family bias / qk-norm) on [..., D] activations;
+    outputs stay flat [..., H*hd] / [..., Hkv*hd] — callers reshape for
+    their layout. This is the single seam every attention path (prefill,
+    chunked prefill, both decode steps) goes through, so per-family query/
+    key transforms live here exactly once."""
     q = qdot(x, lp["wq"])
     k = qdot(x, lp["wk"])
     v = qdot(x, lp["wv"])
@@ -200,6 +207,16 @@ def _qkv(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
         q = q + lp["bq"]
         k = k + lp["bk"]
         v = v + lp["bv"]
+    if cfg.qk_norm:
+        # Qwen3: per-head RMSNorm over head_dim, applied pre-rope. Weights
+        # are one [hd] vector per layer, shared across heads.
+        hd = cfg.resolved_head_dim
+        q = _rms_norm(
+            q.reshape(*q.shape[:-1], -1, hd), lp["q_norm"], cfg.norm_eps
+        ).reshape(q.shape)
+        k = _rms_norm(
+            k.reshape(*k.shape[:-1], -1, hd), lp["k_norm"], cfg.norm_eps
+        ).reshape(k.shape)
     return q, k, v
 
 
@@ -491,6 +508,13 @@ def llama_prefill_chunk_batch(
     Returns (logits [A, V] f32 at each row's last valid position,
     new_cache_k, new_cache_v).
     """
+    if cfg.kv_lora_rank:  # MLA family: absorbed chunked prefill over latents
+        from .mla import mla_prefill_chunk_batch
+
+        return mla_prefill_chunk_batch(
+            cfg, params, cache_k, cache_v, tokens, slots, starts, nvalid,
+            skey=skey,
+        )
     quantized = isinstance(cache_k, dict)
     L, B, Hkv, S, hd = _cache_shape(cache_k)
     H = cfg.n_heads

@@ -289,6 +289,184 @@ def mla_prefill(
     return logits, to_engine_layout(cs), to_engine_layout(krs)
 
 
+def mla_prefill_chunk_batch(
+    cfg: ModelConfig,
+    params: Params,
+    cache_c: Any,  # [L, B, 1, S, R] latents (or int8 {"q","s"} pytree)
+    cache_r: Any,  # [L, B, 1, S, dr] rope keys
+    tokens: jnp.ndarray,  # [A, C] int32 — right-padded chunks, one per slot
+    slots: jnp.ndarray,  # [A] int32 engine slots
+    starts: jnp.ndarray,  # [A] int32 absolute position of each chunk's start
+    nvalid: jnp.ndarray,  # [A] int32 valid tokens per chunk
+    skey: int = 0,  # STATIC bound on the PAST key range (0 = whole S)
+) -> tuple[jnp.ndarray, Any, Any]:
+    """Batched chunked prefill for MLA — the absorbed-attention analog of
+    `llama_prefill_chunk_batch` (same engine contract: one bounded chunk for
+    up to A slots in a single dispatch, read-past-then-write-in-place,
+    static (C, skey) buckets).
+
+    The chunk's queries fold through W_uk exactly as `mla_decode_step` does,
+    so the PAST segment scores straight against the latent cache — context
+    prefilled by earlier chunks is never re-expanded to per-head K/V. The
+    SELF segment scores against the chunk's own in-register latents (exact
+    bf16 even over an int8 cache — the decode kernel's current-token
+    override, generalized to C tokens). One joint softmax over [past |
+    self]; the value side re-expands only the attended [H, R] context
+    through W_uv. This is what unlocks the engine's prompt-prefix KV cache
+    for the MLA family: a prefix hit copies latent rows, and the suffix
+    rides this path with start = P0.
+    """
+    H, dn, dr, dv = _dims(cfg)
+    quantized = isinstance(cache_c, dict)
+    L, B, _, S, R = (cache_c["q"] if quantized else cache_c).shape
+    A, C = tokens.shape
+    Sk = min(skey, S) if skey else S
+    scale = mla_scale(cfg)
+    neg = jnp.float32(-1e30)
+    slots = jnp.asarray(slots, dtype=jnp.int32)
+    starts = jnp.asarray(starts, dtype=jnp.int32)
+    nvalid = jnp.asarray(nvalid, dtype=jnp.int32)
+
+    h = _embed_in(cfg, params, tokens)  # [A, C, D]
+    q_pos = starts[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]  # [A, C]
+    cos, sin = rope_tables(cfg, dr, q_pos)  # [A, C, dr/2]
+    key_pos = jnp.arange(Sk, dtype=jnp.int32)
+    # past segment: cache rows strictly before each chunk's start
+    past_mask = jnp.broadcast_to(
+        key_pos[None, None, :] < starts[:, None, None], (A, C, Sk)
+    )
+    # self segment: causal within the chunk (pad rows past nvalid are
+    # written but never attended by valid queries — llama chunk invariant)
+    c_idx = jnp.arange(C, dtype=jnp.int32)
+    self_mask = jnp.broadcast_to((c_idx[None, :] <= c_idx[:, None])[None], (A, C, C))
+
+    def layer(carry, lp):
+        h, cc_all, cr_all, li = carry
+        x = _norm(cfg, h, lp["attn_norm"])
+        qn, qr = _queries(cfg, lp, x)  # [A, C, H, dn/dr]
+        qr = apply_rope(qr, cos, sin)
+        c, kr = _latents(cfg, lp, x)  # [A, C, R], [A, C, dr]
+        kr = apply_rope(kr[..., None, :], cos, sin)[..., 0, :]
+        w_uk, w_uv = _absorbed_w(lp, h.dtype, R, H, dn, dv)
+        qt = jnp.einsum("achd,rhd->achr", qn, w_uk)  # [A, C, H, R]
+
+        # ---- reads first: past latents/rope keys from the PRE-write cache
+        def past_rows(cache, d):
+            return jnp.stack(
+                [
+                    jax.lax.dynamic_slice(
+                        cache, (li, slots[a], 0, 0, 0), (1, 1, 1, Sk, d)
+                    )[0, 0, 0]
+                    for a in range(A)
+                ]
+            )  # [A, Sk, d]
+
+        if quantized:
+            lat = past_rows(cc_all["q"], R)
+            rop = past_rows(cr_all["q"], dr)
+            ls = jnp.stack(
+                [
+                    jax.lax.dynamic_slice(
+                        cc_all["s"], (li, slots[a], 0, 0), (1, 1, 1, Sk)
+                    )[0, 0, 0]
+                    for a in range(A)
+                ]
+            ).astype(jnp.float32)  # [A, Sk]
+            rs = jnp.stack(
+                [
+                    jax.lax.dynamic_slice(
+                        cr_all["s"], (li, slots[a], 0, 0), (1, 1, 1, Sk)
+                    )[0, 0, 0]
+                    for a in range(A)
+                ]
+            ).astype(jnp.float32)
+            # per-token dequant scales fold POST-DOT (decode path's trick)
+            s_past = (
+                jnp.einsum("achr,asr->ahcs", qt, lat.astype(qt.dtype)).astype(
+                    jnp.float32
+                )
+                * ls[:, None, None, :]
+                + jnp.einsum("achd,asd->ahcs", qr, rop.astype(qr.dtype)).astype(
+                    jnp.float32
+                )
+                * rs[:, None, None, :]
+            ) * scale
+        else:
+            lat = past_rows(cc_all, R)
+            rop = past_rows(cr_all, dr)
+            s_past = (
+                jnp.einsum("achr,asr->ahcs", qt, lat.astype(qt.dtype))
+                + jnp.einsum("achd,asd->ahcs", qr, rop.astype(qr.dtype))
+            ).astype(jnp.float32) * scale
+        s_self = (
+            jnp.einsum("achr,atr->ahct", qt, c)
+            + jnp.einsum("achd,atd->ahct", qr, kr)
+        ).astype(jnp.float32) * scale
+        s_past = jnp.where(past_mask[:, None], s_past, neg)
+        s_self = jnp.where(self_mask[:, None], s_self, neg)
+
+        # joint softmax over [past | self]
+        s = jnp.concatenate([s_past, s_self], axis=-1)  # [A, H, C, Sk+C]
+        probs = jax.nn.softmax(s, axis=-1)
+        p_past, p_self = probs[..., :Sk], probs[..., Sk:]
+        if quantized:
+            p_past = p_past * ls[:, None, None, :]  # value-side dequant
+        ctx_lat = jnp.einsum(
+            "ahcs,asr->achr", p_past.astype(h.dtype), lat.astype(h.dtype)
+        ) + jnp.einsum("ahct,atr->achr", p_self.astype(h.dtype), c)
+        ctx = jnp.einsum("achr,rhd->achd", ctx_lat, w_uv).reshape(A, C, H * dv)
+        h = h + qdot(ctx, lp["wo_mla"])
+        h = _ffn_residual(cfg, lp, h, moe_valid=c_idx[None, :] < nvalid[:, None])
+
+        # ---- writes last: in place (write-after-read)
+        if quantized:
+            cq = quantize_kv(c, scale_dtype=cc_all["s"].dtype)
+            rq = quantize_kv(kr, scale_dtype=cr_all["s"].dtype)
+            for a in range(A):
+                cc_all = {
+                    "q": jax.lax.dynamic_update_slice(
+                        cc_all["q"], cq["q"][a][None, None, None],
+                        (li, slots[a], 0, starts[a], 0),
+                    ),
+                    "s": jax.lax.dynamic_update_slice(
+                        cc_all["s"], cq["s"][a][None, None, None],
+                        (li, slots[a], 0, starts[a]),
+                    ),
+                }
+                cr_all = {
+                    "q": jax.lax.dynamic_update_slice(
+                        cr_all["q"], rq["q"][a][None, None, None],
+                        (li, slots[a], 0, starts[a], 0),
+                    ),
+                    "s": jax.lax.dynamic_update_slice(
+                        cr_all["s"], rq["s"][a][None, None, None],
+                        (li, slots[a], 0, starts[a]),
+                    ),
+                }
+        else:
+            for a in range(A):
+                cc_all = jax.lax.dynamic_update_slice(
+                    cc_all, c[a][None, None, None].astype(cc_all.dtype),
+                    (li, slots[a], 0, starts[a], 0),
+                )
+                cr_all = jax.lax.dynamic_update_slice(
+                    cr_all, kr[a][None, None, None].astype(cr_all.dtype),
+                    (li, slots[a], 0, starts[a], 0),
+                )
+        return (h, cc_all, cr_all, li + 1), None
+
+    carry = (h, cache_c, cache_r, jnp.int32(0))
+    if "dense_layers" in params:
+        # DeepSeek first-dense prologue; carried li keeps cache rows aligned
+        # with absolute layer position
+        carry, _ = jax.lax.scan(layer, carry, params["dense_layers"])
+    (h, new_c, new_r, _), _ = jax.lax.scan(layer, carry, params["layers"])
+    last = jnp.take_along_axis(
+        h, jnp.clip(nvalid - 1, 0, C - 1)[:, None, None], axis=1
+    )[:, 0]  # [A, D]
+    return _logits(cfg, params, last), new_c, new_r
+
+
 def _absorbed_w(lp, h_dtype, R, H, dn, dv):
     """(W_uk [R,H,dn], W_uv [R,H,dv]) from this layer's (possibly int8)
     up-projection — dequantized once per step."""

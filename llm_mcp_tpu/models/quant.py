@@ -247,6 +247,10 @@ def init_llama_params_quantized(
         layers["bq"] = jnp.zeros((L, H * hd), dtype=scale_dtype)
         layers["bk"] = jnp.zeros((L, Hkv * hd), dtype=scale_dtype)
         layers["bv"] = jnp.zeros((L, Hkv * hd), dtype=scale_dtype)
+    if cfg.qk_norm:
+        # Qwen3 per-head q/k RMSNorm weights stay full precision
+        layers["q_norm"] = jnp.ones((L, hd), dtype=scale_dtype)
+        layers["k_norm"] = jnp.ones((L, hd), dtype=scale_dtype)
     if cfg.post_norms:
         layers["post_attn_norm"] = norm_init
         layers["post_ffn_norm"] = norm_init

@@ -165,6 +165,12 @@ def _layer_map(cfg: ModelConfig) -> list[tuple[str, str, bool]]:
             ("bk", "self_attn.k_proj.bias", False),
             ("bv", "self_attn.v_proj.bias", False),
         ]
+    if cfg.qk_norm:
+        # Qwen3: per-head q/k RMSNorm weights, [head_dim] per layer
+        m += [
+            ("q_norm", "self_attn.q_norm.weight", False),
+            ("k_norm", "self_attn.k_norm.weight", False),
+        ]
     return m
 
 

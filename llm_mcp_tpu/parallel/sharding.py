@@ -83,6 +83,10 @@ def llama_param_specs(cfg: ModelConfig) -> dict[str, Any]:
     if cfg.qkv_bias:
         # biases follow their projection's output sharding
         layers.update({"bq": P(None, "tp"), "bk": P(None, "tp"), "bv": P(None, "tp")})
+    if cfg.qk_norm:
+        # per-head norm weights are [L, hd] — every tp shard applies the
+        # same head-local norm, so they replicate
+        layers.update({"q_norm": P(None, None), "k_norm": P(None, None)})
     if cfg.post_norms:
         layers.update(
             {"post_attn_norm": P(None, None), "post_ffn_norm": P(None, None)}

@@ -570,3 +570,96 @@ def test_soak_churn_parity():
     finally:
         full.shutdown()
         plain.shutdown()
+
+
+def test_pipelined_decode_depth_parity(monkeypatch):
+    """Depth-2/3 pipelined decode (device token ring, optimistic lengths,
+    slot-reuse cooling) is token-for-token the depth-1 engine under greedy:
+    sequential AND concurrent mixed-length requests, slot churn included."""
+    import concurrent.futures as cf
+
+    kw = dict(
+        max_slots=4, max_seq_len=96, dtype=jnp.float32, decode_chunk=4,
+        admit_batch=2, seed=5,
+    )
+    monkeypatch.setenv("TPU_PIPELINE_DEPTH", "1")
+    ref = GenerationEngine("tiny-llm", **kw).start()
+    try:
+        cases = [(f"pipe {i} " * (1 + i % 4), 2 + i % 6) for i in range(12)]
+        want = [ref.generate(p, max_tokens=n, temperature=0.0)["text"]
+                for p, n in cases]
+    finally:
+        ref.shutdown()
+    for depth in ("2", "3"):
+        monkeypatch.setenv("TPU_PIPELINE_DEPTH", depth)
+        eng = GenerationEngine("tiny-llm", **kw).start()
+        try:
+            assert eng.pipeline_depth == int(depth)
+            got = [eng.generate(p, max_tokens=n, temperature=0.0)["text"]
+                   for p, n in cases]
+            assert got == want, f"sequential parity at depth {depth}"
+            with cf.ThreadPoolExecutor(max_workers=len(cases)) as ex:
+                conc = list(ex.map(
+                    lambda i: eng.generate(
+                        cases[i][0], max_tokens=cases[i][1], temperature=0.0
+                    )["text"],
+                    range(len(cases)),
+                ))
+            assert conc == want, f"concurrent parity at depth {depth}"
+            assert eng.total_errors == 0
+        finally:
+            eng.shutdown()
+
+
+def test_pipelined_seq_cap_finishes(monkeypatch):
+    """At depth 2, rows that reach the context cap mid-pipeline still
+    finish with reason 'length' (the dispatch filter + fast-scan cap rule
+    leave no dangling active row)."""
+    monkeypatch.setenv("TPU_PIPELINE_DEPTH", "2")
+    eng = GenerationEngine(
+        "tiny-llm", max_slots=2, max_seq_len=32, dtype=jnp.float32,
+        decode_chunk=4,
+    ).start()
+    try:
+        out = eng.generate("fill the window " * 4, max_tokens=512,
+                           temperature=0.0)
+        assert out["finish_reason"] == "length"
+        assert out["usage"]["completion_tokens"] >= 1
+        # engine stays serviceable after cap finishes (slots uncooled)
+        again = eng.generate("after cap", max_tokens=4, temperature=0.0)
+        assert again["usage"]["completion_tokens"] >= 1
+    finally:
+        eng.shutdown()
+
+
+def test_pipelined_compact_cap_churn(monkeypatch):
+    """Compact dispatch under the pipelined loop when every slot is
+    occupied and some rows sit at the context cap awaiting their fetch:
+    the pad-row search must find a safe non-dispatched target (review
+    regression: it used to StopIteration and error every live stream)."""
+    import concurrent.futures as cf
+
+    monkeypatch.setenv("TPU_PIPELINE_DEPTH", "2")
+    eng = GenerationEngine(
+        "tiny-llm", max_slots=16, max_seq_len=32, dtype=jnp.float32,
+        decode_chunk=4, kv_quant="int8", decode_compact="on",
+        admit_batch=8,
+    ).start()
+    try:
+        # staggered prompt lengths -> rows reach the cap on different
+        # rounds, so occupied-at-cap and still-active rows coexist
+        cases = ["w " * (3 + i) for i in range(16)]
+        with cf.ThreadPoolExecutor(max_workers=16) as ex:
+            outs = list(ex.map(
+                lambda p: eng.generate(p, max_tokens=512, temperature=0.0),
+                cases,
+            ))
+        assert all(o["finish_reason"] == "length" for o in outs), [
+            o["finish_reason"] for o in outs
+        ]
+        assert eng.total_errors == 0
+        # engine remains serviceable afterwards
+        again = eng.generate("post churn", max_tokens=3, temperature=0.0)
+        assert again["usage"]["completion_tokens"] >= 1
+    finally:
+        eng.shutdown()

@@ -19,7 +19,7 @@ from llm_mcp_tpu.models.llama import (
     llama_prefill,
 )
 
-FAMILIES = ["tiny-qwen", "tiny-mistral", "tiny-gemma"]
+FAMILIES = ["tiny-qwen", "tiny-qwen3", "tiny-mistral", "tiny-gemma"]
 
 
 @pytest.fixture(scope="module", params=FAMILIES)
@@ -174,3 +174,65 @@ def test_deepseek_r1_distill_configs():
     assert get_config("deepseek-r1:7b").name == "qwen2.5-7b"
     with pytest.raises(KeyError):
         get_config("deepseek-r1:14b")
+
+
+def test_qwen3_qk_norm_params_exist_and_matter():
+    """qk_norm (Qwen3): per-head RMSNorm weights exist, apply pre-rope in
+    every path, and perturbing them moves the logits."""
+    cfg = get_config("tiny-qwen3")
+    assert cfg.resolved_head_dim == 64 and cfg.dim // cfg.n_heads == 32
+    params = init_llama_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    assert set(params["layers"]) >= {"q_norm", "k_norm"}
+    assert "bq" not in params["layers"]  # qwen3 dropped the qwen2 biases
+    prompt = jnp.array([[7, 9, 11]], dtype=jnp.int32)
+    lens = jnp.array([3], dtype=jnp.int32)
+    base, _, _ = llama_prefill(cfg, params, prompt, lens)
+    bumped = dict(params)
+    bumped["layers"] = dict(
+        params["layers"], k_norm=params["layers"]["k_norm"] * 3.0
+    )
+    out, _, _ = llama_prefill(cfg, bumped, prompt, lens)
+    assert float(jnp.max(jnp.abs(out - base))) > 1e-4
+
+
+def test_qwen3_hf_config_inferred():
+    """A Qwen3-style config.json maps to qk_norm=True with the explicit
+    head_dim (decoupled from dim // n_heads below 8B)."""
+    from llm_mcp_tpu.models.configs import config_from_hf
+
+    cfg = config_from_hf(
+        {
+            "model_type": "qwen3",
+            "vocab_size": 512,
+            "hidden_size": 128,
+            "num_hidden_layers": 2,
+            "num_attention_heads": 4,
+            "num_key_value_heads": 2,
+            "intermediate_size": 256,
+            "head_dim": 64,
+            "rope_theta": 1000000.0,
+            "rms_norm_eps": 1e-6,
+            "max_position_embeddings": 4096,
+            "tie_word_embeddings": True,
+        },
+        name="qwen3-test",
+    )
+    assert cfg.qk_norm and not cfg.qkv_bias
+    assert cfg.resolved_head_dim == 64
+    assert cfg.rope_theta == 1000000.0
+
+
+def test_engine_serves_qwen3():
+    from llm_mcp_tpu.executor import GenerationEngine
+
+    eng = GenerationEngine(
+        "tiny-qwen3", max_slots=2, max_seq_len=64, dtype=jnp.float32,
+        decode_chunk=2, quant="int8", kv_quant="int8",
+    ).start()
+    try:
+        a = eng.generate("qwen3 qk norm", max_tokens=6, temperature=0.0)
+        b = eng.generate("qwen3 qk norm", max_tokens=6, temperature=0.0)
+        assert a["text"] == b["text"]
+        assert a["usage"]["completion_tokens"] >= 1
+    finally:
+        eng.shutdown()
