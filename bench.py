@@ -591,8 +591,9 @@ def main() -> None:
                 gc.collect()
                 # int8 LATENTS at serving shapes: S=2048 fits the whole-S
                 # s8-MXU MLA kernel (decode_attend_q8_mla) — this sweep is
-                # its on-hardware evidence (the 32k sweep above exceeds the
-                # kernel's VMEM budget and stays on the XLA path)
+                # its on-hardware evidence (the 32k sweep above runs bf16
+                # latents on the XLA absorbed path; int8 latents at 32k
+                # would take the BLOCKED s8 kernel)
                 try:
                     mk = round(
                         raw_decode_tps("mla-8b", 32, 2048, 32, rounds=2,
