@@ -65,7 +65,7 @@ def main() -> None:
             emodel,
             max_seq_len=min(cfg.tpu_max_seq_len, 8192),
             dtype=jnp.bfloat16,
-            weights_dir=cfg.tpu_weights_dir,
+            weights_dir=cfg.tpu_embed_weights_dir,
             quant=cfg.tpu_embed_quant,
         )
 

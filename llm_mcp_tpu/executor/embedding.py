@@ -16,9 +16,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.configs import ModelConfig, get_config
+from ..models.configs import ModelConfig, resolve_config
 from ..models.embedder import init_embedder_params, embed_forward
-from ..parallel.sharding import embedder_param_specs, shard_pytree
+from ..parallel.sharding import (
+    embedder_param_specs,
+    llama_param_specs,
+    shard_pytree,
+)
 from .common import pow2_bucket
 from .tokenizer import Tokenizer, load_tokenizer
 
@@ -38,17 +42,47 @@ class EmbeddingEngine:
         weights_dir: str = "",
         quant: str = "",
     ):
-        # catalog-only resolution: config_from_hf infers DECODER families;
-        # encoder checkpoints (nomic_bert, qwen3 embedders) would either
-        # warn-spam or silently get a decoder config — until encoder
-        # inference exists, the name catalog is the single source of truth
-        self.cfg = get_config(model) if isinstance(model, str) else model
+        # a config.json beside the weights is authoritative, exactly as for
+        # GenerationEngine. Two architectures serve embeddings:
+        #   arch="encoder"  — bidirectional mean/cls pooling
+        #                     (models/embedder.py; nomic-class)
+        #   decoder configs — causal LM with last-token pooling
+        #                     (models/llama.py:llama_encode; Qwen3-Embedding
+        #                     checkpoints are Qwen3ForCausalLM, so their
+        #                     config.json resolves here and real safetensors
+        #                     load through the ordinary decoder mapping)
+        self.cfg = resolve_config(model, weights_dir) if isinstance(model, str) else model
+        self.decoder_arch = self.cfg.arch != "encoder"
         self.mesh = mesh
         self.max_batch = max_batch
         self.max_seq_len = max_seq_len
         self.tokenizer: Tokenizer = tokenizer or load_tokenizer(weights_dir)
 
-        if params is None:
+        if self.decoder_arch:
+            from ..models import init_llama_params
+            from ..models.weights import load_llama_checkpoint
+            from .engine import _has_safetensors
+
+            if params is None and _has_safetensors(weights_dir):
+                params = load_llama_checkpoint(
+                    self.cfg, weights_dir, dtype=dtype, mesh=mesh
+                )
+            elif params is None:
+                if quant == "int8":
+                    from ..models.quant import init_llama_params_quantized
+
+                    params = init_llama_params_quantized(
+                        self.cfg, jax.random.PRNGKey(seed), scale_dtype=dtype
+                    )
+                else:
+                    params = init_llama_params(
+                        self.cfg, jax.random.PRNGKey(seed), dtype=dtype
+                    )
+            if quant == "int8":
+                from ..models.quant import quantize_params
+
+                params = quantize_params(params)  # no-op on int8 trees
+        elif params is None:
             if quant == "int8":
                 # direct int8 init: an 8B-class embedder's bf16 tree
                 # (~15 GB) never fits beside activations on a 16 GB chip
@@ -66,7 +100,11 @@ class EmbeddingEngine:
 
             params = quantize_params(params)
         if mesh is not None:
-            specs = embedder_param_specs(self.cfg)
+            specs = (
+                llama_param_specs(self.cfg)
+                if self.decoder_arch
+                else embedder_param_specs(self.cfg)
+            )
             if quant == "int8":
                 # {"q","s"} leaves need the quantized spec shape (the same
                 # step GenerationEngine takes before sharding int8 trees)
@@ -78,9 +116,18 @@ class EmbeddingEngine:
 
         cfg = self.cfg
 
-        @jax.jit
-        def fwd(params, tokens, lengths):
-            return embed_forward(cfg, params, tokens, lengths)
+        if self.decoder_arch:
+            from ..models.llama import llama_encode
+
+            @jax.jit
+            def fwd(params, tokens, lengths):
+                return llama_encode(cfg, params, tokens, lengths)
+
+        else:
+
+            @jax.jit
+            def fwd(params, tokens, lengths):
+                return embed_forward(cfg, params, tokens, lengths)
 
         self._fwd = fwd
         self._lock = threading.Lock()

@@ -542,18 +542,25 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         embed_dim=768,
         params_b=0.137,
     ),
+    # Qwen3-Embedding-8B is architecturally a Qwen3 CAUSAL LM (HF exports
+    # Qwen3ForCausalLM) pooled at the last token — it serves through
+    # EmbeddingEngine's decoder path (models/llama.py:llama_encode), so real
+    # safetensors load via the ordinary qwen3 weights mapping.
     "qwen3-embedding-8b": ModelConfig(
         name="qwen3-embedding-8b",
-        arch="encoder",
         vocab_size=151_936,
         dim=4096,
         n_layers=36,
         n_heads=32,
         n_kv_heads=8,
         ffn_hidden=12_288,
+        head_dim=128,
         rope_theta=1_000_000.0,
+        norm_eps=1e-6,
         max_seq_len=32_768,
-        pooling="mean",
+        qk_norm=True,
+        tie_embeddings=True,  # encoding never touches a head
+        pooling="last",
         embed_dim=4096,
         params_b=7.57,
     ),

@@ -408,6 +408,38 @@ def llama_prefill(
     return _logits(cfg, params, last), ks, vs
 
 
+def llama_encode(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,  # [B, S] int32 right-padded
+    lengths: jnp.ndarray,  # [B] int32 true lengths
+    attn_impl: str = "xla",
+) -> jnp.ndarray:
+    """The causal decoder run as a TEXT ENCODER: hidden state at each
+    sequence's last valid position, final-normed and L2-normalized —
+    [B, D] unit vectors. This is how decoder-architecture embedding models
+    (Qwen3-Embedding: a Qwen3 causal LM with last-token pooling) serve
+    through EmbeddingEngine; the bidirectional mean/cls-pooling families
+    stay on models/embedder.py. The reference only reaches any embedder
+    through Ollama's /api/embed proxy (handlers.go:1942-2015)."""
+    h = _embed_in(cfg, params, tokens)  # [B, S, D]
+    cos, sin, mask = prefill_masks(cfg, tokens.shape[1], lengths)
+
+    def layer(h, xs):
+        lp, win = xs
+        h, _ = prefill_layer(
+            cfg, lp, h, cos, sin, mask, lengths, attn_impl, window=win
+        )
+        return h, None
+
+    h, _ = jax.lax.scan(layer, h, (params["layers"], layer_windows(cfg)))
+    last = jnp.take_along_axis(
+        h, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]  # [B, D]
+    e = _norm(cfg, last, params["final_norm"]).astype(jnp.float32)
+    return e / jnp.maximum(jnp.linalg.norm(e, axis=-1, keepdims=True), 1e-9)
+
+
 def _decode_step_q8(
     cfg: ModelConfig,
     params: Params,

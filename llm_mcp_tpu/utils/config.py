@@ -109,6 +109,13 @@ class Config:
     # "" | int8 — 8B-class embedders (qwen3-embedding-8b) only fit 16 GB int8
     tpu_embed_quant: str = field(default_factory=lambda: getenv("TPU_EMBED_QUANT", ""))
     tpu_weights_dir: str = field(default_factory=lambda: getenv("TPU_WEIGHTS_DIR", ""))
+    # the embed model's OWN checkpoint dir — a config.json beside weights is
+    # authoritative per engine, so the generator's dir must never leak into
+    # the embedder's config resolution (decoder-architecture embedders like
+    # qwen3-embedding load real safetensors through this)
+    tpu_embed_weights_dir: str = field(
+        default_factory=lambda: getenv("TPU_EMBED_WEIGHTS_DIR", "")
+    )
     # 32 fits the default llama-3.1-8b KV cache alongside its weights on one
     # chip; for 1B-class models TPU_MAX_SLOTS=64 is the measured throughput
     # optimum (bench.py sweep — larger hits an XLA full-cache-copy cliff).

@@ -60,7 +60,7 @@ def main() -> None:
             cfg.tpu_embed_model,
             max_seq_len=min(cfg.tpu_max_seq_len, 8192),
             dtype=jnp.bfloat16,
-            weights_dir=cfg.tpu_weights_dir,
+            weights_dir=cfg.tpu_embed_weights_dir,
             quant=cfg.tpu_embed_quant,
         )
 

@@ -179,3 +179,54 @@ def test_prefill_chunk_int8_cache(params):
     a, b = np.asarray(logits[0]), np.asarray(full_logits[0])
     assert np.argmax(a) == np.argmax(b)  # greedy token survives quantization
     np.testing.assert_allclose(a, b, rtol=0.1, atol=0.35)
+
+
+def test_llama_encode_decoder_embedding():
+    """The causal decoder as a text encoder (Qwen3-Embedding style): unit
+    vectors, padding-invariant, last-token sensitive."""
+    from llm_mcp_tpu.models.llama import llama_encode
+
+    cfg = get_config("tiny-qwen3")
+    p = init_llama_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    toks = jax.random.randint(jax.random.PRNGKey(4), (2, 8), 3, cfg.vocab_size)
+    lens = jnp.array([8, 5], dtype=jnp.int32)
+    out = llama_encode(cfg, p, toks, lens)
+    assert out.shape == (2, cfg.dim)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1), 1.0, rtol=1e-5
+    )
+    # junk in the padded tail must not move row 1's vector
+    out2 = llama_encode(cfg, p, toks.at[1, 5:].set(9), lens)
+    np.testing.assert_allclose(
+        np.asarray(out[1]), np.asarray(out2[1]), rtol=1e-4, atol=1e-5
+    )
+    # changing the LAST valid token must move it (last-token pooling)
+    out3 = llama_encode(
+        cfg, p, toks.at[1, 4].set((int(toks[1, 4]) + 1) % cfg.vocab_size), lens
+    )
+    assert float(np.abs(np.asarray(out3[1]) - np.asarray(out[1])).max()) > 1e-4
+
+
+def test_embedding_engine_decoder_arch():
+    """EmbeddingEngine serves decoder configs through llama_encode (incl.
+    int8), with Matryoshka truncation renormalized."""
+    from llm_mcp_tpu.executor import EmbeddingEngine
+
+    eng = EmbeddingEngine(
+        "tiny-qwen3", max_batch=4, max_seq_len=64, dtype=jnp.float32
+    )
+    assert eng.decoder_arch
+    vecs, ntok = eng.embed(["decoder embedding one", "two"], dimensions=32)
+    assert len(vecs) == 2 and len(vecs[0]) == 32 and ntok > 0
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=-1), 1.0, rtol=1e-5)
+    # quantize the SAME weights (a fresh int8 init would be a different
+    # random model): int8 must track the f32 vector closely (w8a8 bound)
+    q = EmbeddingEngine(
+        "tiny-qwen3", max_batch=2, max_seq_len=64, dtype=jnp.float32,
+        quant="int8", params=eng.params,
+    )
+    vq, _ = q.embed(["decoder embedding one"])
+    assert len(vq[0]) == eng.cfg.dim
+    vf, _ = eng.embed(["decoder embedding one"])
+    cos = float(np.dot(vq[0], vf[0]))
+    assert cos > 0.98, cos
