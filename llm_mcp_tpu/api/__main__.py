@@ -60,6 +60,7 @@ def main() -> None:
             prefill_buckets=cfg.tpu_prefill_buckets,
         ).start()
         emodel = cfg.tpu_embed_model
+        cfg.warn_embed_dir_gap(log)
         log.info("loading embedding engine: %s", emodel)
         embed_engines[emodel] = EmbeddingEngine(
             emodel,

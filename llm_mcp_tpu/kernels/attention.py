@@ -1088,7 +1088,7 @@ def decode_attend_q8_mla(
     # blocked path: BS must divide S (a floored trip count would drop the
     # tail — including the current position)
     BS = next((c for c in (512, 256, 128) if S % c == 0), 0)
-    if not _HAS_PLTPU or (not interp and (R % 128 != 0 or (not fits and BS == 0))):
+    if not _HAS_PLTPU or (not fits and BS == 0) or (not interp and R % 128 != 0):
         return _decode_attend_q8_mla_fallback(
             qt, qr, new_c, new_r, cache_c, cache_r, layer, lengths, scale, slot_ids
         )

@@ -139,6 +139,20 @@ class Config:
     def has_openrouter(self) -> bool:
         return bool(self.openrouter_api_key)
 
+    def warn_embed_dir_gap(self, log) -> None:
+        """Deployments that set only TPU_WEIGHTS_DIR: the generator's dir
+        deliberately does NOT leak into the embedder (its config.json would
+        be authoritative for the wrong model), but the resulting silent
+        byte-tokenizer fallback changes embedding outputs — say it out loud
+        at every serving entrypoint."""
+        if not self.tpu_embed_weights_dir and self.tpu_weights_dir:
+            log.warning(
+                "TPU_EMBED_WEIGHTS_DIR is unset while TPU_WEIGHTS_DIR=%s: "
+                "embedder %s has no checkpoint dir and will use the byte "
+                "tokenizer; set TPU_EMBED_WEIGHTS_DIR to its weights dir",
+                self.tpu_weights_dir, self.tpu_embed_model,
+            )
+
 
 def enable_compile_cache(path: str | None = None) -> None:
     """Persistent XLA compile cache (serving entrypoints + bench): first 8B

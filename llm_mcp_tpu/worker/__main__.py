@@ -56,6 +56,7 @@ def main() -> None:
             prompt_cache_mb=cfg.tpu_prompt_cache_mb,
             prefill_buckets=cfg.tpu_prefill_buckets,
         ).start()
+        cfg.warn_embed_dir_gap(logging.getLogger("worker"))
         embed_engines[cfg.tpu_embed_model] = EmbeddingEngine(
             cfg.tpu_embed_model,
             max_seq_len=min(cfg.tpu_max_seq_len, 8192),

@@ -546,13 +546,16 @@ def test_mla_blocked_kernel_matches_fallback(monkeypatch):
     """The BLOCKED long-context MLA kernel (manual-DMA double buffering,
     dynamic trip count) matches the exact-f32 fallback — forced via the
     VMEM-fit seam so shapes stay CPU-small while interpret mode emulates
-    the real DMA loop. Lengths cover block boundaries (BS=128 at S=256),
-    the compaction indirection, and a parked row."""
+    the real DMA loop. S=384 forces BS=128 (384 is not divisible by 512
+    or 256), so rows at lens 128/380 stream MULTIPLE blocks — the
+    double-buffered prefetch and cross-block online-softmax accumulation
+    actually execute. Lengths cover block boundaries, the compaction
+    indirection, and a parked row."""
     import llm_mcp_tpu.kernels.attention as A
 
     monkeypatch.setattr(A, "mla_whole_s_fits", lambda *a: False)
     rng = np.random.default_rng(7)
-    L, B, S, R, dr, H = 2, 4, 256, 32, 16, 4
+    L, B, S, R, dr, H = 2, 4, 384, 32, 16, 4
 
     def q8(shape):
         return {
@@ -566,8 +569,9 @@ def test_mla_blocked_kernel_matches_fallback(monkeypatch):
     qr = jnp.asarray(rng.standard_normal((B, H, dr)), jnp.float32)
     nc = jnp.asarray(rng.standard_normal((B, R)), jnp.float32)
     nr = jnp.asarray(rng.standard_normal((B, dr)), jnp.float32)
-    # boundaries: first block, boundary-1, boundary, deep in last block
-    lens = jnp.asarray([0, 127, 128, 250], jnp.int32)
+    # boundaries: first block, boundary-1, boundary (2 blocks), deep in
+    # the third block (3-block dynamic trip count)
+    lens = jnp.asarray([0, 127, 128, 380], jnp.int32)
     for ids in (None, jnp.asarray([3, 1, 0, 2], jnp.int32)):
         out = A.decode_attend_q8_mla(
             qt, qr, nc, nr, cache_c, cache_r, jnp.int32(1), lens,
