@@ -391,6 +391,7 @@ def embed_path_metrics(
             assert len(doc["data"][0]["embedding"]) == dimensions
         return (time.perf_counter() - t0) * 1000.0
 
+    breakdown: dict[str, float] = {}
     try:
         post()  # warm the (batch-bucket, seq-bucket) executable
         post()
@@ -401,6 +402,44 @@ def embed_path_metrics(
             lats.append(post())
             n_embeds += batch
         wall = time.perf_counter() - t0
+        if batch == 1:
+            # Latency budget for the single-input case (VERDICT r4 #5): on a
+            # remote-tunnel chip the dispatch→fetch sync dominates p50 and
+            # is environment, not framework — record the floor (identity
+            # kernel fetch) and the forward's own fetch so the headline
+            # separates wire latency from host work. On locally-attached
+            # TPU the same path is host_ms + device compute (~1 ms).
+            import numpy as np
+
+            # mirror EmbeddingEngine.embed exactly (same bucket, same [SEP]
+            # append) so fwd_fetch_ms times the SAME executable the p50
+            # path dispatched — a different bucket is a different kernel
+            ids = eng.tokenizer.encode(texts[0])[: eng.max_seq_len]
+            eos = getattr(eng.tokenizer, "eos_id", -1)
+            if not eng.decoder_arch and eos is not None and eos >= 0 and (
+                not ids or ids[-1] != eos
+            ):
+                ids = ids[: eng.max_seq_len - 1] + [eos]
+            bucket = eng._bucket(len(ids))
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, : len(ids)] = ids
+            lens = np.asarray([len(ids)], np.int32)
+            np.asarray(eng._fwd(eng.params, toks, lens))  # warm this bucket
+            ident = jax.jit(lambda x: x + 1)
+            z = jnp.zeros((1,), jnp.float32)
+            np.asarray(ident(z))
+            fwd_ms, floor_ms = [], []
+            for _ in range(12):
+                t1 = time.perf_counter()
+                np.asarray(eng._fwd(eng.params, toks, lens))
+                fwd_ms.append((time.perf_counter() - t1) * 1e3)
+                t1 = time.perf_counter()
+                np.asarray(ident(z))
+                floor_ms.append((time.perf_counter() - t1) * 1e3)
+            fwd_p50 = statistics.median(fwd_ms)
+            breakdown["sync_floor_ms"] = statistics.median(floor_ms)
+            breakdown["fwd_fetch_ms"] = fwd_p50
+            breakdown["host_ms"] = max(statistics.median(lats) - fwd_p50, 0.0)
     finally:
         # a failed sweep must not leave the engine's weights resident — the
         # 8B serve headline runs after this on the same 16 GB chip
@@ -411,6 +450,7 @@ def embed_path_metrics(
         "embeds_per_s": n_embeds / wall,
         "p50_ms": statistics.median(lats),
         "n_requests": float(len(lats)),
+        **breakdown,
     }
 
 
@@ -626,6 +666,12 @@ def main() -> None:
                     em["embeds_per_s"], 1
                 )
                 secondary["embed_p50_ms_nomic-embed-text_b1"] = round(em["p50_ms"], 1)
+                if "sync_floor_ms" in em:
+                    # p50 ≈ sync_floor (wire) + host_ms (framework): on the
+                    # tunneled bench chip the floor dominates; the framework
+                    # cost an operator would see on local TPU is host_ms
+                    secondary["embed_b1_sync_floor_ms"] = round(em["sync_floor_ms"], 1)
+                    secondary["embed_b1_host_ms"] = round(em["host_ms"], 1)
             except Exception as e:
                 print(f"# nomic embed sweep failed: {e!r}", flush=True)
                 secondary["embed_nomic_error"] = 0.0

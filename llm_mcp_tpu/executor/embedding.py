@@ -55,6 +55,10 @@ class EmbeddingEngine:
         self.decoder_arch = self.cfg.arch != "encoder"
         self.mesh = mesh
         self.max_batch = max_batch
+        if self.cfg.arch == "encoder" and self.cfg.enc_pos == "learned":
+            # a learned position table has exactly cfg.max_seq_len rows
+            # (BERT: 512) — longer buckets would index past it
+            max_seq_len = min(max_seq_len, self.cfg.max_seq_len)
         self.max_seq_len = max_seq_len
         self.tokenizer: Tokenizer = tokenizer or load_tokenizer(weights_dir)
 
@@ -83,7 +87,22 @@ class EmbeddingEngine:
 
                 params = quantize_params(params)  # no-op on int8 trees
         elif params is None:
-            if quant == "int8":
+            from .engine import _has_safetensors
+
+            if _has_safetensors(weights_dir):
+                # real encoder checkpoint (BERT/nomic naming) — quantize
+                # after load when asked (encoder checkpoints are small
+                # enough to materialize first, unlike the 8B decoder path)
+                from ..models.weights import load_embedder_checkpoint
+
+                params = load_embedder_checkpoint(
+                    self.cfg, weights_dir, dtype=dtype, mesh=None
+                )
+                if quant == "int8":
+                    from ..models.quant import quantize_params
+
+                    params = quantize_params(params)
+            elif quant == "int8":
                 # direct int8 init: an 8B-class embedder's bf16 tree
                 # (~15 GB) never fits beside activations on a 16 GB chip
                 from ..models.embedder import init_embedder_params_quantized
@@ -145,6 +164,14 @@ class EmbeddingEngine:
         if not texts:
             return [], 0
         all_ids = [self.tokenizer.encode(t)[: self.max_seq_len] for t in texts]
+        eos = getattr(self.tokenizer, "eos_id", -1)
+        if not self.decoder_arch and eos is not None and eos >= 0:
+            # BERT-family encoders were trained on [CLS] … [SEP] frames; the
+            # tokenizer wrapper adds [CLS] (bos) but not the trailing [SEP]
+            all_ids = [
+                ids[: self.max_seq_len - 1] + [eos] if (not ids or ids[-1] != eos) else ids
+                for ids in all_ids
+            ]
         total_tokens = sum(len(i) for i in all_ids)
         vectors: list[list[float]] = []
 

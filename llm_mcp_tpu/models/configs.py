@@ -34,6 +34,15 @@ class ModelConfig:
     # encoder-only fields
     pooling: str = "mean"  # mean | cls
     embed_dim: int = 0  # output embedding dim (0 → dim)
+    # encoder (BERT-family) variation knobs — one shared bidirectional
+    # encoder serves nomic/BERT checkpoints the way one decoder serves the
+    # llama families (models/embedder.py honors all of these):
+    enc_norm: str = "rms"  # rms | layer (LayerNorm with learned bias)
+    enc_post_ln: bool = False  # BERT/nomic: post-LN residuals + embedding LN
+    enc_pos: str = "rope"  # rope | learned (absolute position table)
+    enc_gated: bool = True  # gated MLP (SwiGLU); False = fc1→act→fc2 (BERT)
+    enc_bias: bool = False  # biases on attention/MLP linears (classic BERT)
+    type_vocab_size: int = 0  # BERT segment embeddings (segment 0 at inference)
     # family variation knobs (one shared decoder serves all families, the
     # way the reference's one Ollama runtime serves its whole catalog):
     qkv_bias: bool = False  # Qwen2: biases on q/k/v projections
@@ -527,6 +536,9 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         tie_embeddings=True,
         params_b=0.001,
     ),
+    # the published nomic_bert architecture (checkpoint config.json remains
+    # authoritative when a weights dir is given): full-rotary rope, post-LN
+    # LayerNorm, biasless gated SwiGLU, segment embeddings, mean pooling
     "nomic-embed-text": ModelConfig(
         name="nomic-embed-text",
         arch="encoder",
@@ -537,7 +549,13 @@ MODEL_CONFIGS: dict[str, ModelConfig] = {
         n_kv_heads=12,
         ffn_hidden=3072,
         rope_theta=10_000.0,
+        norm_eps=1e-12,
         max_seq_len=8192,
+        enc_norm="layer",
+        enc_post_ln=True,
+        enc_gated=True,
+        enc_bias=False,
+        type_vocab_size=2,
         pooling="mean",
         embed_dim=768,
         params_b=0.137,
@@ -588,6 +606,93 @@ def _compact(s: str) -> str:
     return re.sub(r"[-_.:\s]", "", s.lower())
 
 
+def _encoder_config_from_hf(doc: dict, mt: str, name: str) -> ModelConfig:
+    """Encoder (embedding) families: classic BERT and nomic_bert. The
+    reference serves any embed model an Ollama host carries, inferring kind
+    and metadata for unseen names (`discovery.go:482-560`); here an unseen
+    encoder checkpoint dir becomes servable the same way."""
+    import dataclasses
+
+    if mt == "bert":
+        act = str(doc.get("hidden_act") or "gelu").lower()
+        if act not in ("gelu", "gelu_new", "gelu_pytorch_tanh", "relu", "silu"):
+            # a silently-substituted activation would embed garbage
+            raise ValueError(f"unsupported hidden_act {act!r} for bert")
+        dim = int(doc["hidden_size"])
+        kw = dict(
+            name=name or str(doc.get("_name_or_path") or mt),
+            arch="encoder",
+            vocab_size=int(doc["vocab_size"]),
+            dim=dim,
+            n_layers=int(doc["num_hidden_layers"]),
+            n_heads=int(doc["num_attention_heads"]),
+            n_kv_heads=int(doc["num_attention_heads"]),
+            ffn_hidden=int(doc["intermediate_size"]),
+            norm_eps=float(doc.get("layer_norm_eps") or 1e-12),
+            max_seq_len=int(doc.get("max_position_embeddings") or 512),
+            act=act,
+            enc_norm="layer",
+            enc_post_ln=True,
+            enc_pos="learned",
+            enc_gated=False,
+            enc_bias=True,
+            type_vocab_size=int(doc.get("type_vocab_size") or 0),
+            pooling="mean",
+            embed_dim=dim,
+        )
+    elif mt == "nomic_bert":
+        # GPT-style key names (the nomic_bert config descends from GPT2Config)
+        dim = int(doc.get("n_embd") or doc.get("hidden_size") or 768)
+        n_heads = int(doc.get("n_head") or doc.get("num_attention_heads") or 12)
+        act = str(doc.get("activation_function") or "swiglu").lower()
+        if act not in ("swiglu", "geglu", "silu", "gelu", "gelu_new", "relu"):
+            raise ValueError(f"unsupported activation_function {act!r} for nomic_bert")
+        if bool(doc.get("prenorm", False)):
+            # prenorm nomic needs a final-norm tensor whose checkpoint
+            # naming we have no fixture for — fail loud, don't guess
+            raise ValueError("unsupported nomic_bert prenorm=true (post-LN only)")
+        rot_frac = float(doc.get("rotary_emb_fraction", 1.0) or 0.0)
+        kw = dict(
+            name=name or str(doc.get("_name_or_path") or mt),
+            arch="encoder",
+            vocab_size=int(doc["vocab_size"]),
+            dim=dim,
+            n_layers=int(doc.get("n_layer") or doc.get("num_hidden_layers") or 12),
+            n_heads=n_heads,
+            n_kv_heads=n_heads,
+            ffn_hidden=int(doc.get("n_inner") or doc.get("intermediate_size") or 4 * dim),
+            rope_theta=float(doc.get("rotary_emb_base") or 10_000.0),
+            norm_eps=float(doc.get("layer_norm_epsilon") or 1e-12),
+            max_seq_len=int(doc.get("n_positions") or doc.get("max_position_embeddings") or 2048),
+            # swiglu → silu gate; geglu → gelu gate; plain names pass through
+            act=(
+                "silu" if act in ("swiglu", "silu")
+                else "gelu" if act == "geglu"
+                else act
+            ),
+            enc_norm="layer",
+            # prenorm=False (the nomic default) means post-LN residuals
+            enc_post_ln=not bool(doc.get("prenorm", False)),
+            enc_pos="rope" if rot_frac > 0 else "learned",
+            enc_gated="glu" in act,
+            enc_bias=bool(doc.get("qkv_proj_bias", True)),
+            type_vocab_size=int(doc.get("type_vocab_size") or 0),
+            pooling="mean",
+            embed_dim=dim,
+        )
+        if 0.0 < rot_frac < 1.0:
+            # partial-rotary needs a split rope application the encoder does
+            # not implement — refuse rather than embed garbage
+            raise ValueError(
+                f"unsupported rotary_emb_fraction {rot_frac} for nomic_bert "
+                "(only 0.0 or 1.0)"
+            )
+    else:  # pragma: no cover — dispatcher only sends the two types above
+        raise ValueError(f"unsupported encoder model_type {mt!r}")
+    cfg = ModelConfig(**kw)
+    return dataclasses.replace(cfg, params_b=round(cfg.param_count() / 1e9, 3))
+
+
 def config_from_hf(doc: dict, name: str = "") -> ModelConfig:
     """Build a ModelConfig from an HF checkpoint's config.json dict.
 
@@ -595,12 +700,14 @@ def config_from_hf(doc: dict, name: str = "") -> ModelConfig:
     catalog metadata for names it has never seen
     (`discovery.go:482-560`); this is the in-process analog — an arbitrary
     checkpoint directory becomes servable without a hand-written entry in
-    MODEL_CONFIGS. Covers the implemented decoder families; anything else
-    raises ValueError (a silently-wrong architecture would produce garbage
-    weights-load "successes")."""
+    MODEL_CONFIGS. Covers the implemented decoder families plus the
+    BERT-family encoders; anything else raises ValueError (a silently-wrong
+    architecture would produce garbage weights-load "successes")."""
     import dataclasses
 
     mt = str(doc.get("model_type", "")).lower()
+    if mt in ("bert", "nomic_bert"):
+        return _encoder_config_from_hf(doc, mt, name)
     n_heads = int(doc.get("num_attention_heads", 32))
     kw: dict = dict(
         name=name or str(doc.get("_name_or_path") or mt or "hf-model"),
@@ -689,7 +796,7 @@ def config_from_hf(doc: dict, name: str = "") -> ModelConfig:
         raise ValueError(
             f"unsupported HF model_type {mt!r} "
             "(supported: llama, qwen2, qwen3, mistral, mixtral, gemma2, "
-            "deepseek_v2)"
+            "deepseek_v2, bert, nomic_bert)"
         )
     if rs_type and kw.get("rope_factor", 1.0) <= 1.0 and rs_type != "default":
         # a scaling recipe we did not apply: serving it with plain rope
@@ -700,12 +807,28 @@ def config_from_hf(doc: dict, name: str = "") -> ModelConfig:
 
 
 def config_from_hf_dir(path: str, name: str = "") -> ModelConfig:
-    """`config_from_hf` over a checkpoint directory's config.json."""
+    """`config_from_hf` over a checkpoint directory's config.json. For
+    encoder checkpoints a sentence-transformers `1_Pooling/config.json`
+    beside the weights decides the pooling mode (config.json itself never
+    records it)."""
+    import dataclasses
     import json as _json
     import os as _os
 
     with open(_os.path.join(path, "config.json")) as f:
-        return config_from_hf(_json.load(f), name=name)
+        cfg = config_from_hf(_json.load(f), name=name)
+    pool_path = _os.path.join(path, "1_Pooling", "config.json")
+    if cfg.arch == "encoder" and _os.path.isfile(pool_path):
+        try:
+            with open(pool_path) as f:
+                pdoc = _json.load(f)
+            if pdoc.get("pooling_mode_cls_token"):
+                cfg = dataclasses.replace(cfg, pooling="cls")
+            elif pdoc.get("pooling_mode_mean_tokens"):
+                cfg = dataclasses.replace(cfg, pooling="mean")
+        except Exception:
+            pass  # malformed pooling config: keep the family default
+    return cfg
 
 
 def resolve_config(model, weights_dir: str = "") -> ModelConfig:

@@ -6,6 +6,17 @@ Replaces the reference's delegated Ollama `/api/embed` batch path
 `POST /v1/embeddings` directly from TPU. Same TPU-first conventions as
 models/llama.py: scan over layers, static shapes, bf16 with f32 reductions.
 
+One parameterized encoder serves the BERT families the way one decoder
+serves the llama families (the reference trivially serves any embed model
+an Ollama host carries, `discovery.go:482-560`):
+
+  - nomic-class (`model_type: nomic_bert`): rope, post-LN LayerNorm,
+    gated SwiGLU without linear biases, segment-0 type embeddings
+  - classic BERT (`model_type: bert`): learned absolute positions,
+    post-LN LayerNorm, ungated GELU MLP, biases everywhere
+  - the original TPU-native default: rope + RMSNorm + SwiGLU pre-norm
+    (tiny-embed and random-init benchmarks)
+
 Matryoshka `dimensions` truncation (reference `handlers.go:2063-2078` does
 client-side truncation as a fallback) is exact here: truncate then
 re-normalize — done in the engine so one forward pass serves any requested
@@ -27,31 +38,84 @@ from .quant import embed_lookup, qdot
 Params = dict[str, Any]
 
 
+def _act(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.act == "gelu":
+        # erf-based: HF BERT "gelu" is exact, and the tanh approximation
+        # drifts embeddings enough to matter for cosine-similarity users
+        return jax.nn.gelu(x, approximate=False)
+    if cfg.act in ("gelu_new", "gelu_pytorch_tanh"):
+        return jax.nn.gelu(x, approximate=True)
+    if cfg.act == "relu":
+        return jax.nn.relu(x)
+    if cfg.act == "silu":
+        return jax.nn.silu(x)
+    # config inference validates activations; reaching here means a config
+    # was hand-built with a name this forward does not implement
+    raise ValueError(f"unsupported encoder activation {cfg.act!r}")
+
+
 def init_embedder_params(
     cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
 ) -> Params:
     hd = cfg.resolved_head_dim
     L, D, H, F, V = cfg.n_layers, cfg.dim, cfg.n_heads, cfg.ffn_hidden, cfg.vocab_size
-    keys = jax.random.split(key, 8)
+    keys = jax.random.split(key, 12)
 
     def w(k, shape, fan_in):
         return (jax.random.normal(k, shape, dtype=jnp.float32) * (fan_in**-0.5)).astype(dtype)
 
-    return {
-        "embed": w(keys[0], (V, D), D),
-        "layers": {
-            "attn_norm": jnp.ones((L, D), dtype=dtype),
-            "wq": w(keys[1], (L, D, H * hd), D),
-            "wk": w(keys[2], (L, D, H * hd), D),
-            "wv": w(keys[3], (L, D, H * hd), D),
-            "wo": w(keys[4], (L, H * hd, D), H * hd),
-            "ffn_norm": jnp.ones((L, D), dtype=dtype),
-            "w1": w(keys[5], (L, D, F), D),
-            "w3": w(keys[6], (L, D, F), D),
-            "w2": w(keys[7], (L, F, D), F),
-        },
-        "final_norm": jnp.ones((D,), dtype=dtype),
+    layers: Params = {
+        "attn_norm": jnp.ones((L, D), dtype=dtype),
+        "wq": w(keys[1], (L, D, H * hd), D),
+        "wk": w(keys[2], (L, D, H * hd), D),
+        "wv": w(keys[3], (L, D, H * hd), D),
+        "wo": w(keys[4], (L, H * hd, D), H * hd),
+        "ffn_norm": jnp.ones((L, D), dtype=dtype),
+        "w1": w(keys[5], (L, D, F), D),
+        "w2": w(keys[7], (L, F, D), F),
     }
+    if cfg.enc_gated:
+        layers["w3"] = w(keys[6], (L, D, F), D)
+    if cfg.enc_norm == "layer":
+        layers["attn_norm_b"] = jnp.zeros((L, D), dtype=dtype)
+        layers["ffn_norm_b"] = jnp.zeros((L, D), dtype=dtype)
+    if cfg.enc_bias:
+        layers["bq"] = jnp.zeros((L, H * hd), dtype=dtype)
+        layers["bk"] = jnp.zeros((L, H * hd), dtype=dtype)
+        layers["bv"] = jnp.zeros((L, H * hd), dtype=dtype)
+        layers["bo"] = jnp.zeros((L, D), dtype=dtype)
+        layers["b1"] = jnp.zeros((L, F), dtype=dtype)
+        layers["b2"] = jnp.zeros((L, D), dtype=dtype)
+        if cfg.enc_gated:
+            layers["b3"] = jnp.zeros((L, F), dtype=dtype)
+
+    params: Params = {"embed": w(keys[0], (V, D), D), "layers": layers}
+    if cfg.enc_pos == "learned":
+        params["pos_embed"] = w(keys[8], (cfg.max_seq_len, D), D)
+    if cfg.type_vocab_size:
+        params["type_embed"] = w(keys[9], (cfg.type_vocab_size, D), D)
+    if cfg.enc_post_ln:
+        # post-LN stacks normalize AFTER embeddings and inside each block;
+        # there is no final norm
+        params["embed_norm"] = jnp.ones((D,), dtype=dtype)
+        if cfg.enc_norm == "layer":
+            params["embed_norm_b"] = jnp.zeros((D,), dtype=dtype)
+    else:
+        params["final_norm"] = jnp.ones((D,), dtype=dtype)
+    return params
+
+
+def _norm(cfg: ModelConfig, x: jnp.ndarray, w: jnp.ndarray, b) -> jnp.ndarray:
+    if cfg.enc_norm == "layer":
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(axis=-1, keepdims=True)
+        var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
+        out = (xf - mu) * jax.lax.rsqrt(var + cfg.norm_eps)
+        out = out * w.astype(jnp.float32)
+        if b is not None:
+            out = out + b.astype(jnp.float32)
+        return out.astype(x.dtype)
+    return _rms_norm(x, w, cfg.norm_eps)
 
 
 def embed_forward(
@@ -66,37 +130,69 @@ def embed_forward(
     H = cfg.n_heads
 
     h = embed_lookup(params["embed"], tokens)
-    positions = jnp.arange(S, dtype=jnp.int32)[None, :]
-    cos, sin = rope_tables(cfg, hd, positions)
+    if cfg.enc_pos == "learned":
+        h = h + params["pos_embed"][:S][None, :, :].astype(h.dtype)
+    if cfg.type_vocab_size:
+        h = h + params["type_embed"][0][None, None, :].astype(h.dtype)  # segment 0
+    if cfg.enc_post_ln:
+        h = _norm(cfg, h, params["embed_norm"], params.get("embed_norm_b"))
+
+    use_rope = cfg.enc_pos == "rope"
+    if use_rope:
+        positions = jnp.arange(S, dtype=jnp.int32)[None, :]
+        cos, sin = rope_tables(cfg, hd, positions)
 
     valid = jnp.arange(S)[None, :] < lengths[:, None]  # [B, S]
     mask = valid[:, None, :]  # [B, 1(q), S(k)] — bidirectional, pad-masked
     neg = jnp.float32(-1e30)
 
-    def layer(h, lp):
-        # qdot keeps int8 weight trees transparent (w8a8 on the MXU) — the
-        # 8B-class embedder only fits a 16 GB chip quantized
-        x = _rms_norm(h, lp["attn_norm"], cfg.norm_eps)
-        q = qdot(x, lp["wq"]).reshape(B, S, H, hd)
-        k = qdot(x, lp["wk"]).reshape(B, S, H, hd)
-        v = qdot(x, lp["wv"]).reshape(B, S, H, hd)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+    def bias(x, lp, k):
+        return x + lp[k].astype(x.dtype) if cfg.enc_bias else x
 
+    def attn(x, lp):
+        """Attention sublayer; residual/norm order is decided by the caller
+        (pre-norm vs post-LN)."""
+        q = bias(qdot(x, lp["wq"]), lp, "bq").reshape(B, S, H, hd)
+        k = bias(qdot(x, lp["wk"]), lp, "bk").reshape(B, S, H, hd)
+        v = bias(qdot(x, lp["wv"]), lp, "bv").reshape(B, S, H, hd)
+        if use_rope:
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * (hd**-0.5)
         scores = jnp.where(mask[:, None, :, :], scores, neg)
-        probs = jax.nn.softmax(scores, axis=-1).astype(h.dtype)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, H * hd)
-        h = h + qdot(ctx, lp["wo"])
+        return bias(qdot(ctx, lp["wo"]), lp, "bo")
 
-        x = _rms_norm(h, lp["ffn_norm"], cfg.norm_eps)
-        gate = jax.nn.silu(qdot(x, lp["w1"]))
-        up = qdot(x, lp["w3"])
-        h = h + qdot(gate * up, lp["w2"])
-        return h, None
+    def mlp(x, lp):
+        up = bias(qdot(x, lp["w1"]), lp, "b1")
+        if cfg.enc_gated:
+            up = _act(cfg, up) * bias(qdot(x, lp["w3"]), lp, "b3")
+        else:
+            up = _act(cfg, up)
+        return bias(qdot(up, lp["w2"]), lp, "b2")
+
+    if cfg.enc_post_ln:
+
+        def layer(h, lp):
+            h = _norm(cfg, h + attn(h, lp), lp["attn_norm"], lp.get("attn_norm_b"))
+            h = _norm(cfg, h + mlp(h, lp), lp["ffn_norm"], lp.get("ffn_norm_b"))
+            return h, None
+
+    else:
+
+        def layer(h, lp):
+            x = _norm(cfg, h, lp["attn_norm"], lp.get("attn_norm_b"))
+            h = h + attn(x, lp)
+            x = _norm(cfg, h, lp["ffn_norm"], lp.get("ffn_norm_b"))
+            h = h + mlp(x, lp)
+            return h, None
 
     h, _ = jax.lax.scan(layer, h, params["layers"])
-    h = _rms_norm(h, params["final_norm"], cfg.norm_eps).astype(jnp.float32)
+    if cfg.enc_post_ln:
+        h = h.astype(jnp.float32)
+    else:
+        h = _norm(cfg, h, params["final_norm"], None).astype(jnp.float32)
 
     if cfg.pooling == "cls":
         pooled = h[:, 0]
@@ -113,7 +209,8 @@ def init_embedder_params_quantized(
     """Random-init the encoder tree DIRECTLY in int8-quantized form — the
     bf16 tree of an 8B-class embedder (~15 GB) never materializes on a
     16 GB chip (same scheme as quant.py:init_llama_params_quantized:
-    uniform int8 payloads, fan_in**-0.5 / 73.3 per-output-channel scales)."""
+    uniform int8 payloads, fan_in**-0.5 / 73.3 per-output-channel scales).
+    Biases and norms stay in `scale_dtype` (qdot quantizes matmuls only)."""
     from .quant import qw_random
 
     hd = cfg.resolved_head_dim
@@ -124,18 +221,43 @@ def init_embedder_params_quantized(
     def qw(shape, fan_in, scale_axes):
         return qw_random(next(kit), shape, fan_in, scale_axes, scale_dtype)
 
-    return {
-        "embed": qw((V, D), D, (V,)),  # per-row scales (embed_lookup contract)
-        "layers": {
-            "attn_norm": jnp.ones((L, D), dtype=scale_dtype),
-            "wq": qw((L, D, H * hd), D, (L, H * hd)),
-            "wk": qw((L, D, H * hd), D, (L, H * hd)),
-            "wv": qw((L, D, H * hd), D, (L, H * hd)),
-            "wo": qw((L, H * hd, D), H * hd, (L, D)),
-            "ffn_norm": jnp.ones((L, D), dtype=scale_dtype),
-            "w1": qw((L, D, F), D, (L, F)),
-            "w3": qw((L, D, F), D, (L, F)),
-            "w2": qw((L, F, D), F, (L, D)),
-        },
-        "final_norm": jnp.ones((D,), dtype=scale_dtype),
+    layers: Params = {
+        "attn_norm": jnp.ones((L, D), dtype=scale_dtype),
+        "wq": qw((L, D, H * hd), D, (L, H * hd)),
+        "wk": qw((L, D, H * hd), D, (L, H * hd)),
+        "wv": qw((L, D, H * hd), D, (L, H * hd)),
+        "wo": qw((L, H * hd, D), H * hd, (L, D)),
+        "ffn_norm": jnp.ones((L, D), dtype=scale_dtype),
+        "w1": qw((L, D, F), D, (L, F)),
+        "w2": qw((L, F, D), F, (L, D)),
     }
+    if cfg.enc_gated:
+        layers["w3"] = qw((L, D, F), D, (L, F))
+    if cfg.enc_norm == "layer":
+        layers["attn_norm_b"] = jnp.zeros((L, D), dtype=scale_dtype)
+        layers["ffn_norm_b"] = jnp.zeros((L, D), dtype=scale_dtype)
+    if cfg.enc_bias:
+        layers["bq"] = jnp.zeros((L, H * hd), dtype=scale_dtype)
+        layers["bk"] = jnp.zeros((L, H * hd), dtype=scale_dtype)
+        layers["bv"] = jnp.zeros((L, H * hd), dtype=scale_dtype)
+        layers["bo"] = jnp.zeros((L, D), dtype=scale_dtype)
+        layers["b1"] = jnp.zeros((L, F), dtype=scale_dtype)
+        layers["b2"] = jnp.zeros((L, D), dtype=scale_dtype)
+        if cfg.enc_gated:
+            layers["b3"] = jnp.zeros((L, F), dtype=scale_dtype)
+
+    params: Params = {
+        "embed": qw((V, D), D, (V,)),  # per-row scales (embed_lookup contract)
+        "layers": layers,
+    }
+    if cfg.enc_pos == "learned":
+        params["pos_embed"] = jnp.zeros((cfg.max_seq_len, D), dtype=scale_dtype)
+    if cfg.type_vocab_size:
+        params["type_embed"] = jnp.zeros((cfg.type_vocab_size, D), dtype=scale_dtype)
+    if cfg.enc_post_ln:
+        params["embed_norm"] = jnp.ones((D,), dtype=scale_dtype)
+        if cfg.enc_norm == "layer":
+            params["embed_norm_b"] = jnp.zeros((D,), dtype=scale_dtype)
+    else:
+        params["final_norm"] = jnp.ones((D,), dtype=scale_dtype)
+    return params

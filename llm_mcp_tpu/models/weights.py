@@ -439,6 +439,194 @@ def llama_to_hf_tensors(
 
 
 # ---------------------------------------------------------------------------
+# HF encoder-family (BERT/nomic) name mapping → stacked scan layout
+# ---------------------------------------------------------------------------
+
+# (our key, HF layer suffix, transpose?) for classic BERT checkpoints
+# (google-bert/*, sentence-transformers exports; optional "bert." prefix).
+_BERT_LAYER_MAP = [
+    ("wq", "attention.self.query.weight", True),
+    ("bq", "attention.self.query.bias", False),
+    ("wk", "attention.self.key.weight", True),
+    ("bk", "attention.self.key.bias", False),
+    ("wv", "attention.self.value.weight", True),
+    ("bv", "attention.self.value.bias", False),
+    ("wo", "attention.output.dense.weight", True),
+    ("bo", "attention.output.dense.bias", False),
+    ("attn_norm", "attention.output.LayerNorm.weight", False),
+    ("attn_norm_b", "attention.output.LayerNorm.bias", False),
+    ("w1", "intermediate.dense.weight", True),
+    ("b1", "intermediate.dense.bias", False),
+    ("w2", "output.dense.weight", True),
+    ("b2", "output.dense.bias", False),
+    ("ffn_norm", "output.LayerNorm.weight", False),
+    ("ffn_norm_b", "output.LayerNorm.bias", False),
+]
+
+
+def hf_to_embedder_params(
+    cfg: ModelConfig, tensors: dict[str, np.ndarray]
+) -> dict[str, Any]:
+    """Re-layout an HF encoder checkpoint (BERT or nomic_bert naming) into
+    the stacked tree models/embedder.py scans over.
+
+    Classic BERT: `encoder.layer.{i}.attention.self.query.weight`-style,
+    with an optional `bert.` prefix. nomic_bert: flash-attn style
+    `encoder.layers.{i}.attn.Wqkv.weight` (fused qkv, split on load) with
+    post-LN norms as `norm1`/`norm2`. The gated MLP's fc11/fc12 split the
+    fused flash-attn GatedMlp fc1, whose forward chunks into (y, gate) and
+    applies the activation to the SECOND chunk: fc11 is the multiplicative
+    path (our w3), fc12 the activated gate (our w1). Raises KeyError naming
+    the missing tensor on an incomplete checkpoint."""
+    prefix = "bert." if any(k.startswith("bert.") for k in tensors) else ""
+
+    def get(name: str) -> np.ndarray:
+        t = tensors.get(prefix + name)
+        if t is None:
+            raise KeyError(f"checkpoint missing tensor {prefix + name!r}")
+        return t
+
+    def opt(name: str) -> np.ndarray | None:
+        return tensors.get(prefix + name)
+
+    L, D = cfg.n_layers, cfg.dim
+    nomic = any(".attn.Wqkv." in k for k in tensors)
+    layers: dict[str, list[np.ndarray]] = {}
+
+    def push(key: str, t: np.ndarray) -> None:
+        layers.setdefault(key, []).append(t)
+
+    for i in range(L):
+        if nomic:
+            base = f"encoder.layers.{i}."
+            wqkv = get(base + "attn.Wqkv.weight")  # [3D, D] fused, HF [out, in]
+            q, k, v = np.split(wqkv, 3, axis=0)
+            push("wq", q.T), push("wk", k.T), push("wv", v.T)
+            bqkv = opt(base + "attn.Wqkv.bias")
+            if cfg.enc_bias:
+                if bqkv is None:
+                    raise KeyError(f"checkpoint missing tensor {base}attn.Wqkv.bias")
+                bq, bk, bv = np.split(bqkv, 3, axis=0)
+                push("bq", bq), push("bk", bk), push("bv", bv)
+                push("bo", get(base + "attn.out_proj.bias"))
+                push("b1", get(base + "mlp.fc12.bias"))
+                push("b3", get(base + "mlp.fc11.bias"))
+                push("b2", get(base + "mlp.fc2.bias"))
+            push("wo", get(base + "attn.out_proj.weight").T)
+            push("attn_norm", get(base + "norm1.weight"))
+            push("attn_norm_b", get(base + "norm1.bias"))
+            # fc12 feeds the activation (our w1), fc11 the multiplicative
+            # path (our w3) — flash-attn chunk order, see docstring
+            push("w1", get(base + "mlp.fc12.weight").T)
+            push("w3", get(base + "mlp.fc11.weight").T)
+            push("w2", get(base + "mlp.fc2.weight").T)
+            push("ffn_norm", get(base + "norm2.weight"))
+            push("ffn_norm_b", get(base + "norm2.bias"))
+        else:
+            base = f"encoder.layer.{i}."
+            for ours, suffix, transpose in _BERT_LAYER_MAP:
+                if ours in ("bq", "bk", "bv", "bo", "b1", "b2") and not cfg.enc_bias:
+                    continue
+                if ours in ("attn_norm_b", "ffn_norm_b") and cfg.enc_norm != "layer":
+                    continue
+                t = get(base + suffix)
+                push(ours, t.T if transpose else t)
+
+    params: dict[str, Any] = {
+        "embed": get("embeddings.word_embeddings.weight"),
+        "layers": {k: np.stack(v, axis=0) for k, v in layers.items()},
+    }
+    if cfg.enc_pos == "learned":
+        pos = get("embeddings.position_embeddings.weight")
+        params["pos_embed"] = pos[: cfg.max_seq_len]
+    if cfg.type_vocab_size:
+        params["type_embed"] = get("embeddings.token_type_embeddings.weight")
+    if cfg.enc_post_ln:
+        ew = opt("emb_ln.weight") if nomic else opt("embeddings.LayerNorm.weight")
+        eb = opt("emb_ln.bias") if nomic else opt("embeddings.LayerNorm.bias")
+        if ew is None or eb is None:
+            raise KeyError("checkpoint missing embedding LayerNorm tensors")
+        params["embed_norm"], params["embed_norm_b"] = ew, eb
+    else:
+        params["final_norm"] = get("final_norm.weight")
+    return params
+
+
+def encoder_to_hf_tensors(
+    cfg: ModelConfig, params: dict[str, Any], *, naming: str = "bert"
+) -> dict[str, np.ndarray]:
+    """Inverse of `hf_to_embedder_params` (roundtrip tests / re-export).
+    `naming` picks the checkpoint dialect: "bert" (separate q/k/v) or
+    "nomic" (fused Wqkv + fc11/fc12)."""
+    lt = {k: np.asarray(v) for k, v in params["layers"].items()}
+    out: dict[str, np.ndarray] = {
+        "embeddings.word_embeddings.weight": np.asarray(params["embed"]),
+    }
+    if "pos_embed" in params:
+        out["embeddings.position_embeddings.weight"] = np.asarray(params["pos_embed"])
+    if "type_embed" in params:
+        out["embeddings.token_type_embeddings.weight"] = np.asarray(params["type_embed"])
+    if cfg.enc_post_ln:
+        ln_w, ln_b = "emb_ln.weight", "emb_ln.bias"
+        if naming == "bert":
+            ln_w, ln_b = "embeddings.LayerNorm.weight", "embeddings.LayerNorm.bias"
+        out[ln_w] = np.asarray(params["embed_norm"])
+        out[ln_b] = np.asarray(params["embed_norm_b"])
+    else:
+        out["final_norm.weight"] = np.asarray(params["final_norm"])
+    for i in range(cfg.n_layers):
+        if naming == "nomic":
+            base = f"encoder.layers.{i}."
+            out[base + "attn.Wqkv.weight"] = np.concatenate(
+                [lt["wq"][i].T, lt["wk"][i].T, lt["wv"][i].T], axis=0
+            )
+            if cfg.enc_bias:
+                out[base + "attn.Wqkv.bias"] = np.concatenate(
+                    [lt["bq"][i], lt["bk"][i], lt["bv"][i]], axis=0
+                )
+                out[base + "attn.out_proj.bias"] = lt["bo"][i]
+                out[base + "mlp.fc12.bias"] = lt["b1"][i]
+                out[base + "mlp.fc11.bias"] = lt["b3"][i]
+                out[base + "mlp.fc2.bias"] = lt["b2"][i]
+            out[base + "attn.out_proj.weight"] = lt["wo"][i].T
+            out[base + "norm1.weight"] = lt["attn_norm"][i]
+            out[base + "norm1.bias"] = lt["attn_norm_b"][i]
+            out[base + "mlp.fc12.weight"] = lt["w1"][i].T
+            out[base + "mlp.fc11.weight"] = lt["w3"][i].T
+            out[base + "mlp.fc2.weight"] = lt["w2"][i].T
+            out[base + "norm2.weight"] = lt["ffn_norm"][i]
+            out[base + "norm2.bias"] = lt["ffn_norm_b"][i]
+        else:
+            base = f"encoder.layer.{i}."
+            for ours, suffix, transpose in _BERT_LAYER_MAP:
+                if ours not in lt:
+                    continue
+                t = lt[ours][i]
+                out[base + suffix] = t.T if transpose else t
+    return out
+
+
+def load_embedder_checkpoint(
+    cfg: ModelConfig,
+    ckpt_dir: str,
+    *,
+    dtype: Any = None,
+    mesh: Any = None,
+) -> Any:
+    """One-call load for encoder checkpoints: HF safetensors dir →
+    (sharded) device param tree (the encoder analog of
+    `load_llama_checkpoint`)."""
+    tensors = read_checkpoint_dir(ckpt_dir)
+    host = hf_to_embedder_params(cfg, tensors)
+    specs = None
+    if mesh is not None:
+        from ..parallel.sharding import embedder_param_specs
+
+        specs = embedder_param_specs(cfg)
+    return place_params(host, dtype=dtype, mesh=mesh, specs=specs)
+
+
+# ---------------------------------------------------------------------------
 # Device placement (optionally sharded)
 # ---------------------------------------------------------------------------
 

@@ -128,22 +128,59 @@ def llama_param_specs(cfg: ModelConfig) -> dict[str, Any]:
     return specs
 
 
+# Spec per encoder leaf name (full table, unconditional). Biases shard with
+# their projection's output axis; norms and position/type tables replicate.
+_ENCODER_LAYER_SPECS: dict[str, Any] = {
+    "attn_norm": P(None, None),
+    "attn_norm_b": P(None, None),
+    "wq": P(None, None, "tp"),
+    "bq": P(None, "tp"),
+    "wk": P(None, None, "tp"),
+    "bk": P(None, "tp"),
+    "wv": P(None, None, "tp"),
+    "bv": P(None, "tp"),
+    "wo": P(None, "tp", None),
+    "bo": P(None, None),
+    "ffn_norm": P(None, None),
+    "ffn_norm_b": P(None, None),
+    "w1": P(None, None, "tp"),
+    "b1": P(None, "tp"),
+    "w3": P(None, None, "tp"),
+    "b3": P(None, "tp"),
+    "w2": P(None, "tp", None),
+    "b2": P(None, None),
+}
+_ENCODER_TOP_SPECS: dict[str, Any] = {
+    "embed": P("tp", None),
+    "pos_embed": P(None, None),
+    "type_embed": P(None, None),
+    "embed_norm": P(None),
+    "embed_norm_b": P(None),
+    "final_norm": P(None),
+}
+
+
 def embedder_param_specs(cfg: ModelConfig) -> dict[str, Any]:
-    return {
-        "embed": P("tp", None),
-        "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, None, "tp"),
-            "wk": P(None, None, "tp"),
-            "wv": P(None, None, "tp"),
-            "wo": P(None, "tp", None),
-            "ffn_norm": P(None, None),
-            "w1": P(None, None, "tp"),
-            "w3": P(None, None, "tp"),
-            "w2": P(None, "tp", None),
-        },
-        "final_norm": P(None),
-    }
+    """Specs for models/embedder.py:init_embedder_params, derived from the
+    init tree's OWN structure via eval_shape — the conditional leaf set
+    (gated w3, norm/linear biases, pos/type tables, embed vs final norm)
+    lives in exactly one place, so specs can never drift from params
+    (place_params zips flattened specs against flattened params and a
+    mismatch would silently shard the wrong leaves)."""
+    import jax
+
+    from ..models.embedder import init_embedder_params
+
+    shapes = jax.eval_shape(
+        lambda: init_embedder_params(cfg, jax.random.PRNGKey(0))
+    )
+    specs: dict[str, Any] = {}
+    for key, sub in shapes.items():
+        if key == "layers":
+            specs["layers"] = {k: _ENCODER_LAYER_SPECS[k] for k in sub}
+        else:
+            specs[key] = _ENCODER_TOP_SPECS[key]
+    return specs
 
 
 def kv_cache_specs(quantized: bool = False, latent: bool = False) -> dict[str, Any]:

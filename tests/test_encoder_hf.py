@@ -1,0 +1,288 @@
+"""Encoder-family HF checkpoint support: config.json inference (bert /
+nomic_bert), weights mapping in both checkpoint dialects, and end-to-end
+serving of an unseen-name encoder checkpoint dir.
+
+Reference analog: the reference serves any embed model an Ollama host
+carries, inferring kind and metadata for unseen names
+(`core/internal/discovery/discovery.go:482-560`). Here the checkpoint's own
+config.json is the metadata source and the weights load into the
+parameterized encoder (models/embedder.py).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_mcp_tpu.models.configs import config_from_hf, config_from_hf_dir, resolve_config
+from llm_mcp_tpu.models.embedder import embed_forward, init_embedder_params
+from llm_mcp_tpu.models.weights import (
+    encoder_to_hf_tensors,
+    hf_to_embedder_params,
+    write_safetensors,
+)
+
+BERT_DOC = {
+    "model_type": "bert",
+    "vocab_size": 384,
+    "hidden_size": 64,
+    "num_hidden_layers": 2,
+    "num_attention_heads": 4,
+    "intermediate_size": 128,
+    "layer_norm_eps": 1e-12,
+    "max_position_embeddings": 96,
+    "hidden_act": "gelu",
+    "type_vocab_size": 2,
+}
+
+NOMIC_DOC = {
+    "model_type": "nomic_bert",
+    "vocab_size": 384,
+    "n_embd": 64,
+    "n_layer": 2,
+    "n_head": 4,
+    "n_inner": 128,
+    "rotary_emb_fraction": 1.0,
+    "rotary_emb_base": 10000,
+    "layer_norm_epsilon": 1e-12,
+    "n_positions": 256,
+    "activation_function": "swiglu",
+    "qkv_proj_bias": False,
+    "prenorm": False,
+    "type_vocab_size": 2,
+}
+
+
+def test_bert_config_inference():
+    cfg = config_from_hf(BERT_DOC, name="org/some-bert-embedder")
+    assert cfg.arch == "encoder"
+    assert (cfg.dim, cfg.n_layers, cfg.n_heads, cfg.ffn_hidden) == (64, 2, 4, 128)
+    assert cfg.enc_norm == "layer" and cfg.enc_post_ln and cfg.enc_bias
+    assert cfg.enc_pos == "learned" and not cfg.enc_gated
+    assert cfg.act == "gelu" and cfg.type_vocab_size == 2
+    assert cfg.pooling == "mean" and cfg.embed_dim == 64
+    assert cfg.max_seq_len == 96
+
+
+def test_nomic_config_inference():
+    cfg = config_from_hf(NOMIC_DOC, name="org/unseen-nomic")
+    assert cfg.arch == "encoder"
+    assert (cfg.dim, cfg.n_layers, cfg.n_heads, cfg.ffn_hidden) == (64, 2, 4, 128)
+    assert cfg.enc_norm == "layer" and cfg.enc_post_ln and not cfg.enc_bias
+    assert cfg.enc_pos == "rope" and cfg.enc_gated and cfg.act == "silu"
+    assert cfg.rope_theta == 10000.0 and cfg.max_seq_len == 256
+
+
+def test_nomic_partial_rotary_fails_loud():
+    doc = dict(NOMIC_DOC, rotary_emb_fraction=0.5)
+    with pytest.raises(ValueError, match="rotary_emb_fraction"):
+        config_from_hf(doc)
+
+
+def test_nomic_fc_convention_pinned():
+    """fc12 is the ACTIVATED gate (our w1), fc11 the multiplicative path
+    (our w3) — the flash-attn GatedMlp chunk order `(y, gate) = fc1(x)`
+    with the activation applied to the second chunk. A swap here silently
+    corrupts real nomic checkpoints (silu(a)·b ≠ a·silu(b))."""
+    cfg = config_from_hf(NOMIC_DOC, name="pin-nomic")
+    params = init_embedder_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    marked = dict(params)
+    marked["layers"] = dict(params["layers"])
+    marked["layers"]["w1"] = params["layers"]["w1"] + 7.0  # activated path
+    tensors = encoder_to_hf_tensors(cfg, marked, naming="nomic")
+    got = tensors["encoder.layers.0.mlp.fc12.weight"]  # fc12 == gate == w1
+    np.testing.assert_allclose(
+        got, np.asarray(marked["layers"]["w1"][0]).T, atol=0
+    )
+    back = hf_to_embedder_params(cfg, {k: np.asarray(v) for k, v in tensors.items()})
+    np.testing.assert_allclose(
+        np.asarray(back["layers"]["w1"]), np.asarray(marked["layers"]["w1"]), atol=0
+    )
+
+
+def test_unsupported_encoder_variants_fail_loud():
+    with pytest.raises(ValueError, match="hidden_act"):
+        config_from_hf(dict(BERT_DOC, hidden_act="tanh"))
+    with pytest.raises(ValueError, match="activation_function"):
+        config_from_hf(dict(NOMIC_DOC, activation_function="mish"))
+    with pytest.raises(ValueError, match="prenorm"):
+        config_from_hf(dict(NOMIC_DOC, prenorm=True))
+    # supported variants resolve: gelu_new bert, geglu nomic (gelu gate)
+    cfg = config_from_hf(dict(BERT_DOC, hidden_act="gelu_new"))
+    assert cfg.act == "gelu_new"
+    cfg = config_from_hf(dict(NOMIC_DOC, activation_function="geglu"))
+    assert cfg.act == "gelu" and cfg.enc_gated
+
+
+def test_pooling_from_sentence_transformers_dir(tmp_path):
+    (tmp_path / "config.json").write_text(json.dumps(BERT_DOC))
+    pool = tmp_path / "1_Pooling"
+    pool.mkdir()
+    (pool / "config.json").write_text(json.dumps({
+        "pooling_mode_cls_token": True, "pooling_mode_mean_tokens": False,
+    }))
+    cfg = config_from_hf_dir(str(tmp_path), name="cls-pooled")
+    assert cfg.pooling == "cls"
+
+
+@pytest.mark.parametrize("doc,naming", [(BERT_DOC, "bert"), (NOMIC_DOC, "nomic")])
+def test_encoder_weights_roundtrip(doc, naming, tmp_path):
+    """init → export to the HF dialect → reload → identical embeddings.
+    Exercises the fused-Wqkv split and fc11/fc12 gate/up mapping for the
+    nomic dialect; separate q/k/v + biases + LayerNorms for bert."""
+    cfg = config_from_hf(doc, name=f"rt-{naming}")
+    params = init_embedder_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    # non-trivial biases/norms so the mapping is actually load-bearing
+    key = jax.random.PRNGKey(1)
+    params = jax.tree.map(
+        lambda x: x + 0.01 * jax.random.normal(key, x.shape, dtype=x.dtype), params
+    )
+    tensors = encoder_to_hf_tensors(cfg, params, naming=naming)
+    reloaded = hf_to_embedder_params(cfg, {k: np.asarray(v) for k, v in tensors.items()})
+    reloaded = jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), reloaded)
+
+    tokens = jnp.asarray([[5, 6, 7, 0], [9, 10, 0, 0]], jnp.int32)
+    lengths = jnp.asarray([3, 2], jnp.int32)
+    a = embed_forward(cfg, params, tokens, lengths)
+    b = embed_forward(cfg, reloaded, tokens, lengths)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_bert_parity_against_transformers():
+    """Our parameterized encoder computes the same function as the canonical
+    HF BertModel: random-init a tiny torch BertModel, map its state_dict
+    through hf_to_embedder_params, and compare masked-mean-pooled normalized
+    embeddings."""
+    torch = pytest.importorskip("torch")
+    trf = pytest.importorskip("transformers")
+
+    hf_cfg = trf.BertConfig(
+        vocab_size=BERT_DOC["vocab_size"],
+        hidden_size=BERT_DOC["hidden_size"],
+        num_hidden_layers=BERT_DOC["num_hidden_layers"],
+        num_attention_heads=BERT_DOC["num_attention_heads"],
+        intermediate_size=BERT_DOC["intermediate_size"],
+        max_position_embeddings=BERT_DOC["max_position_embeddings"],
+        type_vocab_size=2,
+        hidden_act="gelu",
+        layer_norm_eps=1e-12,
+        attention_probs_dropout_prob=0.0,
+        hidden_dropout_prob=0.0,
+    )
+    torch.manual_seed(0)
+    model = trf.BertModel(hf_cfg, add_pooling_layer=False).eval()
+    tensors = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+
+    cfg = config_from_hf(BERT_DOC, name="parity-bert")
+    params = hf_to_embedder_params(cfg, tensors)
+    params = jax.tree.map(lambda x: jnp.asarray(np.asarray(x), jnp.float32), params)
+
+    tokens = np.array([[11, 12, 13, 14, 0, 0], [21, 22, 0, 0, 0, 0]], np.int32)
+    lengths = np.array([4, 2], np.int32)
+    ours = np.asarray(embed_forward(cfg, params, jnp.asarray(tokens), jnp.asarray(lengths)))
+
+    att = (np.arange(tokens.shape[1])[None, :] < lengths[:, None]).astype(np.int64)
+    with torch.no_grad():
+        hs = model(
+            input_ids=torch.tensor(tokens, dtype=torch.long),
+            attention_mask=torch.tensor(att),
+        ).last_hidden_state.numpy()
+    w = att[:, :, None].astype(np.float32)
+    ref = (hs * w).sum(1) / np.maximum(w.sum(1), 1.0)
+    ref = ref / np.maximum(np.linalg.norm(ref, axis=-1, keepdims=True), 1e-9)
+
+    np.testing.assert_allclose(ours, ref, atol=2e-4)
+
+
+def test_embedding_engine_serves_unseen_encoder_checkpoint(tmp_path):
+    """End to end: an encoder checkpoint dir (config.json + safetensors)
+    under a name the catalog has never heard of loads and embeds — and the
+    engine resolves the checkpoint's architecture, not the name-heuristic
+    catalog fallback."""
+    from llm_mcp_tpu.executor import EmbeddingEngine
+
+    cfg = config_from_hf(NOMIC_DOC, name="org/never-seen-embedder")
+    params = init_embedder_params(cfg, jax.random.PRNGKey(2), dtype=jnp.float32)
+    (tmp_path / "config.json").write_text(json.dumps(NOMIC_DOC))
+    write_safetensors(
+        str(tmp_path / "model.safetensors"),
+        {k: np.asarray(v) for k, v in encoder_to_hf_tensors(cfg, params, naming="nomic").items()},
+    )
+    eng = EmbeddingEngine(
+        "org/never-seen-embedder", max_seq_len=64, dtype=jnp.float32,
+        weights_dir=str(tmp_path),
+    )
+    assert eng.cfg.arch == "encoder" and eng.cfg.dim == 64
+    vecs, ntok = eng.embed(["unseen encoder checkpoint", "second input"])
+    assert len(vecs) == 2 and len(vecs[0]) == 64 and ntok > 0
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=1), 1.0, atol=1e-4)
+    # engine forward equals direct forward on the loaded tree (same tokens)
+    ids = eng.tokenizer.encode("unseen encoder checkpoint")
+    eos = eng.tokenizer.eos_id
+    if eos is not None and eos >= 0 and ids[-1] != eos:
+        ids = ids + [eos]
+    toks = np.zeros((1, 32), np.int32)
+    toks[0, : len(ids)] = ids
+    direct = embed_forward(
+        cfg,
+        jax.tree.map(lambda x: jnp.asarray(np.asarray(x), jnp.float32), params),
+        jnp.asarray(toks),
+        jnp.asarray([len(ids)], jnp.int32),
+    )
+    np.testing.assert_allclose(np.asarray(vecs[0]), np.asarray(direct[0]), atol=1e-4)
+
+
+def test_bert_learned_pos_clamps_engine_seq_len(tmp_path):
+    """A learned-position checkpoint caps the engine's bucket ladder at the
+    table size (BERT: 512-ish) even when the engine default asks for more."""
+    from llm_mcp_tpu.executor import EmbeddingEngine
+
+    cfg = config_from_hf(BERT_DOC, name="tiny-bert-pos")
+    params = init_embedder_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    (tmp_path / "config.json").write_text(json.dumps(BERT_DOC))
+    write_safetensors(
+        str(tmp_path / "model.safetensors"),
+        {k: np.asarray(v) for k, v in encoder_to_hf_tensors(cfg, params, naming="bert").items()},
+    )
+    eng = EmbeddingEngine(
+        "tiny-bert-pos", max_seq_len=8192, dtype=jnp.float32,
+        weights_dir=str(tmp_path),
+    )
+    assert eng.max_seq_len == 96  # clamped to the position table
+    vecs, _ = eng.embed(["x " * 400])  # longer than the table; truncates
+    assert len(vecs) == 1 and np.isfinite(vecs[0]).all()
+
+
+def test_encoder_sharded_load_and_quant(tmp_path):
+    """The conditional encoder tree round-trips through embedder_param_specs
+    (sharded placement over the 8-device mesh) and through quantize_params
+    (biases/norms stay unquantized)."""
+    from llm_mcp_tpu.models.quant import quantize_params
+    from llm_mcp_tpu.models.weights import load_embedder_checkpoint
+    from llm_mcp_tpu.parallel.mesh import make_mesh
+
+    cfg = config_from_hf(BERT_DOC, name="shard-bert")
+    params = init_embedder_params(cfg, jax.random.PRNGKey(4), dtype=jnp.float32)
+    write_safetensors(
+        str(tmp_path / "model.safetensors"),
+        {k: np.asarray(v) for k, v in encoder_to_hf_tensors(cfg, params, naming="bert").items()},
+    )
+    mesh = make_mesh("dp=2,tp=4")
+    sharded = load_embedder_checkpoint(cfg, str(tmp_path), dtype=jnp.float32, mesh=mesh)
+    tokens = jnp.asarray([[5, 6, 7, 0]], jnp.int32)
+    out = embed_forward(cfg, sharded, tokens, jnp.asarray([3], jnp.int32))
+    ref = embed_forward(
+        cfg, jax.tree.map(lambda x: jnp.asarray(np.asarray(x), jnp.float32), params),
+        tokens, jnp.asarray([3], jnp.int32),
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    q = quantize_params(jax.tree.map(lambda x: jnp.asarray(np.asarray(x)), params))
+    assert isinstance(q["layers"]["wq"], dict) and "q" in q["layers"]["wq"]
+    assert not isinstance(q["layers"]["bq"], dict)  # biases stay plain
+    qout = embed_forward(cfg, q, tokens, jnp.asarray([3], jnp.int32))
+    # int8 forward stays close in cosine terms on a tiny model
+    cos = float((np.asarray(qout[0]) * np.asarray(ref[0])).sum())
+    assert cos > 0.98
