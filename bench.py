@@ -411,15 +411,9 @@ def embed_path_metrics(
             # TPU the same path is host_ms + device compute (~1 ms).
             import numpy as np
 
-            # mirror EmbeddingEngine.embed exactly (same bucket, same [SEP]
-            # append) so fwd_fetch_ms times the SAME executable the p50
+            # prepare_ids + _bucket reproduce the exact executable the p50
             # path dispatched — a different bucket is a different kernel
-            ids = eng.tokenizer.encode(texts[0])[: eng.max_seq_len]
-            eos = getattr(eng.tokenizer, "eos_id", -1)
-            if not eng.decoder_arch and eos is not None and eos >= 0 and (
-                not ids or ids[-1] != eos
-            ):
-                ids = ids[: eng.max_seq_len - 1] + [eos]
+            ids = eng.prepare_ids(texts[0])
             bucket = eng._bucket(len(ids))
             toks = np.zeros((1, bucket), np.int32)
             toks[0, : len(ids)] = ids

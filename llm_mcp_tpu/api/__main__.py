@@ -25,6 +25,13 @@ def main() -> None:
     cfg = Config()
     enable_compile_cache()
 
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower() == "cpu":
+        # an already-registered accelerator plugin ignores the env var; the
+        # config-level pin is the one mechanism it respects (CI / CPU sims)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
 
     from ..executor import EmbeddingEngine, GenerationEngine
@@ -40,25 +47,55 @@ def main() -> None:
 
         mesh = None
         if cfg.tpu_mesh_shape:
-            distributed.initialize()
+            multi = distributed.initialize()
             mesh = distributed.make_global_mesh(cfg.tpu_mesh_shape)
             log.info("serving over mesh %s", dict(zip(mesh.axis_names, mesh.devices.shape)))
+            if multi and cfg.tpu_slice_cmd_addr:
+                # Multi-PROCESS serving: the model spans hosts, so the whole
+                # cluster serves as ONE schedulable device — process 0 runs
+                # the leader SliceEngine inside CoreServer (registers via
+                # discovery exactly like a single-host engine); every other
+                # process mirrors dispatches over the command channel and
+                # never binds HTTP (executor/slice_engine.py).
+                import jax
+
+                from ..executor import SliceEngine
+
+                eng = SliceEngine(
+                    cfg.tpu_model,
+                    mesh=mesh,
+                    cmd_addr=cfg.tpu_slice_cmd_addr,
+                    max_slots=cfg.tpu_max_slots,
+                    max_seq_len=cfg.tpu_max_seq_len,
+                    dtype=jnp.bfloat16,
+                    quant=cfg.tpu_quant,
+                    weights_dir=cfg.tpu_weights_dir,
+                )
+                if jax.process_index() != 0:
+                    log.info("slice follower %d/%d: mirroring dispatches",
+                             jax.process_index(), jax.process_count())
+                    eng.run_follower()
+                    return
+                gen_engines[cfg.tpu_model] = eng.start()
         model = cfg.tpu_model
-        log.info("loading generation engine: %s", model)
-        gen_engines[model] = GenerationEngine(
-            model,
-            mesh=mesh,
-            max_slots=cfg.tpu_max_slots,
-            max_seq_len=cfg.tpu_max_seq_len,
-            dtype=jnp.bfloat16,
-            weights_dir=cfg.tpu_weights_dir,
-            quant=cfg.tpu_quant,
-            kv_quant=cfg.tpu_kv_quant,
-            prefill_chunk=cfg.tpu_prefill_chunk,
-            decode_compact=cfg.tpu_decode_compact,
-            prompt_cache_mb=cfg.tpu_prompt_cache_mb,
-            prefill_buckets=cfg.tpu_prefill_buckets,
-        ).start()
+        if model in gen_engines:
+            log.info("generation engine: %s (multi-host slice leader)", model)
+        else:
+            log.info("loading generation engine: %s", model)
+            gen_engines[model] = GenerationEngine(
+                model,
+                mesh=mesh,
+                max_slots=cfg.tpu_max_slots,
+                max_seq_len=cfg.tpu_max_seq_len,
+                dtype=jnp.bfloat16,
+                weights_dir=cfg.tpu_weights_dir,
+                quant=cfg.tpu_quant,
+                kv_quant=cfg.tpu_kv_quant,
+                prefill_chunk=cfg.tpu_prefill_chunk,
+                decode_compact=cfg.tpu_decode_compact,
+                prompt_cache_mb=cfg.tpu_prompt_cache_mb,
+                prefill_buckets=cfg.tpu_prefill_buckets,
+            ).start()
         emodel = cfg.tpu_embed_model
         cfg.warn_embed_dir_gap(log)
         log.info("loading embedding engine: %s", emodel)

@@ -1,6 +1,7 @@
 from .tokenizer import ByteTokenizer, load_tokenizer
 from .engine import GenerationEngine, GenRequest
 from .embedding import EmbeddingEngine
+from .slice_engine import SliceEngine, SliceRequest
 
 __all__ = [
     "ByteTokenizer",
@@ -8,4 +9,6 @@ __all__ = [
     "GenerationEngine",
     "GenRequest",
     "EmbeddingEngine",
+    "SliceEngine",
+    "SliceRequest",
 ]

@@ -156,6 +156,20 @@ class EmbeddingEngine:
     def _bucket(self, n: int) -> int:
         return pow2_bucket(n, self.max_seq_len)
 
+    def prepare_ids(self, text: str) -> list[int]:
+        """Tokenize one input exactly as `embed` feeds the forward pass
+        (truncation + the trailing [SEP] for encoder tokenizers). The single
+        source of truth for anything that must time or replay the REAL
+        executable (bench.py's b1 latency breakdown)."""
+        ids = self.tokenizer.encode(text)[: self.max_seq_len]
+        eos = getattr(self.tokenizer, "eos_id", -1)
+        if not self.decoder_arch and eos is not None and eos >= 0:
+            # BERT-family encoders were trained on [CLS] … [SEP] frames; the
+            # tokenizer wrapper adds [CLS] (bos) but not the trailing [SEP]
+            if not ids or ids[-1] != eos:
+                ids = ids[: self.max_seq_len - 1] + [eos]
+        return ids
+
     def embed(
         self, texts: list[str], dimensions: int | None = None
     ) -> tuple[list[list[float]], int]:
@@ -163,15 +177,7 @@ class EmbeddingEngine:
         `max_batch`, padded per-batch to the longest bucket."""
         if not texts:
             return [], 0
-        all_ids = [self.tokenizer.encode(t)[: self.max_seq_len] for t in texts]
-        eos = getattr(self.tokenizer, "eos_id", -1)
-        if not self.decoder_arch and eos is not None and eos >= 0:
-            # BERT-family encoders were trained on [CLS] … [SEP] frames; the
-            # tokenizer wrapper adds [CLS] (bos) but not the trailing [SEP]
-            all_ids = [
-                ids[: self.max_seq_len - 1] + [eos] if (not ids or ids[-1] != eos) else ids
-                for ids in all_ids
-            ]
+        all_ids = [self.prepare_ids(t) for t in texts]
         total_tokens = sum(len(i) for i in all_ids)
         vectors: list[list[float]] = []
 

@@ -1,0 +1,67 @@
+"""Minimal multi-process SliceEngine demo entrypoint.
+
+Run one copy per process of a `jax.distributed` cluster (the standard env
+triplet JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID, plus
+SLICE_CMD_ADDR for the leader→follower command channel):
+
+    python -m llm_mcp_tpu.executor.slice_demo
+
+The leader (process 0) generates a short greedy completion through the
+sliced engine — every decode round's dp axis crosses the process boundary —
+and prints `SLICE DEMO OK`; followers mirror the dispatches and exit on the
+leader's stop command. Used by `__graft_entry__.dryrun_multichip` to prove
+the multi-host serving engine executes, and serves as the template for a
+real multi-host deployment (swap tiny-llm for the production model and wrap
+the leader in CoreServer — tests/test_slice_engine.py does exactly that)."""
+
+from __future__ import annotations
+
+import os
+
+
+def main() -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        n = os.environ.get("SLICE_LOCAL_DEVICES", "4")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}".strip()
+        )
+    import jax
+
+    if os.environ.get("SLICE_DEMO_CPU", "1") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from ..parallel import distributed
+    from .slice_engine import SliceEngine
+
+    if not distributed.initialize():
+        raise SystemExit("slice demo needs a jax.distributed env triplet")
+    mesh_spec = os.environ.get("SLICE_MESH", "dp=4,tp=2")
+    mesh = distributed.make_global_mesh(mesh_spec)
+    eng = SliceEngine(
+        os.environ.get("SLICE_MODEL", "tiny-llm"),
+        mesh=mesh,
+        cmd_addr=os.environ["SLICE_CMD_ADDR"],
+        max_slots=int(os.environ.get("SLICE_SLOTS", "8")),
+        max_seq_len=int(os.environ.get("SLICE_SEQ", "128")),
+        dtype=jnp.float32,
+        decode_chunk=4,
+    )
+    if jax.process_index() == 0:
+        eng.start()
+        out = eng.generate("slice dryrun", max_tokens=6, temperature=0.0)
+        assert out["usage"]["completion_tokens"] >= 1, out
+        eng.shutdown()
+        print(
+            f"SLICE DEMO OK: {jax.process_count()} processes, "
+            f"mesh {mesh_spec}, {out['usage']['completion_tokens']} tokens",
+            flush=True,
+        )
+    else:
+        eng.run_follower()
+        print("SLICE FOLLOWER OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,687 @@
+"""Multi-host serving engine: ONE GSPMD data plane spanning every process
+of a `jax.distributed` cluster, driven by a leader/follower command channel.
+
+The reference's multi-host story is one schedulable device per Ollama
+endpoint (`core/internal/discovery/discovery.go:266-280`) — each host serves
+alone. A TPU slice is different: the MODEL spans hosts, so serving it means
+every process of the slice must dispatch the same XLA program over one
+global `jax.sharding.Mesh` while exactly one process talks HTTP. This module
+is that per-slice device:
+
+  - **Process 0 (leader)** owns all host-side state: the request queue, slot
+    table, sampling params, stop/EOS handling, SSE emission. It exposes the
+    same `generate_stream` interface `GenerationEngine` gives CoreServer, so
+    the slice registers through discovery as ONE device and serves
+    `/v1/chat/completions` unchanged.
+  - **Processes 1..n-1 (followers)** are stateless executors: they block on
+    a TCP command channel (the cluster-plane analog of the reference's
+    HTTP/gRPC control plane — SURVEY.md §2.2) and mirror every dispatch.
+    Commands carry the full host-side inputs (tokens, lengths, masks, RNG
+    counter), so a follower needs no scheduling logic and cannot diverge:
+    multi-controller JAX treats identical numpy inputs as replicated global
+    arrays, and the jitted programs are identical by construction.
+  - **Device state** (weights, KV cache) is born sharded: params and cache
+    init run as jitted programs with explicit `out_shardings` over the
+    global mesh, so no process ever materializes the full tree and a real
+    checkpoint streams per-process shards (`make_array_from_callback`).
+
+The decode round returns its sampled tokens with a REPLICATED out-sharding
+(XLA inserts the all-gather across dp), so the leader fetches the full
+token block locally — followers fetch nothing and stay async.
+
+Scope vs `GenerationEngine`: whole-prompt bucketed prefill (no chunked
+prefill / prompt-prefix cache / pipelined rings yet) — the single-host
+engine keeps those; this engine's job is the cross-process data plane.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import queue
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import (
+    init_kv_cache,
+    init_llama_params,
+    llama_decode_step,
+    llama_prefill,
+)
+from ..models.configs import ModelConfig, resolve_config
+from ..ops.sampling import sample_tokens
+from .common import pow2_bucket
+from .tokenizer import Tokenizer, load_tokenizer
+
+log = logging.getLogger("slice")
+
+_DONE = object()
+
+
+# ---------------------------------------------------------------------------
+# Command channel: leader → followers, length-prefixed pickles over TCP
+# ---------------------------------------------------------------------------
+
+
+class CmdLeader:
+    """Leader side: accept one connection per follower, broadcast commands."""
+
+    def __init__(self, bind_addr: str, n_followers: int, timeout_s: float = 60.0):
+        host, _, port = bind_addr.rpartition(":")
+        self._srv = socket.create_server((host or "0.0.0.0", int(port)))
+        self._srv.settimeout(timeout_s)
+        self.conns: list[socket.socket] = []
+        for _ in range(n_followers):
+            c, _addr = self._srv.accept()
+            c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self.conns.append(c)
+
+    def send(self, obj: Any) -> None:
+        blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        frame = struct.pack("<I", len(blob)) + blob
+        for c in self.conns:
+            c.sendall(frame)
+
+    def close(self) -> None:
+        for c in self.conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._srv.close()
+
+
+class CmdFollower:
+    """Follower side: connect (with retry — the leader may boot later) and
+    block on recv."""
+
+    def __init__(self, addr: str, timeout_s: float = 60.0):
+        host, _, port = addr.rpartition(":")
+        deadline = time.time() + timeout_s
+        while True:
+            try:
+                self._c = socket.create_connection((host, int(port)), timeout=5.0)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.2)
+        self._c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._c.settimeout(None)
+
+    def recv(self) -> Any:
+        hdr = self._recv_exact(4)
+        (n,) = struct.unpack("<I", hdr)
+        return pickle.loads(self._recv_exact(n))
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._c.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("command channel closed")
+            buf += chunk
+        return buf
+
+    def close(self) -> None:
+        self._c.close()
+
+
+# ---------------------------------------------------------------------------
+# Requests / slots (leader-side bookkeeping)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SliceRequest:
+    prompt_ids: list[int]
+    max_tokens: int = 256
+    temperature: float = 0.7
+    top_k: int = 0
+    top_p: float = 1.0
+    stop: list[str] = field(default_factory=list)
+    out: "queue.Queue[Any]" = field(default_factory=queue.Queue)
+
+
+@dataclass
+class _Slot:
+    req: SliceRequest
+    prompt_len: int
+    generated: int = 0
+    text: str = ""
+    pending: bytes = b""
+
+
+class SliceEngine:
+    """See module docstring. Construct in EVERY process of the cluster with
+    identical arguments; then `.start()` on the leader (process 0) and
+    `.run_follower()` everywhere else."""
+
+    def __init__(
+        self,
+        model: str | ModelConfig = "tiny-llm",
+        *,
+        mesh: Any,
+        cmd_addr: str,
+        max_slots: int = 8,
+        max_seq_len: int = 256,
+        dtype: Any = jnp.bfloat16,
+        decode_chunk: int = 8,
+        quant: str = "",
+        weights_dir: str = "",
+        tokenizer: Tokenizer | None = None,
+        seed: int = 0,
+        connect_timeout_s: float = 60.0,
+    ):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..models.quant import quantized_specs
+        from ..parallel.sharding import kv_cache_specs, llama_param_specs
+
+        self.cfg = resolve_config(model, weights_dir) if isinstance(model, str) else model
+        self.mesh = mesh
+        self.max_slots = max_slots
+        self.max_seq_len = max_seq_len
+        self.decode_chunk = decode_chunk
+        self.quant = quant
+        self.tokenizer = tokenizer or load_tokenizer(weights_dir)
+        self.process_index = jax.process_index()
+        self.process_count = jax.process_count()
+        self.is_leader = self.process_index == 0
+        self._cmd_addr = cmd_addr
+        self._connect_timeout_s = connect_timeout_s
+        cfg = self.cfg
+
+        dp = mesh.shape.get("dp", 1)
+        if max_slots % max(dp, 1) != 0:
+            raise ValueError(f"max_slots {max_slots} must divide over dp={dp}")
+
+        def ns(spec):
+            return jax.tree.map(
+                lambda s: NamedSharding(mesh, s), spec,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+
+        pspecs = llama_param_specs(cfg)
+        if quant == "int8":
+            from ..models.quant import init_llama_params_quantized
+
+            pspecs = quantized_specs(pspecs)
+            init_params = partial(
+                init_llama_params_quantized, cfg, jax.random.PRNGKey(seed),
+                scale_dtype=dtype,
+            )
+        else:
+            init_params = partial(
+                init_llama_params, cfg, jax.random.PRNGKey(seed), dtype=dtype
+            )
+        cspecs = kv_cache_specs()
+        repl = NamedSharding(mesh, P())
+
+        with mesh:
+            if weights_dir:
+                self.params = self._load_checkpoint_global(
+                    cfg, weights_dir, dtype, mesh, ns(pspecs)
+                )
+            else:
+                # born sharded: the init runs as ONE GSPMD program with
+                # explicit out_shardings — no process materializes the tree
+                self.params = jax.jit(init_params, out_shardings=ns(pspecs))()
+            cache = jax.jit(
+                partial(init_kv_cache, cfg, max_slots, max_seq_len, dtype=dtype),
+                out_shardings=jax.tree.map(
+                    lambda s: NamedSharding(mesh, s), cspecs,
+                    is_leaf=lambda x: isinstance(x, P),
+                ),
+            )()
+        self._ck, self._cv = cache["k"], cache["v"]
+        self._base_key = jax.random.PRNGKey(seed + 1)
+        base_key = self._base_key
+
+        cache_out = (
+            jax.tree.map(lambda s: NamedSharding(mesh, s), cspecs["k"],
+                         is_leaf=lambda x: isinstance(x, P)),
+            jax.tree.map(lambda s: NamedSharding(mesh, s), cspecs["v"],
+                         is_leaf=lambda x: isinstance(x, P)),
+        )
+
+        K = decode_chunk
+
+        @partial(
+            jax.jit,
+            donate_argnums=(1, 2),
+            out_shardings=((repl,) + cache_out),
+        )
+        def decode_fn(params, ck, cv, toks, lens, active, temps, topks, topps,
+                      counter):
+            """K chained steps + fused sampling. `toks`/`lens`/`active` and
+            the sampling params arrive as identical numpy on every process
+            (replicated by multi-controller semantics). Output tokens are
+            REPLICATED [K, B] so the leader fetches them without a separate
+            collective; inactive rows freeze (their lengths do not advance
+            and their token repeats)."""
+
+            cmd_key = jax.random.fold_in(base_key, counter)
+
+            def step(carry, i):
+                ck, cv, toks, lens = carry
+                logits, ck, cv = llama_decode_step(cfg, params, ck, cv, toks, lens)
+                key = jax.random.fold_in(cmd_key, i)  # i < K; admit uses K
+                new = sample_tokens(logits, key, temps, topks, topps)
+                new = jnp.where(active, new, toks)
+                lens = lens + active.astype(jnp.int32)
+                return (ck, cv, new, lens), new
+
+            (ck, cv, _, _), out = jax.lax.scan(
+                step, (ck, cv, toks, lens), jnp.arange(K)
+            )
+            return out, ck, cv
+
+        kv_axes = 5  # [L, B, Hkv, S, hd]
+
+        @partial(jax.jit, donate_argnums=(1, 2),
+                 out_shardings=(cache_out + (repl,)))
+        def admit_fn(params, ck, cv, tokens, lengths, slots, live_n, temps,
+                     topks, topps, counter):
+            """Whole-prompt batched prefill + cache insert + first-token
+            sample, one dispatch (the slice analog of GenerationEngine's
+            fused admit_fn). Pad rows (i >= live_n) write nothing."""
+            logits, ks, vs = llama_prefill(cfg, params, tokens, lengths)
+
+            def body(i, cc):
+                ck, cv = cc
+
+                def ins(cc):
+                    ck, cv = cc
+                    kr = jax.lax.dynamic_slice_in_dim(ks, i, 1, 1)
+                    vr = jax.lax.dynamic_slice_in_dim(vs, i, 1, 1)
+                    start = (0, slots[i]) + (0,) * (kv_axes - 2)
+                    ck = jax.lax.dynamic_update_slice(ck, kr.astype(ck.dtype), start)
+                    cv = jax.lax.dynamic_update_slice(cv, vr.astype(cv.dtype), start)
+                    return ck, cv
+
+                return jax.lax.cond(i < live_n, ins, lambda cc: cc, (ck, cv))
+
+            ck, cv = jax.lax.fori_loop(0, tokens.shape[0], body, (ck, cv))
+            # fold (counter, K): disjoint from decode's (counter, i<K) space
+            key = jax.random.fold_in(jax.random.fold_in(base_key, counter), K)
+            toks0 = sample_tokens(logits, key, temps, topks, topps)
+            return ck, cv, toks0
+
+        self._decode_fn = decode_fn
+        self._admit_fn = admit_fn
+
+        # leader-side bookkeeping
+        self._queue: "queue.Queue[Any]" = queue.Queue()
+        self._slots: list[_Slot | None] = [None] * max_slots
+        self._toks = np.zeros(max_slots, np.int32)
+        self._lens = np.zeros(max_slots, np.int32)
+        self._temps = np.zeros(max_slots, np.float32)
+        self._topks = np.zeros(max_slots, np.int32)
+        self._topps = np.ones(max_slots, np.float32)
+        self._counter = 0
+        self._shutdown = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._leader_ch: CmdLeader | None = None
+        self.total_tokens = 0
+        self.total_requests = 0
+        self.total_errors = 0
+        self._ttfts: deque[float] = deque(maxlen=512)
+        self._tps_marks: deque[tuple[float, int]] = deque(maxlen=256)
+        self.attn_impl = "xla"
+        self.dead: str = ""  # non-empty = engine loop died with this error
+
+    # -- checkpoint -------------------------------------------------------
+
+    @staticmethod
+    def _load_checkpoint_global(cfg, ckpt_dir, dtype, mesh, shardings):
+        """Every process reads the safetensors dir (standard multi-host
+        practice) and contributes ONLY its addressable shards via
+        make_array_from_callback — the full tree is never resident per
+        process beyond the mmap'd host file."""
+        from ..models.weights import hf_to_llama_params, read_checkpoint_dir
+
+        host = hf_to_llama_params(cfg, read_checkpoint_dir(ckpt_dir))
+
+        def up(arr, sharding):
+            a = np.asarray(arr)
+            if dtype is not None:
+                a = a.astype(dtype)
+            return jax.make_array_from_callback(
+                a.shape, sharding, lambda idx: a[idx]
+            )
+
+        return jax.tree.map(up, host, shardings)
+
+    # -- follower ---------------------------------------------------------
+
+    def run_follower(self) -> None:
+        """Blocking command loop; returns on the leader's stop command."""
+        assert not self.is_leader
+        ch = CmdFollower(self._cmd_addr, timeout_s=self._connect_timeout_s)
+        try:
+            while True:
+                cmd = ch.recv()
+                op = cmd[0]
+                if op == "stop":
+                    return
+                if op == "admit":
+                    _, tokens, lengths, slots, live_n, temps, topks, topps, ctr = cmd
+                    with self.mesh:
+                        self._ck, self._cv, _ = self._admit_fn(
+                            self.params, self._ck, self._cv, tokens, lengths,
+                            slots, live_n, temps, topks, topps, ctr,
+                        )
+                elif op == "decode":
+                    _, toks, lens, active, temps, topks, topps, ctr = cmd
+                    with self.mesh:
+                        _, self._ck, self._cv = self._decode_fn(
+                            self.params, self._ck, self._cv, toks, lens,
+                            active, temps, topks, topps, ctr,
+                        )
+                else:  # pragma: no cover
+                    raise ValueError(f"unknown slice command {op!r}")
+        finally:
+            ch.close()
+
+    # -- leader -----------------------------------------------------------
+
+    def start(self) -> "SliceEngine":
+        assert self.is_leader, "start() is leader-only; followers run_follower()"
+        self._leader_ch = CmdLeader(
+            self._cmd_addr, self.process_count - 1,
+            timeout_s=self._connect_timeout_s,
+        )
+        self._thread = threading.Thread(
+            target=self._engine_loop, name="slice-engine", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def submit(self, req: SliceRequest) -> None:
+        if self.dead:
+            req.out.put({"type": "error", "error": f"engine dead: {self.dead}"})
+            req.out.put(_DONE)
+            return
+        self._queue.put(req)
+
+    def generate_stream(
+        self,
+        prompt: str,
+        *,
+        max_tokens: int = 256,
+        temperature: float = 0.7,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        stop: list[str] | None = None,
+    ) -> Iterator[dict[str, Any]]:
+        ids = self.tokenizer.encode(prompt)
+        req = SliceRequest(
+            prompt_ids=ids, max_tokens=max_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, stop=stop or [],
+        )
+        req._t0 = time.time()  # type: ignore[attr-defined]
+        self.submit(req)
+        while True:
+            evt = req.out.get()
+            if evt is _DONE:
+                return
+            yield evt
+            if evt.get("type") in ("done", "error"):
+                return
+
+    def generate(self, prompt: str, **kw: Any) -> dict[str, Any]:
+        parts: list[str] = []
+        final: dict[str, Any] = {}
+        for evt in self.generate_stream(prompt, **kw):
+            if evt["type"] == "token":
+                parts.append(evt["text"])
+            elif evt["type"] == "done":
+                final = evt
+            elif evt["type"] == "error":
+                raise RuntimeError(evt.get("error", "generation failed"))
+        return {
+            "text": "".join(parts),
+            "usage": final.get("usage", {}),
+            "finish_reason": final.get("finish_reason", "stop"),
+        }
+
+    # CoreServer dashboard interface (GenerationEngine parity)
+    decode_compact = "off"  # compaction is a single-host engine feature
+    stalled = False
+
+    def slots_in_use(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    def current_tps(self) -> float:
+        now = time.time()
+        window = [(t, n) for t, n in self._tps_marks if now - t <= 10.0]
+        return sum(n for _, n in window) / 10.0 if window else 0.0
+
+    def prefix_cache_stats(self) -> dict[str, Any]:
+        return {"enabled": False}
+
+    def ttft_percentiles(self) -> tuple[float, float, int]:
+        if not self._ttfts:
+            return 0.0, 0.0, 0
+        xs = sorted(self._ttfts)
+        return (
+            xs[len(xs) // 2],
+            xs[min(len(xs) - 1, int(len(xs) * 0.95))],
+            len(xs),
+        )
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        if self._leader_ch is not None:
+            try:
+                self._leader_ch.send(("stop",))
+            except OSError:
+                pass
+            self._leader_ch.close()
+
+    # -- engine loop ------------------------------------------------------
+
+    def _free_slots(self) -> list[int]:
+        return [i for i, s in enumerate(self._slots) if s is None]
+
+    def _engine_loop(self) -> None:
+        try:
+            while not self._shutdown.is_set():
+                admitted = self._try_admit()
+                decoded = self._try_decode()
+                if not admitted and not decoded:
+                    time.sleep(0.002)
+        except Exception as e:
+            # The donated KV buffers died with the failed dispatch, so this
+            # engine cannot recover: mark it dead (submit() rejects from now
+            # on), fail every active AND queued request loudly, and release
+            # the followers — they must not block on recv() forever.
+            log.exception("slice engine loop died")
+            self.total_errors += 1
+            self.dead = repr(e)
+            for b in range(self.max_slots):
+                s = self._slots[b]
+                if s is not None:
+                    s.req.out.put({"type": "error", "error": repr(e)})
+                    s.req.out.put(_DONE)
+                    self._slots[b] = None
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                req.out.put({"type": "error", "error": repr(e)})
+                req.out.put(_DONE)
+            if self._leader_ch is not None:
+                try:
+                    self._leader_ch.send(("stop",))
+                except OSError:
+                    pass
+
+    def _try_admit(self) -> bool:
+        free = self._free_slots()
+        if not free:
+            return False
+        batch: list[SliceRequest] = []
+        while len(batch) < len(free):
+            try:
+                batch.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        if not batch:
+            return False
+        A = len(batch)
+        self.total_requests += A
+        maxlen = max(len(r.prompt_ids) for r in batch)
+        bucket = pow2_bucket(min(maxlen, self.max_seq_len - 1), self.max_seq_len)
+        tokens = np.zeros((A, bucket), np.int32)
+        lengths = np.zeros(A, np.int32)
+        slots = np.zeros(A, np.int32)
+        temps = np.zeros(A, np.float32)
+        topks = np.zeros(A, np.int32)
+        topps = np.ones(A, np.float32)
+        for i, r in enumerate(batch):
+            # keep the TAIL of over-long prompts (the latest context is what
+            # matters in chat — same policy as GenerationEngine), and
+            # reserve a full decode round of KV headroom past the prompt
+            limit = max(self.max_seq_len - self.decode_chunk - 1, 1)
+            ids = r.prompt_ids[-limit:] or [0]
+            tokens[i, : len(ids)] = ids
+            lengths[i] = len(ids)
+            slots[i] = free[i]
+            temps[i] = r.temperature
+            topks[i] = r.top_k
+            topps[i] = r.top_p
+        ctr = self._counter
+        self._counter += 1
+        cmd = ("admit", tokens, lengths, slots, np.int32(A), temps, topks,
+               topps, np.int32(ctr))
+        if self._leader_ch is not None:
+            self._leader_ch.send(cmd)
+        with self.mesh:
+            self._ck, self._cv, toks0 = self._admit_fn(
+                self.params, self._ck, self._cv, tokens, lengths, slots,
+                np.int32(A), temps, topks, topps, np.int32(ctr),
+            )
+        toks0 = np.asarray(toks0)
+        now = time.time()
+        for i, r in enumerate(batch):
+            slot = _Slot(req=r, prompt_len=int(lengths[i]))
+            self._slots[free[i]] = slot
+            self._toks[free[i]] = toks0[i]
+            self._lens[free[i]] = lengths[i]
+            self._temps[free[i]] = r.temperature
+            self._topks[free[i]] = r.top_k
+            self._topps[free[i]] = r.top_p
+            t0 = getattr(r, "_t0", None)
+            if t0 is not None:
+                self._ttfts.append((now - t0) * 1000.0)
+            self._emit_token(free[i], int(toks0[i]))
+        return True
+
+    def _try_decode(self) -> bool:
+        active0 = np.asarray([s is not None for s in self._slots], bool)
+        if not active0.any():
+            return False
+        ctr = self._counter
+        self._counter += 1
+        cmd = ("decode", self._toks.copy(), self._lens.copy(), active0.copy(),
+               self._temps.copy(), self._topks.copy(), self._topps.copy(),
+               np.int32(ctr))
+        if self._leader_ch is not None:
+            self._leader_ch.send(cmd)
+        with self.mesh:
+            out, self._ck, self._cv = self._decode_fn(
+                self.params, self._ck, self._cv, self._toks, self._lens,
+                active0, self._temps, self._topks, self._topps, np.int32(ctr),
+            )
+        out = np.asarray(out)  # [K, B] replicated
+        K = out.shape[0]
+        self._tps_marks.append((time.time(), int(active0.sum()) * K))
+        for k in range(K):
+            for b in range(self.max_slots):
+                if not active0[b] or self._slots[b] is None:
+                    continue  # finished mid-round: ignore its later tokens
+                self._emit_token(b, int(out[k, b]))
+        live = np.asarray([s is not None for s in self._slots], bool)
+        self._toks = np.where(live, out[-1], self._toks).astype(np.int32)
+        # the device advanced lengths once per step for every row active at
+        # round START (its `active` is constant through the scan)
+        adv = np.where(active0, K, 0).astype(np.int32)
+        self._lens = self._lens + adv
+        # a round writes K/V at positions lens..lens+K-1: a slot without a
+        # full round of headroom must finish NOW — an out-of-bounds cache
+        # write would be clamped/dropped and the tokens sampled from that
+        # corrupted attention state would stream to the client
+        for b in range(self.max_slots):
+            if self._slots[b] is not None and (
+                int(self._lens[b]) + K > self.max_seq_len - 1
+            ):
+                self._finish_slot(b, "length")
+        return True
+
+    def _emit_token(self, b: int, tok: int) -> None:
+        slot = self._slots[b]
+        if slot is None:
+            return
+        req = slot.req
+        self.total_tokens += 1
+        slot.generated += 1
+        eos = getattr(self.tokenizer, "eos_id", -1)
+        finish = None
+        if eos is not None and tok == eos:
+            finish = "stop"
+            text = ""
+        else:
+            text, slot.pending = self.tokenizer.decode_stream(slot.pending, [tok])
+        if text:
+            slot.text += text
+            for stop_s in req.stop:
+                idx = slot.text.find(stop_s)
+                if idx >= 0:
+                    # emit up to the stop string, then finish
+                    keep = idx - (len(slot.text) - len(text))
+                    if keep > 0:
+                        req.out.put({"type": "token", "text": text[:keep]})
+                    finish = "stop"
+                    text = ""
+                    break
+            if text and finish is None:
+                req.out.put({"type": "token", "text": text})
+        if finish is None and slot.generated >= req.max_tokens:
+            finish = "length"
+        if finish is not None:
+            self._finish_slot(b, finish)
+
+    def _finish_slot(self, b: int, finish: str) -> None:
+        slot = self._slots[b]
+        if slot is None:
+            return
+        req = slot.req
+        tail = self.tokenizer.decode_flush(slot.pending)
+        if tail and finish != "stop":
+            req.out.put({"type": "token", "text": tail})
+        req.out.put({
+            "type": "done",
+            "finish_reason": finish,
+            "usage": {
+                "prompt_tokens": slot.prompt_len,
+                "completion_tokens": slot.generated,
+                "total_tokens": slot.prompt_len + slot.generated,
+            },
+        })
+        req.out.put(_DONE)
+        self._slots[b] = None

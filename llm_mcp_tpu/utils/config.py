@@ -122,6 +122,11 @@ class Config:
     tpu_max_slots: int = field(default_factory=lambda: getenv_int("TPU_MAX_SLOTS", 32))
     tpu_max_seq_len: int = field(default_factory=lambda: getenv_int("TPU_MAX_SEQ_LEN", 2048))
     tpu_mesh_shape: str = field(default_factory=lambda: getenv("TPU_MESH_SHAPE", ""))  # e.g. "dp=1,tp=8"
+    # multi-PROCESS serving (executor/slice_engine.py): leader→follower
+    # command channel address; non-empty + a jax.distributed triplet puts
+    # process 0 in CoreServer as the slice leader, every other process in
+    # the follower loop — the whole slice registers as ONE device
+    tpu_slice_cmd_addr: str = field(default_factory=lambda: getenv("TPU_SLICE_CMD_ADDR", ""))
     tpu_quant: str = field(default_factory=lambda: getenv("TPU_QUANT", ""))  # "" | int8
     tpu_kv_quant: str = field(default_factory=lambda: getenv("TPU_KV_QUANT", ""))  # "" | int8
     # chunked prefill segment length (tokens); 0 disables interleaved prefill
