@@ -232,12 +232,23 @@ def serve_path_metrics(
     with eng.stats_lock:
         tok0, err0 = eng.total_tokens, eng.total_errors
         fin0, ftok0 = eng.finished_requests, eng.finished_tokens
+    ph0 = eng.phase_budget()
     m0 = time.time()
     time.sleep(measure_s)
     with eng.stats_lock:
         tok1, err1 = eng.total_tokens, eng.total_errors
         fin1, ftok1 = eng.finished_requests, eng.finished_tokens
+    ph1 = eng.phase_budget()
     m1 = time.time()
+    # engine-loop budget over the window: where each wall-clock second of
+    # the serve loop went (fetch = device round wait, dispatch = staging,
+    # admit/prefill = admission work, emit = tokenizer+SSE queue puts,
+    # idle = no work; the remainder is untimed loop overhead)
+    wall = max(m1 - m0, 1e-9)
+    phase_pct = {
+        k: round(100.0 * (ph1[k] - ph0[k]) / wall, 1) for k in ph1
+    }
+    print(f"# serve phase budget (% of window wall): {phase_pct}", flush=True)
     # settle BEFORE stopping: requests POSTed near the window end whose first
     # delta is still pending are exactly the tail the p95 must capture —
     # cutting here would right-censor the percentiles low. Scaled so tiny
@@ -312,6 +323,7 @@ def serve_path_metrics(
     del eng, srv
     gc.collect()
     out = {"tok_per_s": (tok1 - tok0) / (m1 - m0)}
+    out["phase_pct"] = phase_pct
     if direct_tps > 0:
         out["engine_direct_tok_per_s"] = direct_tps
     out["prefix_cache_hits"] = float(pstats.get("hits", 0))
@@ -791,6 +803,31 @@ def main() -> None:
                 print(f"# K={alt_chunk} sweep failed: {e!r}", flush=True)
                 secondary[f"ttft_k{alt_chunk}_error"] = 0.0
             gc.collect()
+        if (
+            serve
+            and os.environ.get("BENCH_COLDSTART", "1") != "0"
+            and not over_budget(0.85, "cold-start probe", "coldstart_skipped")
+        ):
+            # Restart honesty (VERDICT r4 #9): boot→first-token with an
+            # EMPTY compile cache vs a warm persistent cache, in fresh
+            # subprocesses so the measurement includes every first compile
+            # an operator's restart would pay.
+            try:
+                secondary.update(
+                    coldstart_metrics(model, B, S, use_cache=platform != "cpu")
+                )
+            except Exception as e:
+                print(f"# cold-start probe failed: {e!r}", flush=True)
+                secondary["coldstart_error"] = 0.0
+            gc.collect()
+        real_dir = os.environ.get("BENCH_REAL_CKPT_DIR", "")
+        if real_dir and os.path.isfile(os.path.join(real_dir, "config.json")):
+            try:
+                secondary.update(real_ckpt_metrics(real_dir))
+            except Exception as e:
+                print(f"# real-checkpoint probe failed: {e!r}", flush=True)
+                secondary["real_ckpt_error"] = 0.0
+            gc.collect()
         if not serve and not raw_attempted:
             # serve disabled/failed and the raw sweep was never attempted:
             # it becomes the headline. (If it was attempted and FAILED, do
@@ -817,6 +854,9 @@ def main() -> None:
                 line["engine_direct_tok_per_s"] = round(
                     serve["engine_direct_tok_per_s"], 1
                 )
+            if "phase_pct" in serve:
+                # where the engine loop's wall-clock went during the window
+                line["serve_phase_pct"] = serve["phase_pct"]
             if secondary:
                 line["secondary"] = secondary
             print(json.dumps(line))
@@ -855,6 +895,128 @@ def main() -> None:
     if secondary:
         line["secondary"] = secondary
     print(json.dumps(line))
+
+
+def real_ckpt_metrics(ckpt_dir: str) -> dict[str, float]:
+    """Published-checkpoint secondary (VERDICT r4 #8): serve a real HF
+    checkpoint dir, check factual-continuation sanity, record decode tok/s.
+    The pytest half lives in tests/test_published_checkpoint.py; this makes
+    the same evidence appear in the bench artifact when weights are present."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_mcp_tpu.executor import GenerationEngine
+
+    platform = jax.devices()[0].platform
+    dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
+    eng = GenerationEngine(
+        os.path.basename(ckpt_dir.rstrip("/")), weights_dir=ckpt_dir,
+        max_slots=8, max_seq_len=512, dtype=dtype, quant="int8",
+        kv_quant="int8",
+    ).start()
+    try:
+        out = eng.generate(
+            "Question: What is the capital of France?\nAnswer:",
+            max_tokens=8, temperature=0.0,
+        )
+        sane = 1.0 if "paris" in out["text"].lower() else 0.0
+        t0 = time.perf_counter()
+        r = eng.generate("Write one sentence about the sea.",
+                         max_tokens=64, temperature=0.0)
+        dt = time.perf_counter() - t0
+        return {
+            "real_ckpt_sanity": sane,
+            "real_ckpt_tok_per_s_b1": round(
+                r["usage"]["completion_tokens"] / max(dt, 1e-9), 1
+            ),
+        }
+    finally:
+        eng.shutdown()
+        gc.collect()
+
+
+def coldstart_child(model: str, slots: int, seq: int) -> None:
+    """Boot a fresh engine and time boot→first-streamed-token for ONE
+    request (the operator's restart experience). The parent points
+    JAX_COMPILATION_CACHE_DIR at an empty dir for the cold number and at
+    the now-populated dir for the warm one — the same persistent-cache
+    mechanics the serving entrypoints default to."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_mcp_tpu.executor import GenerationEngine
+
+    platform = jax.devices()[0].platform
+    dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
+    t0 = time.perf_counter()
+    eng = GenerationEngine(
+        model, max_slots=slots, max_seq_len=seq, dtype=dtype,
+        quant="int8", kv_quant="int8", decode_chunk=16, admit_batch=8,
+    ).start()
+    boot_s = time.perf_counter() - t0
+    ttft_s = -1.0
+    t1 = time.perf_counter()
+    for evt in eng.generate_stream(
+        "cold start: time to the first streamed token after a restart?",
+        max_tokens=4, temperature=0.0,
+    ):
+        if evt["type"] == "token":
+            ttft_s = time.perf_counter() - t1
+            break
+        if evt["type"] == "error":
+            break
+    eng.shutdown()
+    if ttft_s < 0:
+        # no first token = no measurement; a sentinel folded into the sum
+        # would publish a silently wrong restart number
+        print("# coldstart child: no token event", flush=True)
+        raise SystemExit(3)
+    print(json.dumps({"boot_s": round(boot_s, 2), "ttft_s": round(ttft_s, 2)}),
+          flush=True)
+
+
+def coldstart_metrics(
+    model: str, slots: int, seq: int, use_cache: bool = True
+) -> dict[str, float]:
+    """Run coldstart_child twice against one cache dir: empty (cold) then
+    populated (warm restart). `use_cache=False` (the CPU harness) skips the
+    cache env injection — the repo deliberately keeps the persistent cache
+    opt-in on CPU (round-tripped AOT executables are slow/unsafe there), so
+    both children then measure plain restarts."""
+    import subprocess
+    import sys
+    import tempfile
+
+    import shutil
+
+    cache_dir = tempfile.mkdtemp(prefix="bench_coldstart_cache_")
+    out: dict[str, float] = {}
+    try:
+        for label in ("empty_cache", "warm_cache"):
+            env = dict(os.environ)
+            if use_cache:
+                env["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--coldstart-child",
+                 model, str(slots), str(seq)],
+                env=env, capture_output=True, text=True, timeout=1800,
+            )
+            wall = time.perf_counter() - t0
+            if proc.returncode != 0:
+                raise RuntimeError(f"coldstart child ({label}) rc={proc.returncode}: "
+                                   f"{proc.stderr[-800:]}")
+            doc = json.loads([l for l in proc.stdout.splitlines()
+                              if l.startswith("{")][-1])
+            out[f"coldstart_first_token_s_{label}"] = round(
+                doc["boot_s"] + doc["ttft_s"], 1
+            )
+            out[f"coldstart_wall_s_{label}"] = round(wall, 1)
+    finally:
+        # an 8B compile cache is hundreds of MB; a leaked dir per bench run
+        # would eventually fill /tmp on the bench host
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    return out
 
 
 def client_proc(url: str, n: int, max_tokens: int, model: str, prompt: str) -> None:
@@ -956,6 +1118,9 @@ if __name__ == "__main__":
             _sys.argv[2], int(_sys.argv[3]), int(_sys.argv[4]),
             _sys.argv[5], _sys.argv[6],
         )
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "--coldstart-child":
+        coldstart_child(_sys.argv[2], int(_sys.argv[3]), int(_sys.argv[4]))
+        _exit_now(0)
     else:
         try:
             main()

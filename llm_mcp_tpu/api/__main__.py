@@ -95,6 +95,7 @@ def main() -> None:
                 decode_compact=cfg.tpu_decode_compact,
                 prompt_cache_mb=cfg.tpu_prompt_cache_mb,
                 prefill_buckets=cfg.tpu_prefill_buckets,
+                prefill_boost=cfg.tpu_prefill_boost,
             ).start()
         emodel = cfg.tpu_embed_model
         cfg.warn_embed_dir_gap(log)

@@ -277,7 +277,8 @@ class SliceEngine:
                 ck, cv, toks, lens = carry
                 logits, ck, cv = llama_decode_step(cfg, params, ck, cv, toks, lens)
                 key = jax.random.fold_in(cmd_key, i)  # i < K; admit uses K
-                new = sample_tokens(logits, key, temps, topks, topps)
+                new = sample_tokens(logits, key, temps, topks, topps,
+                                    active=active)
                 new = jnp.where(active, new, toks)
                 lens = lens + active.astype(jnp.int32)
                 return (ck, cv, new, lens), new
@@ -315,7 +316,8 @@ class SliceEngine:
             ck, cv = jax.lax.fori_loop(0, tokens.shape[0], body, (ck, cv))
             # fold (counter, K): disjoint from decode's (counter, i<K) space
             key = jax.random.fold_in(jax.random.fold_in(base_key, counter), K)
-            toks0 = sample_tokens(logits, key, temps, topks, topps)
+            toks0 = sample_tokens(logits, key, temps, topks, topps,
+                                  active=jnp.arange(tokens.shape[0]) < live_n)
             return ck, cv, toks0
 
         self._decode_fn = decode_fn
@@ -340,6 +342,7 @@ class SliceEngine:
         self._tps_marks: deque[tuple[float, int]] = deque(maxlen=256)
         self.attn_impl = "xla"
         self.dead: str = ""  # non-empty = engine loop died with this error
+        self._dead_lock = threading.Lock()  # atomizes submit vs shutdown drain
 
     # -- checkpoint -------------------------------------------------------
 
@@ -409,11 +412,15 @@ class SliceEngine:
         return self
 
     def submit(self, req: SliceRequest) -> None:
-        if self.dead:
-            req.out.put({"type": "error", "error": f"engine dead: {self.dead}"})
-            req.out.put(_DONE)
-            return
-        self._queue.put(req)
+        # the dead-check and the put must be atomic against shutdown()'s
+        # queue drain: a submit that passed the check pre-drain would
+        # otherwise land in a dead queue and hang its consumer forever
+        with self._dead_lock:
+            if self.dead:
+                req.out.put({"type": "error", "error": f"engine dead: {self.dead}"})
+                req.out.put(_DONE)
+                return
+            self._queue.put(req)
 
     def generate_stream(
         self,
@@ -482,9 +489,31 @@ class SliceEngine:
         )
 
     def shutdown(self) -> None:
+        with self._dead_lock:
+            if not self.dead:
+                self.dead = "engine shut down"  # submit() rejects from here on
         self._shutdown.set()
         if self._thread is not None:
             self._thread.join(timeout=30)
+        # drain: active slots and queued requests must get terminal events —
+        # an SSE handler blocked in req.out.get() would otherwise hang the
+        # server's shutdown forever (GenerationEngine.shutdown parity). The
+        # drain runs under the same lock as submit's dead-check+put, so no
+        # request can slip into the queue after it.
+        with self._dead_lock:
+            for b in range(self.max_slots):
+                s = self._slots[b]
+                if s is not None:
+                    s.req.out.put({"type": "error", "error": "engine shut down"})
+                    s.req.out.put(_DONE)
+                    self._slots[b] = None
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                req.out.put({"type": "error", "error": "engine shut down"})
+                req.out.put(_DONE)
         if self._leader_ch is not None:
             try:
                 self._leader_ch.send(("stop",))
@@ -511,20 +540,21 @@ class SliceEngine:
             # the followers — they must not block on recv() forever.
             log.exception("slice engine loop died")
             self.total_errors += 1
-            self.dead = repr(e)
-            for b in range(self.max_slots):
-                s = self._slots[b]
-                if s is not None:
-                    s.req.out.put({"type": "error", "error": repr(e)})
-                    s.req.out.put(_DONE)
-                    self._slots[b] = None
-            while True:
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                req.out.put({"type": "error", "error": repr(e)})
-                req.out.put(_DONE)
+            with self._dead_lock:  # same atomicity as shutdown's drain
+                self.dead = repr(e)
+                for b in range(self.max_slots):
+                    s = self._slots[b]
+                    if s is not None:
+                        s.req.out.put({"type": "error", "error": repr(e)})
+                        s.req.out.put(_DONE)
+                        self._slots[b] = None
+                while True:
+                    try:
+                        req = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    req.out.put({"type": "error", "error": repr(e)})
+                    req.out.put(_DONE)
             if self._leader_ch is not None:
                 try:
                     self._leader_ch.send(("stop",))
@@ -569,14 +599,23 @@ class SliceEngine:
         self._counter += 1
         cmd = ("admit", tokens, lengths, slots, np.int32(A), temps, topks,
                topps, np.int32(ctr))
-        if self._leader_ch is not None:
-            self._leader_ch.send(cmd)
-        with self.mesh:
-            self._ck, self._cv, toks0 = self._admit_fn(
-                self.params, self._ck, self._cv, tokens, lengths, slots,
-                np.int32(A), temps, topks, topps, np.int32(ctr),
-            )
-        toks0 = np.asarray(toks0)
+        try:
+            if self._leader_ch is not None:
+                self._leader_ch.send(cmd)
+            with self.mesh:
+                self._ck, self._cv, toks0 = self._admit_fn(
+                    self.params, self._ck, self._cv, tokens, lengths, slots,
+                    np.int32(A), temps, topks, topps, np.int32(ctr),
+                )
+            toks0 = np.asarray(toks0)
+        except Exception as e:
+            # these requests were already popped off the queue — the loop's
+            # crash handler can no longer see them, so fail them HERE or
+            # their consumers block in out.get() forever
+            for r in batch:
+                r.out.put({"type": "error", "error": repr(e)})
+                r.out.put(_DONE)
+            raise
         now = time.time()
         for i, r in enumerate(batch):
             slot = _Slot(req=r, prompt_len=int(lengths[i]))

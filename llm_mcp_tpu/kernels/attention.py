@@ -687,10 +687,38 @@ def decode_attend_q8(
 
     nk4 = new_k.reshape(B, Hkv, 1, hd)
     nv4 = new_v.reshape(B, Hkv, 1, hd)
-    if S <= decode_pallas_max_seq(hd, Hkv, Hkv * G, quantized=True):
+    can_whole = S <= decode_pallas_max_seq(hd, Hkv, Hkv * G, quantized=True)
+    # BS must divide S (a floored block count would silently drop the tail —
+    # including the current position)
+    BS = next((c for c in (256, 128, 64, 32) if S % c == 0), 0)
+    if not can_whole and BS == 0:
+        # no whole-S fit and no int8-tileable block divides S: exact f32
+        # math of the CPU fallback (slower, never wrong)
+        return _decode_attend_q8_fallback(
+            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids
+        )
+    ids = (
+        jnp.arange(B, dtype=jnp.int32)
+        if slot_ids is None
+        else slot_ids.astype(jnp.int32)
+    )
+    args = (
+        jnp.reshape(layer, (1,)).astype(jnp.int32),
+        ids,
+        lengths.astype(jnp.int32),
+        q,
+        nk4,
+        nv4,
+        cache_k["q"],
+        cache_k["s"],
+        cache_v["q"],
+        cache_v["s"],
+    )
+    out_shape = jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype)
+
+    def run_whole():
         # whole-S tiles fit VMEM: one big DMA per tensor per cell, pipelined
-        # across grid cells — measured faster than blockwise streaming at
-        # serving sizes (24.1 vs 26.3 ms/step at 8B B=112 S=1024)
+        # across grid cells — the cheaper shape once rows are mostly full
         kernel = functools.partial(_attend_q8_kernel, scale=sc)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,  # layer [1], slot ids [Ba], lengths [Ba]
@@ -718,18 +746,16 @@ def decode_attend_q8(
                 (1, Hkv, G, hd), lambda b, li, ids, lens: (b, 0, 0, 0)
             ),
         )
-    else:
-        # long context: rows stream blockwise from HBM with a dynamic trip
-        # count — no VMEM cliff at any S, and only the attended prefix
-        # [0, w] is ever read. BS must divide S (a floored block count would
-        # silently drop the tail — including the current position).
-        BS = next((c for c in (256, 128, 64, 32) if S % c == 0), 0)
-        if BS == 0:
-            # no int8-tileable block divides S: use the exact f32 math of
-            # the CPU fallback (slower, never wrong)
-            return _decode_attend_q8_fallback(
-                q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids
-            )
+        return pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interp
+        )(*args)
+
+    def run_blocked():
+        # rows stream blockwise from HBM with a dynamic trip count — no
+        # VMEM cliff at any S, and only the attended prefix [0, w] is ever
+        # read. Pays ~2.5 µs/cell of DMA-issue latency (measured: ~9 ms of
+        # fixed cost at 8B B=112), so it wins at LOW fill and loses to the
+        # whole-S pipeline once rows are mostly full.
         kernel = functools.partial(
             _attend_q8_blocked_kernel, scale=sc, block_s=BS, seq_len=S
         )
@@ -756,28 +782,33 @@ def decode_attend_q8(
                 pltpu.SemaphoreType.DMA((2, 4)),
             ],
         )
-    ids = (
-        jnp.arange(B, dtype=jnp.int32)
-        if slot_ids is None
-        else slot_ids.astype(jnp.int32)
-    )
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype),
-        interpret=interp,
-    )(
-        jnp.reshape(layer, (1,)).astype(jnp.int32),
-        ids,
-        lengths.astype(jnp.int32),
-        q,
-        nk4,
-        nv4,
-        cache_k["q"],
-        cache_k["s"],
-        cache_v["q"],
-        cache_v["s"],
-    )
+        return pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interp
+        )(*args)
+
+    mode = os.environ.get("LLM_MCP_TPU_Q8_DECODE", "auto")
+    if mode == "whole" and can_whole:
+        return run_whole()
+    if mode == "blocked" and BS:
+        return run_blocked()
+    if not can_whole:
+        return run_blocked()
+    if BS == 0 or interp:
+        # interpret mode keeps the static whole-S choice: a runtime cond
+        # would emulate BOTH kernels per call in tests
+        return run_whole()
+    # Runtime hybrid (both executables compile once): measured at 8B B=112
+    # S=1024, the blocked kernel wins below ~40% traffic ratio (20.5 vs
+    # 24.4 ms/step empty — cache reads scale with actual lengths) and the
+    # whole-S pipeline wins once rows are mostly full (24.4 vs 29.2 at 88%).
+    # Compare the kernels' ACTUAL traffic: whole-S DMAs all B rows in full
+    # (parked/pad rows included), blocked streams the attended prefix per
+    # active row and ONE block per parked row — so the ratio denominator is
+    # B·S, not active·S (normalizing by active rows would overestimate the
+    # whole-S path exactly in the low-occupancy regime blocked wins).
+    w_eff = jnp.where(lengths < S, jnp.minimum(lengths + 1, S), BS)
+    ratio = jnp.sum(w_eff.astype(jnp.float32)) / (B * S)
+    return jax.lax.cond(ratio < 0.4, run_blocked, run_whole)
 
 
 def _attend_q8_mla_kernel(

@@ -116,6 +116,13 @@ def test_slice_engine_dead_loop_fails_requests():
         eng._admit_fn = boom
         with pytest.raises(RuntimeError, match="injected"):
             eng.generate("kill it", max_tokens=4)
+        # the request's error event is delivered from _try_admit BEFORE the
+        # loop's crash handler marks the engine dead — wait for the handler
+        import time as _time
+
+        deadline = _time.time() + 10
+        while not eng.dead and _time.time() < deadline:
+            _time.sleep(0.05)
         assert eng.dead
         with pytest.raises(RuntimeError, match="engine dead"):
             eng.generate("after death", max_tokens=4)
