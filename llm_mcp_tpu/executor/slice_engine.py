@@ -501,19 +501,7 @@ class SliceEngine:
         # drain runs under the same lock as submit's dead-check+put, so no
         # request can slip into the queue after it.
         with self._dead_lock:
-            for b in range(self.max_slots):
-                s = self._slots[b]
-                if s is not None:
-                    s.req.out.put({"type": "error", "error": "engine shut down"})
-                    s.req.out.put(_DONE)
-                    self._slots[b] = None
-            while True:
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                req.out.put({"type": "error", "error": "engine shut down"})
-                req.out.put(_DONE)
+            self._drain_requests("engine shut down")
         if self._leader_ch is not None:
             try:
                 self._leader_ch.send(("stop",))
@@ -525,6 +513,24 @@ class SliceEngine:
 
     def _free_slots(self) -> list[int]:
         return [i for i, s in enumerate(self._slots) if s is None]
+
+    def _drain_requests(self, msg: str) -> None:
+        """Fail every active slot and queued request with a terminal event.
+        Caller holds _dead_lock (both the shutdown and crash paths — one
+        copy, so the two drains cannot drift apart)."""
+        for b in range(self.max_slots):
+            s = self._slots[b]
+            if s is not None:
+                s.req.out.put({"type": "error", "error": msg})
+                s.req.out.put(_DONE)
+                self._slots[b] = None
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.out.put({"type": "error", "error": msg})
+            req.out.put(_DONE)
 
     def _engine_loop(self) -> None:
         try:
@@ -542,19 +548,7 @@ class SliceEngine:
             self.total_errors += 1
             with self._dead_lock:  # same atomicity as shutdown's drain
                 self.dead = repr(e)
-                for b in range(self.max_slots):
-                    s = self._slots[b]
-                    if s is not None:
-                        s.req.out.put({"type": "error", "error": repr(e)})
-                        s.req.out.put(_DONE)
-                        self._slots[b] = None
-                while True:
-                    try:
-                        req = self._queue.get_nowait()
-                    except queue.Empty:
-                        break
-                    req.out.put({"type": "error", "error": repr(e)})
-                    req.out.put(_DONE)
+                self._drain_requests(repr(e))
             if self._leader_ch is not None:
                 try:
                     self._leader_ch.send(("stop",))

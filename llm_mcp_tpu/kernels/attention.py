@@ -916,15 +916,17 @@ def _attend_q8_mla_blocked_kernel(
     nc_ref,  # [1, 1, R] VMEM — this step's exact latent
     nr_ref,  # [1, 1, dr] VMEM — this step's exact rope key
     lat_hbm,  # [L, B, 1, S, R] int8 — latent payload, stays in HBM (ANY)
-    lats_hbm,  # [L, B, 1, S] — latent scales
-    rop_hbm,  # [L, B, 1, S, dr] int8 — rope-key payload
-    rops_hbm,  # [L, B, 1, S] — rope-key scales
+    lats_ref,  # [1, 1, 1, S] VMEM — latent scales (whole row via BlockSpec)
+    rop_ref,  # [1, 1, 1, S, dr] VMEM — rope payload (whole row: dr < the
+    #           128-lane tile, so a manual DMA of a [BS, dr] slice of its
+    #           lane-padded HBM layout is rejected; the BlockSpec pipeline
+    #           is layout-aware. Rope+scales are ≤1/8 of the latent bytes
+    #           and the caller caps S//BS at 64, so whole-row VMEM is ≤3 MB)
+    rops_ref,  # [1, 1, 1, S] VMEM — rope scales
     o_ref,  # [1, H, R] VMEM out — context in latent space
-    lat_buf,  # VMEM scratch [2, BS, R] int8 (double buffer)
-    lats_buf,  # [2, BS]
-    rop_buf,  # [2, BS, dr] int8
-    rops_buf,  # [2, BS]
-    sems,  # DMA semaphores [2, 4]
+    lat_buf,  # VMEM scratch [2, BS, R] int8 (double buffer) — the latent
+    #           payload is the real bandwidth and DOES stream blockwise
+    sems,  # DMA semaphores [2]
     *,
     scale: float,
     block_s: int,
@@ -932,12 +934,20 @@ def _attend_q8_mla_blocked_kernel(
 ):
     """Long-context MLA decode attention: the blocked-DMA analog of
     `_attend_q8_mla_kernel` (absorbed MQA-shaped form, second additive
-    rope-score term) with `_attend_q8_blocked_kernel`'s streaming structure
-    — the latent row stays in HBM and a double-buffered manual DMA loop
-    with a DYNAMIC trip count streams exactly the attended prefix [0, w],
-    flash-style online softmax accumulating the latent-space context across
-    blocks. No VMEM cliff at any S: this is what replaces the XLA
-    dequant-then-dot path at S=32k int8-latent serving."""
+    rope-score term) — the latent row stays in HBM and a double-buffered
+    DMA loop streams the attended prefix [0, w], flash-style online softmax
+    accumulating the latent-space context across blocks.
+
+    The block loop is a STATIC python unroll over seq_len//BS with every
+    DMA gated by `pl.when(j < nblk)`: static block indices keep every
+    slice/index in the op classes the whole-S kernel already proves Mosaic
+    accepts (dynamic slot/offset forms tripped a parade of tiling-alignment
+    rejections: size-1 bf16 sublane slices, (2,128)-tiled f32 row DMA dsts,
+    64-lane rope slices). Blocks past nblk skip their DMA; their compute
+    runs on stale buffer contents and is a NATURAL no-op — every position
+    masks to -inf, so the online-softmax update leaves (acc, m, l)
+    unchanged. The caller bounds seq_len//BS (program size is linear in
+    it) and falls back to exact math beyond the cap."""
     b = pl.program_id(0)
     li = li_ref[0]
     row = ids_ref[b]
@@ -948,33 +958,21 @@ def _attend_q8_mla_blocked_kernel(
     # parked/free rows (w >= S) produce discarded output: stream one block
     nblk = jnp.where(w >= seq_len, 1, nblk)
 
-    def copies(j, slot):
-        return (
-            pltpu.make_async_copy(
-                lat_hbm.at[li, row, 0, pl.ds(j * BS, BS), :], lat_buf.at[slot],
-                sems.at[slot, 0],
-            ),
-            pltpu.make_async_copy(
-                lats_hbm.at[li, row, 0, pl.ds(j * BS, BS)], lats_buf.at[slot],
-                sems.at[slot, 1],
-            ),
-            pltpu.make_async_copy(
-                rop_hbm.at[li, row, 0, pl.ds(j * BS, BS), :], rop_buf.at[slot],
-                sems.at[slot, 2],
-            ),
-            pltpu.make_async_copy(
-                rops_hbm.at[li, row, 0, pl.ds(j * BS, BS)], rops_buf.at[slot],
-                sems.at[slot, 3],
-            ),
+    def copy(j: int, slot: int):
+        return pltpu.make_async_copy(
+            lat_hbm.at[li, row, 0, pl.ds(j * BS, BS), :], lat_buf.at[slot],
+            sems.at[slot],
         )
 
-    def start(j, slot):
-        for c in copies(j, slot):
-            c.start()
+    def start(j: int, slot: int):
+        @pl.when(j < nblk)
+        def _():
+            copy(j, slot).start()
 
-    def wait(j, slot):
-        for c in copies(j, slot):
-            c.wait()
+    def wait(j: int, slot: int):
+        @pl.when(j < nblk)
+        def _():
+            copy(j, slot).wait()
 
     start(0, 0)
 
@@ -990,57 +988,57 @@ def _attend_q8_mla_blocked_kernel(
     )[:, None] * scale  # [H, 1]
 
     H, R = qt.shape
-    acc0 = jnp.zeros((H, R), jnp.float32)
-    m0 = jnp.full((H, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((H, 1), jnp.float32)
+    acc = jnp.zeros((H, R), jnp.float32)
+    m = jnp.full((H, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((H, 1), jnp.float32)
 
-    def body(j, carry):
-        acc, m, l = carry
-        slot = jax.lax.rem(j, 2)
-
-        @pl.when(j + 1 < nblk)
-        def _prefetch():
+    for j in range(nblk_max):  # static unroll; see docstring
+        slot = j % 2
+        if j + 1 < nblk_max:
             start(j + 1, 1 - slot)
-
         wait(j, slot)
         lat = lat_buf[slot]  # [BS, R] int8
-        lats = lats_buf[slot].astype(jnp.float32)  # [BS]
+        # static block slices of the BlockSpec-delivered rows (j is a
+        # python int: every start is a provable tile multiple)
+        lats = lats_ref[0, 0, 0, j * BS:(j + 1) * BS].astype(jnp.float32)
         # latent scores: s8 x s8 -> s32 on the MXU, post-dot scale fold
         s_i = jax.lax.dot_general(
             qt8, lat, (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32
         )  # [H, BS]
         s = s_i.astype(jnp.float32) * (scale * qsc)[:, None] * lats[None, :]
         # rope scores: BS x dr is tiny — dequant on the VPU, f32 dot
-        rop = rop_buf[slot].astype(jnp.float32) * rops_buf[slot].astype(
-            jnp.float32
-        )[:, None]  # [BS, dr]
+        rops = rops_ref[0, 0, 0, j * BS:(j + 1) * BS].astype(jnp.float32)
+        rop = rop_ref[0, 0, 0, j * BS:(j + 1) * BS, :].astype(jnp.float32) * rops[:, None]
         s = s + jax.lax.dot_general(
             qr, rop, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         pos = j * BS + jax.lax.broadcasted_iota(jnp.int32, (1, BS), 1)
-        s = jnp.where(pos == w, s_new, s)
-        s = jnp.where(pos <= w, s, NEG_INF)
+        # skipped blocks (j >= nblk) hold STALE buffer bytes — every mask
+        # must also gate on the block being live, or a parked row (w >= S,
+        # so pos <= w everywhere) would exponentiate garbage into NaN
+        live = pos <= jnp.where(j < nblk, w, -1)
+        cur = live & (pos == w)
+        s = jnp.where(cur, s_new, s)
+        s = jnp.where(live, s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.where(pos <= w, jnp.exp(s - m_new), 0.0)
+        p = jnp.where(live, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        p_w = jnp.sum(jnp.where(pos == w, p, 0.0), axis=-1, keepdims=True)
-        # fold latent dequant scales into the probs, requantize, PV on MXU
-        pv = jnp.where(pos == w, 0.0, p * lats[None, :])  # [H, BS]
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        p_w = jnp.sum(jnp.where(cur, p, 0.0), axis=-1, keepdims=True)
+        # fold latent dequant scales into the probs, requantize, PV on MXU.
+        # Gate on `live`, not just ~cur: a skipped block's stale lats can be
+        # NaN and 0 * NaN = NaN would poison the accumulator.
+        pv = jnp.where(live & ~cur, p * lats[None, :], 0.0)  # [H, BS]
         pa = jnp.max(pv, axis=-1)
         psc = jnp.maximum(pa / 127.0, 1e-30)
         p8 = jnp.round(pv / psc[:, None]).astype(jnp.int8)
         ctx_i = jax.lax.dot_general(
             p8, lat, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
         )  # [H, R]
-        acc_new = (
-            acc * alpha + ctx_i.astype(jnp.float32) * psc[:, None]
-            + p_w * nc[None, :]
-        )
-        return acc_new, m_new, l_new
+        acc = acc * alpha + ctx_i.astype(jnp.float32) * psc[:, None] + p_w * nc[None, :]
+        m = m_new
 
-    acc, m, l = jax.lax.fori_loop(0, nblk, body, (acc0, m0, l0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
 
 
@@ -1119,12 +1117,37 @@ def decode_attend_q8_mla(
     # blocked path: BS must divide S (a floored trip count would drop the
     # tail — including the current position)
     BS = next((c for c in (512, 256, 128) if S % c == 0), 0)
+    if BS and S // BS > 64:
+        # the blocked kernel's program size is linear in S//BS (static
+        # unroll — see _attend_q8_mla_blocked_kernel docstring): past 64
+        # blocks (S=32k at BS=512) compile time outgrows the win
+        BS = 0
     if not _HAS_PLTPU or (not fits and BS == 0) or (not interp and R % 128 != 0):
         return _decode_attend_q8_mla_fallback(
             qt, qr, new_c, new_r, cache_c, cache_r, layer, lengths, scale, slot_ids
         )
 
-    if fits:
+    ids = (
+        jnp.arange(Ba, dtype=jnp.int32)
+        if slot_ids is None
+        else slot_ids.astype(jnp.int32)
+    )
+    args = (
+        jnp.reshape(layer, (1,)).astype(jnp.int32),
+        ids,
+        lengths.astype(jnp.int32),
+        qt,
+        qr,
+        new_c.reshape(Ba, 1, R),
+        new_r.reshape(Ba, 1, dr),
+        cache_c["q"],
+        cache_c["s"],
+        cache_r["q"],
+        cache_r["s"],
+    )
+    out_shape = jax.ShapeDtypeStruct((Ba, H, R), qt.dtype)
+
+    def run_whole():
         kernel = functools.partial(_attend_q8_mla_kernel, scale=scale)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,  # layer [1], slot ids [Ba], lengths [Ba]
@@ -1149,7 +1172,11 @@ def decode_attend_q8_mla(
             ],
             out_specs=pl.BlockSpec((1, H, R), lambda b, li, ids, lens: (b, 0, 0)),
         )
-    else:
+        return pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interp
+        )(*args)
+
+    def run_blocked():
         kernel = functools.partial(
             _attend_q8_mla_blocked_kernel, scale=scale, block_s=BS, seq_len=S
         )
@@ -1161,43 +1188,42 @@ def decode_attend_q8_mla(
                 pl.BlockSpec((1, H, dr), lambda b, li, ids, lens: (b, 0, 0)),
                 pl.BlockSpec((1, 1, R), lambda b, li, ids, lens: (b, 0, 0)),
                 pl.BlockSpec((1, 1, dr), lambda b, li, ids, lens: (b, 0, 0)),
-                pl.BlockSpec(memory_space=pl.ANY),  # latent payload
-                pl.BlockSpec(memory_space=pl.ANY),  # latent scales
-                pl.BlockSpec(memory_space=pl.ANY),  # rope payload
-                pl.BlockSpec(memory_space=pl.ANY),  # rope scales
+                pl.BlockSpec(memory_space=pl.ANY),  # latent payload (DMA'd)
+                # scales + the (small, lane-padded) rope row ride the
+                # layout-aware BlockSpec pipeline — see kernel docstring
+                pl.BlockSpec(
+                    (1, 1, 1, S), lambda b, li, ids, lens: (li[0], ids[b], 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, 1, S, dr), lambda b, li, ids, lens: (li[0], ids[b], 0, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, 1, S), lambda b, li, ids, lens: (li[0], ids[b], 0, 0)
+                ),
             ],
             out_specs=pl.BlockSpec((1, H, R), lambda b, li, ids, lens: (b, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((2, BS, R), jnp.int8),
-                pltpu.VMEM((2, BS), cache_c["s"].dtype),
-                pltpu.VMEM((2, BS, dr), jnp.int8),
-                pltpu.VMEM((2, BS), cache_r["s"].dtype),
-                pltpu.SemaphoreType.DMA((2, 4)),
+                pltpu.SemaphoreType.DMA((2,)),
             ],
         )
-    ids = (
-        jnp.arange(Ba, dtype=jnp.int32)
-        if slot_ids is None
-        else slot_ids.astype(jnp.int32)
-    )
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((Ba, H, R), qt.dtype),
-        interpret=interp,
-    )(
-        jnp.reshape(layer, (1,)).astype(jnp.int32),
-        ids,
-        lengths.astype(jnp.int32),
-        qt,
-        qr,
-        new_c.reshape(Ba, 1, R),
-        new_r.reshape(Ba, 1, dr),
-        cache_c["q"],
-        cache_c["s"],
-        cache_r["q"],
-        cache_r["s"],
-    )
+        return pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interp
+        )(*args)
+
+    # STATIC selection (unlike decode_attend_q8's runtime hybrid): measured
+    # at mla-8b kv8 B=32 S=2048, whole-S beats blocked even at low fill
+    # (1845 vs 1653 tok/s — the absorbed form is MQA-shaped, so whole-S
+    # cells amortize one huge row DMA over ALL heads and the traffic-ratio
+    # trade that pays off for GQA does not appear). The blocked kernel's
+    # job is S past the VMEM budget — int8-latent long context (S=32k) on
+    # the MXU instead of the XLA dequant path.
+    mode = os.environ.get("LLM_MCP_TPU_Q8_DECODE", "auto")
+    if mode == "whole" and fits:
+        return run_whole()
+    if mode == "blocked" and BS:
+        return run_blocked()
+    return run_whole() if fits else run_blocked()
 
 
 def _append_q8_kernel(

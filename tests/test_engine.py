@@ -31,6 +31,20 @@ def test_generate_basic(engine):
     assert out["finish_reason"] in ("stop", "length")
 
 
+def test_phase_budget_accumulates(engine):
+    """The serve-budget breakdown bench.py publishes relies on this
+    contract: phase keys are stable, values accumulate monotonically, and
+    generation moves at least the dispatch/fetch/emit phases."""
+    before = engine.phase_budget()
+    assert set(before) == {"dispatch", "fetch", "admit", "prefill", "emit", "idle"}
+    engine.generate("phase budget probe", max_tokens=6, temperature=0.0)
+    after = engine.phase_budget()
+    assert all(after[k] >= before[k] for k in before)
+    assert after["dispatch"] > before["dispatch"]
+    assert after["fetch"] > before["fetch"]
+    assert after["emit"] > before["emit"]
+
+
 def test_generate_deterministic_greedy(engine):
     a = engine.generate("same prompt", max_tokens=12, temperature=0.0)
     b = engine.generate("same prompt", max_tokens=12, temperature=0.0)
