@@ -73,6 +73,25 @@ def test_slice_engine_single_process():
         eng.shutdown()
 
 
+def test_slice_engine_int8_weights():
+    """quant="int8" builds the quantized tree with quantized_specs over the
+    global mesh (the 8B single-chip serving config, slice-engine form)."""
+    mesh = make_mesh("dp=4,tp=2")
+    eng = SliceEngine(
+        "tiny-llm", mesh=mesh, cmd_addr="127.0.0.1:0", max_slots=4,
+        max_seq_len=128, dtype=jnp.float32, decode_chunk=4, quant="int8",
+    ).start()
+    try:
+        out = eng.generate("int8 slice", max_tokens=6, temperature=0.0)
+        assert out["usage"]["completion_tokens"] == 6
+        out2 = eng.generate("int8 slice", max_tokens=6, temperature=0.0)
+        assert out["text"] == out2["text"]
+        # the tree really is quantized ({"q","s"} leaves)
+        assert isinstance(eng.params["layers"]["wq"], dict)
+    finally:
+        eng.shutdown()
+
+
 def test_slice_engine_capacity_headroom():
     """Near the KV bound the engine must finish with "length" BEFORE a
     decode round would write past the cache (an OOB scatter is silently
