@@ -180,6 +180,12 @@ class CoreServer:
                 "decode_compact": e.decode_compact,
                 "stalled": e.stalled,
                 "prefix_cache": e.prefix_cache_stats(),
+                # engine-loop wall-clock by phase since boot (the serve
+                # budget breakdown bench.py windows — here cumulative, so
+                # operators can diff two dashboard snapshots)
+                "phase_s": {
+                    k: round(v, 1) for k, v in e.phase_budget().items()
+                },
             }
             self.metrics.engine_slots_in_use.set(e.slots_in_use())
             self.metrics.engine_tps.set(e.current_tps())

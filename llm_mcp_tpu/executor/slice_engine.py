@@ -478,6 +478,9 @@ class SliceEngine:
     def prefix_cache_stats(self) -> dict[str, Any]:
         return {"enabled": False}
 
+    def phase_budget(self) -> dict[str, float]:
+        return {}  # per-phase accounting is a single-host engine feature
+
     def ttft_percentiles(self) -> tuple[float, float, int]:
         if not self._ttfts:
             return 0.0, 0.0, 0
