@@ -251,6 +251,13 @@ def test_dashboard_and_debug(base):
     assert dash["devices_online"] >= 1
     assert "jobs" in dash and "issues" in dash
     assert any(h["role"] for h in dash["hosts"])
+    # serve-budget breakdown per engine (cumulative; bench windows it)
+    gen_info = next(
+        v for v in dash["engines"].values() if v["kind"] == "generate"
+    )
+    assert set(gen_info["phase_s"]) == {
+        "dispatch", "fetch", "admit", "prefill", "emit", "idle",
+    }
 
     health = httpx.get(f"{base}/v1/debug/health").json()
     assert health["status"] == "ok"
