@@ -17,6 +17,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 from urllib.parse import parse_qs, urlparse
 
+from ..telemetry import tracing
 from ..utils.faults import maybe_fail
 
 log = logging.getLogger("api")
@@ -56,6 +57,7 @@ class Response:
     def __init__(self, handler: "_Handler"):
         self._h = handler
         self.started = False
+        self.status = 0  # last status written (0 = nothing sent yet)
         # extra response headers (e.g. X-Selected-Model) emitted by every
         # write_* / start_sse below
         self.extra_headers: dict[str, str] = {}
@@ -74,6 +76,7 @@ class Response:
         h.end_headers()
         h.wfile.write(data)
         self.started = True
+        self.status = status
 
     def write_error(self, message: str, status: int = 400, code: str = "") -> None:
         # error contract shape mirrors the reference (helpers_test.go:14-127)
@@ -88,6 +91,7 @@ class Response:
         h.end_headers()
         h.wfile.write(data)
         self.started = True
+        self.status = status
 
     # -- SSE ---------------------------------------------------------------
 
@@ -103,6 +107,7 @@ class Response:
         self._send_extra()
         h.end_headers()
         self.started = True
+        self.status = 200
 
     def sse_data(self, payload: Any) -> bool:
         """Send one `data:` frame; JSON-encodes non-strings. Returns False
@@ -182,6 +187,23 @@ class HTTPApi:
                 continue
             req = Request(handler, m.groupdict())
             resp = Response(handler)
+            tracer = tracing.get_tracer()
+            # Root span per request, joining an inbound W3C traceparent when
+            # present. Probe endpoints are untraced: /health and /metrics
+            # polling would evict every interesting trace from the ring.
+            trace = tracer.enabled and path not in tracing.UNTRACED_PATHS
+            span = (
+                tracer.start_span(
+                    f"http {method} {path}",
+                    parent=req.headers.get("traceparent") or tracing.NEW_TRACE,
+                    attrs={"http.method": method, "http.path": path},
+                )
+                if trace
+                else None
+            )
+            if span is not None:
+                resp.extra_headers["X-Trace-Id"] = span.trace_id
+                tracing.push_span(span)
             try:
                 maybe_fail("api.request", path)
                 r.fn(req, resp)
@@ -190,11 +212,20 @@ class HTTPApi:
                     resp.write_error("invalid JSON body", 400)
             except (BrokenPipeError, ConnectionResetError):
                 handler.close_connection = True
+                if span is not None:
+                    span.set_error("client disconnected")
             except Exception as e:  # noqa: BLE001 — handler crash → 500
                 log.exception("handler error %s %s", method, path)
+                if span is not None:
+                    span.set_error(f"{type(e).__name__}: {e}")
                 if not resp.started:
                     resp.write_error(f"internal error: {e}", 500)
             finally:
+                if span is not None:
+                    tracing.pop_span(span)
+                    if resp.status:
+                        span.set_attr("http.status", resp.status)
+                    span.end()
                 self._drain(handler, req.consumed)
             return
         self._drain(handler, 0)

@@ -35,7 +35,7 @@ from ..executor import EmbeddingEngine, GenerationEngine
 from ..routing import Router, quality_deadline_s
 from ..state.catalog import Catalog
 from ..state.queue import JobQueue
-from ..telemetry import Metrics
+from ..telemetry import Metrics, tracing
 from ..utils.tokens import messages_to_prompt, split_think
 from .http import Request, Response
 
@@ -248,9 +248,24 @@ class InferenceAPI:
 
         t0 = time.time()
         prompt = messages_to_prompt(messages)
-        engine = self._local_gen(model)
+        with tracing.get_tracer().span(
+            "route", attrs={"model": model, "kind": "generate"}
+        ) as rspan:
+            engine = self._local_gen(model)
+            dev = None if engine is not None else self.router.select_device(model, "generate")
+            if engine is not None:
+                rspan.set_attrs(
+                    {"provider": "tpu", "device": self.device_id, "reason": "local-engine"}
+                )
+            else:
+                rspan.set_attrs(
+                    {
+                        "provider": "tpu",
+                        "device": dev["id"] if dev else "",
+                        "reason": "device-select" if dev else "no-device",
+                    }
+                )
         if engine is None:
-            dev = self.router.select_device(model, "generate")
             if dev is not None and dev["id"] != self.device_id and dev["addr"]:
                 self._chat_proxy(resp, dev, body, model, stream)
                 return
@@ -270,13 +285,22 @@ class InferenceAPI:
             self._chat_sync_local(resp, engine, model, prompt, gen_kwargs, cmpl_id, created, t0)
 
     def _chat_sync_local(self, resp, engine, model, prompt, gen_kwargs, cmpl_id, created, t0):
-        try:
-            out = engine.generate(prompt, **gen_kwargs)
-        except RuntimeError as e:
-            resp.write_error(str(e), 500)
-            self.metrics.chat_requests.labels(model=model, provider="tpu", status="error").inc()
-            self.router.circuit.record(self.device_id, ok=False)
-            return
+        with tracing.get_tracer().span("engine.generate", attrs={"model": model}) as sp:
+            try:
+                out = engine.generate(prompt, **gen_kwargs)
+            except RuntimeError as e:
+                sp.set_error(str(e))
+                resp.write_error(str(e), 500)
+                self.metrics.chat_requests.labels(model=model, provider="tpu", status="error").inc()
+                self.router.circuit.record(self.device_id, ok=False)
+                return
+            sp.set_attrs(
+                {
+                    "prompt_tokens": out["usage"].get("prompt_tokens", 0),
+                    "completion_tokens": out["usage"].get("completion_tokens", 0),
+                    "finish_reason": out["finish_reason"],
+                }
+            )
         self.router.circuit.record(self.device_id, ok=True)
         usage = out["usage"]
         thinking, answer = split_think(out["text"])
@@ -307,24 +331,37 @@ class InferenceAPI:
         finish = "stop"
         ok = True
         ttft: float | None = None
-        for evt in engine.generate_stream(prompt, **gen_kwargs):
-            if evt["type"] == "token":
-                if ttft is None:
-                    ttft = time.time() - t0
-                    self.metrics.chat_ttft.labels(model=model).observe(ttft)
-                chunk = dict(
-                    base,
-                    choices=[{"index": 0, "delta": {"content": evt["text"]}, "finish_reason": None}],
-                )
-                if not resp.sse_data(chunk):
-                    return  # client went away; engine keeps finishing the slot
-            elif evt["type"] == "done":
-                usage = evt.get("usage", {})
-                finish = evt.get("finish_reason", "stop")
-            elif evt["type"] == "error":
-                ok = False
-                resp.sse_data(dict(base, error={"message": evt.get("error", "")}))
-                break
+        with tracing.get_tracer().span(
+            "engine.generate", attrs={"model": model, "stream": True}
+        ) as sp:
+            for evt in engine.generate_stream(prompt, **gen_kwargs):
+                if evt["type"] == "token":
+                    if ttft is None:
+                        ttft = time.time() - t0
+                        self.metrics.chat_ttft.labels(model=model).observe(ttft)
+                        sp.set_attr("ttft_ms", round(ttft * 1000.0, 1))
+                    chunk = dict(
+                        base,
+                        choices=[{"index": 0, "delta": {"content": evt["text"]}, "finish_reason": None}],
+                    )
+                    if not resp.sse_data(chunk):
+                        sp.set_attr("client_disconnected", True)
+                        return  # client went away; engine keeps finishing the slot
+                elif evt["type"] == "done":
+                    usage = evt.get("usage", {})
+                    finish = evt.get("finish_reason", "stop")
+                elif evt["type"] == "error":
+                    ok = False
+                    sp.set_error(evt.get("error", ""))
+                    resp.sse_data(dict(base, error={"message": evt.get("error", "")}))
+                    break
+            sp.set_attrs(
+                {
+                    "prompt_tokens": usage.get("prompt_tokens", 0),
+                    "completion_tokens": usage.get("completion_tokens", 0),
+                    "finish_reason": finish,
+                }
+            )
         final = dict(
             base, choices=[{"index": 0, "delta": {}, "finish_reason": finish}], usage=usage
         )
@@ -339,10 +376,14 @@ class InferenceAPI:
         import httpx
 
         url = f"http://{dev['addr']}/v1/chat/completions"
+        # carry the trace across the device hop (remote serves its own root
+        # span joined to this trace via the traceparent header)
+        ctx = tracing.current_traceparent()
+        headers = {"traceparent": ctx} if ctx else {}
         try:
             if stream:
                 with httpx.stream(
-                    "POST", url, json=body, timeout=CHAT_PROXY_TIMEOUT_S
+                    "POST", url, json=body, headers=headers, timeout=CHAT_PROXY_TIMEOUT_S
                 ) as r:
                     if r.status_code >= 400:
                         # surface the remote error as an error, not a 200 SSE
@@ -357,7 +398,7 @@ class InferenceAPI:
                                 break
                 self.router.circuit.record(dev["id"], ok=True)
             else:
-                r = httpx.post(url, json=body, timeout=CHAT_PROXY_TIMEOUT_S)
+                r = httpx.post(url, json=body, headers=headers, timeout=CHAT_PROXY_TIMEOUT_S)
                 resp.write_bytes(r.content, "application/json", r.status_code)
                 self.router.circuit.record(dev["id"], ok=r.status_code < 500)
         except Exception as e:  # connection-class failure → breaker
@@ -371,6 +412,9 @@ class InferenceAPI:
             resp.write_error("no cloud provider configured", 503)
             return
         t0 = time.time()
+        sp = tracing.current_span()
+        if sp is not None:
+            sp.set_attrs({"provider": "cloud", "model": model})
         try:
             if stream:
                 resp.start_sse()
@@ -558,11 +602,19 @@ class InferenceAPI:
         )
         payload = dict(body)
         payload.update(decision.payload_overlay())
+        # the job carries the trace context so queue-wait / worker / rpc
+        # spans from other threads and processes join this request's trace
+        ctx = tracing.current_traceparent()
+        if ctx and "_traceparent" not in payload:
+            payload["_traceparent"] = ctx
         deadline = None
         if quality:
             deadline = time.time() + quality_deadline_s(quality)
         job = self.queue.submit(kind, payload, deadline_at=deadline)
         self.metrics.jobs_created.labels(kind=kind).inc()
+        sp = tracing.current_span()
+        if sp is not None:
+            sp.set_attrs({"job_id": job.id, "quality": quality or ""})
         resp.write_json(
             {
                 "job_id": job.id,

@@ -21,8 +21,9 @@ from typing import Any
 
 from ..routing import Router
 from ..state.catalog import Catalog
+from ..state.jobtrace import record_job_end, record_queue_wait
 from ..state.queue import JobQueue, JobStatus
-from ..telemetry import Metrics
+from ..telemetry import Metrics, tracing
 from ..utils.config import Config
 from .http import Request, Response
 
@@ -75,6 +76,11 @@ class JobsAPI:
         except (TypeError, ValueError):
             resp.write_error("priority/max_attempts/deadline_at must be numeric", 400)
             return
+        # stamp the submitting request's trace context into the payload so
+        # claim/complete (possibly another process) can join spans to it
+        ctx = tracing.current_traceparent()
+        if ctx and "_traceparent" not in payload:
+            payload["_traceparent"] = ctx
         job = self.queue.submit(
             kind,
             payload,
@@ -83,6 +89,9 @@ class JobsAPI:
             deadline_at=deadline_at,
         )
         self.metrics.jobs_created.labels(kind=kind).inc()
+        sp = tracing.current_span()
+        if sp is not None:
+            sp.set_attrs({"job_id": job.id, "kind": kind})
         resp.write_json({"job_id": job.id, "status": job.status}, status=202)
 
     def handle_get(self, req: Request, resp: Response) -> None:
@@ -126,6 +135,7 @@ class JobsAPI:
         if job is None:
             resp.write_json({"job": None}, status=200)
             return
+        record_queue_wait(job, worker_id=worker_id)
         resp.write_json({"job": job.to_dict()})
 
     def handle_complete(self, req: Request, resp: Response) -> None:
@@ -144,6 +154,7 @@ class JobsAPI:
             if dev:
                 self.router.circuit.record(dev, ok=True)
             self._record_benchmark_result(job)
+            record_job_end(job, JobStatus.DONE)
         resp.write_json({"status": "done"})
 
     def handle_fail(self, req: Request, resp: Response) -> None:
@@ -159,6 +170,8 @@ class JobsAPI:
             dev = job.payload.get("device_id") or job.device_id
             if dev:
                 self.router.circuit.record(dev, ok=False)
+            if status in JobStatus.TERMINAL:  # retries keep the trace open
+                record_job_end(job, status)
         resp.write_json({"status": status})
 
     def handle_heartbeat(self, req: Request, resp: Response) -> None:

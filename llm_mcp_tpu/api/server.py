@@ -27,7 +27,7 @@ from ..routing import CircuitBreaker, LimitsEngine, Router
 from ..state.catalog import Catalog, sync_cloud_catalog
 from ..state.db import Database
 from ..state.queue import JobQueue
-from ..telemetry import Metrics
+from ..telemetry import Metrics, tracing
 from ..utils.config import Config
 from .dashboard import DashboardAPI
 from .http import HTTPApi, Request, Response
@@ -36,6 +36,15 @@ from .jobs import JobsAPI
 from .providers import CloudClient
 
 log = logging.getLogger("server")
+
+# span name → llmtpu_stage_duration_seconds stage label. rpc.* spans (any
+# transport method) all observe under "rpc".
+_SPAN_STAGES = {
+    "queue.wait": "queue_wait",
+    "route": "route",
+    "engine.prefill": "prefill",
+    "engine.decode": "decode",
+}
 
 
 class CoreServer:
@@ -98,6 +107,12 @@ class CoreServer:
             cfg=self.cfg,
             engines_info=self.engines_info,
         )
+
+        # Process-default tracer: the HTTP layer, router, engines, and
+        # workers all land spans in this ring; /v1/traces serves it and the
+        # observer below derives the per-stage latency histograms from it.
+        self.tracer = tracing.get_tracer()
+        self.tracer.add_observer(self._observe_span)
 
         self.api = HTTPApi()
         self._register_routes()
@@ -233,6 +248,8 @@ class CoreServer:
         r("POST", "/v1/discovery/run", self.handle_discovery_run)
 
         # observability / business
+        r("GET", "/v1/traces", self.handle_traces)
+        r("GET", "/v1/traces/{id}", self.handle_trace)
         r("GET", "/v1/dashboard", self.dashboard.handle_dashboard)
         r("GET", "/v1/costs/summary", self.handle_costs_summary)
         r("GET", "/v1/costs/balance", self.handle_costs_balance)
@@ -288,6 +305,34 @@ class CoreServer:
         )
         data, ctype = self.metrics.render()
         resp.write_bytes(data, ctype)
+
+    def _observe_span(self, span: tracing.Span) -> None:
+        """Tracer observer → per-stage latency histograms. Keeps the span
+        library metrics-free: the bridge lives here."""
+        stage = _SPAN_STAGES.get(span.name) or (
+            "rpc" if span.name.startswith("rpc.") else ""
+        )
+        if stage:
+            self.metrics.stage_duration.labels(stage=stage).observe(span.duration_s)
+
+    def handle_traces(self, req: Request, resp: Response) -> None:
+        """Newest-first summaries of the completed-trace ring."""
+        try:
+            limit = int(req.query.get("limit") or 50)
+        except ValueError:
+            resp.write_error("limit must be an integer", 400)
+            return
+        resp.write_json(
+            {"enabled": self.tracer.enabled, "traces": self.tracer.traces(limit=limit)}
+        )
+
+    def handle_trace(self, req: Request, resp: Response) -> None:
+        trace_id = req.params["id"]
+        spans = self.tracer.get_trace(trace_id)
+        if not spans:
+            resp.write_error("trace not found", 404)
+            return
+        resp.write_json({"trace_id": trace_id, "spans": spans})
 
     def handle_models(self, req: Request, resp: Response) -> None:
         models = self.catalog.list_models(kind=req.query.get("kind"))
@@ -501,6 +546,7 @@ class CoreServer:
             self._stall_offlined = False
 
     def shutdown(self) -> None:
+        self.tracer.remove_observer(self._observe_span)
         self._bg_stop.set()
         self.api.shutdown()
         for e in self.gen_engines.values():

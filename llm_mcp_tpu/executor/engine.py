@@ -59,6 +59,7 @@ from ..models.llama import (
 )
 from ..ops.sampling import sample_tokens
 from ..parallel.sharding import llama_param_specs, kv_cache_specs, shard_pytree
+from ..telemetry import tracing
 from .common import fine_bucket, pow2_bucket
 from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
 
@@ -85,6 +86,11 @@ class GenRequest:
     # filled by the engine
     out: "queue.Queue[Any]" = field(default_factory=queue.Queue)
     created_at: float = field(default_factory=time.time)
+    # tracing: wire context captured on the submitting thread; the engine
+    # loop records admit/prefill/decode child spans against it retroactively
+    # (the loop thread never blocks on the tracer)
+    trace_ctx: str = ""
+    admitted_at: float = 0.0  # stamped when the loop pops the request
 
 
 @dataclass
@@ -887,6 +893,7 @@ class GenerationEngine:
             top_k=top_k,
             top_p=top_p,
             stop=stop or [],
+            trace_ctx=tracing.current_traceparent(),
         )
         self.submit(req)
         while True:
@@ -1211,6 +1218,7 @@ class GenerationEngine:
                     req = self._admit.get_nowait()
                 except queue.Empty:
                     break
+                req.admitted_at = time.time()
                 ids = req.prompt_ids
                 # Leave room for at least one decode chunk after the prompt.
                 max_prompt = self.max_seq_len - self.decode_chunk
@@ -1443,6 +1451,25 @@ class GenerationEngine:
             self.total_requests += 1
             self._ttft_window.append(
                 (s.first_token_at, (s.first_token_at - req.created_at) * 1000.0)
+            )
+        if req.trace_ctx:
+            # retroactive spans from timestamps already stamped: the caller's
+            # trace gets engine.admit (submit→pop) and engine.prefill
+            # (pop→first token, i.e. TTFT minus queue time)
+            tracer = tracing.get_tracer()
+            admitted = req.admitted_at or req.created_at
+            tracer.record(
+                "engine.admit", req.created_at, admitted,
+                parent=req.trace_ctx, attrs={"request_id": req.request_id},
+            )
+            tracer.record(
+                "engine.prefill", admitted, s.first_token_at,
+                parent=req.trace_ctx,
+                attrs={
+                    "request_id": req.request_id,
+                    "prompt_tokens": P,
+                    "ttft_ms": round((s.first_token_at - req.created_at) * 1000.0, 1),
+                },
             )
         # tok0's KV will be written at position P in the first decode round.
         self._emit_token(slot, s, tok0, pos=P - 1)
@@ -1866,6 +1893,21 @@ class GenerationEngine:
         with self.stats_lock:
             self.finished_requests += 1
             self.finished_tokens += s.generated
+        # record BEFORE the done/_DONE events publish: a caller unblocked by
+        # the queue must be able to see the completed trace immediately
+        if req.trace_ctx and s.first_token_at:
+            now = time.time()
+            dur = max(now - s.first_token_at, 1e-9)
+            tracing.get_tracer().record(
+                "engine.decode", s.first_token_at, now,
+                parent=req.trace_ctx,
+                attrs={
+                    "request_id": req.request_id,
+                    "completion_tokens": s.generated,
+                    "tok_per_s": round(s.generated / dur, 1),
+                    "finish_reason": finish,
+                },
+            )
         req.out.put(
             {
                 "type": "done",

@@ -48,6 +48,8 @@ from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Iterator
 
+from contextlib import nullcontext
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -73,6 +75,9 @@ _DONE = object()
 # ---------------------------------------------------------------------------
 
 
+PING_INTERVAL_S = 5.0  # leader liveness beacon cadence while the queue is idle
+
+
 class CmdLeader:
     """Leader side: accept one connection per follower, broadcast commands."""
 
@@ -81,6 +86,10 @@ class CmdLeader:
         self._srv = socket.create_server((host or "0.0.0.0", int(port)))
         self._srv.settimeout(timeout_s)
         self.conns: list[socket.socket] = []
+        # send() is called from the engine loop AND shutdown()'s thread (the
+        # "stop" frame); interleaved sendall() would corrupt the frame stream
+        self._send_lock = threading.Lock()
+        self.last_send_t = time.monotonic()
         for _ in range(n_followers):
             c, _addr = self._srv.accept()
             c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -89,8 +98,15 @@ class CmdLeader:
     def send(self, obj: Any) -> None:
         blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
         frame = struct.pack("<I", len(blob)) + blob
-        for c in self.conns:
-            c.sendall(frame)
+        with self._send_lock:
+            for c in self.conns:
+                c.sendall(frame)
+            self.last_send_t = time.monotonic()
+
+    def ping_if_idle(self, interval_s: float = PING_INTERVAL_S) -> None:
+        """Beacon so followers can tell a quiet leader from a dead one."""
+        if time.monotonic() - self.last_send_t >= interval_s:
+            self.send(("ping",))
 
     def close(self) -> None:
         for c in self.conns:
@@ -103,9 +119,12 @@ class CmdLeader:
 
 class CmdFollower:
     """Follower side: connect (with retry — the leader may boot later) and
-    block on recv."""
+    wait on recv with a liveness bound: the leader beacons ("ping") every
+    PING_INTERVAL_S while idle, so a follower that sees NO bytes for
+    `idle_timeout_s` concludes the leader process is dead (not merely quiet)
+    and raises instead of blocking forever on a half-open socket."""
 
-    def __init__(self, addr: str, timeout_s: float = 60.0):
+    def __init__(self, addr: str, timeout_s: float = 60.0, idle_timeout_s: float = 600.0):
         host, _, port = addr.rpartition(":")
         deadline = time.time() + timeout_s
         while True:
@@ -117,7 +136,12 @@ class CmdFollower:
                     raise
                 time.sleep(0.2)
         self._c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._c.settimeout(None)
+        # finite so recv wakes periodically to check the liveness deadline.
+        # idle_timeout_s is deliberately generous: the leader stops beaconing
+        # while ITS dispatch blocks (first-admit XLA compiles can run
+        # minutes), so this guards against a dead leader, not a slow one.
+        self.idle_timeout_s = max(idle_timeout_s, 1.0)
+        self._c.settimeout(min(PING_INTERVAL_S, self.idle_timeout_s))
 
     def recv(self) -> Any:
         hdr = self._recv_exact(4)
@@ -126,11 +150,21 @@ class CmdFollower:
 
     def _recv_exact(self, n: int) -> bytes:
         buf = b""
+        deadline = time.monotonic() + self.idle_timeout_s
         while len(buf) < n:
-            chunk = self._c.recv(n - len(buf))
+            try:
+                chunk = self._c.recv(n - len(buf))
+            except TimeoutError:
+                if time.monotonic() > deadline:
+                    raise ConnectionError(
+                        f"leader sent nothing for {self.idle_timeout_s:.0f}s "
+                        "(no command or ping): presumed dead"
+                    ) from None
+                continue
             if not chunk:
                 raise ConnectionError("command channel closed")
             buf += chunk
+            deadline = time.monotonic() + self.idle_timeout_s
         return buf
 
     def close(self) -> None:
@@ -231,7 +265,7 @@ class SliceEngine:
         with mesh:
             if weights_dir:
                 self.params = self._load_checkpoint_global(
-                    cfg, weights_dir, dtype, mesh, ns(pspecs)
+                    cfg, weights_dir, dtype, mesh, ns(pspecs), quant=quant
                 )
             else:
                 # born sharded: the init runs as ONE GSPMD program with
@@ -347,7 +381,7 @@ class SliceEngine:
     # -- checkpoint -------------------------------------------------------
 
     @staticmethod
-    def _load_checkpoint_global(cfg, ckpt_dir, dtype, mesh, shardings):
+    def _load_checkpoint_global(cfg, ckpt_dir, dtype, mesh, shardings, quant: str = ""):
         """Every process reads the safetensors dir (standard multi-host
         practice) and contributes ONLY its addressable shards via
         make_array_from_callback — the full tree is never resident per
@@ -355,10 +389,29 @@ class SliceEngine:
         from ..models.weights import hf_to_llama_params, read_checkpoint_dir
 
         host = hf_to_llama_params(cfg, read_checkpoint_dir(ckpt_dir))
+        if quant == "int8":
+            from ..models.quant import quantize_params
+
+            # quantize the host tree BEFORE placement so its structure matches
+            # the quantized PartitionSpecs; pin the work to the CPU backend —
+            # the tree must stay host-resident until make_array_from_callback
+            # streams per-process shards
+            try:
+                cpu = jax.local_devices(backend="cpu")[0]
+            except RuntimeError:
+                cpu = None
+            with jax.default_device(cpu) if cpu is not None else nullcontext():
+                host = quantize_params(host)
+        elif quant:
+            raise NotImplementedError(
+                f"slice engine quant={quant!r} with a checkpoint (only 'int8' is supported)"
+            )
 
         def up(arr, sharding):
             a = np.asarray(arr)
-            if dtype is not None:
+            # int8 payloads must keep their dtype; only float leaves
+            # (weights, scales, norms) follow the engine compute dtype
+            if dtype is not None and np.issubdtype(a.dtype, np.floating):
                 a = a.astype(dtype)
             return jax.make_array_from_callback(
                 a.shape, sharding, lambda idx: a[idx]
@@ -376,6 +429,8 @@ class SliceEngine:
             while True:
                 cmd = ch.recv()
                 op = cmd[0]
+                if op == "ping":  # leader liveness beacon, no work
+                    continue
                 if op == "stop":
                     return
                 if op == "admit":
@@ -541,6 +596,8 @@ class SliceEngine:
                 admitted = self._try_admit()
                 decoded = self._try_decode()
                 if not admitted and not decoded:
+                    if self._leader_ch is not None:
+                        self._leader_ch.ping_if_idle()
                     time.sleep(0.002)
         except Exception as e:
             # The donated KV buffers died with the failed dispatch, so this

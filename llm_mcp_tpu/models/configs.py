@@ -652,6 +652,16 @@ def _encoder_config_from_hf(doc: dict, mt: str, name: str) -> ModelConfig:
             # naming we have no fixture for — fail loud, don't guess
             raise ValueError("unsupported nomic_bert prenorm=true (post-LN only)")
         rot_frac = float(doc.get("rotary_emb_fraction", 1.0) or 0.0)
+        qkv_bias = bool(doc.get("qkv_proj_bias", True))
+        for bias_key in ("mlp_fc1_bias", "mlp_fc2_bias"):
+            if bias_key in doc and bool(doc[bias_key]) != qkv_bias:
+                # one enc_bias flag covers every linear; a checkpoint with
+                # biased attention but bias-free MLP (or vice versa) would
+                # load-fail or silently zero-fill — refuse up front
+                raise ValueError(
+                    f"unsupported nomic_bert bias split: {bias_key}="
+                    f"{bool(doc[bias_key])} but qkv_proj_bias={qkv_bias}"
+                )
         kw = dict(
             name=name or str(doc.get("_name_or_path") or mt),
             arch="encoder",
@@ -675,7 +685,7 @@ def _encoder_config_from_hf(doc: dict, mt: str, name: str) -> ModelConfig:
             enc_post_ln=not bool(doc.get("prenorm", False)),
             enc_pos="rope" if rot_frac > 0 else "learned",
             enc_gated="glu" in act,
-            enc_bias=bool(doc.get("qkv_proj_bias", True)),
+            enc_bias=qkv_bias,
             type_vocab_size=int(doc.get("type_vocab_size") or 0),
             pooling="mean",
             embed_dim=dim,

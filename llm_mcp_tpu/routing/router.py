@@ -23,6 +23,7 @@ from typing import Any
 
 from ..state.catalog import Catalog
 from ..state.db import Database
+from ..telemetry import tracing
 from ..utils.config import getenv
 from .circuit import CircuitBreaker
 from .limits import LimitsEngine
@@ -234,8 +235,55 @@ class Router:
     ) -> RouteDecision:
         """Route one LLM request. The cascade mirrors RouteLLM
         (router.go:126-274); a `quality` value engages smart routing
-        (router.go:407-528)."""
+        (router.go:407-528). The decision is recorded as a `route` span:
+        chosen provider/device/tier, the human reason, the fallback chain
+        actually walked, and the chosen device's circuit-breaker state."""
+        chain: list[str] = []
+        with tracing.get_tracer().span(
+            "route", attrs={"kind": kind, "model": model, "quality": quality}
+        ) as sp:
+            d = self._route_cascade(
+                chain,
+                kind=kind,
+                model=model,
+                prompt=prompt,
+                provider=provider,
+                quality=quality,
+                thinking=thinking,
+                max_latency_ms=max_latency_ms,
+                force_cloud=force_cloud,
+                prefer_local=prefer_local,
+            )
+            sp.set_attrs(
+                {
+                    "provider": d.provider,
+                    "decided_model": d.model,
+                    "device": d.device_id,
+                    "tier": d.tier,
+                    "reason": d.reason,
+                    "fallback_chain": ">".join(chain),
+                }
+            )
+            if d.device_id:
+                sp.set_attr("circuit", self.circuit.status(d.device_id))
+            return d
+
+    def _route_cascade(
+        self,
+        chain: list[str],
+        *,
+        kind: str,
+        model: str,
+        prompt: str,
+        provider: str,
+        quality: str,
+        thinking: bool | None,
+        max_latency_ms: float,
+        force_cloud: bool,
+        prefer_local: bool,
+    ) -> RouteDecision:
         if quality:
+            chain.append(f"smart:{quality}")
             return self._route_smart(
                 kind=kind,
                 prompt=prompt,
@@ -246,9 +294,11 @@ class Router:
 
         # explicit provider
         if provider in (PROVIDER_OPENROUTER, PROVIDER_OPENAI):
+            chain.append(f"explicit:{provider}")
             return self._cloud_decision(provider, model, kind, reason="explicit provider")
         if provider == PROVIDER_TPU:
             local = self._local_decision(model, kind, max_latency_ms)
+            chain.append("explicit:tpu" if local else "explicit:tpu:miss")
             if local:
                 return local
             return RouteDecision(
@@ -260,21 +310,31 @@ class Router:
         if kind == "embed" and not force_cloud:
             local = self._local_decision(model, kind, max_latency_ms)
             if local:
+                chain.append("local-embed")
                 return local
+            chain.append("local-embed:miss")
         if force_cloud:
             cloud = self._first_cloud(model, kind, reason="force_cloud")
             if cloud:
+                chain.append("cloud:forced")
                 return cloud
+            chain.append("cloud:forced:miss")
         if prefer_local and not force_cloud:
             local = self._local_decision(model, kind, max_latency_ms)
             if local:
+                chain.append("local")
                 return local
+            chain.append("local:miss")
         cloud = self._first_cloud(model, kind, reason="cloud fallback")
         if cloud:
+            chain.append("cloud")
             return cloud
+        chain.append("cloud:miss")
         local = self._local_decision(model, kind, max_latency_ms)
         if local:
+            chain.append("local-last-resort")
             return local
+        chain.append("none")
         return RouteDecision(
             provider=PROVIDER_TPU, kind=kind, model=model, reason="no provider available"
         )

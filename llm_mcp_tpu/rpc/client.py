@@ -16,6 +16,7 @@ from typing import Any, Iterator
 
 import grpc
 
+from ..telemetry import tracing
 from ..worker.client import TerminalHTTPError
 from .pb import llm_mcp_tpu_pb2 as pb
 from .server import SERVICE_NAME, TERMINAL
@@ -79,9 +80,16 @@ class GrpcCoreClient:
 
     def _call(self, fn, req):
         try:
-            return fn(req, timeout=self.timeout_s)
+            return fn(req, timeout=self.timeout_s, metadata=self._trace_metadata())
         except grpc.RpcError as e:
             raise self._map_error(e) from e
+
+    @staticmethod
+    def _trace_metadata():
+        """Trace context as gRPC invocation metadata — the wire analog of
+        the HTTP traceparent header."""
+        ctx = tracing.current_traceparent()
+        return (("traceparent", ctx),) if ctx else None
 
     @staticmethod
     def _map_error(e: grpc.RpcError) -> Exception:
@@ -196,7 +204,9 @@ class GrpcCoreClient:
 
     def stream(self, job_id: str, timeout_s: float = 120.0) -> Iterator[dict[str, Any]]:
         try:
-            for j in self._stream(pb.JobRef(id=job_id), timeout=timeout_s):
+            for j in self._stream(
+                pb.JobRef(id=job_id), timeout=timeout_s, metadata=self._trace_metadata()
+            ):
                 d = self.job_to_dict(j)
                 yield d
                 if d["status"] in TERMINAL:

@@ -20,12 +20,15 @@ import logging
 import threading
 import time
 from concurrent import futures
+from contextlib import nullcontext
 from typing import Any, Callable
 
 import grpc
 
 from ..state.catalog import Catalog, record_benchmark_from_job
+from ..state.jobtrace import record_job_end, record_queue_wait
 from ..state.queue import Job, JobQueue
+from ..telemetry import tracing
 from .pb import llm_mcp_tpu_pb2 as pb
 
 log = logging.getLogger("rpc.server")
@@ -145,18 +148,32 @@ class GrpcCoreServer:
     # -- RPCs --------------------------------------------------------------
 
     def SubmitJob(self, req: pb.SubmitJobRequest, ctx) -> pb.Job:
-        try:
-            payload = json.loads(req.payload_json) if req.payload_json else {}
-        except json.JSONDecodeError:
-            ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, "payload_json is not valid JSON")
-        job = self.queue.submit(
-            req.kind or "generate",
-            payload,
-            priority=req.priority,
-            max_attempts=req.max_attempts or None,
-            deadline_at=req.deadline_at or None,
-        )
-        return job_to_pb(job)
+        # Submits always get a span (joined to the caller's trace when gRPC
+        # metadata carries a traceparent, rooted otherwise) — the wire analog
+        # of the HTTP layer's root span on POST /v1/jobs.
+        tp = self._traceparent(ctx)
+        with tracing.get_tracer().span(
+            "rpc.SubmitJob", parent=tp or tracing.NEW_TRACE, attrs={"kind": req.kind or "generate"}
+        ) as sp:
+            try:
+                payload = json.loads(req.payload_json) if req.payload_json else {}
+            except json.JSONDecodeError:
+                ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, "payload_json is not valid JSON")
+            # same propagation as the HTTP submit path: stamp the trace
+            # context into the payload so queue-wait / worker / job-end spans
+            # recorded at claim/complete time can join this trace
+            ctx_tp = sp.traceparent or tp
+            if ctx_tp and "_traceparent" not in payload:
+                payload["_traceparent"] = ctx_tp
+            job = self.queue.submit(
+                req.kind or "generate",
+                payload,
+                priority=req.priority,
+                max_attempts=req.max_attempts or None,
+                deadline_at=req.deadline_at or None,
+            )
+            sp.set_attr("job_id", job.id)
+            return job_to_pb(job)
 
     def GetJob(self, req: pb.JobRef, ctx) -> pb.Job:
         job = self.queue.get(req.id)
@@ -206,16 +223,18 @@ class GrpcCoreServer:
     def ClaimJob(self, req: pb.ClaimRequest, ctx) -> pb.ClaimResponse:
         if not req.worker_id:
             ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, "worker_id required")
-        job = self.queue.claim(
-            req.worker_id,
-            kinds=list(req.kinds),
-            lease_seconds=req.lease_seconds or self.default_lease_s,
-            device_max_concurrency=self.device_max_concurrency,
-        )
-        self.catalog.worker_heartbeat(req.worker_id)
-        if job is None:
-            return pb.ClaimResponse(found=False)
-        return pb.ClaimResponse(found=True, job=job_to_pb(job))
+        with self._rpc_span(ctx, "ClaimJob", {"worker_id": req.worker_id}):
+            job = self.queue.claim(
+                req.worker_id,
+                kinds=list(req.kinds),
+                lease_seconds=req.lease_seconds or self.default_lease_s,
+                device_max_concurrency=self.device_max_concurrency,
+            )
+            self.catalog.worker_heartbeat(req.worker_id)
+            if job is None:
+                return pb.ClaimResponse(found=False)
+            record_queue_wait(job, worker_id=req.worker_id)
+            return pb.ClaimResponse(found=True, job=job_to_pb(job))
 
     def Heartbeat(self, req: pb.HeartbeatRequest, ctx) -> pb.Ack:
         ok = self.queue.heartbeat(
@@ -227,20 +246,26 @@ class GrpcCoreServer:
         return pb.Ack(ok=True)
 
     def CompleteJob(self, req: pb.CompleteRequest, ctx) -> pb.Ack:
-        result = self._parse_json(req.result_json, ctx, "result_json")
-        metrics = self._parse_json(req.metrics_json, ctx, "metrics_json")
-        ok = self.queue.complete(req.job_id, req.worker_id, result=result, metrics=metrics)
-        if not ok:
-            ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, "job not running under this worker")
-        self._post_complete(req.job_id, ok=True)
-        return pb.Ack(ok=True)
+        with self._rpc_span(ctx, "CompleteJob", {"job_id": req.job_id}):
+            result = self._parse_json(req.result_json, ctx, "result_json")
+            metrics = self._parse_json(req.metrics_json, ctx, "metrics_json")
+            ok = self.queue.complete(req.job_id, req.worker_id, result=result, metrics=metrics)
+            if not ok:
+                ctx.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION, "job not running under this worker"
+                )
+            self._post_complete(req.job_id, ok=True)
+            return pb.Ack(ok=True)
 
     def FailJob(self, req: pb.FailRequest, ctx) -> pb.FailResponse:
-        status = self.queue.fail(req.job_id, req.worker_id, req.error or "unknown error")
-        if status is None:
-            ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, "job not running under this worker")
-        self._post_complete(req.job_id, ok=False)
-        return pb.FailResponse(status=status)
+        with self._rpc_span(ctx, "FailJob", {"job_id": req.job_id}):
+            status = self.queue.fail(req.job_id, req.worker_id, req.error or "unknown error")
+            if status is None:
+                ctx.abort(
+                    grpc.StatusCode.FAILED_PRECONDITION, "job not running under this worker"
+                )
+            self._post_complete(req.job_id, ok=False)
+            return pb.FailResponse(status=status)
 
     def ReportMetrics(self, req: pb.MetricsReport, ctx) -> pb.Ack:
         metrics = self._parse_json(req.metrics_json, ctx, "metrics_json")
@@ -275,6 +300,25 @@ class GrpcCoreServer:
 
     # -- helpers -----------------------------------------------------------
 
+    @staticmethod
+    def _traceparent(ctx) -> str:
+        """Trace context from gRPC invocation metadata — the wire analog of
+        the HTTP traceparent header (rpc/client.py attaches it)."""
+        for key, value in ctx.invocation_metadata() or ():
+            if key == "traceparent":
+                return str(value)
+        return ""
+
+    def _rpc_span(self, ctx, method: str, attrs: dict[str, Any] | None = None):
+        """Server-side span for a worker-protocol RPC, joined to the caller's
+        trace. RPCs arriving without a traceparent are not spanned — rooting
+        a fresh trace per idle claim poll (every 1.5 s per worker) would
+        churn the trace ring with noise."""
+        tp = self._traceparent(ctx)
+        if not tp:
+            return nullcontext()
+        return tracing.get_tracer().span(f"rpc.{method}", parent=tp, attrs=attrs)
+
     def _parse_json(self, text: str, ctx, field: str) -> dict[str, Any] | None:
         if not text:
             return None
@@ -291,6 +335,8 @@ class GrpcCoreServer:
         job = self.queue.get(job_id)
         if job is None:
             return
+        if job.status in TERMINAL:  # fail() may have requeued for retry
+            record_job_end(job, job.status)
         dev = job.payload.get("device_id") or job.device_id
         if dev and self.circuit is not None:
             self.circuit.record(str(dev), ok=ok)

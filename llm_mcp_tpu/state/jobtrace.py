@@ -1,0 +1,58 @@
+"""Retroactive job-lifecycle spans, shared across transports.
+
+Both the HTTP worker protocol (api/jobs.py) and the gRPC core server
+(rpc/server.py) mutate the same queue, so the trace spans for a job's
+lifecycle — submit→claim queue wait, submit→terminal end-to-end — are
+recorded here once and called from both. Spans are reconstructed from the
+timestamps the queue already stamps on the Job row (created/started/
+finished), parented off the submitting request's context carried in
+payload["_traceparent"]; jobs submitted without one are simply not traced.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from ..telemetry import tracing
+from .queue import Job
+
+
+def record_queue_wait(job: Job, *, worker_id: str = "") -> None:
+    """Retroactive submit→claim span, joined to the submitting request's
+    trace via payload["_traceparent"]. Called at claim time by both the
+    HTTP worker protocol and the gRPC transport."""
+    ctx = (job.payload or {}).get("_traceparent")
+    if not ctx:
+        return
+    tracing.get_tracer().record(
+        "queue.wait",
+        job.created_at,
+        job.started_at or time.time(),
+        parent=str(ctx),
+        attrs={
+            "job_id": job.id,
+            "kind": job.kind,
+            "worker_id": worker_id,
+            "attempts": job.attempts,
+        },
+    )
+
+
+def record_job_end(job: Job, status: str) -> None:
+    """Retroactive end-to-end job span (submit→terminal). Carries the
+    quality deadline as `deadline_s` so the slow-trace alert hook in
+    telemetry/alerts.py can fire on overruns."""
+    ctx = (job.payload or {}).get("_traceparent")
+    if not ctx:
+        return
+    attrs: dict[str, Any] = {"job_id": job.id, "kind": job.kind, "job.status": status}
+    if job.deadline_at:
+        attrs["deadline_s"] = round(job.deadline_at - job.created_at, 3)
+    tracing.get_tracer().record(
+        "job",
+        job.created_at,
+        job.finished_at or time.time(),
+        parent=str(ctx),
+        attrs=attrs,
+    )

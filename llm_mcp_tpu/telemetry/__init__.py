@@ -1,5 +1,16 @@
 from .alerts import AlertMonitor, snapshot_status
 from .metrics import Metrics
 from .telegram import TelegramGateway
+from .tracing import Span, Tracer, current_traceparent, get_tracer, set_tracer
 
-__all__ = ["AlertMonitor", "Metrics", "TelegramGateway", "snapshot_status"]
+__all__ = [
+    "AlertMonitor",
+    "Metrics",
+    "Span",
+    "TelegramGateway",
+    "Tracer",
+    "current_traceparent",
+    "get_tracer",
+    "set_tracer",
+    "snapshot_status",
+]

@@ -23,10 +23,12 @@ import html
 import logging
 import threading
 import time
+from collections import deque
 from typing import Any
 
 from ..state.db import Database
 from .telegram import TelegramGateway
+from .tracing import Span, Tracer
 
 log = logging.getLogger("telemetry.alerts")
 
@@ -53,6 +55,12 @@ class AlertMonitor:
         self._stuck_alerted = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # slow-trace hook: spans overrunning their deadline_s attribute are
+        # queued here by the tracer observer and drained on the next scan
+        self._tracer: Tracer | None = None
+        self._slow_lock = threading.Lock()
+        self._slow_spans: deque[tuple[str, str, float, float]] = deque(maxlen=100)
+        self._seen_slow: dict[str, None] = {}
 
     # -- scan logic --------------------------------------------------------
 
@@ -62,6 +70,7 @@ class AlertMonitor:
         alerts += self._scan_devices()
         alerts += self._scan_failed_jobs()
         alerts += self._scan_stuck_queue()
+        alerts += self._scan_slow_traces()
         for a in alerts:
             log.warning("alert: %s", a)
             if self.gateway is not None:
@@ -140,6 +149,48 @@ class AlertMonitor:
                 return ["✅ queue drained"]
         return []
 
+    # -- slow-trace hook ---------------------------------------------------
+
+    def attach_tracer(self, tracer: Tracer) -> "AlertMonitor":
+        """Observe completed spans; any span carrying a ``deadline_s``
+        attribute (the end-to-end job/chat spans stamp their quality-tier
+        deadline, `router.quality_deadline_s`) that overran it is raised as
+        an alert on the next scan."""
+        self.detach_tracer()
+        self._tracer = tracer
+        tracer.add_observer(self._on_span_end)
+        return self
+
+    def detach_tracer(self) -> None:
+        if self._tracer is not None:
+            self._tracer.remove_observer(self._on_span_end)
+            self._tracer = None
+
+    def _on_span_end(self, span: Span) -> None:
+        try:
+            deadline = float(span.attrs.get("deadline_s") or 0.0)
+        except (TypeError, ValueError):
+            return
+        if deadline <= 0.0 or span.duration_s <= deadline:
+            return
+        with self._slow_lock:
+            if span.trace_id in self._seen_slow:
+                return  # one alert per trace, however many spans overrun
+            self._seen_slow[span.trace_id] = None
+            while len(self._seen_slow) > 10000:
+                self._seen_slow.pop(next(iter(self._seen_slow)))
+            self._slow_spans.append((span.trace_id, span.name, span.duration_s, deadline))
+
+    def _scan_slow_traces(self) -> list[str]:
+        with self._slow_lock:
+            drained = list(self._slow_spans)
+            self._slow_spans.clear()
+        return [
+            f"🐌 slow trace <code>{html.escape(tid)}</code> ({html.escape(name)}): "
+            f"{dur:.2f}s &gt; {deadline:.0f}s deadline"
+            for tid, name, dur, deadline in drained
+        ]
+
     # -- loop --------------------------------------------------------------
 
     def run(self, stop: threading.Event | None = None) -> None:
@@ -158,6 +209,7 @@ class AlertMonitor:
         return self
 
     def stop(self) -> None:
+        self.detach_tracer()
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)

@@ -104,6 +104,15 @@ class Metrics:
             buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10),
             registry=r,
         )
+        # Fed from trace spans (tracing.py observer wired in CoreServer):
+        # stage ∈ {queue_wait, route, rpc, prefill, decode}.
+        self.stage_duration = Histogram(
+            "llmtpu_stage_duration_seconds",
+            "Per-request stage latency, derived from trace spans",
+            ["stage"],
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60),
+            registry=r,
+        )
 
     def render(self) -> tuple[bytes, str]:
         return generate_latest(self.registry), CONTENT_TYPE_LATEST

@@ -20,6 +20,8 @@ import urllib.error
 import urllib.request
 from typing import Any, Callable
 
+from ..telemetry import tracing
+
 log = logging.getLogger("worker.client")
 
 # post(path, body, timeout) -> (status_code, parsed_json_or_{})
@@ -33,14 +35,20 @@ class TerminalHTTPError(RuntimeError):
         self.body = body
 
 
-def post_json(url: str, body: dict[str, Any] | None, timeout: float) -> tuple[int, dict[str, Any]]:
+def post_json(
+    url: str,
+    body: dict[str, Any] | None,
+    timeout: float,
+    headers: dict[str, str] | None = None,
+) -> tuple[int, dict[str, Any]]:
     """One JSON POST → (status, parsed body). HTTP error statuses are
     RETURNED, not raised — only transport failures raise, so callers can
     distinguish device-unreachable from device-said-no."""
     data = json.dumps(body or {}).encode()
-    req = urllib.request.Request(
-        url, data=data, method="POST", headers={"Content-Type": "application/json"}
-    )
+    hdrs = {"Content-Type": "application/json"}
+    if headers:
+        hdrs.update(headers)
+    req = urllib.request.Request(url, data=data, method="POST", headers=hdrs)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:  # noqa: S310
             return r.status, json.loads(r.read() or b"{}")
@@ -71,7 +79,11 @@ class CoreClient:
     def _default_post(
         self, path: str, body: dict[str, Any] | None, timeout: float
     ) -> tuple[int, dict[str, Any]]:
-        return post_json(f"{self.base_url}{path}", body, timeout)
+        # propagate the calling thread's trace context (the worker wraps job
+        # execution in a span, so complete/fail reports join the job's trace)
+        ctx = tracing.current_traceparent()
+        headers = {"traceparent": ctx} if ctx else None
+        return post_json(f"{self.base_url}{path}", body, timeout, headers=headers)
 
     def post(self, path: str, body: dict[str, Any] | None = None) -> dict[str, Any]:
         """POST with backoff. Raises TerminalHTTPError on non-retryable 4xx,

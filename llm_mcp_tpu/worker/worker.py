@@ -17,6 +17,7 @@ import time
 import uuid
 from typing import Any
 
+from ..telemetry import tracing
 from ..utils.faults import FaultInjected, maybe_fail
 from .client import CoreClient, TerminalHTTPError
 from .executors import ExecutionError, Executors
@@ -94,7 +95,19 @@ class Worker:
         kind = str(job.get("kind") or "")
         payload = job.get("payload") or {}
         log.info("job %s kind=%s model=%s", job_id, kind, payload.get("model", ""))
+        # join the submitting request's trace (payload-propagated context);
+        # jobs submitted without one get their own root trace. The span
+        # wraps dispatch AND the completion report, so the client's
+        # complete/fail POSTs carry the trace header too.
+        ctx = str(payload.get("_traceparent") or "")
+        with tracing.get_tracer().span(
+            "worker.execute",
+            parent=ctx or tracing.NEW_TRACE,
+            attrs={"job_id": job_id, "kind": kind, "worker_id": self.worker_id},
+        ):
+            self._execute_traced(job_id, kind, payload)
 
+    def _execute_traced(self, job_id: str, kind: str, payload: dict[str, Any]) -> None:
         hb_stop = threading.Event()
         hb = threading.Thread(
             target=self._heartbeat_loop, args=(job_id, hb_stop),

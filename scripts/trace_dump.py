@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""Pretty-print request traces as indented span trees with durations.
+
+Reads from either source the tracer exposes:
+  - the live core's /v1/traces API (``--core http://localhost:8080``), one
+    tree per recent trace, or a single trace by id;
+  - a TPU_TRACE_FILE JSONL export (``--file traces.jsonl``), offline.
+
+Usage:
+    python scripts/trace_dump.py --core http://localhost:8080            # recent
+    python scripts/trace_dump.py --core http://localhost:8080 <trace_id>
+    python scripts/trace_dump.py --file /tmp/traces.jsonl [<trace_id>]
+
+Stdlib-only (urllib), so it runs anywhere the core does — including inside
+the serving container where httpx may not be installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+from typing import Any, Iterable
+
+
+def _fetch_json(url: str, timeout: float = 10.0) -> Any:
+    with urllib.request.urlopen(url, timeout=timeout) as r:  # noqa: S310
+        return json.loads(r.read())
+
+
+def load_from_core(core: str, trace_id: str | None, limit: int) -> dict[str, list[dict]]:
+    """trace_id → spans, from the /v1/traces API."""
+    base = core.rstrip("/")
+    if trace_id:
+        doc = _fetch_json(f"{base}/v1/traces/{trace_id}")
+        return {doc["trace_id"]: doc["spans"]}
+    doc = _fetch_json(f"{base}/v1/traces?limit={limit}")
+    out: dict[str, list[dict]] = {}
+    for summary in doc.get("traces") or []:
+        tid = summary["trace_id"]
+        try:
+            out[tid] = _fetch_json(f"{base}/v1/traces/{tid}")["spans"]
+        except urllib.error.HTTPError:
+            continue  # evicted between the list and the fetch
+    return out
+
+
+def load_from_file(path: str, trace_id: str | None) -> dict[str, list[dict]]:
+    """trace_id → spans, from a TPU_TRACE_FILE JSONL export."""
+    out: dict[str, list[dict]] = {}
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                span = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn write at the tail of a live file
+            tid = span.get("trace_id")
+            if not tid or (trace_id and tid != trace_id):
+                continue
+            out.setdefault(tid, []).append(span)
+    return out
+
+
+def _fmt_duration(seconds: float) -> str:
+    return f"{seconds * 1000.0:.1f}ms" if seconds < 1.0 else f"{seconds:.2f}s"
+
+
+def _fmt_attrs(attrs: dict[str, Any]) -> str:
+    keep = {k: v for k, v in attrs.items() if v not in ("", None)}
+    if not keep:
+        return ""
+    return "  " + " ".join(f"{k}={v}" for k, v in sorted(keep.items()))
+
+
+def print_trace(trace_id: str, spans: Iterable[dict], out=None) -> None:
+    out = out if out is not None else sys.stdout
+    spans = sorted(spans, key=lambda s: (s.get("start") or 0.0))
+    by_parent: dict[str, list[dict]] = {}
+    ids = {s.get("span_id") for s in spans}
+    for s in spans:
+        parent = s.get("parent_id") or ""
+        # spans whose parent never completed (or was evicted) print as roots
+        by_parent.setdefault(parent if parent in ids else "", []).append(s)
+
+    total = 0.0
+    if spans:
+        t0 = min(s.get("start") or 0.0 for s in spans)
+        total = max((s.get("start") or 0.0) + (s.get("duration_s") or 0.0) for s in spans) - t0
+    print(f"trace {trace_id}  ({_fmt_duration(total)} end-to-end, {len(spans)} spans)", file=out)
+
+    def walk(parent_id: str, depth: int) -> None:
+        for s in by_parent.get(parent_id, []):
+            mark = " ✗" if s.get("status") == "error" else ""
+            print(
+                f"  {'  ' * depth}{s.get('name', '?'):<{max(28 - 2 * depth, 8)}} "
+                f"{_fmt_duration(s.get('duration_s') or 0.0):>9}{mark}"
+                f"{_fmt_attrs(s.get('attrs') or {})}",
+                file=out,
+            )
+            walk(s.get("span_id") or "", depth + 1)
+
+    walk("", 0)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace_id", nargs="?", help="print only this trace")
+    ap.add_argument("--core", help="core base URL (uses /v1/traces)")
+    ap.add_argument("--file", help="TPU_TRACE_FILE JSONL export to read")
+    ap.add_argument("--limit", type=int, default=10, help="recent traces to show (default 10)")
+    args = ap.parse_args(argv)
+
+    if bool(args.core) == bool(args.file):
+        ap.error("exactly one of --core or --file is required")
+    try:
+        if args.core:
+            traces = load_from_core(args.core, args.trace_id, args.limit)
+        else:
+            traces = load_from_file(args.file, args.trace_id)
+    except (urllib.error.URLError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    if not traces:
+        print("no traces found", file=sys.stderr)
+        return 1
+    for i, (tid, spans) in enumerate(traces.items()):
+        if i:
+            print()
+        print_trace(tid, spans)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,6 +116,24 @@ def test_unsupported_encoder_variants_fail_loud():
     assert cfg.act == "gelu" and cfg.enc_gated
 
 
+def test_nomic_bias_split_fails_loud():
+    """One enc_bias flag covers every linear: a checkpoint whose MLP bias
+    flags disagree with qkv_proj_bias can't be represented and must refuse
+    instead of zero-filling or load-failing deep in the weights mapper."""
+    # agreeing flags (either polarity) still resolve
+    cfg = config_from_hf(dict(NOMIC_DOC, mlp_fc1_bias=False, mlp_fc2_bias=False))
+    assert not cfg.enc_bias
+    cfg = config_from_hf(
+        dict(NOMIC_DOC, qkv_proj_bias=True, mlp_fc1_bias=True, mlp_fc2_bias=True)
+    )
+    assert cfg.enc_bias
+    # NOMIC_DOC has qkv_proj_bias=False: a biased MLP must fail loud
+    with pytest.raises(ValueError, match="mlp_fc1_bias"):
+        config_from_hf(dict(NOMIC_DOC, mlp_fc1_bias=True))
+    with pytest.raises(ValueError, match="mlp_fc2_bias"):
+        config_from_hf(dict(NOMIC_DOC, qkv_proj_bias=True, mlp_fc2_bias=False))
+
+
 def test_pooling_from_sentence_transformers_dir(tmp_path):
     (tmp_path / "config.json").write_text(json.dumps(BERT_DOC))
     pool = tmp_path / "1_Pooling"

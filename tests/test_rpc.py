@@ -40,7 +40,10 @@ def test_submit_get_roundtrip(rpc):
     job = client.submit("echo", {"data": 1}, priority=5)
     assert job["status"] == "queued" and job["priority"] == 5
     got = client.get(job["id"])
-    assert got["payload"] == {"data": 1}
+    # infra keys (underscore-prefixed, e.g. the _traceparent trace context)
+    # ride along in the payload; the user payload must round-trip untouched
+    user_payload = {k: v for k, v in got["payload"].items() if not k.startswith("_")}
+    assert user_payload == {"data": 1}
     assert queue.get(job["id"]) is not None
 
 

@@ -231,3 +231,39 @@ def test_probe_embed_kind_builds_input_payload(live_stack):
     probe_mod.probe_model(core, "tiny-embed", "embed", 1, "hello", timeout_s=5.0, max_tokens=4)
     jobs = live_stack.queue.list(kind="embed", limit=5)
     assert jobs and jobs[0].payload.get("input") == ["hello"]
+
+
+# ------------------------------------------------------------- trace_dump --
+
+trace_dump_mod = _load("trace_dump")
+
+
+def test_trace_dump_file_mode(tmp_path, capsys):
+    from llm_mcp_tpu.telemetry import tracing
+
+    path = str(tmp_path / "traces.jsonl")
+    tr = tracing.Tracer(export_path=path)
+    with tr.span("http POST /v1/jobs", attrs={"job_id": "j1"}) as root:
+        with tr.span("route", attrs={"reason": "local-engine"}):
+            pass
+        tid = root.trace_id
+    assert trace_dump_mod.main(["--file", path]) == 0
+    out = capsys.readouterr().out
+    assert tid in out and "route" in out and "ms" in out
+    # filtering by an unknown trace id finds nothing
+    assert trace_dump_mod.main(["--file", path, "f" * 32]) == 1
+
+
+def test_trace_dump_core_mode(live_stack, capsys):
+    from llm_mcp_tpu.telemetry import tracing
+
+    core = f"http://127.0.0.1:{live_stack.api.port}"
+    import urllib.request
+
+    with urllib.request.urlopen(f"{core}/health") as r:  # untraced path
+        r.read()
+    with urllib.request.urlopen(f"{core}/v1/jobs?limit=1") as r:  # traced
+        r.read()
+    assert trace_dump_mod.main(["--core", core, "--limit", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "http GET /v1/jobs" in out

@@ -92,6 +92,103 @@ def test_slice_engine_int8_weights():
         eng.shutdown()
 
 
+def test_slice_engine_int8_from_checkpoint(tmp_path):
+    """quant="int8" + weights_dir used to crash at boot: the checkpoint
+    loader built an UNQUANTIZED host tree and tree-mapped it against the
+    quantized PartitionSpecs (structure mismatch). The host tree must be
+    quantized before placement; int8 payloads keep their dtype."""
+    from llm_mcp_tpu.models import (
+        get_config, init_llama_params, llama_to_hf_tensors, write_safetensors,
+    )
+
+    cfg = get_config("tiny-llm")
+    params = init_llama_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    write_safetensors(
+        str(tmp_path / "model.safetensors"), llama_to_hf_tensors(cfg, params)
+    )
+    mesh = make_mesh("dp=4,tp=2")
+    eng = SliceEngine(
+        "tiny-llm", mesh=mesh, cmd_addr="127.0.0.1:0", max_slots=4,
+        max_seq_len=128, dtype=jnp.float32, decode_chunk=4, quant="int8",
+        weights_dir=str(tmp_path),
+    ).start()
+    try:
+        wq = eng.params["layers"]["wq"]
+        assert isinstance(wq, dict) and wq["q"].dtype == jnp.int8
+        out = eng.generate("int8 checkpoint slice", max_tokens=6, temperature=0.0)
+        assert out["usage"]["completion_tokens"] == 6
+        out2 = eng.generate("int8 checkpoint slice", max_tokens=6, temperature=0.0)
+        assert out["text"] == out2["text"]
+    finally:
+        eng.shutdown()
+
+
+def test_slice_engine_unknown_quant_with_checkpoint_fails_loud(tmp_path):
+    from llm_mcp_tpu.models import get_config, init_llama_params, llama_to_hf_tensors
+    from llm_mcp_tpu.models.weights import write_safetensors
+    from llm_mcp_tpu.executor.slice_engine import SliceEngine as SE
+
+    cfg = get_config("tiny-llm")
+    params = init_llama_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    write_safetensors(
+        str(tmp_path / "model.safetensors"), llama_to_hf_tensors(cfg, params)
+    )
+    with pytest.raises(NotImplementedError, match="quant"):
+        SE(
+            "tiny-llm", mesh=make_mesh("dp=4,tp=2"), cmd_addr="127.0.0.1:0",
+            max_slots=4, max_seq_len=128, dtype=jnp.float32, quant="int4",
+            weights_dir=str(tmp_path),
+        )
+
+
+def test_cmd_follower_presumes_dead_leader():
+    """A connected-but-silent leader (hung process, half-open socket) must
+    fail the follower's recv within idle_timeout_s — it used to block on a
+    recv with NO timeout, wedging the follower process forever."""
+    from llm_mcp_tpu.executor.slice_engine import CmdFollower
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+    try:
+        fol = CmdFollower(f"127.0.0.1:{port}", timeout_s=5.0, idle_timeout_s=1.0)
+        conn, _ = srv.accept()  # connected, then the "leader" goes silent
+        try:
+            with pytest.raises(ConnectionError, match="presumed dead"):
+                fol.recv()
+        finally:
+            conn.close()
+        fol.close()
+    finally:
+        srv.close()
+
+
+def test_cmd_leader_ping_keeps_follower_alive():
+    """The leader's idle beacon resets the follower's liveness deadline, and
+    pings are visible as ("ping",) frames the command loop skips."""
+    from llm_mcp_tpu.executor.slice_engine import CmdFollower, CmdLeader
+
+    port = _free_port()
+    fol_box: list = []
+
+    def connect():
+        fol_box.append(CmdFollower(f"127.0.0.1:{port}", timeout_s=10.0, idle_timeout_s=2.0))
+
+    t = threading.Thread(target=connect)
+    t.start()
+    leader = CmdLeader(f"127.0.0.1:{port}", n_followers=1, timeout_s=10.0)
+    t.join(timeout=10)
+    fol = fol_box[0]
+    try:
+        leader.ping_if_idle(interval_s=0.0)
+        assert fol.recv() == ("ping",)
+        # a real command still round-trips after pings
+        leader.send(("stop",))
+        assert fol.recv() == ("stop",)
+    finally:
+        fol.close()
+        leader.close()
+
+
 def test_slice_engine_capacity_headroom():
     """Near the KV bound the engine must finish with "length" BEFORE a
     decode round would write past the cache (an OOB scatter is silently
