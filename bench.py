@@ -97,6 +97,18 @@ class _SkipDirect(Exception):
     pass
 
 
+def serve_efficiency(serve: dict) -> float | None:
+    """serve tok/s ÷ engine-direct tok/s, the serving-layer tax as ONE
+    first-class tracked number (scripts/perf_gate.py gates on it): 1.0
+    means the serve path delivers the engine's full decode rate; r05's
+    regression was 0.295 hiding in plain sight across two fields. None
+    when the direct measurement was unavailable."""
+    direct = serve.get("engine_direct_tok_per_s", 0.0)
+    if direct and direct > 0:
+        return serve.get("tok_per_s", 0.0) / direct
+    return None
+
+
 def serve_path_metrics(
     model: str,
     *,
@@ -326,6 +338,9 @@ def serve_path_metrics(
     out["phase_pct"] = phase_pct
     if direct_tps > 0:
         out["engine_direct_tok_per_s"] = direct_tps
+        eff = serve_efficiency(out)
+        if eff is not None:
+            out["serve_efficiency"] = eff
     out["prefix_cache_hits"] = float(pstats.get("hits", 0))
     out["prefix_cache_misses"] = float(pstats.get("misses", 0))
     # Degenerate-window evidence (a run where decode is broken still serves
@@ -883,10 +898,15 @@ def main() -> None:
                 ),
             }
             if "engine_direct_tok_per_s" in serve:
-                # the serving-layer tax, measured in the SAME process/run
+                # the serving-layer tax, measured in the SAME process/run —
+                # and its ratio as a first-class gated metric
+                # (scripts/perf_gate.py): serve ÷ engine-direct
                 line["engine_direct_tok_per_s"] = round(
                     serve["engine_direct_tok_per_s"], 1
                 )
+                eff = serve_efficiency(serve)
+                if eff is not None:
+                    line["serve_efficiency"] = round(eff, 3)
             if "phase_pct" in serve:
                 # where the engine loop's wall-clock went during the window
                 line["serve_phase_pct"] = serve["phase_pct"]

@@ -70,6 +70,8 @@ def main() -> None:
                     dtype=jnp.bfloat16,
                     quant=cfg.tpu_quant,
                     weights_dir=cfg.tpu_weights_dir,
+                    prefill_chunk=cfg.tpu_prefill_chunk,
+                    target_ttft_ms=cfg.tpu_target_ttft_ms,
                 )
                 if jax.process_index() != 0:
                     log.info("slice follower %d/%d: mirroring dispatches",
@@ -95,7 +97,7 @@ def main() -> None:
                 decode_compact=cfg.tpu_decode_compact,
                 prompt_cache_mb=cfg.tpu_prompt_cache_mb,
                 prefill_buckets=cfg.tpu_prefill_buckets,
-                prefill_boost=cfg.tpu_prefill_boost,
+                target_ttft_ms=cfg.tpu_target_ttft_ms,
             ).start()
         emodel = cfg.tpu_embed_model
         cfg.warn_embed_dir_gap(log)

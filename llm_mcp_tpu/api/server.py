@@ -63,6 +63,9 @@ class CoreServer:
         self.queue = JobQueue(self.db)
         self.catalog = Catalog(self.db)
         self.metrics = Metrics()
+        # starved_rounds is cumulative per engine; the Prometheus counter
+        # advances by the delta observed between engines_info() refreshes
+        self._sched_starved: dict[str, float] = {}
         self.limits = LimitsEngine(self.db, strict=self.cfg.strict_model_limits)
         self.circuit = CircuitBreaker()
         self.router = Router(
@@ -204,6 +207,21 @@ class CoreServer:
             }
             self.metrics.engine_slots_in_use.set(e.slots_in_use())
             self.metrics.engine_tps.set(e.current_tps())
+            ss = getattr(e, "scheduler_stats", None)
+            if ss is not None:
+                st = ss()
+                info[name]["scheduler"] = st
+                self.metrics.sched_prefill_token_budget.set(
+                    st.get("prefill_token_budget", 0.0)
+                )
+                self.metrics.sched_decode_occupancy.set(
+                    st.get("decode_batch_occupancy", 0.0)
+                )
+                prev = self._sched_starved.get(name, 0.0)
+                cur = float(st.get("starved_rounds", 0.0))
+                if cur > prev:
+                    self.metrics.sched_starved_rounds.inc(cur - prev)
+                self._sched_starved[name] = cur
         for name, e in self.embed_engines.items():
             info[name] = {
                 "kind": "embed",

@@ -61,6 +61,7 @@ from ..ops.sampling import sample_tokens
 from ..parallel.sharding import llama_param_specs, kv_cache_specs, shard_pytree
 from ..telemetry import tracing
 from .common import fine_bucket, pow2_bucket
+from .scheduler import TokenBudgetScheduler
 from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
 
 log = logging.getLogger("engine")
@@ -121,6 +122,7 @@ class _DispatchedRound:
     base: Any  # np lengths snapshot at dispatch
     t0: float
     rid: int = 0  # monotonic round id (slot-reuse cooling fence)
+    prefill_tokens: int = 0  # fused chunk-group tokens (scheduler cost attribution)
 
 
 @dataclass
@@ -145,6 +147,25 @@ class _PrefillState:
     aborted: bool = False
 
 
+@dataclass
+class _PrefillGroup:
+    """A staged chunked-prefill group: up to admit_batch mid-prefill slots'
+    next chunks sharing (bucket, skey), total valid tokens bounded by the
+    token-budget scheduler. Dispatched either FUSED into a decode round
+    (fused_step_fn — the stall-free path) or standalone when no decode rows
+    are active (pure-prefill window, back-to-back)."""
+
+    metas: list  # [(slot, _PrefillState, n)] — n = valid tokens this chunk
+    tokens: Any  # np [Ab, bucket]
+    slots_arr: Any  # np [Ab]
+    starts_arr: Any  # np [Ab]
+    nv_arr: Any  # np [Ab]
+    bucket: int
+    skey: int
+    n_tokens: int  # total valid tokens staged (≤ the round's budget)
+    logits: Any = None  # device [Ab, V] once dispatched
+
+
 class GenerationEngine:
     def __init__(
         self,
@@ -167,6 +188,7 @@ class GenerationEngine:
         prompt_cache_mb: int = 256,
         prefill_buckets: str = "fine",
         prefill_boost: float = 2.0,
+        target_ttft_ms: float = 2000.0,
     ):
         # a config.json beside the weights is authoritative: any supported-
         # family checkpoint serves without a catalog entry (models/configs.py
@@ -264,11 +286,21 @@ class GenerationEngine:
         # and a starved admission path caps how many slots ever decode
         # (measured: 102 tok/s vs 1.8k+ at B=64 with per-request prefill)
         self.admit_batch = max(1, admit_batch)
-        # chunked-prefill budget multiplier while the mid-prefill backlog is
-        # deeper than admit_batch (TTFT p95 tail — _prefill_round). A/B at
-        # 8B B=80: 2.0 cut p95 TTFT 6.7x at equal-or-better throughput.
-        self.prefill_boost = max(1.0, prefill_boost)
-        self._last_decode_s = 0.05
+        # Token-budget scheduler (scheduler.py): prefill rides INSIDE decode
+        # rounds under a per-round token budget self-tuned from measured
+        # per-token prefill vs decode-round cost, clamped so the oldest
+        # mid-prefill prompt still activates within target_ttft_ms. Replaces
+        # the retired wall-clock alternation (last decode time ×
+        # TPU_PREFILL_BOOST) that let prefill monopolize the loop on a
+        # locally-attached chip. `prefill_boost` is accepted-and-ignored so
+        # existing construction sites keep working.
+        del prefill_boost
+        self.target_ttft_ms = max(1.0, float(target_ttft_ms))
+        self._sched = TokenBudgetScheduler(
+            target_ttft_ms=self.target_ttft_ms,
+            min_budget=min(64, self.prefill_chunk) if self.prefill_chunk else 1,
+        )
+        self._last_active_n = 0  # decode rows in the most recent dispatch
 
         if params is None and _has_safetensors(weights_dir):
             # Real checkpoint: stream safetensors shards straight into
@@ -339,7 +371,7 @@ class GenerationEngine:
                 allowed[bad] = False
         self._allowed_mask = jnp.asarray(allowed) if not allowed.all() else None
 
-        self._decode_fn = self._build_decode()
+        self._decode_fn, self._fused_fn = self._build_decode()
         mask = self._allowed_mask
         cfg_ = self.cfg
 
@@ -679,10 +711,10 @@ class GenerationEngine:
         impl = self.decode_impl
         base_key = self._base_key
 
-        @partial(jax.jit, donate_argnums=(1, 2, 7), static_argnames=("compact",))
-        def decode_chunk_fn(params, ck, cv, packed, d_temp, d_topk, d_topp,
-                            d_last, compact):
-            """One decode round (K fused steps).
+        def decode_body(params, ck, cv, packed, d_temp, d_topk, d_topp,
+                        d_last, compact):
+            """One decode round (K fused steps) — traced body shared by
+            decode_chunk_fn and fused_step_fn.
 
             All per-round host inputs ride ONE packed i32 transfer (on a
             remote-TPU tunnel every separate transfer/dispatch is tens of
@@ -743,7 +775,43 @@ class GenerationEngine:
                 d_last = last
             return out, ck, cv, d_last  # out: [K, Ba]
 
-        return decode_chunk_fn
+        @partial(jax.jit, donate_argnums=(1, 2, 7), static_argnames=("compact",))
+        def decode_chunk_fn(params, ck, cv, packed, d_temp, d_topk, d_topp,
+                            d_last, compact):
+            return decode_body(params, ck, cv, packed, d_temp, d_topk,
+                               d_topp, d_last, compact)
+
+        @partial(
+            jax.jit, donate_argnums=(1, 2, 7),
+            static_argnames=("compact", "skey"),
+        )
+        def fused_step_fn(params, ck, cv, packed, d_temp, d_topk, d_topp,
+                          d_last, p_tokens, p_slots, p_starts, p_nvalid,
+                          compact, skey):
+            """Fused scheduler step: one decode round (K steps for the
+            active rows) AND one budget-bounded prefill chunk group in the
+            SAME dispatch (the token-budget scheduler's stall-free shape —
+            decode cadence never waits behind a host-paced prefill phase,
+            and the chunk group costs at most ~one extra decode round of
+            device time by budget construction).
+
+            Decode rows and the chunk group's slots are DISJOINT (mid-
+            prefill slots are reserved, parked at length=S, and never in the
+            active set), so running the chunk after the decode scan on the
+            threaded cache is value-identical to two separate dispatches.
+            The prefill logits return un-fetched; activation samples from
+            them only when a prompt's last chunk landed."""
+            out, ck, cv, d_last = decode_body(
+                params, ck, cv, packed, d_temp, d_topk, d_topp, d_last,
+                compact,
+            )
+            p_logits, ck, cv = llama_prefill_chunk_batch(
+                cfg, params, ck, cv, p_tokens, p_slots, p_starts, p_nvalid,
+                skey=skey,
+            )
+            return out, p_logits, ck, cv, d_last
+
+        return decode_chunk_fn, fused_step_fn
 
     def stall_seconds(self) -> float:
         """Age of the engine loop's last progress stamp. Large values with
@@ -951,6 +1019,16 @@ class GenerationEngine:
         p95 = vals[max(0, min(n - 1, int(n * 0.95 + 0.5) - 1))]
         return p50, p95, n
 
+    def scheduler_stats(self) -> dict[str, float]:
+        """Token-budget scheduler observability (telemetry/metrics.py gauges
+        + the starvation counter): the live prefill token budget, decode
+        batch occupancy, and cost-model EMAs."""
+        out = self._sched.stats()
+        out["decode_batch_occupancy"] = (
+            self._last_active_n / self.max_slots if self.max_slots else 0.0
+        )
+        return out
+
     def current_tps(self, window_s: float = 10.0) -> float:
         now = time.time()
         with self.stats_lock:
@@ -1077,14 +1155,18 @@ class GenerationEngine:
         loop; the reference never faces this — Ollama owns its hot loop).
 
         Order within one iteration:
-          1. dispatch round N (device starts; active set from round N-1's
-             fast finish-scan, so finished slots never decode an extra round)
-          2. emit round N-1's tokens (overlapped with 1's device time)
-          3. admissions + chunked prefill (their dispatches queue behind
-             round N on the stream — the device never goes idle)
+          1. stage a prefill chunk group under the token-budget scheduler's
+             budget (scheduler.py — bounded so the group costs ~one decode
+             round of device time)
+          2. dispatch round N FUSED with the staged group (fused_step_fn:
+             decode never stalls behind prefill; with no active decode rows
+             the group runs standalone, back-to-back); advance chunk
+             progress and activate finished prompts
+          3. emit round N-1's tokens + admissions (overlapped with 2's
+             device time)
           4. fetch round N; fast finish-scan frees finishing slots and
              advances host mirrors (emission itself is deferred to the next
-             iteration's step 2)
+             iteration's step 3)
         """
         pending: _PendingRound | None = None
         inflight: deque[_DispatchedRound] = deque()
@@ -1135,12 +1217,20 @@ class GenerationEngine:
                 i for i, s in enumerate(self._slots)
                 if s is not None and self._lengths[i] + K <= S
             ]
+            # Token-budget scheduling (see scheduler.py): stage up to
+            # `prefill_token_budget` prompt tokens from mid-prefill slots,
+            # then FUSE the chunk group into the decode dispatch — decode
+            # cadence never stalls behind a prefill backlog, and the group's
+            # device time is capped at ~one decode round by construction.
+            group = timed("prefill", self._stage_prefill_group, len(active))
             if active:
                 try:
                     # tokens come from the device ring, lengths advance
                     # optimistically — this dispatch does NOT wait for any
                     # earlier round's fetch (decode_chunk_fn docstring)
-                    inflight.append(timed("dispatch", self._dispatch_decode, active))
+                    inflight.append(
+                        timed("dispatch", self._dispatch_decode, active, group)
+                    )
                 except Exception as e:  # a poisoned dispatch must not kill the loop
                     if pending is not None:
                         # deliver already-fetched tokens BEFORE the error
@@ -1149,14 +1239,25 @@ class GenerationEngine:
                         # computed tokens per stream
                         self._emit_round(pending)
                         pending = None
+                    if group is not None:
+                        self._fail_prefill_group(group, e)
+                        group = None
                     drain_failed(e, also=active)
+                else:
+                    if group is not None:
+                        # advance chunk progress + activate finished prompts
+                        # (samples from the fused round's prefill logits)
+                        timed("prefill", self._finish_prefill_group, group)
+            elif group is not None:
+                # pure-prefill window: nothing decoding, so the group runs as
+                # a standalone chunk dispatch — back-to-back, no wall pacing
+                # (the stale-budget alternation this replaces paced cold
+                # bursts in arbitrary 50 ms slices)
+                timed("prefill", self._dispatch_prefill_group, group)
             if pending is not None:
                 timed("emit", self._emit_round, pending)
                 pending = None
             admitted = timed("admit", self._admit_pending)
-            # One bounded prefill chunk per iteration: admission work
-            # interleaves with decode rounds instead of stalling them.
-            prefilled = timed("prefill", self._prefill_round)
             # fetch the OLDEST round only once the pipeline is full (or the
             # batch went idle): up to pipeline_depth rounds chain on device
             # without a host sync, so a slow tunnel fetch overlaps compute
@@ -1170,7 +1271,7 @@ class GenerationEngine:
                 except Exception as e:  # poisoned execution surfaces at fetch
                     inflight.appendleft(disp)  # drain fails its slots too
                     drain_failed(e)
-            elif not (active or admitted or prefilled or inflight):
+            elif not (active or admitted or group is not None or inflight):
                 t_idle = time.perf_counter()
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
@@ -1252,9 +1353,10 @@ class GenerationEngine:
                     continue
                 if self.sp == 1 and self.prefill_chunk and len(ids) > self.prefill_chunk:
                     # Long prompt: reserve the slot and prefill chunk-by-chunk
-                    # in _prefill_round, interleaved with decode rounds (no
-                    # head-of-line blocking of in-flight streams). sp>1 keeps
-                    # whole-prompt prefill: the sp axis bounds per-chip work.
+                    # under the token-budget scheduler, fused into decode
+                    # rounds (no head-of-line blocking of in-flight streams).
+                    # sp>1 keeps whole-prompt prefill: the sp axis bounds
+                    # per-chip work.
                     self._prefills[slot] = _PrefillState(req=req, ids=list(ids))
                     self._prefill_q.append(slot)
                     continue
@@ -1469,35 +1571,27 @@ class GenerationEngine:
                     "request_id": req.request_id,
                     "prompt_tokens": P,
                     "ttft_ms": round((s.first_token_at - req.created_at) * 1000.0, 1),
+                    # scheduler decision context at activation: the budget
+                    # this prompt's last chunk rode in under, and whether the
+                    # backlog has been outrunning the TTFT deadline
+                    "prefill_token_budget": self._sched.last_budget,
+                    "sched_starved_rounds": self._sched.starved_rounds,
                 },
             )
         # tok0's KV will be written at position P in the first decode round.
         self._emit_token(slot, s, tok0, pos=P - 1)
 
-    def _prefill_round(self) -> bool:
-        """Run chunked-prefill work for mid-prefill slots, bounded by roughly
-        one decode round's wall time — in-flight streams keep their
-        inter-token cadence while long admissions make steady progress.
-        Returns True when any chunk work happened."""
-        if not self._prefill_q:
-            return False
-        budget = max(0.05, self._last_decode_s)
-        if len(self._prefill_q) > self.admit_batch:
-            # TTFT-priority boost: a deep mid-prefill backlog means admitted
-            # streams are waiting for their FIRST token while decode holds
-            # the loop at one-round-per-round pacing — clearing bursts at
-            # 2x costs in-flight streams a little cadence for a round or
-            # two, but p95 TTFT stops tracking the whole backlog drain.
-            budget *= self.prefill_boost
-        t0 = time.perf_counter()
-        while self._prefill_q:
-            self._prefill_chunk_step()
-            if time.perf_counter() - t0 >= budget:
-                break
-        return True
+    def _prefill_backlog(self) -> int:
+        """Prompt tokens not yet written for live mid-prefill slots."""
+        return sum(
+            len(st.ids) - st.done
+            for st in self._prefills.values()
+            if not st.aborted
+        )
 
-    def _chunk_shape(self, slot: int) -> tuple[int, int, int, int]:
-        """(start, n, bucket, skey) for a mid-prefill slot's next chunk.
+    def _chunk_shape(self, slot: int, cap: int = 0) -> tuple[int, int, int, int]:
+        """(start, n, bucket, skey) for a mid-prefill slot's next chunk,
+        with `cap` (>0) bounding n to the scheduler's remaining budget.
 
         bucket never runs past the cache row end — dynamic_update_slice would
         CLAMP the start index and silently overwrite earlier prompt KV
@@ -1508,6 +1602,8 @@ class GenerationEngine:
         st = self._prefills[slot]
         start = st.done
         n = min(self.prefill_chunk, len(st.ids) - start)
+        if cap > 0:
+            n = min(n, cap)
         bucket = min(pow2_bucket(n, self.prefill_chunk), self.max_seq_len - start)
         skey = (
             min(pow2_bucket(start, self.max_seq_len), self.max_seq_len)
@@ -1516,13 +1612,13 @@ class GenerationEngine:
         )
         return start, n, bucket, skey
 
-    def _prefill_chunk_step(self) -> None:
-        """One batched chunk dispatch for up to admit_batch mid-prefill slots
+    def _stage_prefill_group(self, n_active: int) -> _PrefillGroup | None:
+        """Ask the scheduler for this round's prefill token budget and stage
+        one batched chunk group under it: up to admit_batch mid-prefill slots
         whose next chunks share (bucket, skey) — the chunk weight pass is the
         cost, and batching amortizes it like _start_batch does for short
-        prompts."""
-        group: list[int] = []
-        metas: list[tuple[int, _PrefillState, int]] = []
+        prompts. Staging only; the group is dispatched fused with the decode
+        round (_dispatch_decode) or standalone (_dispatch_prefill_group)."""
         # states the stall watchdog error-terminated while the loop was
         # wedged: reclaim silently (their consumers are gone)
         for slot in [
@@ -1532,44 +1628,110 @@ class GenerationEngine:
             self._prefill_q.remove(slot)
             del self._prefills[slot]
         if not self._prefill_q:
-            return
-        try:  # the whole step: staging bugs must also fail over to waiters
+            self._sched.decide(0, n_active, 0.0)
+            return None
+        oldest = min(
+            self._prefills[s].req.created_at for s in self._prefill_q
+        )
+        budget = self._sched.decide(
+            self._prefill_backlog(), n_active, time.time() - oldest
+        )
+        if budget <= 0:
+            return None
+        group: list[int] = []
+        metas: list[tuple[int, _PrefillState, int]] = []
+        try:  # staging bugs must also fail over to waiters
             first = self._prefill_q[0]
-            _, _, f_bucket, f_skey = self._chunk_shape(first)
+            _, f_n, f_bucket, f_skey = self._chunk_shape(first, cap=budget)
             group.append(first)
+            used = f_n
             for slot in list(self._prefill_q)[1:]:
-                if len(group) >= self.admit_batch:
+                if len(group) >= self.admit_batch or used >= budget:
                     break
-                _, _, b2, s2 = self._chunk_shape(slot)
-                if (b2, s2) == (f_bucket, f_skey):
+                start2, n2, _, s2 = self._chunk_shape(
+                    slot, cap=min(budget - used, f_bucket)
+                )
+                # join only on identical (bucket, skey): one executable per
+                # group shape. n2 rides row raggedness (nvalid) inside
+                # f_bucket, so a budget-trimmed tail row still joins.
+                if s2 == f_skey and n2 > 0 and start2 + f_bucket <= self.max_seq_len:
                     group.append(slot)
-            A = len(group)
-            Ab = 1 << (A - 1).bit_length()
+                    used += n2
+            Ab = 1 << (len(group) - 1).bit_length()
             tokens = np.zeros((Ab, f_bucket), dtype=np.int32)
             slots_arr = np.zeros((Ab,), dtype=np.int32)
             starts_arr = np.zeros((Ab,), dtype=np.int32)
             nv_arr = np.ones((Ab,), dtype=np.int32)
+            total = 0
+            rem = budget
             for i, slot in enumerate(group):
                 st = self._prefills[slot]
-                start, n, _, _ = self._chunk_shape(slot)
+                start, n, _, _ = self._chunk_shape(
+                    slot, cap=min(rem, f_bucket) if i else budget
+                )
                 tokens[i, :n] = st.ids[start : start + n]
                 slots_arr[i] = slot
                 starts_arr[i] = start
                 nv_arr[i] = n
                 metas.append((slot, st, n))
-            for i in range(A, Ab):  # pad rows duplicate row 0: identical writes
+                total += n
+                rem -= n
+            for i in range(len(group), Ab):  # pad rows dup row 0: identical writes
                 tokens[i] = tokens[0]
                 slots_arr[i] = slots_arr[0]
                 starts_arr[i] = starts_arr[0]
                 nv_arr[i] = nv_arr[0]
-            maybe_fail("engine.prefill", f"slots={group}")
-            self._note_exec_shape("chunk", Ab, f_bucket, f_skey)
-            logits, self._ck, self._cv = self._prefill_chunk_fn(
-                self.params, self._ck, self._cv, tokens,
-                slots_arr, starts_arr, nv_arr, f_skey,
+            return _PrefillGroup(
+                metas=metas, tokens=tokens, slots_arr=slots_arr,
+                starts_arr=starts_arr, nv_arr=nv_arr,
+                bucket=f_bucket, skey=f_skey, n_tokens=total,
             )
+        except Exception as e:
+            self._fail_prefill_group(
+                _PrefillGroup(
+                    metas=metas or [
+                        (s, self._prefills[s], 0)
+                        for s in group or self._prefill_q
+                        if s in self._prefills
+                    ],
+                    tokens=None, slots_arr=None, starts_arr=None,
+                    nv_arr=None, bucket=0, skey=0, n_tokens=0,
+                ),
+                e,
+            )
+            return None
+
+    def _dispatch_prefill_group(self, group: _PrefillGroup) -> None:
+        """Standalone chunk dispatch for a pure-prefill window (no decode
+        rows active — nothing to fuse with). Synchronous: the measured wall
+        feeds the scheduler's per-token prefill cost EMA."""
+        try:
+            maybe_fail(
+                "engine.prefill", f"slots={[s for s, _, _ in group.metas]}"
+            )
+            self._note_exec_shape("chunk", group.tokens.shape[0],
+                                  group.bucket, group.skey)
+            t0 = time.perf_counter()
+            group.logits, self._ck, self._cv = self._prefill_chunk_fn(
+                self.params, self._ck, self._cv, group.tokens,
+                group.slots_arr, group.starts_arr, group.nv_arr, group.skey,
+            )
+            jax.block_until_ready(self._ck)
+            self._sched.observe_prefill(
+                group.n_tokens, time.perf_counter() - t0
+            )
+        except Exception as e:
+            self._fail_prefill_group(group, e)
+            return
+        self._finish_prefill_group(group)
+
+    def _finish_prefill_group(self, group: _PrefillGroup) -> None:
+        """Advance chunk progress for a dispatched group and activate the
+        prompts whose last chunk just landed (first-token sample from the
+        group's prefill logits)."""
+        try:
             fin: list[tuple[int, int, _PrefillState]] = []
-            for i, (slot, st, n) in enumerate(metas):
+            for i, (slot, st, n) in enumerate(group.metas):
                 st.done += n
                 if st.done >= len(st.ids):
                     fin.append((i, slot, st))
@@ -1585,7 +1747,7 @@ class GenerationEngine:
                 topks = np.asarray([st.req.top_k for _, _, st in fin], np.int32)
                 topps = np.asarray([st.req.top_p for _, _, st in fin], np.float32)
                 toks0 = self._sample1(
-                    logits[rows], self._next_key(), temps, topks, topps
+                    group.logits[rows], self._next_key(), temps, topks, topps
                 )
                 self._d_temp = self._d_temp.at[slots_fin].set(jnp.asarray(temps))
                 self._d_topk = self._d_topk.at[slots_fin].set(jnp.asarray(topks))
@@ -1604,39 +1766,53 @@ class GenerationEngine:
                     self._activate_state(slot, st.req, st.ids, int(toks0[k]))
                     del self._prefills[slot]
         except Exception as e:
-            log.exception("chunked prefill failed (slots %s)", group)
-            for slot in group:
-                st = self._prefills.pop(slot, None)
-                if st is not None:
-                    try:
-                        self._prefill_q.remove(slot)
-                    except ValueError:
-                        pass
-                    # free the slot if activation partially completed
-                    s = self._slots[slot]
-                    if s is not None and s.req is st.req:
-                        self._free_now(slot)
-                    if not st.aborted:  # watchdog may have terminated it already
-                        self._count_error()
-                        st.req.out.put({"type": "error", "error": str(e)})
-                        st.req.out.put(_DONE)
-            if self._recover_cache():
-                self._abort_all("kv cache lost in failed prefill chunk")
+            self._fail_prefill_group(group, e)
 
-    def _dispatch_decode(self, active: list[int]) -> _DispatchedRound:
+    def _fail_prefill_group(self, group: _PrefillGroup, e: Exception) -> None:
+        """Fail a chunk group's waiters and recover the cache if the failed
+        dispatch consumed the donated buffers."""
+        slots = [s for s, _, _ in group.metas]
+        log.exception("chunked prefill failed (slots %s)", slots)
+        for slot in slots:
+            st = self._prefills.pop(slot, None)
+            if st is not None:
+                try:
+                    self._prefill_q.remove(slot)
+                except ValueError:
+                    pass
+                # free the slot if activation partially completed
+                s = self._slots[slot]
+                if s is not None and s.req is st.req:
+                    self._free_now(slot)
+                if not st.aborted:  # watchdog may have terminated it already
+                    self._count_error()
+                    st.req.out.put({"type": "error", "error": str(e)})
+                    st.req.out.put(_DONE)
+        if self._recover_cache():
+            self._abort_all("kv cache lost in failed prefill chunk")
+
+    def _dispatch_decode(
+        self, active: list[int], group: _PrefillGroup | None = None
+    ) -> _DispatchedRound:
         """Phase 1: stage host inputs and dispatch one decode round (NO
         fetch — the returned round is in flight on device). Input tokens
         come from the device-resident ring (decode_chunk_fn), so this never
         waits on an earlier round's output; host lengths advance
         OPTIMISTICALLY here (+K per dispatched row — the device really does
         advance them), which is what lets the next dispatch stage correct
-        write positions before this round is fetched."""
+        write positions before this round is fetched.
+
+        With a staged prefill chunk `group`, the round goes through
+        fused_step_fn: the same dispatch also writes the group's prompt
+        tokens (budget-bounded, slot-disjoint from the active rows) and
+        returns its boundary logits un-fetched on `group.logits`."""
         # chaos site: a failed round must fail active slots with error
         # events, not hang callers (the poisoned-round guard in _run)
         maybe_fail("engine.decode", f"active={len(active)}")
         round_t0 = time.perf_counter()
         B = self.max_slots
         nact = len(active)
+        self._last_active_n = nact
         # Slot compaction: dispatch a pow2 bucket of just the active rows.
         # Floor 8 bounds the executable count (8, 16, 32, ... B); at Ba == B
         # the full-batch trace (slot_ids=None) is reused instead — identical
@@ -1684,19 +1860,45 @@ class GenerationEngine:
             packed = np.concatenate(
                 [self._lengths, [self._next_counter()]]
             ).astype(np.int32)
-        self._note_exec_shape("decode", Ba, compact)
         base = self._lengths.copy()
-        out, self._ck, self._cv, self._d_last_tok = self._decode_fn(
-            self.params,
-            self._ck,
-            self._cv,
-            jnp.asarray(packed),
-            self._d_temp,
-            self._d_topk,
-            self._d_topp,
-            self._d_last_tok,
-            compact=compact,
-        )
+        if group is not None:
+            maybe_fail(
+                "engine.prefill", f"slots={[s for s, _, _ in group.metas]}"
+            )
+            self._note_exec_shape(
+                "fused", Ba, compact, group.tokens.shape[0],
+                group.bucket, group.skey,
+            )
+            (out, group.logits, self._ck, self._cv,
+             self._d_last_tok) = self._fused_fn(
+                self.params,
+                self._ck,
+                self._cv,
+                jnp.asarray(packed),
+                self._d_temp,
+                self._d_topk,
+                self._d_topp,
+                self._d_last_tok,
+                group.tokens,
+                group.slots_arr,
+                group.starts_arr,
+                group.nv_arr,
+                compact=compact,
+                skey=group.skey,
+            )
+        else:
+            self._note_exec_shape("decode", Ba, compact)
+            out, self._ck, self._cv, self._d_last_tok = self._decode_fn(
+                self.params,
+                self._ck,
+                self._cv,
+                jnp.asarray(packed),
+                self._d_temp,
+                self._d_topk,
+                self._d_topp,
+                self._d_last_tok,
+                compact=compact,
+            )
         entries = [
             (b, self._slots[b], (i if compact else b)) for i, b in enumerate(active)
         ]
@@ -1710,6 +1912,7 @@ class GenerationEngine:
         return _DispatchedRound(
             out=out, entries=entries, base=base, t0=round_t0,
             rid=self._rid_dispatched,
+            prefill_tokens=group.n_tokens if group is not None else 0,
         )
 
     def _complete_round(self, disp: _DispatchedRound) -> _PendingRound:
@@ -1724,11 +1927,14 @@ class GenerationEngine:
         implies an emission finish on the same tokens; emission stays
         authoritative for events, usage, and text."""
         out = np.asarray(disp.out)  # [K, Ba] — the only host sync per round
-        # drives the chunked-prefill budget (_prefill_round): a smoothed
-        # decode-round time keeps admission work ≈ one round per round
-        self._last_decode_s = 0.7 * self._last_decode_s + 0.3 * (
-            time.perf_counter() - disp.t0
-        )
+        # feed the token-budget scheduler's cost model: prefill-free rounds
+        # teach the decode-round EMA; fused rounds attribute their time over
+        # that EMA to the chunk group's prompt tokens
+        dt = time.perf_counter() - disp.t0
+        if disp.prefill_tokens:
+            self._sched.observe_fused(dt, disp.prefill_tokens)
+        else:
+            self._sched.observe_decode(dt)
         K = out.shape[0]
         S = self.max_seq_len
         eos = self.tokenizer.eos_id

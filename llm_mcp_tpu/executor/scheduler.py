@@ -1,0 +1,128 @@
+"""Token-budget prefill/decode scheduler (stall-free continuous batching).
+
+The Sarathi-Serve / vLLM insight: schedule prefill by *token budget inside
+the decode round*, not by host wall-clock alternation. The engine loop asks
+`decide()` once per iteration for a prefill token budget, stages that many
+prompt tokens from mid-prefill slots, and fuses them into the same device
+dispatch as the decode round — decode cadence never stalls behind a prefill
+backlog, and TTFT is bounded by budget arithmetic instead of an
+environment-tuned multiplier (the retired `TPU_PREFILL_BOOST`, whose
+wall-clock budget let prefill monopolize the loop on a locally-attached
+chip: 2428 → 464.7 tok/s serve, prefill 81–93% of window wall).
+
+Policy, per round with active decode slots:
+
+  fair_cap = decode_round_s / prefill_tok_s
+      The prefill token count whose device time ≈ one decode round, so a
+      fused round costs at most ~2× a pure decode round — in-flight streams'
+      inter-token latency stays within 2× their no-backlog cadence.
+  need = backlog_tokens / rounds_until_deadline
+      The drain rate that activates the OLDEST mid-prefill prompt within
+      `target_ttft_ms` of its arrival.
+  budget = clamp(need, min_budget, fair_cap)
+      `need > fair_cap` means the deadline is unreachable without starving
+      decode; the starvation counter records it (telemetry: raise
+      target_ttft_ms, add capacity, or shed load).
+
+With ZERO active decode slots (pure-prefill window — e.g. a cold burst of
+long prompts) there is no cadence to protect: the budget is the whole
+backlog and chunks run back-to-back.
+
+Both cost terms self-tune from measured dispatches (EMAs): decode-round
+seconds from prefill-free rounds, per-token prefill seconds from standalone
+chunk dispatches and from the fused rounds' time over the decode EMA. The
+same object drives `GenerationEngine` and the multi-host `SliceEngine`
+leader (followers replay dispatches and need no policy).
+"""
+
+from __future__ import annotations
+
+import math
+
+__all__ = ["TokenBudgetScheduler"]
+
+_EMA = 0.7  # keep-fraction; matches the engine's old decode-time smoothing
+
+
+class TokenBudgetScheduler:
+    def __init__(
+        self,
+        *,
+        target_ttft_ms: float = 2000.0,
+        min_budget: int = 64,
+        decode_seed_s: float = 0.05,
+        prefill_tok_seed_s: float = 1e-4,
+    ):
+        self.target_ttft_s = max(1.0, float(target_ttft_ms)) / 1000.0
+        # floor: a chunk dispatch costs ~a weight pass regardless of size, so
+        # sub-floor budgets would pay full dispatch overhead per few tokens
+        self.min_budget = max(1, int(min_budget))
+        # EMA seeds — replaced by measurements after the first observed
+        # dispatches; the seeds only shape the first few cold rounds
+        self.decode_round_s = float(decode_seed_s)
+        self.prefill_tok_s = float(prefill_tok_seed_s)
+        self.last_budget = 0
+        self.starved_rounds = 0
+
+    # -- cost observation --------------------------------------------------
+
+    def observe_decode(self, round_s: float) -> None:
+        """A prefill-free decode round's wall time (dispatch → fetch)."""
+        if round_s > 0:
+            self.decode_round_s = _EMA * self.decode_round_s + (1 - _EMA) * round_s
+
+    def observe_prefill(self, tokens: int, seconds: float) -> None:
+        """A standalone chunk dispatch: `tokens` prompt tokens in `seconds`."""
+        if tokens <= 0 or seconds <= 0:
+            return
+        per = min(1.0, max(1e-8, seconds / tokens))
+        self.prefill_tok_s = _EMA * self.prefill_tok_s + (1 - _EMA) * per
+
+    def observe_fused(self, round_s: float, prefill_tokens: int) -> None:
+        """A fused round: attribute the time over the decode EMA to its
+        prefill tokens. Rounds faster than the EMA teach nothing (the
+        residual would be negative)."""
+        extra = round_s - self.decode_round_s
+        if prefill_tokens > 0 and extra > 0:
+            self.observe_prefill(prefill_tokens, extra)
+
+    # -- policy ------------------------------------------------------------
+
+    def fair_cap(self) -> int:
+        """Prefill tokens whose estimated device time ≈ one decode round."""
+        return max(self.min_budget, int(self.decode_round_s / self.prefill_tok_s))
+
+    def decide(self, backlog_tokens: int, n_active: int, oldest_wait_s: float) -> int:
+        """Prefill token budget for the next engine iteration.
+
+        backlog_tokens: prompt tokens not yet written for mid-prefill slots.
+        n_active: decoding slots this round (0 ⇒ pure-prefill window).
+        oldest_wait_s: age of the oldest mid-prefill request.
+        """
+        if backlog_tokens <= 0:
+            self.last_budget = 0
+            return 0
+        if n_active == 0:
+            # pure-prefill window: no decode cadence to protect — run the
+            # whole backlog back-to-back (the stale-budget bug this replaces
+            # paced cold bursts in arbitrary 50 ms wall-clock slices)
+            self.last_budget = backlog_tokens
+            return backlog_tokens
+        cap = self.fair_cap()
+        headroom_s = max(self.target_ttft_s - oldest_wait_s, self.decode_round_s)
+        rounds_left = max(1.0, headroom_s / max(self.decode_round_s, 1e-6))
+        need = int(math.ceil(backlog_tokens / rounds_left))
+        if need > cap:
+            self.starved_rounds += 1
+        budget = max(self.min_budget, min(need, cap))
+        self.last_budget = budget
+        return budget
+
+    def stats(self) -> dict[str, float]:
+        return {
+            "prefill_token_budget": float(self.last_budget),
+            "starved_rounds": float(self.starved_rounds),
+            "decode_round_ema_ms": self.decode_round_s * 1000.0,
+            "prefill_tok_cost_us": self.prefill_tok_s * 1e6,
+            "fair_cap_tokens": float(self.fair_cap()),
+        }

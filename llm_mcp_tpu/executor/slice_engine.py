@@ -29,9 +29,17 @@ The decode round returns its sampled tokens with a REPLICATED out-sharding
 (XLA inserts the all-gather across dp), so the leader fetches the full
 token block locally — followers fetch nothing and stay async.
 
-Scope vs `GenerationEngine`: whole-prompt bucketed prefill (no chunked
-prefill / prompt-prefix cache / pipelined rings yet) — the single-host
-engine keeps those; this engine's job is the cross-process data plane.
+Scheduling: with `prefill_chunk > 0` long prompts prefill chunk-by-chunk
+under the SAME token-budget policy as `GenerationEngine`
+(executor/scheduler.py): the leader asks the shared `TokenBudgetScheduler`
+for a per-iteration prefill token budget, stages one bounded chunk group,
+and broadcasts it as a "chunk" command before each decode round — decode
+cadence on the slice is bounded by budget arithmetic, not backlog depth.
+Followers replay the dispatches and need no policy.
+
+Scope vs `GenerationEngine`: no prompt-prefix cache / pipelined rings /
+slot compaction yet — the single-host engine keeps those; this engine's
+job is the cross-process data plane.
 """
 
 from __future__ import annotations
@@ -61,8 +69,10 @@ from ..models import (
     llama_prefill,
 )
 from ..models.configs import ModelConfig, resolve_config
+from ..models.llama import llama_prefill_chunk_batch
 from ..ops.sampling import sample_tokens
 from .common import pow2_bucket
+from .scheduler import TokenBudgetScheduler
 from .tokenizer import Tokenizer, load_tokenizer
 
 log = logging.getLogger("slice")
@@ -196,6 +206,21 @@ class _Slot:
     pending: bytes = b""
 
 
+@dataclass
+class _SlicePrefill:
+    """A reserved slot whose prompt is mid-way through chunked prefill on
+    the slice (leader-side bookkeeping; followers just replay the "chunk"
+    dispatches). The slot's length mirror is PARKED at max_seq_len while
+    chunks land: decode rounds write K/V unconditionally at every row's
+    length, and the out-of-bounds position drops the write instead of
+    corrupting the prompt KV under construction."""
+
+    req: SliceRequest
+    ids: list[int]
+    done: int = 0  # tokens already written into the cache
+    t0: float = 0.0  # submit time (scheduler deadline + TTFT stat)
+
+
 class SliceEngine:
     """See module docstring. Construct in EVERY process of the cluster with
     identical arguments; then `.start()` on the leader (process 0) and
@@ -216,6 +241,8 @@ class SliceEngine:
         tokenizer: Tokenizer | None = None,
         seed: int = 0,
         connect_timeout_s: float = 60.0,
+        prefill_chunk: int = 0,
+        target_ttft_ms: float = 2000.0,
     ):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -227,6 +254,8 @@ class SliceEngine:
         self.max_slots = max_slots
         self.max_seq_len = max_seq_len
         self.decode_chunk = decode_chunk
+        self.prefill_chunk = max(0, prefill_chunk)
+        self.target_ttft_ms = max(1.0, float(target_ttft_ms))
         self.quant = quant
         self.tokenizer = tokenizer or load_tokenizer(weights_dir)
         self.process_index = jax.process_index()
@@ -354,8 +383,20 @@ class SliceEngine:
                                   active=jnp.arange(tokens.shape[0]) < live_n)
             return ck, cv, toks0
 
+        @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("skey",),
+                 out_shardings=((repl,) + cache_out))
+        def chunk_fn(params, ck, cv, tokens, slots, starts, nvalid, skey):
+            """One chunked-prefill group dispatch (GenerationEngine's
+            prefill_chunk_fn, slice flavor): inputs arrive as identical
+            numpy on every process; the boundary logits come back
+            REPLICATED so the leader samples first tokens locally."""
+            return llama_prefill_chunk_batch(
+                cfg, params, ck, cv, tokens, slots, starts, nvalid, skey=skey
+            )
+
         self._decode_fn = decode_fn
         self._admit_fn = admit_fn
+        self._chunk_fn = chunk_fn
 
         # leader-side bookkeeping
         self._queue: "queue.Queue[Any]" = queue.Queue()
@@ -366,6 +407,16 @@ class SliceEngine:
         self._topks = np.zeros(max_slots, np.int32)
         self._topps = np.ones(max_slots, np.float32)
         self._counter = 0
+        # chunked-prefill reservations (leader-only; see _SlicePrefill) and
+        # the shared token-budget policy (executor/scheduler.py) — the SAME
+        # object GenerationEngine uses, so single-host and slice serving
+        # make identical scheduling decisions
+        self._prefills: dict[int, _SlicePrefill] = {}
+        self._prefill_q: deque[int] = deque()
+        self._sched = TokenBudgetScheduler(
+            target_ttft_ms=self.target_ttft_ms,
+            min_budget=min(64, self.prefill_chunk) if self.prefill_chunk else 1,
+        )
         self._shutdown = threading.Event()
         self._thread: threading.Thread | None = None
         self._leader_ch: CmdLeader | None = None
@@ -446,6 +497,16 @@ class SliceEngine:
                         _, self._ck, self._cv = self._decode_fn(
                             self.params, self._ck, self._cv, toks, lens,
                             active, temps, topks, topps, ctr,
+                        )
+                elif op == "chunk":
+                    # budget-bounded chunked-prefill group (token-budget
+                    # scheduler); the leader samples from the logits, a
+                    # follower only needs the cache writes
+                    _, tokens, slots, starts, nvalid, skey = cmd
+                    with self.mesh:
+                        _, self._ck, self._cv = self._chunk_fn(
+                            self.params, self._ck, self._cv, tokens,
+                            slots, starts, nvalid, int(skey),
                         )
                 else:  # pragma: no cover
                     raise ValueError(f"unknown slice command {op!r}")
@@ -536,6 +597,14 @@ class SliceEngine:
     def phase_budget(self) -> dict[str, float]:
         return {}  # per-phase accounting is a single-host engine feature
 
+    def scheduler_stats(self) -> dict[str, float]:
+        """Token-budget scheduler observability (GenerationEngine parity)."""
+        out = self._sched.stats()
+        out["decode_batch_occupancy"] = (
+            self.slots_in_use() / self.max_slots if self.max_slots else 0.0
+        )
+        return out
+
     def ttft_percentiles(self) -> tuple[float, float, int]:
         if not self._ttfts:
             return 0.0, 0.0, 0
@@ -570,18 +639,28 @@ class SliceEngine:
     # -- engine loop ------------------------------------------------------
 
     def _free_slots(self) -> list[int]:
-        return [i for i, s in enumerate(self._slots) if s is None]
+        # mid-prefill reservations are neither free nor decodable
+        return [
+            i for i, s in enumerate(self._slots)
+            if s is None and i not in self._prefills
+        ]
 
     def _drain_requests(self, msg: str) -> None:
-        """Fail every active slot and queued request with a terminal event.
-        Caller holds _dead_lock (both the shutdown and crash paths — one
-        copy, so the two drains cannot drift apart)."""
+        """Fail every active slot, mid-prefill reservation, and queued
+        request with a terminal event. Caller holds _dead_lock (both the
+        shutdown and crash paths — one copy, so the two drains cannot drift
+        apart)."""
         for b in range(self.max_slots):
             s = self._slots[b]
             if s is not None:
                 s.req.out.put({"type": "error", "error": msg})
                 s.req.out.put(_DONE)
                 self._slots[b] = None
+        for st in self._prefills.values():
+            st.req.out.put({"type": "error", "error": msg})
+            st.req.out.put(_DONE)
+        self._prefills.clear()
+        self._prefill_q.clear()
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -594,8 +673,12 @@ class SliceEngine:
         try:
             while not self._shutdown.is_set():
                 admitted = self._try_admit()
+                # one budget-bounded chunk group per iteration BEFORE the
+                # decode round: the token-budget scheduler caps the group so
+                # in-flight streams' cadence stays within ~2x pure decode
+                prefilled = self._try_prefill()
                 decoded = self._try_decode()
-                if not admitted and not decoded:
+                if not (admitted or prefilled or decoded):
                     if self._leader_ch is not None:
                         self._leader_ch.ping_if_idle()
                     time.sleep(0.002)
@@ -619,17 +702,43 @@ class SliceEngine:
         free = self._free_slots()
         if not free:
             return False
-        batch: list[SliceRequest] = []
-        while len(batch) < len(free):
+        pulled: list[SliceRequest] = []
+        while len(pulled) < len(free):
             try:
-                batch.append(self._queue.get_nowait())
+                pulled.append(self._queue.get_nowait())
             except queue.Empty:
                 break
-        if not batch:
+        if not pulled:
             return False
+        self.total_requests += len(pulled)
+        free_q = deque(free)
+        batch: list[tuple[int, SliceRequest, list[int]]] = []
+        reserved = False
+        for r in pulled:
+            # keep the TAIL of over-long prompts (the latest context is what
+            # matters in chat — same policy as GenerationEngine), and
+            # reserve a full decode round of KV headroom past the prompt
+            limit = max(self.max_seq_len - self.decode_chunk - 1, 1)
+            ids = r.prompt_ids[-limit:] or [0]
+            slot = free_q.popleft()
+            if self.prefill_chunk and len(ids) > self.prefill_chunk:
+                # long prompt: reserve the slot; chunks ride the token-budget
+                # scheduler (_try_prefill). PARK the length mirror at S so
+                # decode rounds' unconditional K/V writes drop out-of-bounds
+                # instead of landing inside the prompt KV under construction.
+                self._prefills[slot] = _SlicePrefill(
+                    req=r, ids=list(ids),
+                    t0=getattr(r, "_t0", None) or time.time(),
+                )
+                self._prefill_q.append(slot)
+                self._lens[slot] = self.max_seq_len
+                reserved = True
+                continue
+            batch.append((slot, r, ids))
+        if not batch:
+            return reserved
         A = len(batch)
-        self.total_requests += A
-        maxlen = max(len(r.prompt_ids) for r in batch)
+        maxlen = max(len(ids) for _, _, ids in batch)
         bucket = pow2_bucket(min(maxlen, self.max_seq_len - 1), self.max_seq_len)
         tokens = np.zeros((A, bucket), np.int32)
         lengths = np.zeros(A, np.int32)
@@ -637,15 +746,10 @@ class SliceEngine:
         temps = np.zeros(A, np.float32)
         topks = np.zeros(A, np.int32)
         topps = np.ones(A, np.float32)
-        for i, r in enumerate(batch):
-            # keep the TAIL of over-long prompts (the latest context is what
-            # matters in chat — same policy as GenerationEngine), and
-            # reserve a full decode round of KV headroom past the prompt
-            limit = max(self.max_seq_len - self.decode_chunk - 1, 1)
-            ids = r.prompt_ids[-limit:] or [0]
+        for i, (slot, r, ids) in enumerate(batch):
             tokens[i, : len(ids)] = ids
             lengths[i] = len(ids)
-            slots[i] = free[i]
+            slots[i] = slot
             temps[i] = r.temperature
             topks[i] = r.top_k
             topps[i] = r.top_p
@@ -666,29 +770,155 @@ class SliceEngine:
             # these requests were already popped off the queue — the loop's
             # crash handler can no longer see them, so fail them HERE or
             # their consumers block in out.get() forever
-            for r in batch:
+            for _, r, _ in batch:
                 r.out.put({"type": "error", "error": repr(e)})
                 r.out.put(_DONE)
             raise
         now = time.time()
-        for i, r in enumerate(batch):
+        for i, (b, r, _) in enumerate(batch):
             slot = _Slot(req=r, prompt_len=int(lengths[i]))
-            self._slots[free[i]] = slot
-            self._toks[free[i]] = toks0[i]
-            self._lens[free[i]] = lengths[i]
-            self._temps[free[i]] = r.temperature
-            self._topks[free[i]] = r.top_k
-            self._topps[free[i]] = r.top_p
+            self._slots[b] = slot
+            self._toks[b] = toks0[i]
+            self._lens[b] = lengths[i]
+            self._temps[b] = r.temperature
+            self._topks[b] = r.top_k
+            self._topps[b] = r.top_p
             t0 = getattr(r, "_t0", None)
             if t0 is not None:
                 self._ttfts.append((now - t0) * 1000.0)
-            self._emit_token(free[i], int(toks0[i]))
+            self._emit_token(b, int(toks0[i]))
+        return True
+
+    def _chunk_shape(self, slot: int, cap: int = 0) -> tuple[int, int, int, int]:
+        """(start, n, bucket, skey) for a reserved slot's next chunk, with
+        `cap` (>0) bounding n to the scheduler's remaining budget — same
+        shape rules as GenerationEngine._chunk_shape (one executable per
+        (group size, bucket, skey) forever)."""
+        st = self._prefills[slot]
+        start = st.done
+        n = min(self.prefill_chunk, len(st.ids) - start)
+        if cap > 0:
+            n = min(n, cap)
+        bucket = min(pow2_bucket(n, self.prefill_chunk), self.max_seq_len - start)
+        skey = (
+            min(pow2_bucket(start, self.max_seq_len), self.max_seq_len)
+            if start
+            else min(128, self.max_seq_len)
+        )
+        return start, n, bucket, skey
+
+    def _try_prefill(self) -> bool:
+        """One budget-bounded chunk group per loop iteration: ask the shared
+        TokenBudgetScheduler for this round's prefill token budget, stage a
+        group of reserved slots' next chunks under it, broadcast the "chunk"
+        command, and dispatch. Finished prompts activate (first token
+        sampled from the replicated boundary logits, leader-locally)."""
+        n_active = sum(1 for s in self._slots if s is not None)
+        if not self._prefill_q:
+            self._sched.decide(0, n_active, 0.0)
+            return False
+        backlog = sum(len(st.ids) - st.done for st in self._prefills.values())
+        oldest = min(self._prefills[s].t0 for s in self._prefill_q)
+        budget = self._sched.decide(backlog, n_active, time.time() - oldest)
+        if budget <= 0:
+            return False
+        first = self._prefill_q[0]
+        _, f_n, f_bucket, f_skey = self._chunk_shape(first, cap=budget)
+        group = [first]
+        used = f_n
+        for slot in list(self._prefill_q)[1:]:
+            if len(group) >= 4 or used >= budget:
+                break
+            start2, n2, _, s2 = self._chunk_shape(
+                slot, cap=min(budget - used, f_bucket)
+            )
+            if s2 == f_skey and n2 > 0 and start2 + f_bucket <= self.max_seq_len:
+                group.append(slot)
+                used += n2
+        Ab = 1 << (len(group) - 1).bit_length()
+        tokens = np.zeros((Ab, f_bucket), np.int32)
+        slots_arr = np.zeros((Ab,), np.int32)
+        starts_arr = np.zeros((Ab,), np.int32)
+        nv_arr = np.ones((Ab,), np.int32)
+        metas: list[tuple[int, _SlicePrefill, int]] = []
+        rem = budget
+        for i, slot in enumerate(group):
+            st = self._prefills[slot]
+            start, n, _, _ = self._chunk_shape(
+                slot, cap=min(rem, f_bucket) if i else budget
+            )
+            tokens[i, :n] = st.ids[start : start + n]
+            slots_arr[i] = slot
+            starts_arr[i] = start
+            nv_arr[i] = n
+            metas.append((slot, st, n))
+            rem -= n
+        for i in range(len(group), Ab):  # pad rows dup row 0: identical writes
+            tokens[i] = tokens[0]
+            slots_arr[i] = slots_arr[0]
+            starts_arr[i] = starts_arr[0]
+            nv_arr[i] = nv_arr[0]
+        cmd = ("chunk", tokens, slots_arr, starts_arr, nv_arr,
+               np.int32(f_skey))
+        try:
+            if self._leader_ch is not None:
+                self._leader_ch.send(cmd)
+            t0 = time.perf_counter()
+            with self.mesh:
+                logits, self._ck, self._cv = self._chunk_fn(
+                    self.params, self._ck, self._cv, tokens,
+                    slots_arr, starts_arr, nv_arr, int(f_skey),
+                )
+            jax.block_until_ready(self._ck)
+            self._sched.observe_prefill(
+                sum(n for _, _, n in metas), time.perf_counter() - t0
+            )
+        except Exception as e:
+            # fail the group's waiters HERE (the loop's crash handler drains
+            # the rest): the donated cache died with the dispatch
+            for slot, st, _ in metas:
+                self._prefills.pop(slot, None)
+                try:
+                    self._prefill_q.remove(slot)
+                except ValueError:
+                    pass
+                st.req.out.put({"type": "error", "error": repr(e)})
+                st.req.out.put(_DONE)
+            raise
+        now = time.time()
+        for i, (slot, st, n) in enumerate(metas):
+            st.done += n
+            if st.done < len(st.ids):
+                continue
+            # last chunk landed: activate. The logits are replicated, so the
+            # leader samples locally — followers never need the token (every
+            # decode command ships the full token block from the leader).
+            r = st.req
+            key = jax.random.fold_in(self._base_key, self._counter)
+            self._counter += 1
+            tok0 = int(np.asarray(sample_tokens(
+                jnp.asarray(np.asarray(logits)[i : i + 1]), key,
+                np.asarray([r.temperature], np.float32),
+                np.asarray([r.top_k], np.int32),
+                np.asarray([r.top_p], np.float32),
+            ))[0])
+            self._prefill_q.remove(slot)
+            del self._prefills[slot]
+            self._slots[slot] = _Slot(req=r, prompt_len=len(st.ids))
+            self._toks[slot] = tok0
+            self._lens[slot] = len(st.ids)  # un-park
+            self._temps[slot] = r.temperature
+            self._topks[slot] = r.top_k
+            self._topps[slot] = r.top_p
+            self._ttfts.append((now - st.t0) * 1000.0)
+            self._emit_token(slot, tok0)
         return True
 
     def _try_decode(self) -> bool:
         active0 = np.asarray([s is not None for s in self._slots], bool)
         if not active0.any():
             return False
+        t_round = time.perf_counter()
         ctr = self._counter
         self._counter += 1
         cmd = ("decode", self._toks.copy(), self._lens.copy(), active0.copy(),
@@ -702,6 +932,9 @@ class SliceEngine:
                 active0, self._temps, self._topks, self._topps, np.int32(ctr),
             )
         out = np.asarray(out)  # [K, B] replicated
+        # decode rounds here are never fused with prefill, so every round
+        # teaches the scheduler's decode-round EMA directly
+        self._sched.observe_decode(time.perf_counter() - t_round)
         K = out.shape[0]
         self._tps_marks.append((time.time(), int(active0.sum()) * K))
         for k in range(K):

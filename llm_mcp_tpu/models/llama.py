@@ -534,7 +534,8 @@ def llama_prefill_chunk_batch(
     Padding rows past `nvalid` in a ragged final chunk are written but never
     attended (causal mask; valid q rows never reach garbage columns) and are
     overwritten in place by later decode steps. Engine interleaving:
-    executor/engine.py:_prefill_round. The reference never faces any of
+    executor/engine.py:_stage_prefill_group (token-budget scheduler,
+    executor/scheduler.py). The reference never faces any of
     this — it proxies Ollama (`core/internal/api/handlers.go:2427-2587`).
 
     Returns (logits [A, V] f32 at each row's last valid position,

@@ -113,6 +113,26 @@ class Metrics:
             buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60),
             registry=r,
         )
+        # Token-budget scheduler (executor/scheduler.py): the live per-round
+        # prefill token budget, how full decode dispatches run, and how often
+        # the TTFT deadline demanded more prefill than the fairness cap
+        # allows (starvation — raise TPU_TARGET_TTFT_MS, add capacity, or
+        # shed load; doc/performance.md).
+        self.sched_prefill_token_budget = Gauge(
+            "llmtpu_sched_prefill_token_budget",
+            "Prefill token budget of the engine's most recent scheduling decision",
+            registry=r,
+        )
+        self.sched_decode_occupancy = Gauge(
+            "llmtpu_sched_decode_batch_occupancy",
+            "Active decode rows / max_slots in the most recent dispatch",
+            registry=r,
+        )
+        self.sched_starved_rounds = Counter(
+            "llmtpu_sched_starved_rounds_total",
+            "Rounds where the TTFT deadline needed more prefill tokens than the fairness cap",
+            registry=r,
+        )
 
     def render(self) -> tuple[bytes, str]:
         return generate_latest(self.registry), CONTENT_TYPE_LATEST

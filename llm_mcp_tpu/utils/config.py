@@ -131,9 +131,11 @@ class Config:
     tpu_kv_quant: str = field(default_factory=lambda: getenv("TPU_KV_QUANT", ""))  # "" | int8
     # chunked prefill segment length (tokens); 0 disables interleaved prefill
     tpu_prefill_chunk: int = field(default_factory=lambda: getenv_int("TPU_PREFILL_CHUNK", 512))
-    # chunked-prefill budget multiplier while the mid-prefill backlog is
-    # deep (TTFT p95 tail; engine _prefill_round). 1.0 disables the boost.
-    tpu_prefill_boost: float = field(default_factory=lambda: getenv_float("TPU_PREFILL_BOOST", 2.0))
+    # token-budget scheduler TTFT target (ms): the per-round prefill token
+    # budget is clamped so the oldest mid-prefill prompt activates within
+    # this deadline (executor/scheduler.py). Replaces the retired
+    # TPU_PREFILL_BOOST wall-clock multiplier (doc/performance.md).
+    tpu_target_ttft_ms: float = field(default_factory=lambda: getenv_float("TPU_TARGET_TTFT_MS", 2000.0))
     # slot compaction: decode only active rows (auto | on | off)
     tpu_decode_compact: str = field(default_factory=lambda: getenv("TPU_DECODE_COMPACT", "auto"))
     # admission prompt buckets: fine (pow2 + 1.5x midpoints) | pow2

@@ -55,7 +55,7 @@ def main() -> None:
             decode_compact=cfg.tpu_decode_compact,
             prompt_cache_mb=cfg.tpu_prompt_cache_mb,
             prefill_buckets=cfg.tpu_prefill_buckets,
-            prefill_boost=cfg.tpu_prefill_boost,
+            target_ttft_ms=cfg.tpu_target_ttft_ms,
         ).start()
         cfg.warn_embed_dir_gap(logging.getLogger("worker"))
         embed_engines[cfg.tpu_embed_model] = EmbeddingEngine(

@@ -1,0 +1,156 @@
+#!/usr/bin/env python3
+"""Performance gate: compare a bench record against a baseline and exit
+nonzero on regression.
+
+    python scripts/perf_gate.py BENCH_r05.json BASELINE.json
+
+The r05 regression (serve 2428 → 464.7 tok/s, p95 TTFT 3.4 s → 15.7 s)
+shipped silently because the numbers lived in a JSON blob nobody diffed.
+This gate makes that class of regression impossible to ship silently: run
+it in CI (or by hand before committing a BENCH_*.json) and a regressed
+serve line fails the build with a per-metric report.
+
+Inputs (either argument may be any of these shapes):
+  - a BENCH_rXX.json harness capture: {"n", "cmd", "rc", "tail", ...} —
+    the line of record is the LAST JSON object line inside "tail" that
+    carries a "value" field;
+  - a flat bench line of record (the JSON object bench.py prints);
+  - BASELINE.json (no numeric serve metrics) — comparisons fall back to
+    the ABSOLUTE floors below.
+
+Checks, in order of authority:
+  1. Relative, when the baseline has the metric: higher-is-better metrics
+     (value, engine_direct_tok_per_s, serve_efficiency, vs_baseline,
+     mean_completion_tokens) may drop at most TOLERANCE; lower-is-better
+     metrics (p50/p95 TTFT) may rise at most TTFT_TOLERANCE;
+     window_errors may not increase.
+  2. Absolute floors, always: vs_baseline and serve_efficiency >= 0.5
+     (serve_efficiency is derived from value / engine_direct_tok_per_s
+     when the line predates the field), p95_ttft_ms <= 5000,
+     window_errors == 0. The floors alone catch r05 against the
+     metric-less BASELINE.json.
+
+Missing metrics are reported but never fail the gate (older records
+predate newer fields); a metric PRESENT and regressed always fails.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+# relative tolerances (fraction of baseline)
+TOLERANCE = 0.10  # throughput-class metrics may drop <= 10%
+TTFT_TOLERANCE = 0.25  # latency-class metrics may rise <= 25%
+
+HIGHER_BETTER = (
+    "value",
+    "vs_baseline",
+    "serve_efficiency",
+    "engine_direct_tok_per_s",
+    "mean_completion_tokens",
+)
+LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms")
+
+# absolute floors/ceilings applied regardless of baseline coverage
+ABS_MIN = {"vs_baseline": 0.5, "serve_efficiency": 0.5}
+ABS_MAX = {"p95_ttft_ms": 5000.0, "window_errors": 0.0}
+
+
+def extract_record(doc: dict) -> dict:
+    """The bench line of record from any supported JSON shape."""
+    if "value" in doc:
+        return doc
+    tail = doc.get("tail", "")
+    rec = None
+    for line in str(tail).splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and "value" in obj:
+            rec = obj  # last wins: the line of record is printed last
+    return rec if rec is not None else doc
+
+
+def metric(rec: dict, name: str) -> float | None:
+    v = rec.get(name)
+    if isinstance(v, (int, float)):
+        return float(v)
+    if name == "serve_efficiency":
+        # derive for records that predate the field (bench.py emits it now)
+        val, direct = rec.get("value"), rec.get("engine_direct_tok_per_s")
+        if isinstance(val, (int, float)) and isinstance(direct, (int, float)) and direct > 0:
+            return float(val) / float(direct)
+    return None
+
+
+def check(cand: dict, base: dict) -> list[tuple[str, str, bool]]:
+    """[(metric, message, ok)] for every check that could be evaluated."""
+    results: list[tuple[str, str, bool]] = []
+    for name in HIGHER_BETTER:
+        c, b = metric(cand, name), metric(base, name)
+        if c is None:
+            results.append((name, "absent from candidate (skipped)", True))
+            continue
+        if b is not None:
+            floor = b * (1.0 - TOLERANCE)
+            ok = c >= floor
+            results.append(
+                (name, f"{c:.3f} vs baseline {b:.3f} (floor {floor:.3f})", ok)
+            )
+        if name in ABS_MIN:
+            ok = c >= ABS_MIN[name]
+            results.append((name, f"{c:.3f} >= {ABS_MIN[name]} (abs floor)", ok))
+    for name in LOWER_BETTER:
+        c, b = metric(cand, name), metric(base, name)
+        if c is None or c < 0:  # bench emits -1.0 for "not measured"
+            results.append((name, "absent from candidate (skipped)", True))
+            continue
+        if b is not None and b >= 0:
+            ceil = b * (1.0 + TTFT_TOLERANCE)
+            ok = c <= ceil
+            results.append(
+                (name, f"{c:.1f} vs baseline {b:.1f} (ceiling {ceil:.1f})", ok)
+            )
+        if name in ABS_MAX:
+            ok = c <= ABS_MAX[name]
+            results.append((name, f"{c:.1f} <= {ABS_MAX[name]} (abs ceiling)", ok))
+    c = metric(cand, "window_errors")
+    if c is not None:
+        b = metric(base, "window_errors") or 0.0
+        ok = c <= max(b, ABS_MAX["window_errors"])
+        results.append(("window_errors", f"{c:.0f} (baseline {b:.0f})", ok))
+    return results
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 2:
+        print(__doc__)
+        print("usage: perf_gate.py CANDIDATE.json BASELINE.json", file=sys.stderr)
+        return 2
+    with open(argv[0]) as f:
+        cand = extract_record(json.load(f))
+    with open(argv[1]) as f:
+        base = extract_record(json.load(f))
+    if "value" not in cand:
+        print(f"perf_gate: no bench line of record in {argv[0]}", file=sys.stderr)
+        return 2
+    print(f"candidate: {cand.get('metric', argv[0])}")
+    print(f"baseline:  {base.get('metric', argv[1])}")
+    failed = 0
+    for name, msg, ok in check(cand, base):
+        print(f"  [{'PASS' if ok else 'FAIL'}] {name}: {msg}")
+        failed += 0 if ok else 1
+    if failed:
+        print(f"perf_gate: {failed} metric(s) regressed", file=sys.stderr)
+        return 1
+    print("perf_gate: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -5,10 +5,11 @@ import os
 import sys
 
 import jax.numpy as jnp
+import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import serve_window_degenerate  # noqa: E402
+from bench import serve_efficiency, serve_window_degenerate  # noqa: E402
 
 from llm_mcp_tpu.executor import GenerationEngine  # noqa: E402
 
@@ -54,6 +55,24 @@ def test_no_finishes_in_window_is_not_degenerate():
     # inside the window edge — absence of evidence is not refusal
     serve = {"tok_per_s": 1800.0, "window_errors": 0.0, "window_finished": 0.0}
     assert serve_window_degenerate(serve, 256, raw_error=False) == ""
+
+
+def test_serve_efficiency_ratio():
+    """serve ÷ engine-direct as one first-class number: the r05 regression
+    (0.295) must be visible in a single gated field."""
+    assert serve_efficiency(
+        {"tok_per_s": 464.7, "engine_direct_tok_per_s": 1574.5}
+    ) == pytest.approx(0.295, abs=0.001)
+    assert serve_efficiency(
+        {"tok_per_s": 2400.0, "engine_direct_tok_per_s": 2400.0}
+    ) == pytest.approx(1.0)
+
+
+def test_serve_efficiency_unavailable_direct():
+    assert serve_efficiency({"tok_per_s": 2400.0}) is None
+    assert serve_efficiency(
+        {"tok_per_s": 2400.0, "engine_direct_tok_per_s": 0.0}
+    ) is None
 
 
 def test_engine_counts_finished_and_errors():

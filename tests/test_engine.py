@@ -304,7 +304,8 @@ def test_chunked_prefill_matches_single_shot():
 
 def test_chunked_prefill_interleaves_with_decode():
     """While a long prompt is being admitted, an in-flight stream must keep
-    receiving tokens: decode rounds interleave between prefill chunks."""
+    receiving tokens: under the token-budget scheduler, prefill chunks ride
+    FUSED inside decode rounds (fused_step_fn) instead of stalling them."""
     import threading
 
     eng = GenerationEngine(
@@ -312,9 +313,13 @@ def test_chunked_prefill_interleaves_with_decode():
         decode_chunk=2, prefill_chunk=8,
     )
     trace: list[str] = []
-    orig_p, orig_d = eng._prefill_round, eng._dispatch_decode
-    eng._prefill_round = lambda: (trace.append("p"), orig_p())[1]
-    eng._dispatch_decode = lambda active: (trace.append("d"), orig_d(active))[1]
+    orig_d = eng._dispatch_decode
+
+    def spy_dispatch(active, group=None):
+        trace.append("f" if group is not None else "d")
+        return orig_d(active, group)
+
+    eng._dispatch_decode = spy_dispatch
     eng.start()
     try:
         results = {}
@@ -337,9 +342,10 @@ def test_chunked_prefill_interleaves_with_decode():
         t2.join(timeout=60)
         assert results["long"]["usage"]["prompt_tokens"] >= 295
         joined = "".join(trace)
-        # at least one decode round ran BETWEEN two prefill chunks
+        # the long prompt's chunks must have ridden inside decode rounds
+        # (fused dispatches) while the short stream kept decoding
         if results["short"]["usage"]["completion_tokens"] >= 20:
-            assert "pdp" in joined, joined
+            assert "f" in joined, joined
         # decode rounds running concurrently with the chunked prefill must
         # not corrupt the prefilling slot's prompt KV: the long request's
         # greedy output must match a quiet single-shot engine's

@@ -180,18 +180,19 @@ def test_engine_stall_watchdog(monkeypatch):
         # warm BEFORE wedging: first-compile time must not trip the watchdog
         assert eng.generate("ok", max_tokens=2, temperature=0.0)["finish_reason"]
         state = {"wedged": False}
-        orig_p = eng._prefill_round
+        orig_p = eng._stage_prefill_group
 
-        def wedge():
-            # _prefill_round runs right AFTER admission activates a request:
-            # wedging here guarantees an in-flight slot exists when the loop
-            # blocks (simulated uninterruptible device call)
+        def wedge(n_active):
+            # _stage_prefill_group runs every loop iteration, after a
+            # request activates: wedging here guarantees an in-flight slot
+            # exists when the loop blocks (simulated uninterruptible device
+            # call)
             if not state["wedged"]:
                 state["wedged"] = True
                 release.wait(40)
-            return orig_p()
+            return orig_p(n_active)
 
-        eng._prefill_round = wedge
+        eng._stage_prefill_group = wedge
         # an IN-FLIGHT stream when the wedge hits: its consumer must get a
         # terminal error too, not hang forever on req.out.get()
         results: list = []

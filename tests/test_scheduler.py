@@ -1,0 +1,310 @@
+"""Token-budget scheduler tests: the decide() policy table, engine-loop
+fairness under a prefill backlog (CPU backend, tiny model), and the
+scripts/perf_gate.py regression gate against the repo's real bench records.
+
+The r05 regression these guard against: TPU_PREFILL_BOOST let prefill
+monopolize the engine loop (93% of window wall, serve 2428 → 464.7 tok/s)
+while p95 TTFT still blew out to 15.7 s. The scheduler bounds prefill per
+round by the fairness cap; the gate makes the bench numbers un-shippable
+when they regress anyway.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import threading
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from llm_mcp_tpu.executor import GenerationEngine
+from llm_mcp_tpu.executor.scheduler import TokenBudgetScheduler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------- decide() policy --
+
+
+def test_no_backlog_means_zero_budget():
+    s = TokenBudgetScheduler(target_ttft_ms=2000.0, min_budget=8)
+    assert s.decide(0, n_active=4, oldest_wait_s=0.0) == 0
+    assert s.last_budget == 0
+    assert s.stats()["prefill_token_budget"] == 0.0
+
+
+def test_pure_prefill_window_runs_whole_backlog():
+    """No active decode slots → nothing to protect: the budget is the whole
+    backlog, so cold bursts drain back-to-back (the stale-budget bug fix)."""
+    s = TokenBudgetScheduler(target_ttft_ms=2000.0, min_budget=8)
+    assert s.decide(10_000, n_active=0, oldest_wait_s=0.0) == 10_000
+    # and the very next mixed round is NOT stuck with the burst budget
+    mixed = s.decide(10_000, n_active=4, oldest_wait_s=0.0)
+    assert mixed <= s.fair_cap()
+
+
+def test_fair_cap_clamps_and_counts_starvation():
+    # decode round 10 ms, prefill 100 us/tok → fair cap = 100 tokens
+    s = TokenBudgetScheduler(
+        target_ttft_ms=1000.0, min_budget=4,
+        decode_seed_s=0.010, prefill_tok_seed_s=100e-6,
+    )
+    assert s.fair_cap() == 100
+    # deadline nearly spent: need >> cap, budget pinned at cap, starvation++
+    b = s.decide(50_000, n_active=4, oldest_wait_s=0.99)
+    assert b == 100
+    assert s.starved_rounds == 1
+    # relaxed deadline: need is small, budget well under the cap
+    b2 = s.decide(200, n_active=4, oldest_wait_s=0.0)
+    assert b2 < 100
+    assert s.starved_rounds == 1  # unchanged
+
+
+def test_min_budget_floor():
+    s = TokenBudgetScheduler(
+        target_ttft_ms=60_000.0, min_budget=32,
+        decode_seed_s=0.010, prefill_tok_seed_s=100e-6,
+    )
+    # tiny backlog + huge deadline → need≈1, floored at min_budget
+    assert s.decide(5, n_active=2, oldest_wait_s=0.0) == 32
+
+
+def test_emas_move_toward_observations():
+    s = TokenBudgetScheduler(decode_seed_s=0.05, prefill_tok_seed_s=1e-4)
+    for _ in range(30):
+        s.observe_decode(0.010)
+        s.observe_prefill(1000, 0.010)  # 10 us/token
+    assert s.decode_round_s == pytest.approx(0.010, rel=0.05)
+    assert s.prefill_tok_s == pytest.approx(10e-6, rel=0.05)
+    # fused rounds attribute the over-EMA residual to prefill
+    before = s.prefill_tok_s
+    s.observe_fused(0.030, prefill_tokens=100)  # 20 ms residual / 100 tok
+    assert s.prefill_tok_s > before
+    # rounds faster than the decode EMA teach nothing
+    at = s.prefill_tok_s
+    s.observe_fused(0.001, prefill_tokens=100)
+    assert s.prefill_tok_s == at
+
+
+def test_degenerate_observations_ignored():
+    s = TokenBudgetScheduler()
+    d0, p0 = s.decode_round_s, s.prefill_tok_s
+    s.observe_decode(0.0)
+    s.observe_decode(-1.0)
+    s.observe_prefill(0, 1.0)
+    s.observe_prefill(100, 0.0)
+    assert (s.decode_round_s, s.prefill_tok_s) == (d0, p0)
+    # absurd per-token cost is clamped, keeping fair_cap() > 0 forever
+    s.observe_prefill(1, 3600.0)
+    assert s.prefill_tok_s <= 1.0
+    assert s.fair_cap() >= 1
+
+
+def test_stats_contract():
+    s = TokenBudgetScheduler()
+    s.decide(100, n_active=1, oldest_wait_s=0.0)
+    st = s.stats()
+    assert set(st) == {
+        "prefill_token_budget", "starved_rounds", "decode_round_ema_ms",
+        "prefill_tok_cost_us", "fair_cap_tokens",
+    }
+    assert all(isinstance(v, float) for v in st.values())
+
+
+# ------------------------------------------------- engine-loop integration --
+
+
+def test_staged_groups_respect_budget_with_active_decode():
+    """While other slots are decoding, no staged chunk group may exceed the
+    budget the scheduler decided — the fairness contract that keeps
+    in-flight inter-token latency bounded under a prefill backlog."""
+    eng = GenerationEngine(
+        "tiny-llm", max_slots=2, max_seq_len=512, dtype=jnp.float32,
+        decode_chunk=2, prefill_chunk=8,
+    )
+    staged: list[tuple[int, int]] = []  # (budget decided, tokens staged)
+    orig = eng._stage_prefill_group
+
+    def spy(n_active):
+        g = orig(n_active)
+        if n_active > 0 and g is not None:
+            staged.append((eng._sched.last_budget, g.n_tokens))
+        return g
+
+    eng._stage_prefill_group = spy
+    eng.start()
+    try:
+        results = {}
+
+        def gen(name, prompt, n):
+            results[name] = eng.generate(prompt, max_tokens=n, temperature=0.0)
+
+        t1 = threading.Thread(target=gen, args=("short", "hi there", 200))
+        t1.start()
+        for _ in range(200):
+            if eng.total_requests >= 1:
+                break
+            time.sleep(0.01)
+        t2 = threading.Thread(target=gen, args=("long", "z" * 400, 4))
+        t2.start()
+        t1.join(timeout=120)
+        t2.join(timeout=120)
+        assert results["long"]["usage"]["prompt_tokens"] >= 390
+        assert results["short"]["usage"]["completion_tokens"] >= 1
+        for budget, n_tokens in staged:
+            assert n_tokens <= budget, (budget, n_tokens)
+    finally:
+        eng.shutdown()
+
+
+def test_deep_backlog_measures_ttft_for_every_request():
+    """A burst deeper than the slot count must activate every prompt and
+    record a TTFT sample for each — the p95 the dashboard and bench gate
+    read is real, not a survivor subset."""
+    import concurrent.futures as cf
+
+    eng = GenerationEngine(
+        "tiny-llm", max_slots=4, max_seq_len=256, dtype=jnp.float32,
+        decode_chunk=2, prefill_chunk=16,
+    ).start()
+    try:
+        _, _, n0 = eng.ttft_percentiles()
+        prompts = [f"backlog request {i} " * (3 + i % 4) for i in range(8)]
+        with cf.ThreadPoolExecutor(max_workers=8) as ex:
+            outs = list(ex.map(
+                lambda p: eng.generate(p, max_tokens=12, temperature=0.0),
+                prompts,
+            ))
+        assert all(o["usage"]["completion_tokens"] >= 1 for o in outs)
+        p50, p95, n = eng.ttft_percentiles()
+        assert n - n0 >= 8
+        assert p95 >= p50 > 0
+        # the loop spent wall-clock in every phase the budget tracks
+        pb = eng.phase_budget()
+        assert pb["prefill"] > 0 and pb["dispatch"] > 0
+    finally:
+        eng.shutdown()
+
+
+def test_scheduler_stats_surface():
+    eng = GenerationEngine(
+        "tiny-llm", max_slots=4, max_seq_len=128, dtype=jnp.float32,
+        decode_chunk=2, prefill_chunk=8,
+    ).start()
+    try:
+        eng.generate("stats probe " * 4, max_tokens=6, temperature=0.0)
+        st = eng.scheduler_stats()
+        assert {"prefill_token_budget", "starved_rounds", "decode_round_ema_ms",
+                "prefill_tok_cost_us", "fair_cap_tokens",
+                "decode_batch_occupancy"} <= set(st)
+        assert 0.0 <= st["decode_batch_occupancy"] <= 1.0
+        assert st["decode_round_ema_ms"] > 0
+    finally:
+        eng.shutdown()
+
+
+def test_prefill_boost_arg_accepted_and_ignored():
+    """Launch scripts passing the retired knob must keep working."""
+    eng = GenerationEngine(
+        "tiny-llm", max_slots=2, max_seq_len=64, dtype=jnp.float32,
+        decode_chunk=2, prefill_boost=3.0, target_ttft_ms=1500.0,
+    ).start()
+    try:
+        assert not hasattr(eng, "prefill_boost")
+        assert eng._sched.target_ttft_s == pytest.approx(1.5)
+        out = eng.generate("compat", max_tokens=4, temperature=0.0)
+        assert out["usage"]["completion_tokens"] >= 1
+    finally:
+        eng.shutdown()
+
+
+def test_config_target_ttft_knob(monkeypatch):
+    from llm_mcp_tpu.utils.config import Config
+
+    monkeypatch.delenv("TPU_TARGET_TTFT_MS", raising=False)
+    cfg = Config()
+    assert cfg.tpu_target_ttft_ms == 2000.0
+    assert not hasattr(cfg, "tpu_prefill_boost")
+    monkeypatch.setenv("TPU_TARGET_TTFT_MS", "750")
+    assert Config().tpu_target_ttft_ms == 750.0
+
+
+# ------------------------------------------------------- scripts/perf_gate --
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+gate = _load("perf_gate")
+
+
+def _bench(name):
+    return os.path.join(REPO, name)
+
+
+def test_extract_record_from_harness_capture():
+    import json
+
+    with open(_bench("BENCH_r05.json")) as f:
+        rec = gate.extract_record(json.load(f))
+    assert rec["value"] == pytest.approx(464.7)
+    assert rec["p95_ttft_ms"] == pytest.approx(15664.7)
+    # flat line-of-record shape passes through untouched
+    flat = {"value": 1.0, "metric": "x"}
+    assert gate.extract_record(flat) is flat
+
+
+def test_gate_catches_r05_against_baseline(capsys):
+    """The acceptance criterion: the regressed r05 record must fail even
+    against the metric-less BASELINE.json (absolute floors)."""
+    rc = gate.main([_bench("BENCH_r05.json"), _bench("BASELINE.json")])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "[FAIL] serve_efficiency" in out
+    assert "[FAIL] p95_ttft_ms" in out
+
+
+def test_gate_passes_healthy_r04_against_baseline():
+    assert gate.main([_bench("BENCH_r04.json"), _bench("BASELINE.json")]) == 0
+
+
+def test_gate_catches_r05_against_r04():
+    assert gate.main([_bench("BENCH_r05.json"), _bench("BENCH_r04.json")]) == 1
+
+
+def test_gate_relative_tolerances(tmp_path):
+    import json
+
+    base = {"value": 1000.0, "p95_ttft_ms": 1000.0, "window_errors": 0.0,
+            "engine_direct_tok_per_s": 1100.0}
+    ok = dict(base, value=950.0, p95_ttft_ms=1200.0)  # -5% / +20%: inside
+    bad = dict(base, value=850.0)  # -15% throughput: outside TOLERANCE
+    for n, doc in (("base", base), ("ok", ok), ("bad", bad)):
+        (tmp_path / f"{n}.json").write_text(json.dumps(doc))
+    assert gate.main([str(tmp_path / "ok.json"), str(tmp_path / "base.json")]) == 0
+    assert gate.main([str(tmp_path / "bad.json"), str(tmp_path / "base.json")]) == 1
+
+
+def test_gate_usage_and_unparseable_inputs(tmp_path):
+    assert gate.main([]) == 2
+    (tmp_path / "empty.json").write_text('{"n": 1, "tail": "no record here"}')
+    assert gate.main([str(tmp_path / "empty.json"), _bench("BASELINE.json")]) == 2
+
+
+def test_gate_skips_unmeasured_ttft(tmp_path):
+    """bench emits -1.0 for TTFT when the window measured none; the gate
+    must treat that as absent, not as an excellent latency."""
+    import json
+
+    cand = {"value": 2400.0, "p95_ttft_ms": -1.0, "window_errors": 0.0}
+    (tmp_path / "cand.json").write_text(json.dumps(cand))
+    assert gate.main([str(tmp_path / "cand.json"), _bench("BASELINE.json")]) == 0
