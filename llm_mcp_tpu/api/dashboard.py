@@ -55,6 +55,18 @@ class DashboardAPI:
         hosts = self._host_tree(devices, circuit)
         engines = self.engines_info()
         issues = self._issues(counts, devices, workers, circuit, engines)
+        # condensed self-speculative decoding view (full counters live under
+        # engines[name]["speculation"]): is drafting paying off per engine?
+        speculation = {
+            name: {
+                "enabled": bool(i["speculation"].get("enabled")),
+                "accept_rate": round(i["speculation"].get("accept_rate", 0.0), 3),
+                "tok_per_call": round(i["speculation"].get("tok_per_call", 0.0), 2),
+                "verify_calls": int(i["speculation"].get("verify_calls", 0.0)),
+            }
+            for name, i in engines.items()
+            if isinstance(i.get("speculation"), dict)
+        }
         resp.write_json(
             {
                 "ts": time.time(),
@@ -69,6 +81,7 @@ class DashboardAPI:
                 "costs_24h": costs,
                 "circuit": circuit,
                 "engines": engines,
+                "speculation": speculation,
                 "issues": issues,
             }
         )

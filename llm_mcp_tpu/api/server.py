@@ -66,6 +66,8 @@ class CoreServer:
         # starved_rounds is cumulative per engine; the Prometheus counter
         # advances by the delta observed between engines_info() refreshes
         self._sched_starved: dict[str, float] = {}
+        # same delta bookkeeping for the speculation token counters
+        self._spec_counts: dict[str, dict[str, float]] = {}
         self.limits = LimitsEngine(self.db, strict=self.cfg.strict_model_limits)
         self.circuit = CircuitBreaker()
         self.router = Router(
@@ -222,6 +224,30 @@ class CoreServer:
                 if cur > prev:
                     self.metrics.sched_starved_rounds.inc(cur - prev)
                 self._sched_starved[name] = cur
+            sps = getattr(e, "speculation_stats", None)
+            if sps is not None:
+                sp = sps()
+                info[name]["speculation"] = sp
+                self.metrics.spec_accept_rate.labels(engine=name).set(
+                    sp.get("accept_rate", 0.0)
+                )
+                self.metrics.spec_tok_per_call.labels(engine=name).set(
+                    sp.get("tok_per_call", 0.0)
+                )
+                prev_c = self._spec_counts.get(name, {})
+                for key, counter in (
+                    ("drafted_tokens", self.metrics.spec_drafted_tokens),
+                    ("emitted_tokens", self.metrics.spec_emitted_tokens),
+                ):
+                    cur_c = float(sp.get(key, 0.0))
+                    if cur_c > prev_c.get(key, 0.0):
+                        counter.labels(engine=name).inc(
+                            cur_c - prev_c.get(key, 0.0)
+                        )
+                self._spec_counts[name] = {
+                    "drafted_tokens": float(sp.get("drafted_tokens", 0.0)),
+                    "emitted_tokens": float(sp.get("emitted_tokens", 0.0)),
+                }
         for name, e in self.embed_engines.items():
             info[name] = {
                 "kind": "embed",

@@ -57,10 +57,11 @@ from ..models.llama import (
     llama_decode_step,
     quantize_kv,
 )
-from ..ops.sampling import sample_tokens
+from ..ops.sampling import sample_tokens, spec_verify
 from ..parallel.sharding import llama_param_specs, kv_cache_specs, shard_pytree
 from ..telemetry import tracing
 from .common import fine_bucket, pow2_bucket
+from .drafter import NGramDrafter
 from .scheduler import TokenBudgetScheduler
 from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
 
@@ -108,6 +109,11 @@ class _Slot:
     # request (the consumer already received its terminal event)
     done: bool = False
     aborted: bool = False
+    # self-speculative decoding: the slot's n-gram index over its own token
+    # history (drafter.py), fed by _process_token; None when TPU_SPEC=0
+    spec: Any = None
+    spec_drafted: int = 0  # draft tokens proposed for this request
+    spec_accepted: int = 0  # draft tokens accepted by verify
 
 
 @dataclass
@@ -593,6 +599,12 @@ class GenerationEngine:
         # (the sp path prefills whole prompts by design; sharded entries
         # under a mesh aren't worth the complexity).
         self._prefix_cache: "OrderedDict[tuple, dict]" = OrderedDict()
+        # secondary index: stored-prefix length → {key: entry}. Stored
+        # lengths are pow2-floored (_maybe_store_prefix), so a lookup is
+        # O(log max_seq_len) dict probes instead of a linear scan comparing
+        # every entry's full key (_match_prefix). Kept exactly in sync with
+        # _prefix_cache at the insert and evict sites.
+        self._prefix_by_len: dict[int, dict[tuple, dict]] = {}
         self._prefix_cache_bytes = 0
         self._prefix_budget = (
             int(prompt_cache_mb) * (1 << 20)
@@ -639,6 +651,41 @@ class GenerationEngine:
         self._rid_dispatched = 0
         self._rid_fetched = 0
         self._cooling: dict[int, int] = {}
+
+        # Self-speculative decoding (draft-and-verify): a host-side n-gram
+        # drafter (drafter.py — prompt-lookup over each slot's own history)
+        # proposes up to TPU_SPEC_K tokens; one chunk-machinery model call
+        # verifies them all (_build_verify), accepting the longest agreeing
+        # prefix — exact greedy equality at temp=0, rejection sampling
+        # otherwise (ops/sampling.py:spec_verify). Rejected positions roll
+        # back by arithmetic alone: the cache rows past the accepted
+        # position are dead under the parked-slot OOB invariant (chunk reads
+        # mask key_pos < starts, decode attends < length, later writes
+        # overwrite in place). TPU_SPEC=0 is a hard kill switch: none of
+        # the spec code runs and the decode path is byte-identical. Gated
+        # to sp == 1 (the sp prefill path never chunks; verify rides the
+        # chunk machinery).
+        self.spec_k = max(0, int(os.environ.get("TPU_SPEC_K", "") or 7))
+        self.spec_min_ngram = max(
+            1, int(os.environ.get("TPU_SPEC_MIN_NGRAM", "") or 2)
+        )
+        self.spec_max_ngram = max(self.spec_min_ngram, 3)
+        self.spec_enabled = (
+            os.environ.get("TPU_SPEC", "1") != "0"
+            and self.spec_k > 0
+            and self.sp == 1
+        )
+        # verify-round throughput counters (speculation_stats; engine-thread
+        # writers, lock-free like total_tokens)
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
+        self.spec_calls = 0
+        # adaptive throttle: drafts that keep getting rejected make a verify
+        # round strictly worse than a decode round (1 emitted token per slot
+        # vs decode_chunk) — back off for a while after a low-acceptance call
+        self._spec_cooldown = 0
+        self._verify_fn = self._build_verify() if self.spec_enabled else None
 
         self._admit: "queue.Queue[GenRequest]" = queue.Queue()
         self._stop_evt = threading.Event()
@@ -812,6 +859,48 @@ class GenerationEngine:
             return out, p_logits, ck, cv, d_last
 
         return decode_chunk_fn, fused_step_fn
+
+    def _build_verify(self):
+        """Jitted speculative verify: ONE model call over [token, draft_1..
+        draft_K] per slot through the chunked-prefill machinery (multi-
+        position KV writes for free), full-position logits, then
+        accept/reject + the follow-on sample on device (spec_verify). Only
+        two [A] int arrays (accepted counts, final tokens) ever reach the
+        host — the accepted drafts themselves are already known host-side.
+
+        Pad rows carry slot id B: every cache scatter and the token-ring
+        write drop out of bounds (the admission-path invariant), and their
+        clamped param gathers are excluded from the sampler's homogeneity
+        reductions via `active`."""
+        cfg = self.cfg
+        mask = self._allowed_mask
+        base_key = self._base_key
+        B = self.max_slots
+
+        @partial(jax.jit, donate_argnums=(1, 2, 3), static_argnames=("skey",))
+        def verify_fn(params, ck, cv, d_last, d_temp, d_topk, d_topp,
+                      tokens, slots, starts, nvalid, drafts, ndraft,
+                      counter, skey):
+            logits, ck, cv = llama_prefill_chunk_batch(
+                cfg, params, ck, cv, tokens, slots, starts, nvalid,
+                skey=skey, all_logits=True,
+            )  # [A, C, V]
+            if mask is not None:
+                logits = jnp.where(mask, logits, -jnp.inf)
+            temp = d_temp[slots]
+            topk = d_topk[slots]
+            topp = d_topp[slots]
+            rng = jax.random.fold_in(base_key, counter)
+            n_acc, final = spec_verify(
+                logits, drafts, ndraft, rng, temp, topk, topp,
+                active=slots < B,
+            )
+            # the round's final token into the device ring: the next decode
+            # round reads its input from d_last without host staging
+            d_last = d_last.at[slots].set(final)
+            return n_acc, final, ck, cv, d_last
+
+        return verify_fn
 
     def stall_seconds(self) -> float:
         """Age of the engine loop's last progress stamp. Large values with
@@ -1029,6 +1118,25 @@ class GenerationEngine:
         )
         return out
 
+    def speculation_stats(self) -> dict[str, float]:
+        """Self-speculative decoding observability (telemetry/metrics.py
+        gauges + the engines_info speculation block): cumulative drafted /
+        accepted / emitted token counts, verify-call count, and the derived
+        acceptance rate and tokens-per-verify-call."""
+        drafted = float(self.spec_drafted)
+        calls = float(self.spec_calls)
+        return {
+            "enabled": 1.0 if self._verify_fn is not None else 0.0,
+            "k": float(self.spec_k),
+            "min_ngram": float(self.spec_min_ngram),
+            "drafted_tokens": drafted,
+            "accepted_tokens": float(self.spec_accepted),
+            "emitted_tokens": float(self.spec_emitted),
+            "verify_calls": calls,
+            "accept_rate": (self.spec_accepted / drafted) if drafted else 0.0,
+            "tok_per_call": (self.spec_emitted / calls) if calls else 0.0,
+        }
+
     def current_tps(self, window_s: float = 10.0) -> float:
         now = time.time()
         with self.stats_lock:
@@ -1217,6 +1325,60 @@ class GenerationEngine:
                 i for i, s in enumerate(self._slots)
                 if s is not None and self._lengths[i] + K <= S
             ]
+            if self._verify_fn is not None and active:
+                if self._spec_cooldown > 0:
+                    self._spec_cooldown -= 1
+                elif self._stage_spec(active) is not None:
+                    # Speculative verify round (majority of active slots have
+                    # an n-gram draft). Acceptance is data-dependent, so the
+                    # optimistic-length pipelining contract doesn't hold:
+                    # drain the in-flight rounds (emitting in round order —
+                    # drafts must continue the COMMITTED history) and run the
+                    # verify synchronously. Iterations without a draft
+                    # majority leave the pipelined path untouched.
+                    if pending is not None:
+                        timed("emit", self._emit_round, pending)
+                        pending = None
+                    ok = True
+                    while inflight:
+                        disp = inflight.popleft()
+                        try:
+                            fetched = timed("fetch", self._complete_round, disp)
+                        except Exception as e:
+                            inflight.appendleft(disp)
+                            drain_failed(e)
+                            ok = False
+                            break
+                        timed("emit", self._emit_round, fetched)
+                    if ok:
+                        # re-draft against the post-drain history (slots may
+                        # have finished; tokens arrived)
+                        active = [
+                            i for i, s in enumerate(self._slots)
+                            if s is not None and self._lengths[i] + K <= S
+                        ]
+                        entries = self._stage_spec(active) if active else None
+                        if entries is not None:
+                            # verify tokens count against the round's prefill
+                            # token budget like prefill chunks (scheduler.py)
+                            reserved = sum(1 + len(d) for _, d in entries)
+                            group = timed(
+                                "prefill", self._stage_prefill_group,
+                                len(active), reserved,
+                            )
+                            try:
+                                timed("dispatch", self._spec_round, entries)
+                            except Exception as e:
+                                if group is not None:
+                                    self._fail_prefill_group(group, e)
+                                    group = None
+                                drain_failed(e, also=active)
+                            else:
+                                if group is not None:
+                                    timed("prefill",
+                                          self._dispatch_prefill_group, group)
+                            timed("admit", self._admit_pending)
+                            continue
             # Token-budget scheduling (see scheduler.py): stage up to
             # `prefill_token_budget` prompt tokens from mid-prefill slots,
             # then FUSE the chunk group into the decode dispatch — decode
@@ -1422,9 +1584,17 @@ class GenerationEngine:
             return None
         t = tuple(ids)
         best_key, best = None, None
-        for key, e in self._prefix_cache.items():
-            if e["P"] < len(t) and (best is None or e["P"] > best["P"]) and t[: e["P"]] == key:
-                best_key, best = key, e
+        # Stored lengths are pow2-floored (_maybe_store_prefix), so the
+        # by-length buckets number O(log S): probe longest-first with one
+        # hash lookup each instead of scanning every entry and comparing
+        # prefix_len tokens per entry (O(entries × prefix_len) at scale).
+        for P in sorted(self._prefix_by_len, reverse=True):
+            if P >= len(t):
+                continue  # strict prefix: >= 1 suffix token must remain
+            e = self._prefix_by_len[P].get(t[:P])
+            if e is not None:
+                best_key, best = t[:P], e
+                break
         if best is not None:
             self._prefix_cache.move_to_end(best_key)  # LRU touch
             self.prefix_cache_hits += 1
@@ -1489,11 +1659,18 @@ class GenerationEngine:
             pk = self._ck[:, slot : slot + 1, :, :p0]
             pv = self._cv[:, slot : slot + 1, :, :p0]
         nbytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves((pk, pv)))
-        self._prefix_cache[key] = {"P": p0, "k": pk, "v": pv, "bytes": nbytes}
+        ent = {"P": p0, "k": pk, "v": pv, "bytes": nbytes}
+        self._prefix_cache[key] = ent
+        self._prefix_by_len.setdefault(p0, {})[key] = ent
         self._prefix_cache_bytes += nbytes
         while self._prefix_cache_bytes > self._prefix_budget and self._prefix_cache:
-            _, old = self._prefix_cache.popitem(last=False)  # LRU evict
+            old_key, old = self._prefix_cache.popitem(last=False)  # LRU evict
             self._prefix_cache_bytes -= old["bytes"]
+            bucket_d = self._prefix_by_len.get(old["P"])
+            if bucket_d is not None:
+                bucket_d.pop(old_key, None)
+                if not bucket_d:
+                    del self._prefix_by_len[old["P"]]
         log.info(
             "prefix cache: stored %d-token prefix (%.1f MB, %d entries)",
             p0, nbytes / 1e6, len(self._prefix_cache),
@@ -1578,6 +1755,13 @@ class GenerationEngine:
                     "sched_starved_rounds": self._sched.starved_rounds,
                 },
             )
+        if self._verify_fn is not None:
+            # seed the n-gram drafter with the prompt: prompt-lookup drafting
+            # pays off exactly when completions quote the prompt (extraction,
+            # code edits, RAG). _process_token appends every emitted token so
+            # the index also covers generated history.
+            s.spec = NGramDrafter(self.spec_min_ngram, self.spec_max_ngram)
+            s.spec.extend(ids)
         # tok0's KV will be written at position P in the first decode round.
         self._emit_token(slot, s, tok0, pos=P - 1)
 
@@ -1612,13 +1796,18 @@ class GenerationEngine:
         )
         return start, n, bucket, skey
 
-    def _stage_prefill_group(self, n_active: int) -> _PrefillGroup | None:
+    def _stage_prefill_group(
+        self, n_active: int, reserved_tokens: int = 0
+    ) -> _PrefillGroup | None:
         """Ask the scheduler for this round's prefill token budget and stage
         one batched chunk group under it: up to admit_batch mid-prefill slots
         whose next chunks share (bucket, skey) — the chunk weight pass is the
         cost, and batching amortizes it like _start_batch does for short
         prompts. Staging only; the group is dispatched fused with the decode
-        round (_dispatch_decode) or standalone (_dispatch_prefill_group)."""
+        round (_dispatch_decode) or standalone (_dispatch_prefill_group).
+        `reserved_tokens` is chunk work this iteration already owes elsewhere
+        (a speculative verify dispatch); it shrinks the budget so verify +
+        prefill together stay inside the round's fair share."""
         # states the stall watchdog error-terminated while the loop was
         # wedged: reclaim silently (their consumers are gone)
         for slot in [
@@ -1634,7 +1823,8 @@ class GenerationEngine:
             self._prefills[s].req.created_at for s in self._prefill_q
         )
         budget = self._sched.decide(
-            self._prefill_backlog(), n_active, time.time() - oldest
+            self._prefill_backlog(), n_active, time.time() - oldest,
+            reserved_tokens=reserved_tokens,
         )
         if budget <= 0:
             return None
@@ -1790,6 +1980,142 @@ class GenerationEngine:
                     st.req.out.put(_DONE)
         if self._recover_cache():
             self._abort_all("kv cache lost in failed prefill chunk")
+
+    def _stage_spec(self, active: list[int]) -> list[tuple[int, list[int]]] | None:
+        """Propose drafts for a speculative verify round, or None to keep the
+        normal pipelined decode path.
+
+        Every active slot joins the round (a slot with no n-gram match rides
+        with zero drafts — its verify row degenerates to a single-token
+        decode step, so nobody stalls), but the round only runs when a
+        MAJORITY of slots actually have drafts: a verify dispatch costs a
+        C-wide chunk pass and forces a pipeline drain, so it must beat the
+        K-token decode round it displaces.
+
+        Hard precondition: every row must satisfy len + C <= S, because
+        dynamic_update_slice CLAMPS out-of-range starts — a clamped verify
+        write would silently overwrite live KV. One near-cap slot falls the
+        whole round back to normal decode (it will finish within a few
+        rounds and unblock speculation)."""
+        if not active:
+            return None
+        C = self.spec_k + 1
+        S = self.max_seq_len
+        entries: list[tuple[int, list[int]]] = []
+        n_drafting = 0
+        for b in active:
+            s = self._slots[b]
+            if s is None or s.spec is None:
+                return None
+            if int(self._lengths[b]) + C > S:
+                return None
+            d = s.spec.draft(self.spec_k)
+            if d:
+                n_drafting += 1
+            entries.append((b, d))
+        if n_drafting == 0 or 2 * n_drafting < len(entries):
+            return None
+        return entries
+
+    def _spec_round(self, entries: list[tuple[int, list[int]]]) -> None:
+        """Dispatch one speculative verify round SYNCHRONOUSLY (the pipeline
+        is already drained): one chunk pass over [token, draft_1..draft_nd]
+        per slot, accept the longest agreeing prefix, emit accepted drafts +
+        the device-sampled final token, and roll lengths forward to the
+        accepted position. Rollback on rejection is pure arithmetic: cache
+        rows past base+n_acc are dead (chunk attention masks key_pos >=
+        start per row, decode attends < length, later writes land in place),
+        so nothing is erased."""
+        maybe_fail("engine.verify", f"slots={[b for b, _ in entries]}")
+        t0 = time.perf_counter()
+        B = self.max_slots
+        Kd = self.spec_k
+        C = Kd + 1
+        n = len(entries)
+        A = 1 << (n - 1).bit_length()
+        tokens = np.zeros((A, C), dtype=np.int32)
+        slots_arr = np.full((A,), B, dtype=np.int32)  # pads OOB: writes drop
+        starts_arr = np.zeros((A,), dtype=np.int32)
+        nv_arr = np.ones((A,), dtype=np.int32)
+        drafts_arr = np.zeros((A, Kd), dtype=np.int32)
+        nd_arr = np.zeros((A,), dtype=np.int32)
+        total = 0
+        for i, (b, d) in enumerate(entries):
+            nd = len(d)
+            tokens[i, 0] = self._last_tok[b]
+            if nd:
+                tokens[i, 1 : 1 + nd] = d
+                drafts_arr[i, :nd] = d
+            slots_arr[i] = b
+            starts_arr[i] = self._lengths[b]
+            nv_arr[i] = 1 + nd
+            nd_arr[i] = nd
+            total += 1 + nd
+        skey = min(
+            pow2_bucket(int(starts_arr[:n].max()), self.max_seq_len),
+            self.max_seq_len,
+        )
+        self._note_exec_shape("verify", A, C, skey)
+        n_acc, final, self._ck, self._cv, self._d_last_tok = self._verify_fn(
+            self.params, self._ck, self._cv, self._d_last_tok,
+            self._d_temp, self._d_topk, self._d_topp,
+            jnp.asarray(tokens), jnp.asarray(slots_arr),
+            jnp.asarray(starts_arr), jnp.asarray(nv_arr),
+            jnp.asarray(drafts_arr), jnp.asarray(nd_arr),
+            np.int32(self._next_counter()), skey=skey,
+        )
+        n_acc = np.asarray(n_acc)  # the round's host sync point
+        final = np.asarray(final)
+        self._sched.observe_verify(total, time.perf_counter() - t0)
+        before = self.total_tokens
+        drafted_round = 0
+        accepted_round = 0
+        for i, (b, d) in enumerate(entries):
+            s = self._slots[b]
+            if s is None or s.done:
+                continue
+            if s.aborted:
+                # watchdog delivered the terminal error mid-call
+                self._free_now(b)
+                continue
+            na = min(int(n_acc[i]), len(d))
+            base_b = int(starts_arr[i])
+            drafted_round += len(d)
+            accepted_round += na
+            s.spec_drafted += len(d)
+            s.spec_accepted += na
+            toks = list(d[:na]) + [int(final[i])]
+            parts: list[str] = []
+            finish = None
+            emitted = 0
+            for j, tok in enumerate(toks):
+                emit, finish = self._process_token(s, int(tok), base_b + j)
+                if int(tok) != self.tokenizer.eos_id:
+                    emitted += 1  # mirrors _process_token's counting rule
+                if emit:
+                    parts.append(emit)
+                if finish is not None:
+                    break
+            self.spec_emitted += emitted
+            if parts:
+                s.req.out.put({"type": "token", "text": "".join(parts)})
+            if finish is not None:
+                self._finish_slot(b, s, finish)
+            else:
+                # commit: KV valid through base+na (token + accepted
+                # drafts); `final`'s KV is written by the next round
+                self._lengths[b] = base_b + 1 + na
+                self._last_tok[b] = int(final[i])
+        self.spec_calls += 1
+        self.spec_drafted += drafted_round
+        self.spec_accepted += accepted_round
+        if drafted_round and accepted_round * 4 < drafted_round:
+            # drafts aren't landing (workload shifted away from its own
+            # history): a verify round still emits >=1 token per slot, but a
+            # decode round emits K — back off before re-probing
+            self._spec_cooldown = 50
+        with self.stats_lock:
+            self._window.append((time.time(), self.total_tokens - before))
 
     def _dispatch_decode(
         self, active: list[int], group: _PrefillGroup | None = None
@@ -2058,6 +2384,8 @@ class GenerationEngine:
             # plain int); taking stats_lock per token would mean ~B×K lock
             # round-trips per decode round.
             self.total_tokens += 1
+            if s.spec is not None:
+                s.spec.append(tok)
             text, s.pending = self.tokenizer.decode_stream(s.pending, [tok])
             # Stop sequences trim BEFORE emission (OpenAI/Ollama semantics:
             # the stop string itself is never delivered). Scan the window
@@ -2104,15 +2432,20 @@ class GenerationEngine:
         if req.trace_ctx and s.first_token_at:
             now = time.time()
             dur = max(now - s.first_token_at, 1e-9)
+            attrs = {
+                "request_id": req.request_id,
+                "completion_tokens": s.generated,
+                "tok_per_s": round(s.generated / dur, 1),
+                "finish_reason": finish,
+            }
+            if s.spec is not None:
+                # speculation contribution to this stream: drafted vs
+                # accepted counts explain the tok_per_s figure
+                attrs["spec_drafted"] = s.spec_drafted
+                attrs["spec_accepted"] = s.spec_accepted
             tracing.get_tracer().record(
                 "engine.decode", s.first_token_at, now,
-                parent=req.trace_ctx,
-                attrs={
-                    "request_id": req.request_id,
-                    "completion_tokens": s.generated,
-                    "tok_per_s": round(s.generated / dur, 1),
-                    "finish_reason": finish,
-                },
+                parent=req.trace_ctx, attrs=attrs,
             )
         req.out.put(
             {

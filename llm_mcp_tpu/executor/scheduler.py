@@ -63,6 +63,8 @@ class TokenBudgetScheduler:
         self.prefill_tok_s = float(prefill_tok_seed_s)
         self.last_budget = 0
         self.starved_rounds = 0
+        self.verify_rounds = 0
+        self.verify_tokens = 0
 
     # -- cost observation --------------------------------------------------
 
@@ -86,18 +88,38 @@ class TokenBudgetScheduler:
         if prefill_tokens > 0 and extra > 0:
             self.observe_prefill(prefill_tokens, extra)
 
+    def observe_verify(self, tokens: int, seconds: float) -> None:
+        """A speculative verify dispatch: `tokens` chunk positions (the base
+        token plus drafts, summed over slots) in `seconds`. Verify rides the
+        same chunked-prefill machinery as prompt chunks, so its per-token
+        cost feeds the same EMA the budget arithmetic runs on."""
+        self.verify_rounds += 1
+        self.verify_tokens += max(0, int(tokens))
+        self.observe_prefill(tokens, seconds)
+
     # -- policy ------------------------------------------------------------
 
     def fair_cap(self) -> int:
         """Prefill tokens whose estimated device time ≈ one decode round."""
         return max(self.min_budget, int(self.decode_round_s / self.prefill_tok_s))
 
-    def decide(self, backlog_tokens: int, n_active: int, oldest_wait_s: float) -> int:
+    def decide(
+        self,
+        backlog_tokens: int,
+        n_active: int,
+        oldest_wait_s: float,
+        reserved_tokens: int = 0,
+    ) -> int:
         """Prefill token budget for the next engine iteration.
 
         backlog_tokens: prompt tokens not yet written for mid-prefill slots.
         n_active: decoding slots this round (0 ⇒ pure-prefill window).
         oldest_wait_s: age of the oldest mid-prefill request.
+        reserved_tokens: chunk tokens this iteration already owes elsewhere —
+            a speculative verify dispatch costs chunk positions through the
+            same machinery, so they come out of the round's prefill budget
+            (the budget may drop to 0; the backlog waits a round rather than
+            stacking verify + a full prefill chunk on one decode cadence).
         """
         if backlog_tokens <= 0:
             self.last_budget = 0
@@ -115,6 +137,8 @@ class TokenBudgetScheduler:
         if need > cap:
             self.starved_rounds += 1
         budget = max(self.min_budget, min(need, cap))
+        if reserved_tokens > 0:
+            budget = max(0, budget - int(reserved_tokens))
         self.last_budget = budget
         return budget
 
@@ -125,4 +149,6 @@ class TokenBudgetScheduler:
             "decode_round_ema_ms": self.decode_round_s * 1000.0,
             "prefill_tok_cost_us": self.prefill_tok_s * 1e6,
             "fair_cap_tokens": float(self.fair_cap()),
+            "verify_rounds": float(self.verify_rounds),
+            "verify_tokens": float(self.verify_tokens),
         }

@@ -45,6 +45,7 @@ job is the cross-process data plane.
 from __future__ import annotations
 
 import logging
+import os
 import pickle
 import queue
 import socket
@@ -70,8 +71,9 @@ from ..models import (
 )
 from ..models.configs import ModelConfig, resolve_config
 from ..models.llama import llama_prefill_chunk_batch
-from ..ops.sampling import sample_tokens
+from ..ops.sampling import sample_tokens, spec_verify
 from .common import pow2_bucket
+from .drafter import NGramDrafter
 from .scheduler import TokenBudgetScheduler
 from .tokenizer import Tokenizer, load_tokenizer
 
@@ -204,6 +206,7 @@ class _Slot:
     generated: int = 0
     text: str = ""
     pending: bytes = b""
+    spec: Any = None  # NGramDrafter when speculation is on (leader-only)
 
 
 @dataclass
@@ -394,9 +397,51 @@ class SliceEngine:
                 cfg, params, ck, cv, tokens, slots, starts, nvalid, skey=skey
             )
 
+        # Self-speculative decoding (engine.py policy, slice flavor): the
+        # LEADER drafts host-side (NGramDrafter) and broadcasts a budgeted
+        # "verify" command; followers replay the dispatch like any other.
+        # The env knobs must match across processes (same contract as every
+        # other constructor argument). TPU_SPEC=0 is the kill switch.
+        self.spec_k = max(0, int(os.environ.get("TPU_SPEC_K", "") or 7))
+        self.spec_min_ngram = max(
+            1, int(os.environ.get("TPU_SPEC_MIN_NGRAM", "") or 2)
+        )
+        self.spec_max_ngram = max(self.spec_min_ngram, 3)
+        self.spec_enabled = (
+            os.environ.get("TPU_SPEC", "1") != "0" and self.spec_k > 0
+        )
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
+        self.spec_calls = 0
+        self._spec_cooldown = 0
+        B = max_slots
+
+        @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("skey",),
+                 out_shardings=((repl, repl) + cache_out))
+        def verify_fn(params, ck, cv, tokens, slots, starts, nvalid,
+                      drafts, ndraft, temps, topks, topps, counter, skey):
+            """Speculative verify: ONE chunk pass over [token, draft_1..
+            draft_K] per slot with full-position logits, then accept/reject
+            + the follow-on sample on device (spec_verify). (n_acc, final)
+            come back REPLICATED so the leader reads them locally; pad rows
+            carry slot id B (writes drop out of bounds, and `active`
+            excludes them from the sampler's homogeneity reductions)."""
+            logits, ck, cv = llama_prefill_chunk_batch(
+                cfg, params, ck, cv, tokens, slots, starts, nvalid,
+                skey=skey, all_logits=True,
+            )  # [A, C, V]
+            rng = jax.random.fold_in(base_key, counter)
+            n_acc, final = spec_verify(
+                logits, drafts, ndraft, rng, temps, topks, topps,
+                active=slots < B,
+            )
+            return n_acc, final, ck, cv
+
         self._decode_fn = decode_fn
         self._admit_fn = admit_fn
         self._chunk_fn = chunk_fn
+        self._verify_fn = verify_fn
 
         # leader-side bookkeeping
         self._queue: "queue.Queue[Any]" = queue.Queue()
@@ -508,6 +553,18 @@ class SliceEngine:
                             self.params, self._ck, self._cv, tokens,
                             slots, starts, nvalid, int(skey),
                         )
+                elif op == "verify":
+                    # budgeted speculative verify round: replay the dispatch
+                    # for the cache writes; (n_acc, final) are replicated and
+                    # only the leader consumes them
+                    (_, tokens, slots, starts, nvalid, drafts, ndraft,
+                     temps, topks, topps, ctr, skey) = cmd
+                    with self.mesh:
+                        _, _, self._ck, self._cv = self._verify_fn(
+                            self.params, self._ck, self._cv, tokens, slots,
+                            starts, nvalid, drafts, ndraft, temps, topks,
+                            topps, ctr, int(skey),
+                        )
                 else:  # pragma: no cover
                     raise ValueError(f"unknown slice command {op!r}")
         finally:
@@ -605,6 +662,23 @@ class SliceEngine:
         )
         return out
 
+    def speculation_stats(self) -> dict[str, float]:
+        """Self-speculative decoding observability (GenerationEngine
+        parity — see engine.speculation_stats)."""
+        drafted = float(self.spec_drafted)
+        calls = float(self.spec_calls)
+        return {
+            "enabled": 1.0 if self.spec_enabled else 0.0,
+            "k": float(self.spec_k),
+            "min_ngram": float(self.spec_min_ngram),
+            "drafted_tokens": drafted,
+            "accepted_tokens": float(self.spec_accepted),
+            "emitted_tokens": float(self.spec_emitted),
+            "verify_calls": calls,
+            "accept_rate": (self.spec_accepted / drafted) if drafted else 0.0,
+            "tok_per_call": (self.spec_emitted / calls) if calls else 0.0,
+        }
+
     def ttft_percentiles(self) -> tuple[float, float, int]:
         if not self._ttfts:
             return 0.0, 0.0, 0
@@ -673,11 +747,22 @@ class SliceEngine:
         try:
             while not self._shutdown.is_set():
                 admitted = self._try_admit()
+                # stage speculation FIRST so its chunk positions can be
+                # reserved out of this iteration's prefill token budget
+                # (verify rides the same chunk machinery as prompt chunks)
+                spec_entries = self._stage_spec()
+                reserved = (
+                    sum(1 + len(d) for _, d in spec_entries)
+                    if spec_entries else 0
+                )
                 # one budget-bounded chunk group per iteration BEFORE the
                 # decode round: the token-budget scheduler caps the group so
                 # in-flight streams' cadence stays within ~2x pure decode
-                prefilled = self._try_prefill()
-                decoded = self._try_decode()
+                prefilled = self._try_prefill(reserved_tokens=reserved)
+                if spec_entries:
+                    decoded = self._try_verify(spec_entries)
+                else:
+                    decoded = self._try_decode()
                 if not (admitted or prefilled or decoded):
                     if self._leader_ch is not None:
                         self._leader_ch.ping_if_idle()
@@ -775,8 +860,13 @@ class SliceEngine:
                 r.out.put(_DONE)
             raise
         now = time.time()
-        for i, (b, r, _) in enumerate(batch):
+        for i, (b, r, ids) in enumerate(batch):
             slot = _Slot(req=r, prompt_len=int(lengths[i]))
+            if self.spec_enabled:
+                # seed the drafter with the prompt BEFORE the first emit so
+                # tok0 lands on top of the prompt history
+                slot.spec = NGramDrafter(self.spec_min_ngram, self.spec_max_ngram)
+                slot.spec.extend(ids)
             self._slots[b] = slot
             self._toks[b] = toks0[i]
             self._lens[b] = lengths[i]
@@ -807,19 +897,24 @@ class SliceEngine:
         )
         return start, n, bucket, skey
 
-    def _try_prefill(self) -> bool:
+    def _try_prefill(self, reserved_tokens: int = 0) -> bool:
         """One budget-bounded chunk group per loop iteration: ask the shared
         TokenBudgetScheduler for this round's prefill token budget, stage a
         group of reserved slots' next chunks under it, broadcast the "chunk"
         command, and dispatch. Finished prompts activate (first token
-        sampled from the replicated boundary logits, leader-locally)."""
+        sampled from the replicated boundary logits, leader-locally).
+        `reserved_tokens` is chunk work this iteration already owes to a
+        staged speculative verify round."""
         n_active = sum(1 for s in self._slots if s is not None)
         if not self._prefill_q:
             self._sched.decide(0, n_active, 0.0)
             return False
         backlog = sum(len(st.ids) - st.done for st in self._prefills.values())
         oldest = min(self._prefills[s].t0 for s in self._prefill_q)
-        budget = self._sched.decide(backlog, n_active, time.time() - oldest)
+        budget = self._sched.decide(
+            backlog, n_active, time.time() - oldest,
+            reserved_tokens=reserved_tokens,
+        )
         if budget <= 0:
             return False
         first = self._prefill_q[0]
@@ -904,7 +999,13 @@ class SliceEngine:
             ))[0])
             self._prefill_q.remove(slot)
             del self._prefills[slot]
-            self._slots[slot] = _Slot(req=r, prompt_len=len(st.ids))
+            new_slot = _Slot(req=r, prompt_len=len(st.ids))
+            if self.spec_enabled:
+                new_slot.spec = NGramDrafter(
+                    self.spec_min_ngram, self.spec_max_ngram
+                )
+                new_slot.spec.extend(st.ids)
+            self._slots[slot] = new_slot
             self._toks[slot] = tok0
             self._lens[slot] = len(st.ids)  # un-park
             self._temps[slot] = r.temperature
@@ -912,6 +1013,126 @@ class SliceEngine:
             self._topps[slot] = r.top_p
             self._ttfts.append((now - st.t0) * 1000.0)
             self._emit_token(slot, tok0)
+        return True
+
+    def _stage_spec(self) -> list[tuple[int, list[int]]] | None:
+        """Propose drafts for a speculative verify round (engine.py policy,
+        slice flavor), or None to run a normal decode round. Every active
+        slot joins (zero-draft rows degenerate to one-token decode steps);
+        the round runs only when a MAJORITY of slots have drafts and every
+        row has C = K+1 positions of cache headroom (dynamic_update_slice
+        CLAMPS out-of-range starts — a clamped verify write would overwrite
+        live KV)."""
+        if not self.spec_enabled:
+            return None
+        if self._spec_cooldown > 0:
+            self._spec_cooldown -= 1
+            return None
+        C = self.spec_k + 1
+        entries: list[tuple[int, list[int]]] = []
+        n_drafting = 0
+        for b, s in enumerate(self._slots):
+            if s is None:
+                continue
+            if s.spec is None:
+                return None
+            if int(self._lens[b]) + C > self.max_seq_len - 1:
+                return None
+            d = s.spec.draft(self.spec_k)
+            if d:
+                n_drafting += 1
+            entries.append((b, d))
+        if not entries or n_drafting == 0 or 2 * n_drafting < len(entries):
+            return None
+        return entries
+
+    def _try_verify(self, entries: list[tuple[int, list[int]]]) -> bool:
+        """One speculative verify round in place of the decode round:
+        broadcast the budgeted "verify" command, dispatch the chunk pass over
+        [token, draft_1..draft_nd] per slot, accept the longest agreeing
+        prefix, and roll lengths forward to the accepted position (rows past
+        it are dead by the parked-slot OOB invariant — rollback is pure
+        arithmetic)."""
+        B = self.max_slots
+        Kd = self.spec_k
+        C = Kd + 1
+        n = len(entries)
+        A = 1 << (n - 1).bit_length()
+        tokens = np.zeros((A, C), np.int32)
+        slots_arr = np.full((A,), B, np.int32)  # pads OOB: writes drop
+        starts_arr = np.zeros((A,), np.int32)
+        nv_arr = np.ones((A,), np.int32)
+        drafts_arr = np.zeros((A, Kd), np.int32)
+        nd_arr = np.zeros((A,), np.int32)
+        temps = np.ones((A,), np.float32)
+        topks = np.zeros((A,), np.int32)
+        topps = np.ones((A,), np.float32)
+        total = 0
+        for i, (b, d) in enumerate(entries):
+            nd = len(d)
+            tokens[i, 0] = self._toks[b]
+            if nd:
+                tokens[i, 1 : 1 + nd] = d
+                drafts_arr[i, :nd] = d
+            slots_arr[i] = b
+            starts_arr[i] = self._lens[b]
+            nv_arr[i] = 1 + nd
+            nd_arr[i] = nd
+            temps[i] = self._temps[b]
+            topks[i] = self._topks[b]
+            topps[i] = self._topps[b]
+            total += 1 + nd
+        skey = min(
+            pow2_bucket(int(starts_arr[:n].max()), self.max_seq_len),
+            self.max_seq_len,
+        )
+        ctr = self._counter
+        self._counter += 1
+        cmd = ("verify", tokens, slots_arr, starts_arr, nv_arr, drafts_arr,
+               nd_arr, temps, topks, topps, np.int32(ctr), np.int32(skey))
+        t0 = time.perf_counter()
+        if self._leader_ch is not None:
+            self._leader_ch.send(cmd)
+        with self.mesh:
+            n_acc, final, self._ck, self._cv = self._verify_fn(
+                self.params, self._ck, self._cv, tokens, slots_arr,
+                starts_arr, nv_arr, drafts_arr, nd_arr, temps, topks, topps,
+                np.int32(ctr), int(skey),
+            )
+        n_acc = np.asarray(n_acc)  # replicated: local fetch
+        final = np.asarray(final)
+        self._sched.observe_verify(total, time.perf_counter() - t0)
+        K = self.decode_chunk
+        drafted_round = accepted_round = emitted_round = 0
+        for i, (b, d) in enumerate(entries):
+            s = self._slots[b]
+            if s is None:
+                continue
+            na = min(int(n_acc[i]), len(d))
+            base_b = int(starts_arr[i])
+            drafted_round += len(d)
+            accepted_round += na
+            for tok in list(d[:na]) + [int(final[i])]:
+                emitted_round += 1
+                self._emit_token(b, int(tok))
+                if self._slots[b] is not s:
+                    break  # finished mid-round (eos / stop / max_tokens)
+            if self._slots[b] is s:
+                # commit: KV valid through base+na; `final`'s KV is written
+                # by the next round at the rolled-forward length
+                self._lens[b] = base_b + 1 + na
+                self._toks[b] = np.int32(final[i])
+                if int(self._lens[b]) + K > self.max_seq_len - 1:
+                    self._finish_slot(b, "length")
+        self._tps_marks.append((time.time(), emitted_round))
+        self.spec_calls += 1
+        self.spec_drafted += drafted_round
+        self.spec_accepted += accepted_round
+        self.spec_emitted += emitted_round
+        if drafted_round and accepted_round * 4 < drafted_round:
+            # drafts aren't landing: a verify round emits >=1 token per slot
+            # where a decode round emits K — back off before re-probing
+            self._spec_cooldown = 50
         return True
 
     def _try_decode(self) -> bool:
@@ -973,6 +1194,8 @@ class SliceEngine:
             text = ""
         else:
             text, slot.pending = self.tokenizer.decode_stream(slot.pending, [tok])
+            if slot.spec is not None:
+                slot.spec.append(tok)  # drafter history = committed tokens
         if text:
             slot.text += text
             for stop_s in req.stop:

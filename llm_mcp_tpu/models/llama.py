@@ -510,6 +510,7 @@ def llama_prefill_chunk_batch(
     starts: jnp.ndarray,  # [A] int32 — absolute position of each chunk's first token
     nvalid: jnp.ndarray,  # [A] int32 — valid tokens per chunk
     skey: int = 0,  # STATIC bound on the PAST key range (0 = whole S); >= max(starts)
+    all_logits: bool = False,  # STATIC: logits at every chunk position, not just the last
 ) -> tuple[jnp.ndarray, Any, Any]:
     """Batched chunked prefill: one bounded chunk for up to A slots' prompts
     in a single dispatch, written straight into the engine cache.
@@ -538,7 +539,9 @@ def llama_prefill_chunk_batch(
     executor/scheduler.py). The reference never faces any of
     this — it proxies Ollama (`core/internal/api/handlers.go:2427-2587`).
 
-    Returns (logits [A, V] f32 at each row's last valid position,
+    Returns (logits [A, V] f32 at each row's last valid position — or
+    [A, C, V] at every position when `all_logits` (the speculative-decoding
+    verify path scores each drafted token against the position before it) —
     new_cache_k, new_cache_v).
     """
     if cfg.kv_lora_rank:  # MLA family: absorbed chunked prefill over latents
@@ -546,7 +549,7 @@ def llama_prefill_chunk_batch(
 
         return mla_prefill_chunk_batch(
             cfg, params, cache_k, cache_v, tokens, slots, starts, nvalid,
-            skey=skey,
+            skey=skey, all_logits=all_logits,
         )
     quantized = isinstance(cache_k, dict)
     L, B, Hkv, S, hd = _cache_shape(cache_k)
@@ -701,6 +704,8 @@ def llama_prefill_chunk_batch(
         (h, cache_k, cache_v, jnp.int32(0)),
         (params["layers"], layer_windows(cfg)),
     )
+    if all_logits:
+        return _logits(cfg, params, h), new_k, new_v  # [A, C, V]
     last = jnp.take_along_axis(
         h, (nvalid - 1)[:, None, None].astype(jnp.int32), axis=1
     )[:, 0]  # [A, D]

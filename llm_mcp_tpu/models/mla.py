@@ -299,6 +299,7 @@ def mla_prefill_chunk_batch(
     starts: jnp.ndarray,  # [A] int32 absolute position of each chunk's start
     nvalid: jnp.ndarray,  # [A] int32 valid tokens per chunk
     skey: int = 0,  # STATIC bound on the PAST key range (0 = whole S)
+    all_logits: bool = False,  # STATIC: logits at every chunk position
 ) -> tuple[jnp.ndarray, Any, Any]:
     """Batched chunked prefill for MLA — the absorbed-attention analog of
     `llama_prefill_chunk_batch` (same engine contract: one bounded chunk for
@@ -461,6 +462,8 @@ def mla_prefill_chunk_batch(
         # with absolute layer position
         carry, _ = jax.lax.scan(layer, carry, params["dense_layers"])
     (h, new_c, new_r, _), _ = jax.lax.scan(layer, carry, params["layers"])
+    if all_logits:
+        return _logits(cfg, params, h), new_c, new_r  # [A, C, V]
     last = jnp.take_along_axis(
         h, jnp.clip(nvalid - 1, 0, C - 1)[:, None, None], axis=1
     )[:, 0]  # [A, D]

@@ -109,3 +109,140 @@ def _sample_windowed(
     sampled = jnp.take_along_axis(cand_idx, choice[:, None], axis=1)[:, 0].astype(jnp.int32)
 
     return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def spec_verify(
+    logits: jnp.ndarray,  # [A, C, V] float32 — position j scores offset j+1
+    drafts: jnp.ndarray,  # [A, K] int32 drafted tokens, K = C - 1
+    n_draft: jnp.ndarray,  # [A] int32 — valid drafts per row (<= K)
+    rng: jax.Array,
+    temperature: jnp.ndarray,  # [A]
+    top_k: jnp.ndarray,  # [A] int32 (0 = disabled)
+    top_p: jnp.ndarray,  # [A] float32 (1.0 = disabled)
+    active: jnp.ndarray | None = None,  # [A] bool — rows whose result matters
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Accept/reject a deterministic draft against the target logits and
+    sample the one token that always follows.
+
+    The engine's n-gram drafter is deterministic — it puts probability 1 on
+    its proposal — so standard speculative rejection sampling collapses to:
+    accept draft ``d`` at position ``j`` with probability ``p_target(d)``
+    (greedy rows: exact argmax equality), stop at the first rejection, and
+    sample the next token from the RESIDUAL distribution — the target with
+    the rejected token zeroed and renormalized. That marginal is exactly the
+    target: ``p(d)·1 + (1 - p(d))·p(x)/(1 - p(d)) = p(x)``, so speculation
+    never changes what the engine emits, only how many model calls it costs.
+
+    When every draft is accepted the final token is a "bonus" sample from
+    the unmasked target at the position after the last draft — `C = K + 1`
+    positions of logits guarantee it exists.
+
+    Distribution parity with `sample_tokens` is structural: the same three
+    runtime paths (all-greedy / all plain temperature over the full vocab /
+    candidate-window for rows with top-k/top-p), so speculative and
+    non-speculative decode agree exactly wherever `sample_tokens` itself is
+    exact, and share the same window approximation where it is not.
+
+    Returns ``(n_acc [A] int32, final [A] int32)``: emitted tokens for row
+    ``a`` are ``drafts[a, :n_acc[a]]`` followed by ``final[a]``.
+    """
+    A, C, V = logits.shape
+    K = C - 1
+    n_cand = min(_CANDIDATES, V)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [A, C]
+    valid = jnp.arange(K, dtype=jnp.int32)[None, :] < n_draft[:, None]
+    is_greedy = temperature <= 0.0
+    rng_u, rng_f = jax.random.split(rng)
+    u = jax.random.uniform(rng_u, (A, K), dtype=jnp.float32)
+
+    def _pred(cond: jnp.ndarray) -> jnp.ndarray:
+        return jnp.all(jnp.where(active, cond, True) if active is not None else cond)
+
+    def _count(acc: jnp.ndarray) -> jnp.ndarray:
+        # longest accepted prefix: cumprod zeroes everything past the first
+        # rejection
+        return jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+
+    def _finish(n_acc, sampled):
+        pos_greedy = jnp.take_along_axis(greedy_tok, n_acc[:, None], axis=1)[:, 0]
+        final = jnp.where(is_greedy, pos_greedy, sampled)
+        return n_acc.astype(jnp.int32), final.astype(jnp.int32)
+
+    def _mask_tok(n_acc):
+        # the token to zero out of the residual: the first REJECTED draft.
+        # When nothing was rejected (n_acc == n_draft) the final sample is
+        # the unmasked bonus token — -1 matches no vocab id.
+        rej = jnp.take_along_axis(
+            drafts, jnp.minimum(n_acc, K - 1)[:, None], axis=1
+        )[:, 0]
+        return jnp.where(n_acc < n_draft, rej, -1)
+
+    def _all_greedy(_):
+        n_acc = _count((greedy_tok[:, :K] == drafts) & valid)
+        return _finish(n_acc, jnp.zeros((A,), jnp.int32))
+
+    def _full_vocab(_):
+        temp = jnp.maximum(temperature, 1e-6)[:, None, None]
+        scaled = logits / temp  # [A, C, V]
+        lse = jax.nn.logsumexp(scaled, axis=-1)  # [A, C]
+        d_logit = jnp.take_along_axis(
+            scaled[:, :K], drafts[..., None], axis=-1
+        )[..., 0]
+        p_draft = jnp.exp(d_logit - lse[:, :K])  # [A, K]
+        acc = jnp.where(is_greedy[:, None], greedy_tok[:, :K] == drafts, u < p_draft)
+        n_acc = _count(acc & valid)
+        pos_scaled = jnp.take_along_axis(
+            scaled, n_acc[:, None, None], axis=1
+        )[:, 0]  # [A, V]
+        resid = jnp.where(
+            jnp.arange(V, dtype=jnp.int32)[None, :] == _mask_tok(n_acc)[:, None],
+            -jnp.inf,
+            pos_scaled,
+        )
+        g = jax.random.gumbel(rng_f, (A, V), dtype=jnp.float32)
+        return _finish(n_acc, jnp.argmax(resid + g, axis=-1))
+
+    def _windowed(_):
+        # the same candidate-window distribution _sample_windowed draws
+        # from, applied per chunk position
+        flat = logits.reshape(A * C, V)
+        if V > 4 * n_cand:
+            cand_logits, cand_idx = jax.lax.approx_max_k(
+                flat, n_cand, recall_target=0.95, aggregate_to_topk=True
+            )
+        else:
+            cand_logits, cand_idx = jax.lax.top_k(flat, n_cand)
+        cand_logits = cand_logits.reshape(A, C, n_cand)
+        cand_idx = cand_idx.reshape(A, C, n_cand).astype(jnp.int32)
+        k = jnp.where(top_k <= 0, n_cand, jnp.minimum(top_k, n_cand))
+        k_mask = jnp.arange(n_cand)[None, None, :] < k[:, None, None]
+        temp = jnp.maximum(temperature, 1e-6)[:, None, None]
+        scaled = jnp.where(k_mask, cand_logits / temp, -jnp.inf)
+        probs = jax.nn.softmax(scaled, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        p_mask = (cum - probs) < top_p[:, None, None]
+        p_mask = p_mask.at[:, :, 0].set(True)
+        m = p_mask & k_mask
+        wp = jnp.where(m, probs, 0.0)
+        norm = jnp.maximum(jnp.sum(wp, axis=-1), 1e-9)  # [A, C]
+        match = cand_idx[:, :K] == drafts[:, :, None]  # [A, K, n_cand]
+        p_draft = jnp.sum(jnp.where(match, wp[:, :K], 0.0), axis=-1) / norm[:, :K]
+        acc = jnp.where(is_greedy[:, None], greedy_tok[:, :K] == drafts, u < p_draft)
+        n_acc = _count(acc & valid)
+        take = lambda x: jnp.take_along_axis(x, n_acc[:, None, None], axis=1)[:, 0]
+        w_scaled, w_idx, w_m = take(scaled), take(cand_idx), take(m)
+        resid = jnp.where(
+            w_m & (w_idx != _mask_tok(n_acc)[:, None]), w_scaled, -jnp.inf
+        )
+        g = jax.random.gumbel(rng_f, (A, n_cand), dtype=jnp.float32)
+        choice = jnp.argmax(resid + g, axis=-1)
+        sampled = jnp.take_along_axis(w_idx, choice[:, None], axis=1)[:, 0]
+        return _finish(n_acc, sampled)
+
+    plain = _pred((top_k <= 0) & (top_p >= 1.0))
+    return jax.lax.cond(
+        _pred(is_greedy),
+        _all_greedy,
+        lambda _: jax.lax.cond(plain, _full_vocab, _windowed, None),
+        None,
+    )

@@ -133,6 +133,33 @@ class Metrics:
             "Rounds where the TTFT deadline needed more prefill tokens than the fairness cap",
             registry=r,
         )
+        # Self-speculative decoding (executor/engine.py draft-and-verify,
+        # TPU_SPEC knobs; doc/performance.md). Per-engine labels match the
+        # scheduler gauges' wiring in api/server.py engines_info.
+        self.spec_accept_rate = Gauge(
+            "llmtpu_spec_accept_rate",
+            "Accepted / drafted speculative tokens (cumulative ratio)",
+            ["engine"],
+            registry=r,
+        )
+        self.spec_tok_per_call = Gauge(
+            "llmtpu_spec_tok_per_verify_call",
+            "Tokens emitted per speculative verify dispatch (cumulative ratio)",
+            ["engine"],
+            registry=r,
+        )
+        self.spec_drafted_tokens = Counter(
+            "llmtpu_spec_drafted_tokens_total",
+            "Draft tokens proposed by the n-gram drafter",
+            ["engine"],
+            registry=r,
+        )
+        self.spec_emitted_tokens = Counter(
+            "llmtpu_spec_emitted_tokens_total",
+            "Tokens emitted by speculative verify rounds (accepted + final samples)",
+            ["engine"],
+            registry=r,
+        )
 
     def render(self) -> tuple[bytes, str]:
         return generate_latest(self.registry), CONTENT_TYPE_LATEST

@@ -24,6 +24,17 @@ In-process, the *current* span is tracked on a module-level thread-local
 stack so nested `span()` blocks parent implicitly and helpers like
 `current_traceparent()` work from anywhere on the request thread.
 
+Engine span attribute taxonomy (the executor stamps these; consumers like
+`scripts/trace_dump.py` and the stage histograms key on them):
+
+  engine.admit    request_id
+  engine.prefill  request_id, prompt_tokens, ttft_ms,
+                  prefill_token_budget, sched_starved_rounds
+  engine.decode   request_id, completion_tokens, tok_per_s, finish_reason;
+                  with self-speculative decoding on (TPU_SPEC), also
+                  spec_drafted / spec_accepted — the stream's draft-and-
+                  verify contribution, explaining its tok_per_s
+
 Tracing is on by default and globally disabled with `TPU_TRACE=0`; the
 check is dynamic (read per span start) so tests and operators can flip it
 on a live process.  `TPU_TRACE_FILE=<path>` appends every completed span

@@ -142,6 +142,15 @@ class Config:
     tpu_prefill_buckets: str = field(default_factory=lambda: getenv("TPU_PREFILL_BUCKETS", "fine"))
     # prompt-prefix KV cache budget in MB (0 disables)
     tpu_prompt_cache_mb: int = field(default_factory=lambda: getenv_int("TPU_PROMPT_CACHE_MB", 256))
+    # self-speculative decoding (executor/engine.py draft-and-verify):
+    # TPU_SPEC=0 is the kill switch (byte-identical non-speculative decode
+    # path); TPU_SPEC_K caps the drafts per verify call; TPU_SPEC_MIN_NGRAM
+    # is the shortest suffix the prompt-lookup drafter matches on. The
+    # engines read the env directly at construction (TPU_PIPELINE_DEPTH
+    # pattern); these fields surface the knobs in config dumps.
+    tpu_spec: bool = field(default_factory=lambda: getenv("TPU_SPEC", "1") != "0")
+    tpu_spec_k: int = field(default_factory=lambda: getenv_int("TPU_SPEC_K", 7))
+    tpu_spec_min_ngram: int = field(default_factory=lambda: getenv_int("TPU_SPEC_MIN_NGRAM", 2))
 
     def has_openai(self) -> bool:
         return bool(self.openai_api_key)

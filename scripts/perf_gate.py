@@ -29,9 +29,15 @@ Checks, in order of authority:
      when the line predates the field), p95_ttft_ms <= 5000,
      window_errors == 0. The floors alone catch r05 against the
      metric-less BASELINE.json.
+  3. Self-speculative decoding floors, when the record carries them:
+     spec_accept_rate >= 0.05 and spec_tok_per_call >= 1.0 — below
+     either, drafting is pure verify-pass overhead and TPU_SPEC=0
+     beats shipping it.
 
-Missing metrics are reported but never fail the gate (older records
-predate newer fields); a metric PRESENT and regressed always fails.
+Missing metrics are reported as [SKIP] with a stderr warning but never
+fail the gate (older records predate newer fields — a KeyError here
+would make every old BENCH_*.json ungateable); a metric PRESENT and
+regressed always fails.
 """
 
 from __future__ import annotations
@@ -49,11 +55,23 @@ HIGHER_BETTER = (
     "serve_efficiency",
     "engine_direct_tok_per_s",
     "mean_completion_tokens",
+    "spec_accept_rate",
+    "spec_tok_per_call",
 )
 LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms")
 
-# absolute floors/ceilings applied regardless of baseline coverage
-ABS_MIN = {"vs_baseline": 0.5, "serve_efficiency": 0.5}
+# absolute floors/ceilings applied regardless of baseline coverage (only
+# ever read with .get(): a floor for a metric the record lacks must skip,
+# never KeyError — old records predate new fields)
+ABS_MIN = {
+    "vs_baseline": 0.5,
+    "serve_efficiency": 0.5,
+    # self-speculative decoding: accepting under 5% of drafts, or emitting
+    # barely one token per fused verify call, means the draft-and-verify
+    # pass is pure overhead over plain decode
+    "spec_accept_rate": 0.05,
+    "spec_tok_per_call": 1.0,
+}
 ABS_MAX = {"p95_ttft_ms": 5000.0, "window_errors": 0.0}
 
 
@@ -88,42 +106,59 @@ def metric(rec: dict, name: str) -> float | None:
     return None
 
 
-def check(cand: dict, base: dict) -> list[tuple[str, str, bool]]:
-    """[(metric, message, ok)] for every check that could be evaluated."""
-    results: list[tuple[str, str, bool]] = []
+def check(cand: dict, base: dict) -> list[tuple[str, str, str]]:
+    """[(metric, message, status)] for every check that could be evaluated;
+    status is "pass" | "fail" | "skip". A metric absent from the candidate
+    is a skip (warned by main(), never a failure and never a KeyError)."""
+    results: list[tuple[str, str, str]] = []
     for name in HIGHER_BETTER:
         c, b = metric(cand, name), metric(base, name)
         if c is None:
-            results.append((name, "absent from candidate (skipped)", True))
+            results.append((name, "absent from candidate", "skip"))
             continue
         if b is not None:
             floor = b * (1.0 - TOLERANCE)
             ok = c >= floor
             results.append(
-                (name, f"{c:.3f} vs baseline {b:.3f} (floor {floor:.3f})", ok)
+                (name, f"{c:.3f} vs baseline {b:.3f} (floor {floor:.3f})",
+                 "pass" if ok else "fail")
             )
-        if name in ABS_MIN:
-            ok = c >= ABS_MIN[name]
-            results.append((name, f"{c:.3f} >= {ABS_MIN[name]} (abs floor)", ok))
+        abs_floor = ABS_MIN.get(name)
+        if abs_floor is not None:
+            ok = c >= abs_floor
+            results.append(
+                (name, f"{c:.3f} >= {abs_floor} (abs floor)",
+                 "pass" if ok else "fail")
+            )
     for name in LOWER_BETTER:
         c, b = metric(cand, name), metric(base, name)
         if c is None or c < 0:  # bench emits -1.0 for "not measured"
-            results.append((name, "absent from candidate (skipped)", True))
+            results.append((name, "absent from candidate", "skip"))
             continue
         if b is not None and b >= 0:
             ceil = b * (1.0 + TTFT_TOLERANCE)
             ok = c <= ceil
             results.append(
-                (name, f"{c:.1f} vs baseline {b:.1f} (ceiling {ceil:.1f})", ok)
+                (name, f"{c:.1f} vs baseline {b:.1f} (ceiling {ceil:.1f})",
+                 "pass" if ok else "fail")
             )
-        if name in ABS_MAX:
-            ok = c <= ABS_MAX[name]
-            results.append((name, f"{c:.1f} <= {ABS_MAX[name]} (abs ceiling)", ok))
+        abs_ceil = ABS_MAX.get(name)
+        if abs_ceil is not None:
+            ok = c <= abs_ceil
+            results.append(
+                (name, f"{c:.1f} <= {abs_ceil} (abs ceiling)",
+                 "pass" if ok else "fail")
+            )
     c = metric(cand, "window_errors")
     if c is not None:
         b = metric(base, "window_errors") or 0.0
-        ok = c <= max(b, ABS_MAX["window_errors"])
-        results.append(("window_errors", f"{c:.0f} (baseline {b:.0f})", ok))
+        ok = c <= max(b, ABS_MAX.get("window_errors", 0.0))
+        results.append(
+            ("window_errors", f"{c:.0f} (baseline {b:.0f})",
+             "pass" if ok else "fail")
+        )
+    else:
+        results.append(("window_errors", "absent from candidate", "skip"))
     return results
 
 
@@ -142,9 +177,19 @@ def main(argv: list[str]) -> int:
     print(f"candidate: {cand.get('metric', argv[0])}")
     print(f"baseline:  {base.get('metric', argv[1])}")
     failed = 0
-    for name, msg, ok in check(cand, base):
-        print(f"  [{'PASS' if ok else 'FAIL'}] {name}: {msg}")
-        failed += 0 if ok else 1
+    skipped: list[str] = []
+    for name, msg, status in check(cand, base):
+        print(f"  [{status.upper()}] {name}: {msg}")
+        if status == "fail":
+            failed += 1
+        elif status == "skip":
+            skipped.append(name)
+    if skipped:
+        print(
+            "perf_gate: WARNING metrics absent from candidate, not gated: "
+            + ", ".join(skipped),
+            file=sys.stderr,
+        )
     if failed:
         print(f"perf_gate: {failed} metric(s) regressed", file=sys.stderr)
         return 1

@@ -110,8 +110,43 @@ def test_stats_contract():
     assert set(st) == {
         "prefill_token_budget", "starved_rounds", "decode_round_ema_ms",
         "prefill_tok_cost_us", "fair_cap_tokens",
+        "verify_rounds", "verify_tokens",
     }
     assert all(isinstance(v, float) for v in st.values())
+
+
+def test_reserved_tokens_come_off_the_budget():
+    """A staged speculative verify dispatch owes chunk positions to the
+    round; the prefill budget shrinks by that reservation AFTER the
+    min/cap clamp (so it can reach 0 — never negative)."""
+    s = TokenBudgetScheduler(
+        target_ttft_ms=1000.0, min_budget=4,
+        decode_seed_s=0.010, prefill_tok_seed_s=100e-6,
+    )
+    full = s.decide(50_000, n_active=4, oldest_wait_s=0.99)
+    assert full == s.fair_cap()
+    reserved = s.decide(50_000, n_active=4, oldest_wait_s=0.99,
+                        reserved_tokens=30)
+    assert reserved == full - 30
+    assert s.last_budget == reserved
+    # a reservation larger than the whole budget floors at 0, not negative
+    assert s.decide(50_000, n_active=4, oldest_wait_s=0.99,
+                    reserved_tokens=10_000) == 0
+    # no backlog: reservation is irrelevant, budget stays 0
+    assert s.decide(0, n_active=4, oldest_wait_s=0.0, reserved_tokens=30) == 0
+
+
+def test_observe_verify_counts_and_feeds_prefill_ema():
+    s = TokenBudgetScheduler()
+    p0 = s.prefill_tok_s
+    s.observe_verify(32, 0.004)
+    s.observe_verify(16, 0.002)
+    assert s.verify_rounds == 2
+    assert s.verify_tokens == 48
+    assert s.prefill_tok_s != p0  # verify cost feeds the same EMA
+    st = s.stats()
+    assert st["verify_rounds"] == 2.0
+    assert st["verify_tokens"] == 48.0
 
 
 # ------------------------------------------------- engine-loop integration --
@@ -128,8 +163,8 @@ def test_staged_groups_respect_budget_with_active_decode():
     staged: list[tuple[int, int]] = []  # (budget decided, tokens staged)
     orig = eng._stage_prefill_group
 
-    def spy(n_active):
-        g = orig(n_active)
+    def spy(n_active, reserved_tokens=0):
+        g = orig(n_active, reserved_tokens)
         if n_active > 0 and g is not None:
             staged.append((eng._sched.last_budget, g.n_tokens))
         return g
@@ -298,6 +333,56 @@ def test_gate_usage_and_unparseable_inputs(tmp_path):
     assert gate.main([]) == 2
     (tmp_path / "empty.json").write_text('{"n": 1, "tail": "no record here"}')
     assert gate.main([str(tmp_path / "empty.json"), _bench("BASELINE.json")]) == 2
+
+
+def test_gate_missing_keys_skip_with_warning(tmp_path, capsys):
+    """A candidate that predates the spec metrics (every record before this
+    change) must gate cleanly — [SKIP] rows plus a stderr warning, never a
+    KeyError and never a failure."""
+    import json
+
+    cand = {"value": 2400.0, "window_errors": 0.0}
+    (tmp_path / "cand.json").write_text(json.dumps(cand))
+    assert gate.main([str(tmp_path / "cand.json"), _bench("BASELINE.json")]) == 0
+    captured = capsys.readouterr()
+    assert "[SKIP] spec_accept_rate: absent from candidate" in captured.out
+    assert "WARNING metrics absent from candidate" in captured.err
+    assert "spec_tok_per_call" in captured.err
+
+
+def test_gate_spec_metric_floors(tmp_path):
+    """spec_accept_rate < 0.05 or spec_tok_per_call < 1.0 means drafting is
+    pure overhead: present-and-below-floor must fail the gate."""
+    import json
+
+    good = {"value": 2400.0, "window_errors": 0.0,
+            "spec_accept_rate": 0.42, "spec_tok_per_call": 2.8}
+    bad_rate = dict(good, spec_accept_rate=0.01)
+    bad_tpc = dict(good, spec_tok_per_call=0.4)
+    for n, doc in (("good", good), ("bad_rate", bad_rate), ("bad_tpc", bad_tpc)):
+        (tmp_path / f"{n}.json").write_text(json.dumps(doc))
+    base = _bench("BASELINE.json")
+    assert gate.main([str(tmp_path / "good.json"), base]) == 0
+    assert gate.main([str(tmp_path / "bad_rate.json"), base]) == 1
+    assert gate.main([str(tmp_path / "bad_tpc.json"), base]) == 1
+
+
+def test_gate_spec_metrics_relative_regression(tmp_path):
+    """spec metrics are throughput-class: a drop past TOLERANCE vs a
+    baseline that HAS them fails even above the absolute floors."""
+    import json
+
+    base = {"value": 2400.0, "window_errors": 0.0,
+            "spec_accept_rate": 0.60, "spec_tok_per_call": 4.0}
+    regressed = dict(base, spec_accept_rate=0.30)
+    for n, doc in (("base", base), ("regressed", regressed)):
+        (tmp_path / f"{n}.json").write_text(json.dumps(doc))
+    assert gate.main(
+        [str(tmp_path / "regressed.json"), str(tmp_path / "base.json")]
+    ) == 1
+    assert gate.main(
+        [str(tmp_path / "base.json"), str(tmp_path / "base.json")]
+    ) == 0
 
 
 def test_gate_skips_unmeasured_ttft(tmp_path):

@@ -1,0 +1,331 @@
+"""Self-speculative decoding tests: the n-gram drafter (host-side,
+dependency-free), the fused spec_verify sampler's exactness (greedy
+identity and a chi-square check that rejection sampling preserves the
+target distribution under an ADVERSARIAL drafter), the engine-level
+greedy-identity guarantee (TPU_SPEC on vs off emit identical text), the
+TPU_SPEC=0 kill switch as a structural no-op, and the pow2-bucket prefix
+cache index staying coherent with the LRU store.
+"""
+
+from __future__ import annotations
+
+import math
+import subprocess
+import sys
+
+import pytest
+
+from llm_mcp_tpu.executor.drafter import NGramDrafter
+
+# --------------------------------------------------------------- drafter --
+
+
+def test_drafter_validates_orders():
+    with pytest.raises(ValueError):
+        NGramDrafter(min_n=0)
+    with pytest.raises(ValueError):
+        NGramDrafter(min_n=3, max_n=2)
+
+
+def test_drafter_empty_and_no_match():
+    d = NGramDrafter(min_n=2, max_n=3)
+    assert d.draft(4) == []
+    d.extend([1, 2, 3, 4, 5])  # no repeated bigram anywhere
+    assert d.draft(4) == []
+    assert d.draft(0) == []
+    assert len(d) == 5
+
+
+def test_drafter_proposes_continuation_of_earlier_ngram():
+    # history ... (7 8) 9 ... (7 8) → the earlier (7,8) was followed by 9
+    d = NGramDrafter(min_n=2, max_n=3)
+    d.extend([7, 8, 9, 10, 11, 7, 8])
+    out = d.draft(3)
+    assert out[:1] == [9]
+    # and the continuation keeps following the earlier occurrence
+    assert out == [9, 10, 11]
+
+
+def test_drafter_periodic_history_extends_to_full_k():
+    """A tight loop matches near the history tail (last occurrence wins);
+    the virtual-history re-probe must extend the draft to the full k
+    instead of truncating at the history edge."""
+    d = NGramDrafter(min_n=2, max_n=3)
+    d.extend([1, 2, 3] * 4)  # period-3 loop, ends ... 1 2 3
+    out = d.draft(7)
+    assert out == [1, 2, 3, 1, 2, 3, 1]
+
+
+def test_drafter_last_occurrence_wins():
+    # (5 6) seen twice with different continuations: the RECENT one (→ 9)
+    # must win over the old one (→ 7)
+    d = NGramDrafter(min_n=2, max_n=2)
+    d.extend([5, 6, 7, 0, 5, 6, 9, 1, 5, 6])
+    assert d.draft(1) == [9]
+
+
+def test_drafter_never_imports_jax():
+    """Import-direction lint (the tests/test_tracing.py pattern): the
+    drafter runs on the engine host thread and inside slice-engine follower
+    processes — it must stay pure stdlib, pulling in neither jax nor
+    numpy."""
+    # load by file path: importing through llm_mcp_tpu.executor would run
+    # the package __init__ (which legitimately imports jax) — the lint is
+    # about what drafter.py ITSELF pulls in
+    drafter_path = __import__("llm_mcp_tpu.executor.drafter", fromlist=["x"]).__file__
+    code = (
+        "import sys, importlib.util; "
+        f"spec = importlib.util.spec_from_file_location('drafter', {drafter_path!r}); "
+        "mod = importlib.util.module_from_spec(spec); "
+        "spec.loader.exec_module(mod); "
+        "assert mod.NGramDrafter(2, 3).draft(4) == []; "
+        "bad = [m for m in sys.modules if m.startswith(('jax', 'numpy'))]; "
+        "sys.exit('drafter pulled in: %s' % bad if bad else 0)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=120
+    )
+    assert proc.returncode == 0, proc.stderr or proc.stdout
+
+
+# ----------------------------------------------------------- spec_verify --
+
+
+def _verify(logits, drafts, n_draft, *, temp, top_k=0, top_p=1.0, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    from llm_mcp_tpu.ops.sampling import spec_verify
+
+    A = logits.shape[0]
+    return spec_verify(
+        jnp.asarray(logits, dtype=jnp.float32),
+        jnp.asarray(drafts, dtype=jnp.int32),
+        jnp.asarray(n_draft, dtype=jnp.int32),
+        jax.random.PRNGKey(seed),
+        jnp.full((A,), temp, dtype=jnp.float32),
+        jnp.full((A,), top_k, dtype=jnp.int32),
+        jnp.full((A,), top_p, dtype=jnp.float32),
+    )
+
+
+def test_spec_verify_greedy_accepts_agreeing_prefix():
+    import numpy as np
+
+    V, C = 8, 4
+    # row 0: argmax sequence 3,5,1,6; drafts [3,5,2] agree for 2 then diverge
+    # row 1: drafts [3,5,1] agree fully → bonus final from position 3
+    logits = np.full((2, C, V), -10.0, dtype=np.float32)
+    for j, t in enumerate((3, 5, 1, 6)):
+        logits[:, j, t] = 10.0
+    drafts = np.array([[3, 5, 2], [3, 5, 1]], dtype=np.int32)
+    n_acc, final = _verify(logits, drafts, [3, 3], temp=0.0)
+    n_acc, final = map(lambda a: [int(x) for x in a], (n_acc, final))
+    assert n_acc == [2, 3]
+    # row 0 resamples greedily at the rejected position; row 1 takes the
+    # bonus position's argmax
+    assert final == [1, 6]
+
+
+def test_spec_verify_zero_drafts_is_plain_greedy_step():
+    import numpy as np
+
+    logits = np.zeros((1, 3, 8), dtype=np.float32)
+    logits[0, 0, 5] = 4.0
+    n_acc, final = _verify(logits, np.zeros((1, 2), np.int32), [0], temp=0.0)
+    assert int(n_acc[0]) == 0 and int(final[0]) == 5
+
+
+def test_spec_verify_adversarial_drafter_preserves_distribution():
+    """Rejection sampling exactness: draft the LEAST likely token every
+    time and the emitted-token marginal must still match the target
+    softmax. Chi-square over V=8 outcomes, df=7: critical value 24.32 at
+    p=0.999 — a biased residual path fails this by orders of magnitude."""
+    import numpy as np
+
+    A, V = 2000, 8
+    row = np.array([2.0, 1.5, 1.0, 0.5, 0.0, -0.5, -1.0, -2.0], np.float32)
+    p = np.exp(row - row.max())
+    p /= p.sum()
+    # C = 2 positions (K = 1); position 0 scores the first emitted token
+    logits = np.tile(row, (A, 2, 1)).astype(np.float32)
+    drafts = np.full((A, 1), int(np.argmin(row)), dtype=np.int32)
+    n_acc, final = _verify(logits, drafts, np.ones(A, np.int32), temp=1.0,
+                           seed=7)
+    n_acc = np.asarray(n_acc)
+    final = np.asarray(final)
+    first = np.where(n_acc >= 1, drafts[:, 0], final)
+    counts = np.bincount(first, minlength=V).astype(np.float64)
+    expected = p * A
+    chi2 = float(((counts - expected) ** 2 / expected).sum())
+    assert chi2 < 24.32, (chi2, counts.tolist(), expected.tolist())
+    # the adversarial draft was accepted at roughly its target probability
+    acc = float((n_acc >= 1).mean())
+    assert abs(acc - p[int(drafts[0, 0])]) < 0.05
+
+
+# ------------------------------------------------------------- engine e2e --
+
+
+REPETITIVE_PROMPT = (
+    "repeat this exact list again and again: alpha beta gamma delta "
+    "alpha beta gamma delta alpha beta gamma delta"
+)
+
+
+def _mk_engine(**kw):
+    import jax.numpy as jnp
+
+    from llm_mcp_tpu.executor import GenerationEngine
+
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_seq_len", 256)
+    kw.setdefault("dtype", jnp.float32)
+    kw.setdefault("decode_chunk", 4)
+    return GenerationEngine("tiny-llm", **kw).start()
+
+
+def test_engine_greedy_identity_spec_on_vs_off(monkeypatch):
+    """The acceptance criterion: greedy speculative decode must emit
+    token-for-token what non-speculative greedy decode emits, while
+    actually speculating (verify calls > 0 on a repetitive prompt)."""
+    monkeypatch.delenv("TPU_SPEC", raising=False)
+    spec = _mk_engine()
+    try:
+        assert spec.spec_enabled and spec._verify_fn is not None
+        got = spec.generate(REPETITIVE_PROMPT, max_tokens=48, temperature=0.0)
+        st = spec.speculation_stats()
+        assert st["verify_calls"] > 0, "drafter never engaged"
+        assert st["accepted_tokens"] > 0
+    finally:
+        spec.shutdown()
+    monkeypatch.setenv("TPU_SPEC", "0")
+    plain = _mk_engine()
+    try:
+        want = plain.generate(REPETITIVE_PROMPT, max_tokens=48, temperature=0.0)
+    finally:
+        plain.shutdown()
+    assert got["text"] == want["text"]
+    assert got["usage"] == want["usage"]
+
+
+def test_spec_kill_switch_is_structural_noop(monkeypatch):
+    """TPU_SPEC=0 must leave no speculation machinery in the decode path:
+    no verify executable, no per-slot drafter, zeroed stats."""
+    monkeypatch.setenv("TPU_SPEC", "0")
+    eng = _mk_engine()
+    try:
+        assert not eng.spec_enabled
+        assert eng._verify_fn is None
+        out = eng.generate(REPETITIVE_PROMPT, max_tokens=16, temperature=0.0)
+        assert out["usage"]["completion_tokens"] >= 1
+        assert all(s is None or s.spec is None for s in eng._slots)
+        st = eng.speculation_stats()
+        assert st["enabled"] == 0.0
+        assert st["verify_calls"] == 0.0 and st["drafted_tokens"] == 0.0
+    finally:
+        eng.shutdown()
+
+
+def test_engine_sampled_speculation_completes(monkeypatch):
+    """Sampled requests go through the rejection-sampling verify path; the
+    engine must stay healthy (no errors, plausible completions) with
+    temperature, top-k and top-p in one concurrent batch."""
+    import concurrent.futures as cf
+
+    monkeypatch.delenv("TPU_SPEC", raising=False)
+    eng = _mk_engine(max_slots=4)
+    try:
+        cases = [
+            dict(temperature=0.0),
+            dict(temperature=0.8),
+            dict(temperature=0.9, top_k=8),
+            dict(temperature=0.7, top_p=0.9),
+        ]
+        with cf.ThreadPoolExecutor(max_workers=4) as ex:
+            outs = list(ex.map(
+                lambda kw: eng.generate(REPETITIVE_PROMPT, max_tokens=24, **kw),
+                cases,
+            ))
+        assert all(o["usage"]["completion_tokens"] >= 1 for o in outs)
+        assert eng.total_errors == 0
+    finally:
+        eng.shutdown()
+
+
+# ------------------------------------------------- prefix bucket index --
+
+
+def test_prefix_cache_bucket_index_stays_coherent():
+    """_match_prefix now probes pow2-length buckets instead of scanning
+    every entry; the bucket index must mirror the LRU dict exactly through
+    stores, hits and evictions."""
+    eng = _mk_engine(max_slots=4, prompt_cache_mb=64)
+    try:
+        shared_a = "alpha preamble for the bucket index test. " * 3
+        shared_b = "bravo preamble, longer than the alpha one by a lot. " * 6
+        for shared in (shared_a, shared_b):
+            for i in range(3):
+                eng.generate(shared + f"q{i}?", max_tokens=2, temperature=0.0)
+        assert eng.prefix_cache_hits >= 1
+        assert len(eng._prefix_cache) >= 1
+
+        def assert_coherent():
+            mirrored = {
+                k: e
+                for bucket in eng._prefix_by_len.values()
+                for k, e in bucket.items()
+            }
+            assert mirrored == dict(eng._prefix_cache)
+            for ent in eng._prefix_cache.values():
+                assert ent["P"] in eng._prefix_by_len
+            assert all(eng._prefix_by_len.values())  # no empty buckets
+
+        assert_coherent()
+        # force eviction down to (at most) one entry and re-check
+        eng._prefix_budget = 1
+        eng.generate("charlie " * 30 + "tail", max_tokens=2, temperature=0.0)
+        eng.generate("charlie " * 30 + "tail two", max_tokens=2, temperature=0.0)
+        assert len(eng._prefix_cache) <= 1
+        assert_coherent()
+    finally:
+        eng.shutdown()
+
+
+def test_prefix_match_semantics_unchanged():
+    """The bucket probe preserves the old linear scan's contract: longest
+    stored strict-prefix wins, miss counters still move."""
+    eng = _mk_engine(max_slots=2, prompt_cache_mb=64)
+    try:
+        ids = list(range(40))
+        short_e = {"P": 8, "bytes": 1}
+        long_e = {"P": 32, "bytes": 1}
+        eng._prefix_cache[tuple(ids[:8])] = short_e
+        eng._prefix_by_len.setdefault(8, {})[tuple(ids[:8])] = short_e
+        eng._prefix_cache[tuple(ids[:32])] = long_e
+        eng._prefix_by_len.setdefault(32, {})[tuple(ids[:32])] = long_e
+        h0, m0 = eng.prefix_cache_hits, eng.prefix_cache_misses
+        assert eng._match_prefix(ids) is long_e  # longest strict prefix wins
+        assert eng.prefix_cache_hits == h0 + 1
+        # a full-length key must NOT match itself (>= len(t) is excluded)
+        assert eng._match_prefix(ids[:32]) is short_e
+        # total miss
+        assert eng._match_prefix([999, 998, 997]) is None
+        assert eng.prefix_cache_misses == m0 + 1
+    finally:
+        eng.shutdown()
+
+
+def test_config_spec_knobs(monkeypatch):
+    from llm_mcp_tpu.utils.config import Config
+
+    for k in ("TPU_SPEC", "TPU_SPEC_K", "TPU_SPEC_MIN_NGRAM"):
+        monkeypatch.delenv(k, raising=False)
+    cfg = Config()
+    assert cfg.tpu_spec is True
+    assert cfg.tpu_spec_k == 7
+    assert cfg.tpu_spec_min_ngram == 2
+    monkeypatch.setenv("TPU_SPEC", "0")
+    monkeypatch.setenv("TPU_SPEC_K", "4")
+    cfg = Config()
+    assert cfg.tpu_spec is False and cfg.tpu_spec_k == 4
