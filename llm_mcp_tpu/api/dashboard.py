@@ -67,6 +67,20 @@ class DashboardAPI:
             for name, i in engines.items()
             if isinstance(i.get("speculation"), dict)
         }
+        # condensed KV-pool view (full counters under engines[name]["memory"]):
+        # how close is each engine to shedding, and how churned is the pool?
+        memory = {
+            name: {
+                "headroom": round(i["memory"].get("headroom", 1.0), 3),
+                "offered": int(i["memory"].get("offered", 0.0)),
+                "preempted_held": int(i["memory"].get("preempted_held", 0.0)),
+                "preempted": int(i["memory"].get("preempted_total", 0.0)),
+                "restored": int(i["memory"].get("restored_total", 0.0)),
+                "shed": int(i["memory"].get("shed_total", 0.0)),
+            }
+            for name, i in engines.items()
+            if isinstance(i.get("memory"), dict)
+        }
         resp.write_json(
             {
                 "ts": time.time(),
@@ -82,6 +96,7 @@ class DashboardAPI:
                 "circuit": circuit,
                 "engines": engines,
                 "speculation": speculation,
+                "memory": memory,
                 "issues": issues,
             }
         )

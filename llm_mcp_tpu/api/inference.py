@@ -273,8 +273,35 @@ class InferenceAPI:
             self.metrics.chat_requests.labels(model=model, provider="tpu", status="error").inc()
             return
 
+        # Load shedding (executor/memory.py watermark): above the admission
+        # watermark, queueing more work only grows every stream's latency —
+        # reject NOW with a drain estimate so well-behaved clients back off
+        # (and the router's headroom tag steers new traffic elsewhere).
+        # admission_state is side-effect free; the shed is recorded here,
+        # where the 429 actually happens. Embed engines lack the method.
+        shed, retry_after = getattr(
+            engine, "admission_state", lambda: (False, 0.0)
+        )()
+        if shed:
+            engine.note_shed()
+            self.metrics.chat_requests.labels(
+                model=model, provider="tpu", status="shed"
+            ).inc()
+            resp.extra_headers["Retry-After"] = str(max(1, int(retry_after + 0.5)))
+            resp.write_error(
+                "server overloaded: KV pool above admission watermark; "
+                "retry after the indicated delay",
+                429,
+            )
+            return
+
+        try:
+            priority = int(body.get("priority") or 0)
+        except (TypeError, ValueError):
+            priority = 0
         gen_kwargs = dict(
-            max_tokens=max_tokens, temperature=temperature, top_p=top_p, stop=stop
+            max_tokens=max_tokens, temperature=temperature, top_p=top_p, stop=stop,
+            priority=priority,
         )
         created = int(t0)
         cmpl_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"

@@ -42,12 +42,18 @@ class JobsAPI:
         router: Router,
         metrics: Metrics,
         cfg: Config,
+        overload_check=None,
     ):
         self.queue = queue
         self.catalog = catalog
         self.router = router
         self.metrics = metrics
         self.cfg = cfg
+        # () -> (shed: bool, retry_after_s: float) — wired by CoreServer to
+        # the local engine's KV-pool admission state. Above the watermark,
+        # claims defer instead of leasing work the executor cannot run
+        # (the lease would just expire and bounce the job's attempt count).
+        self.overload_check = overload_check
 
     # -- submit / read -----------------------------------------------------
 
@@ -125,6 +131,20 @@ class JobsAPI:
             resp.write_error("worker_id required", 400)
             return
         kinds = body.get("kinds") or []
+        if self.overload_check is not None:
+            shed, retry_after = self.overload_check()
+            if shed:
+                # no lease: tell the worker when capacity should exist
+                self.catalog.worker_heartbeat(worker_id)
+                resp.write_json(
+                    {
+                        "job": None,
+                        "deferred": True,
+                        "retry_after": max(1, int(retry_after + 0.5)),
+                    },
+                    status=200,
+                )
+                return
         job = self.queue.claim(
             worker_id,
             kinds=[str(k) for k in kinds],

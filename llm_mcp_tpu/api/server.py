@@ -18,6 +18,7 @@ Route inventory (reference server.go:32-62 ↔ here):
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Any
@@ -44,6 +45,8 @@ _SPAN_STAGES = {
     "route": "route",
     "engine.prefill": "prefill",
     "engine.decode": "decode",
+    "engine.preempt": "preempt",
+    "engine.restore": "restore",
 }
 
 
@@ -68,6 +71,8 @@ class CoreServer:
         self._sched_starved: dict[str, float] = {}
         # same delta bookkeeping for the speculation token counters
         self._spec_counts: dict[str, dict[str, float]] = {}
+        # and for the KV-pool preempt/restore/shed counters
+        self._pool_counts: dict[str, dict[str, float]] = {}
         self.limits = LimitsEngine(self.db, strict=self.cfg.strict_model_limits)
         self.circuit = CircuitBreaker()
         self.router = Router(
@@ -103,6 +108,7 @@ class CoreServer:
             router=self.router,
             metrics=self.metrics,
             cfg=self.cfg,
+            overload_check=self._jobs_overload_check,
         )
         self.dashboard = DashboardAPI(
             db=self.db,
@@ -147,6 +153,34 @@ class CoreServer:
             gen_engines=self.gen_engines,
         )
 
+    # -- KV-pool admission bridge ------------------------------------------
+
+    def _jobs_overload_check(self) -> tuple[bool, float]:
+        """Worker claims defer while any local generation engine's KV pool
+        is above the admission watermark — same signal as the 429 path on
+        /v1/chat/completions, applied to the pull side of the queue. With
+        no pool (TPU_KV_HOST_OFFLOAD=0), every engine reports (False, 0)
+        and claims proceed untouched."""
+        for e in self.gen_engines.values():
+            shed, retry = getattr(e, "admission_state", lambda: (False, 0.0))()
+            if shed:
+                e.note_shed()
+                return True, retry
+        return False, 0.0
+
+    def _kv_headroom_tag(self) -> float | None:
+        """Min shed-free headroom across local pooled engines, or None when
+        no engine runs a pool (tag omitted → router treats it as 1.0)."""
+        vals = []
+        for e in self.gen_engines.values():
+            ms = getattr(e, "memory_stats", None)
+            if ms is None:
+                continue
+            st = ms()
+            if st.get("enabled"):
+                vals.append(float(st.get("headroom", 1.0)))
+        return min(vals) if vals else None
+
     # -- local engine device registration ----------------------------------
 
     def register_local_device(self) -> None:
@@ -164,18 +198,23 @@ class CoreServer:
             platform = jax.devices()[0].platform
         except Exception:
             n_chips, platform = 0, "unknown"
+        tags = {
+            "tpu": platform in ("tpu", "axon"),
+            "platform": platform,
+            "chips": n_chips,
+            "slots": slots,
+            "self": True,
+        }
+        headroom = self._kv_headroom_tag()
+        if headroom is not None:
+            # router de-ranks saturated devices on this tag (router.py)
+            tags["kv_headroom"] = round(headroom, 4)
         self.catalog.upsert_device(
             self.device_id,
             name=self.device_id,
             addr=self.advertise_addr,
             online=True,
-            tags={
-                "tpu": platform in ("tpu", "axon"),
-                "platform": platform,
-                "chips": n_chips,
-                "slots": slots,
-                "self": True,
-            },
+            tags=tags,
         )
         for m in self.gen_engines:
             self.catalog.upsert_model(m, kind="llm")
@@ -248,6 +287,29 @@ class CoreServer:
                     "drafted_tokens": float(sp.get("drafted_tokens", 0.0)),
                     "emitted_tokens": float(sp.get("emitted_tokens", 0.0)),
                 }
+            mst = getattr(e, "memory_stats", None)
+            if mst is not None:
+                ms = mst()
+                if ms.get("enabled"):
+                    info[name]["memory"] = ms
+                    self.metrics.kv_pool_headroom.labels(engine=name).set(
+                        ms.get("headroom", 1.0)
+                    )
+                    prev_p = self._pool_counts.get(name, {})
+                    for key, counter in (
+                        ("preempted_total", self.metrics.kv_preempted),
+                        ("restored_total", self.metrics.kv_restored),
+                        ("shed_total", self.metrics.kv_shed),
+                    ):
+                        cur_p = float(ms.get(key, 0.0))
+                        if cur_p > prev_p.get(key, 0.0):
+                            counter.labels(engine=name).inc(
+                                cur_p - prev_p.get(key, 0.0)
+                            )
+                    self._pool_counts[name] = {
+                        k: float(ms.get(k, 0.0))
+                        for k in ("preempted_total", "restored_total", "shed_total")
+                    }
         for name, e in self.embed_engines.items():
             info[name] = {
                 "kind": "embed",
@@ -525,7 +587,21 @@ class CoreServer:
         # Peers of this fleet serve on the same port we do: probe it, not
         # the default (slice-metadata hosts, port-less static endpoints,
         # subnet sweeps all derive their target port from this list).
-        self.discovery.ports = [self.api.port]
+        # TPU_EXTRA_PORTS widens the sweep for fleets with mixed ports
+        # (the OLLAMA_PORTS pattern): comma-separated, own port probed first.
+        ports = [self.api.port]
+        for tok in os.environ.get("TPU_EXTRA_PORTS", "").split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            try:
+                p = int(tok)
+            except ValueError:
+                log.warning("TPU_EXTRA_PORTS: ignoring non-integer %r", tok)
+                continue
+            if 0 < p < 65536 and p not in ports:
+                ports.append(p)
+        self.discovery.ports = ports
         # register AFTER the addr is known so peers can proxy to us
         self.register_local_device()
         self.limits.apply_specs()

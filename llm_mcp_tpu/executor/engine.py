@@ -62,6 +62,13 @@ from ..parallel.sharding import llama_param_specs, kv_cache_specs, shard_pytree
 from ..telemetry import tracing
 from .common import fine_bucket, pow2_bucket
 from .drafter import NGramDrafter
+from .memory import (
+    KVPool,
+    KVSnapshot,
+    RESTORE_AGING_TTFT_MULT,
+    bucket_len,
+    pytree_nbytes,
+)
 from .scheduler import TokenBudgetScheduler
 from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
 
@@ -84,6 +91,9 @@ class GenRequest:
     top_k: int = 0
     top_p: float = 1.0
     stop: list[str] = field(default_factory=list)
+    # KV-pool preemption rank (memory.py): higher survives longer. Only read
+    # when TPU_KV_HOST_OFFLOAD is on; 0 keeps every request equal.
+    priority: int = 0
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex)
     # filled by the engine
     out: "queue.Queue[Any]" = field(default_factory=queue.Queue)
@@ -114,6 +124,9 @@ class _Slot:
     spec: Any = None
     spec_drafted: int = 0  # draft tokens proposed for this request
     spec_accepted: int = 0  # draft tokens accepted by verify
+    # KV pool: last emission wall time, the "idle" preemption policy's
+    # victim signal. Only stamped when the pool is on (hot-path no-op rule).
+    last_emit: float = 0.0
 
 
 @dataclass
@@ -687,6 +700,28 @@ class GenerationEngine:
         self._spec_cooldown = 0
         self._verify_fn = self._build_verify() if self.spec_enabled else None
 
+        # HBM-aware KV pool (memory.py): admission watermark + slot
+        # preemption with host offload. TPU_KV_HOST_OFFLOAD=0 (default)
+        # never constructs the pool — every hot-path touch point is guarded
+        # `if self._pool is not None`, so the off state is a true no-op
+        # (byte-identical scheduler decisions vs the pool-less engine).
+        self._pool = None
+        if os.environ.get("TPU_KV_HOST_OFFLOAD", "0") not in ("", "0", "false", "no", "off"):
+            self._pool = KVPool(
+                max_slots=max_slots,
+                max_seq_len=max_seq_len,
+                bytes_per_slot=pytree_nbytes({"k": self._ck, "v": self._cv})
+                // max(1, max_slots),
+                watermark=float(os.environ.get("TPU_ADMIT_WATERMARK", "") or 1.5),
+                policy=os.environ.get("TPU_PREEMPT_POLICY", "") or "priority",
+            )
+            log.info(
+                "KV pool enabled: %.1f MB/slot, watermark %.2f, policy %s",
+                self._pool.bytes_per_slot / (1 << 20),
+                self._pool.watermark,
+                self._pool.policy,
+            )
+
         self._admit: "queue.Queue[GenRequest]" = queue.Queue()
         self._stop_evt = threading.Event()
         self._wake = threading.Event()
@@ -971,6 +1006,24 @@ class GenerationEngine:
                              "error": "engine stalled: accelerator unresponsive"}
                         )
                         st.req.out.put(_DONE)
+                # preempted-and-offloaded requests wait on restore, which the
+                # wedged loop will never perform — their consumers must not
+                # hang either (pool.drain() removes the snapshots, so a
+                # resuming loop cannot double-deliver)
+                if self._pool is not None and (
+                    self.stall_seconds() > self.stall_timeout_s
+                ):
+                    for snap in self._pool.drain():
+                        s = snap.slot_obj
+                        if s is None or s.aborted or s.done:
+                            continue
+                        s.aborted = True
+                        self._count_error()
+                        s.req.out.put(
+                            {"type": "error",
+                             "error": "engine stalled: accelerator unresponsive"}
+                        )
+                        s.req.out.put(_DONE)
             elif self.stalled:
                 self.stalled = False
                 log.warning("engine loop recovered after stall")
@@ -1039,6 +1092,7 @@ class GenerationEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         stop: list[str] | None = None,
+        priority: int = 0,
     ) -> Iterator[dict[str, Any]]:
         """Yield {"type":"token","text":...} events then a final
         {"type":"done", "usage":..., "finish_reason":...}."""
@@ -1050,6 +1104,7 @@ class GenerationEngine:
             top_k=top_k,
             top_p=top_p,
             stop=stop or [],
+            priority=priority,
             trace_ctx=tracing.current_traceparent(),
         )
         self.submit(req)
@@ -1136,6 +1191,54 @@ class GenerationEngine:
             "accept_rate": (self.spec_accepted / drafted) if drafted else 0.0,
             "tok_per_call": (self.spec_emitted / calls) if calls else 0.0,
         }
+
+    def _offered_load(self) -> int:
+        """Offered load the admission watermark compares against: occupied
+        slots + queued-but-unadmitted requests + offloaded snapshots (they
+        re-enter through the same slots). Only meaningful with the pool on."""
+        queued = self._admit.qsize()
+        preempted = self._pool.preempted_count() if self._pool is not None else 0
+        return self.slots_in_use() + queued + preempted
+
+    def memory_stats(self) -> dict[str, float]:
+        """KV pool observability (engines_info memory block + dashboard +
+        llmtpu_kv_* metrics). {"enabled": 0.0} when TPU_KV_HOST_OFFLOAD is
+        off — the pool doesn't exist and nothing else is meaningful."""
+        pool = self._pool
+        if pool is None:
+            return {"enabled": 0.0}
+        out = pool.stats()
+        out["enabled"] = 1.0
+        offered = self._offered_load()
+        out["offered"] = float(offered)
+        out["headroom"] = pool.headroom(offered)
+        return out
+
+    def admission_state(self) -> tuple[bool, float]:
+        """(shed, retry_after_s) for the API's load-shedding gate. SIDE-
+        EFFECT FREE — dashboards and the jobs claim path call it too; only
+        a caller that actually rejects work records it via note_shed().
+        (False, 0.0) with zero pool bookkeeping when the pool is off."""
+        pool = self._pool
+        if pool is None:
+            return False, 0.0
+        offered = self._offered_load()
+        if pool.admit_ok(offered):
+            return False, 0.0
+        with self.stats_lock:
+            fr, ft = self.finished_requests, self.finished_tokens
+        mean_tokens = (ft / fr) if fr else 64.0
+        n_waiting = self._admit.qsize() + pool.preempted_count()
+        retry = self._sched.drain_estimate_s(
+            max(1, n_waiting), mean_tokens, self.decode_chunk, self.max_slots
+        )
+        return True, min(600.0, max(1.0, retry))
+
+    def note_shed(self, n: int = 1) -> None:
+        """Record that the API shed work on this engine's behalf (429 or a
+        deferred job claim)."""
+        if self._pool is not None:
+            self._pool.note_shed(n)
 
     def current_tps(self, window_s: float = 10.0) -> float:
         now = time.time()
@@ -1236,6 +1339,17 @@ class GenerationEngine:
             st.req.out.put({"type": "error", "error": error})
             st.req.out.put(_DONE)
         self._prefill_q.clear()
+        if self._pool is not None:
+            # offloaded snapshots were waiting on a restore that will never
+            # come (their KV rows on device are gone with everyone else's)
+            for snap in self._pool.drain():
+                s = snap.slot_obj
+                if s is None or s.aborted or s.done:
+                    continue
+                s.aborted = True
+                self._count_error()
+                s.req.out.put({"type": "error", "error": error})
+                s.req.out.put(_DONE)
 
     def _free_slot(self, reserved: set[int] | None = None) -> int | None:
         for i, s in enumerate(self._slots):
@@ -1252,6 +1366,212 @@ class GenerationEngine:
                     del self._cooling[i]
                 return i
         return None
+
+    # -- KV pool: preemption with host offload -----------------------------
+
+    def _aging_s(self) -> float:
+        """Seconds after which a waiter (queue head or offloaded snapshot)
+        overrides priority fairness — bounds starvation in both directions."""
+        return RESTORE_AGING_TTFT_MULT * self.target_ttft_ms / 1000.0
+
+    def _preempt_wanted(self) -> bool:
+        """Should this iteration preempt a slot for the queue head? Only
+        when plain admission cannot proceed (no free slot), a victim exists,
+        the pool's rate/host-memory guards pass, and the head either
+        outranks the lowest-priority active stream or has aged past the
+        TTFT deadline (equal-priority load sheds at the API watermark
+        instead of thrashing slots here)."""
+        pool = self._pool
+        if pool is None or self._admit.empty() or not pool.may_preempt():
+            return False
+        live = [s for s in self._slots if s is not None and not s.done and not s.aborted]
+        if not live or self._free_slot() is not None:
+            return False
+        try:
+            # the engine thread is the queue's only consumer, so peeking the
+            # head without popping is stable
+            head = self._admit.queue[0]
+        except IndexError:
+            return False
+        min_pri = min(s.req.priority for s in live)
+        return head.priority > min_pri or (
+            time.time() - head.created_at > self._aging_s()
+        )
+
+    def _snapshot_rows(self, b: int, Lb: int):
+        """Host copies of slot b's committed KV rows [0, Lb) — one slice per
+        cache tree ("q"+"s" for kv8; k/v last dims differ under MLA but the
+        seq axis is ALWAYS axis 3, so the same slice covers every layout."""
+
+        def cut(arr):
+            if isinstance(arr, dict):
+                return {
+                    "q": jax.device_get(arr["q"][:, b : b + 1, :, :Lb]),
+                    "s": jax.device_get(arr["s"][:, b : b + 1, :, :Lb]),
+                }
+            return jax.device_get(arr[:, b : b + 1, :, :Lb])
+
+        return cut(self._ck), cut(self._cv)
+
+    def _preempt_one(self) -> bool:
+        """Offload one victim slot to host memory and free it. The caller
+        has DRAINED the pipeline (pending emitted, in-flight fetched), so
+        the host mirrors are committed-exact: lengths/last_tok describe
+        exactly the KV rows on device and the snapshot rolls back to a
+        token-identical resume point."""
+        pool = self._pool
+        cands = []
+        for b, s in enumerate(self._slots):
+            if s is None or s.done or s.aborted:
+                continue
+            cands.append({
+                "slot": b,
+                "priority": s.req.priority,
+                "last_activity": s.last_emit or s.first_token_at,
+                "tokens_remaining": max(0, s.req.max_tokens - s.generated),
+            })
+        victim = pool.pick_victim(cands)
+        if victim is None:
+            return False
+        b = victim["slot"]
+        s = self._slots[b]
+        L = int(self._lengths[b])
+        t0 = time.perf_counter()
+        Lb = bucket_len(L, self.max_seq_len)
+        k_rows, v_rows = self._snapshot_rows(b, Lb)
+        dt = time.perf_counter() - t0
+        snap = KVSnapshot(
+            req_id=s.req.request_id,
+            priority=s.req.priority,
+            length=L,
+            bucket=Lb,
+            last_tok=int(self._last_tok[b]),
+            temperature=float(self._temp[b]),
+            top_k=int(self._topk[b]),
+            top_p=float(self._topp[b]),
+            k_rows=k_rows,
+            v_rows=v_rows,
+            nbytes=pytree_nbytes(k_rows) + pytree_nbytes(v_rows),
+            preempted_at=time.time(),
+            slot_obj=s,
+        )
+        pool.offload(snap, dt)
+        # free WITHOUT terminal events: the request is suspended, not dead —
+        # its consumer stays blocked in out.get() until restore resumes
+        # emission. (Post-drain there are no rounds in flight, so this sets
+        # no cooling fence.)
+        self._free_now(b)
+        if s.req.trace_ctx:
+            tracing.get_tracer().record(
+                "engine.preempt", snap.preempted_at - dt, snap.preempted_at,
+                parent=s.req.trace_ctx,
+                attrs={
+                    "request_id": s.req.request_id,
+                    "slot": b,
+                    "kv_tokens": L,
+                    "offload_bytes": snap.nbytes,
+                    "policy": pool.policy,
+                },
+            )
+        log.info(
+            "preempted slot %d (req %s, %d tokens, %.1f MB) in %.1f ms",
+            b, s.req.request_id[:8], L, snap.nbytes / (1 << 20), dt * 1e3,
+        )
+        return True
+
+    def _restore_pending(self) -> bool:
+        """Restore offloaded snapshots into free slots, highest priority /
+        longest-preempted first. A queued request of >= priority keeps its
+        claim on the next free slot unless the snapshot has aged past the
+        TTFT deadline (the mirror of _preempt_wanted's fairness rule)."""
+        pool = self._pool
+        restored = False
+        while pool.has_preempted():
+            snap = pool.pop_restore()
+            if snap is None:
+                break
+            s = snap.slot_obj
+            if s is None or s.done or s.aborted:
+                continue  # terminal events already delivered; drop the rows
+            aged = time.time() - snap.preempted_at > self._aging_s()
+            head = None
+            try:
+                head = self._admit.queue[0]
+            except IndexError:
+                pass
+            if head is not None and head.priority >= snap.priority and not aged:
+                pool.requeue(snap)
+                break
+            slot = self._free_slot()
+            if slot is None:
+                pool.requeue(snap)
+                break
+            try:
+                self._restore_snapshot(slot, snap)
+            except Exception as e:
+                log.exception("restore of preempted slot failed")
+                s.aborted = True
+                self._count_error()
+                s.req.out.put({"type": "error", "error": str(e)})
+                s.req.out.put(_DONE)
+                if self._recover_cache():
+                    self._abort_all("kv cache lost in failed restore")
+                break
+            restored = True
+        return restored
+
+    def _restore_snapshot(self, b: int, snap: KVSnapshot) -> None:
+        """device_put the snapshot's rows and re-activate its slot. Writing
+        the full pow2 bucket is exact: rows in [length, bucket) are dead by
+        the committed-lengths invariant, and the first post-restore decode
+        round writes the real token's KV at position `length` before any
+        read attends there."""
+        s = snap.slot_obj
+        t0 = time.perf_counter()
+
+        def up(rows):
+            if isinstance(rows, dict):
+                return {k: jax.device_put(v) for k, v in rows.items()}
+            return jax.device_put(rows)
+
+        # one executable per (bucket, group=1) — same cache as prefix-hit
+        # admission, so a restore compiles nothing the serve loop hasn't
+        self._note_exec_shape("restore", snap.bucket)
+        self._ck, self._cv = self._insert_cached_fn(
+            self._ck, self._cv, up(snap.k_rows), up(snap.v_rows),
+            jnp.asarray([b], dtype=jnp.int32), np.int32(1),
+        )
+        # device sampling rows + token ring, then host mirrors (the source
+        # of truth for recovery), then the table entry
+        self._d_temp = self._d_temp.at[b].set(snap.temperature)
+        self._d_topk = self._d_topk.at[b].set(snap.top_k)
+        self._d_topp = self._d_topp.at[b].set(snap.top_p)
+        self._d_last_tok = self._d_last_tok.at[b].set(snap.last_tok)
+        self._lengths[b] = snap.length
+        self._last_tok[b] = snap.last_tok
+        self._temp[b] = snap.temperature
+        self._topk[b] = snap.top_k
+        self._topp[b] = snap.top_p
+        self._slots[b] = s
+        dt = time.perf_counter() - t0
+        self._pool.note_restored(snap, dt)
+        if s.req.trace_ctx:
+            now = time.time()
+            tracing.get_tracer().record(
+                "engine.restore", now - dt, now,
+                parent=s.req.trace_ctx,
+                attrs={
+                    "request_id": s.req.request_id,
+                    "slot": b,
+                    "kv_tokens": snap.length,
+                    "preempted_s": round(now - snap.preempted_at, 3),
+                },
+            )
+        log.info(
+            "restored req %s into slot %d (%d tokens) after %.1f s off-device",
+            s.req.request_id[:8], b, snap.length,
+            time.time() - snap.preempted_at,
+        )
 
     def _run(self) -> None:
         """Pipelined decode loop (depth 1): the next decode round is DISPATCHED
@@ -1318,6 +1638,29 @@ class GenerationEngine:
             if self.stalled:
                 self.stalled = False
                 log.warning("engine loop resumed; clearing stall flag")
+            if self._pool is not None and self._preempt_wanted():
+                # Preemption needs committed-exact host mirrors: lengths
+                # advance optimistically at dispatch and last_tok updates at
+                # fetch, so drain the pipeline first (the spec-round drain
+                # pattern below) before snapshotting the victim's rows.
+                if pending is not None:
+                    timed("emit", self._emit_round, pending)
+                    pending = None
+                ok = True
+                while inflight:
+                    disp = inflight.popleft()
+                    try:
+                        fetched = timed("fetch", self._complete_round, disp)
+                    except Exception as e:
+                        inflight.appendleft(disp)
+                        drain_failed(e)
+                        ok = False
+                        break
+                    timed("emit", self._emit_round, fetched)
+                if ok and self._preempt_wanted():
+                    # re-check: the drain may have finished slots, making a
+                    # free slot appear without any eviction
+                    self._preempt_one()
             # dispatchable = active rows whose next K writes still fit. Rows
             # at the cap wait (un-dispatched) for their in-flight round's
             # fetch, where the fast-scan cap rule finishes them.
@@ -1467,6 +1810,11 @@ class GenerationEngine:
 
     def _admit_pending(self) -> bool:
         admitted = False
+        if self._pool is not None and self._pool.has_preempted():
+            # offloaded snapshots re-enter ahead of the queue (subject to
+            # the fairness/aging rule inside) — they already spent their
+            # prefill and hold committed tokens
+            admitted = self._restore_pending() or admitted
         while True:
             batch: list[tuple[int, GenRequest, list[int]]] = []
             # prefix-cache hits grouped by entry: one fused row-copy
@@ -2340,6 +2688,10 @@ class GenerationEngine:
                 # K queue events (and K SSE frames) adds overhead with zero
                 # client-visible timing difference
                 s.req.out.put({"type": "token", "text": "".join(parts)})
+                if self._pool is not None:
+                    # the "idle" preemption policy's victim signal; guarded
+                    # so the pool-off hot path writes nothing
+                    s.last_emit = time.time()
             if finish is not None:
                 self._finish_slot(b, s, finish)
         with self.stats_lock:

@@ -1,0 +1,269 @@
+"""HBM-aware KV pool: admission accounting, preemption policy, host offload.
+
+The engines own a static `[layers, max_slots, heads, max_seq_len, head_dim]`
+KV cache (plus int8-dict and MLA-latent variants) sized at construction; a
+slot is pinned for a request's whole life and an overloaded engine simply
+starves its admission queue. This module adds the memory-manager layer in the
+style of vLLM's PagedAttention pool (Kwon et al., 2023) and Sarathi-Serve's
+SLO-aware admission, without repaginating the cache:
+
+  - **Accounting**: bytes per slot are measured from the live cache pytree
+    (`pytree_nbytes`), so kv8's `{q: int8, s: scale}` dict and MLA's
+    asymmetric latent k/v layouts are covered without layout-specific code.
+  - **Admission**: `admit_ok(offered)` compares offered load (active slots +
+    queued + preempted) against `watermark × max_slots`. Above the
+    watermark the API sheds (429 + Retry-After) instead of queueing work
+    that cannot run.
+  - **Preemption**: `pick_victim` orders candidates by policy — "priority"
+    (lowest priority, then longest-idle, then most-tokens-remaining),
+    "idle" (longest-idle first), "tokens" (most-remaining first). The
+    engine snapshots the victim's committed KV rows to host memory
+    (`jax.device_get` of a dynamic slice — exact by the committed-lengths
+    invariant: rows past the committed length are dead and rewritten in
+    place), frees the slot, and later restores via `device_put` + the
+    `_insert_row` donation path. Greedy output is token-identical across a
+    preempt/restore cycle (pinned by tests/test_memory_pool.py).
+
+The pool itself is pure host-side bookkeeping — no jax imports, no device
+calls — so the engines keep every device interaction in their own dispatch
+paths and `TPU_KV_HOST_OFFLOAD=0` (pool never constructed) stays a true
+no-op. All mutating entry points take an internal lock: the engine thread
+mutates while API threads read `stats()`/`admission` concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+__all__ = ["KVPool", "KVSnapshot", "pytree_nbytes", "bucket_len"]
+
+POLICIES = ("priority", "idle", "tokens")
+
+# Thrash guards: at most one preemption per interval, and restores are
+# aged past fairness after this many multiples of the scheduler's TTFT
+# target (a low-priority snapshot cannot starve forever behind a stream
+# of high-priority arrivals, and vice versa).
+PREEMPT_MIN_INTERVAL_S = 1.0
+RESTORE_AGING_TTFT_MULT = 2.0
+
+
+def pytree_nbytes(tree: Any) -> int:
+    """Total bytes of every array leaf in a nested dict/list/tuple pytree.
+
+    Layout-agnostic HBM accounting: covers bf16 `[L,B,H,S,hd]`, the kv8
+    `{"q": int8, "s": scale}` dict, and MLA's asymmetric latent k/v without
+    enumerating layouts. Leaves only need `.size` and `.dtype.itemsize`
+    (numpy and jax arrays both qualify)."""
+    if isinstance(tree, dict):
+        return sum(pytree_nbytes(v) for v in tree.values())
+    if isinstance(tree, (list, tuple)):
+        return sum(pytree_nbytes(v) for v in tree)
+    size = getattr(tree, "size", None)
+    dtype = getattr(tree, "dtype", None)
+    if size is None or dtype is None:
+        return 0
+    return int(size) * int(dtype.itemsize)
+
+
+def bucket_len(length: int, max_seq_len: int) -> int:
+    """Power-of-two snapshot bucket >= length, capped at max_seq_len.
+
+    Snapshot/restore traffic reuses the engines' pow2 executable buckets so
+    a preempt/restore cycle compiles at most one slice shape per bucket
+    instead of one per request length."""
+    b = 1
+    while b < length:
+        b *= 2
+    return max(1, min(b, max_seq_len))
+
+
+@dataclass
+class KVSnapshot:
+    """A preempted slot's exact host-side state.
+
+    `k_rows`/`v_rows` hold the committed KV rows `[0, bucket)` (host numpy,
+    possibly a dict for kv8). Restore may write the whole bucket back: rows
+    in `[length, bucket)` are dead by the committed-lengths invariant — the
+    first post-restore decode round overwrites position `length` before any
+    read attends to it."""
+
+    req_id: str
+    priority: int
+    length: int
+    bucket: int
+    last_tok: int
+    temperature: float
+    top_k: int
+    top_p: float
+    k_rows: Any
+    v_rows: Any
+    nbytes: int
+    preempted_at: float
+    slot_obj: Any = None  # the engine's live slot record, reinstalled on restore
+    # SliceEngine protocol: every process stores its own host copy of the
+    # rows keyed by this id, so the "restore" command ships (slot, snap_id)
+    # instead of the KV payload over the command channel. -1 = single-host.
+    snap_id: int = -1
+
+
+class KVPool:
+    def __init__(
+        self,
+        *,
+        max_slots: int,
+        max_seq_len: int,
+        bytes_per_slot: int,
+        watermark: float = 1.5,
+        policy: str = "priority",
+        max_preempted: int | None = None,
+    ):
+        if policy not in POLICIES:
+            raise ValueError(f"unknown preempt policy {policy!r}; expected one of {POLICIES}")
+        self.max_slots = int(max_slots)
+        self.max_seq_len = int(max_seq_len)
+        self.bytes_per_slot = int(bytes_per_slot)
+        self.watermark = max(1.0, float(watermark))
+        self.policy = policy
+        # bound host memory: never hold more offloaded snapshots than slots
+        self.max_preempted = int(max_preempted) if max_preempted else self.max_slots
+        self._lock = threading.Lock()
+        self._snaps: list[KVSnapshot] = []
+        self._last_preempt_at = 0.0
+        # cumulative counters (engines_info bridges deltas into Prometheus)
+        self.preempted_total = 0
+        self.restored_total = 0
+        self.shed_total = 0
+        self.offload_bytes_total = 0
+        self.offload_seconds_total = 0.0
+        self.restore_seconds_total = 0.0
+
+    # -- accounting --------------------------------------------------------
+
+    def hbm_bytes(self) -> int:
+        return self.max_slots * self.bytes_per_slot
+
+    def admit_ok(self, offered: int) -> bool:
+        """True while offered load (active + queued + preempted) is under
+        the oversubscription watermark. Side-effect free — callers that act
+        on a shed decision record it via `note_shed()`."""
+        return offered < self.watermark * self.max_slots
+
+    def headroom(self, offered: int) -> float:
+        """Fraction of shed-free capacity remaining, in [0, 1]. Advertised
+        through device tags so the router de-ranks saturated devices."""
+        cap = self.watermark * self.max_slots
+        if cap <= 0:
+            return 0.0
+        return max(0.0, min(1.0, 1.0 - offered / cap))
+
+    # -- preemption policy -------------------------------------------------
+
+    def may_preempt(self, now: float | None = None) -> bool:
+        """Rate limit + host-memory bound; side-effect free."""
+        now = time.time() if now is None else now
+        with self._lock:
+            if len(self._snaps) >= self.max_preempted:
+                return False
+            return now - self._last_preempt_at >= PREEMPT_MIN_INTERVAL_S
+
+    def pick_victim(self, candidates: list[dict]) -> dict | None:
+        """Choose the slot to evict. Each candidate dict carries `priority`
+        (int), `last_activity` (monotonic-ish seconds), `tokens_remaining`
+        (int), plus any engine-side handle keys (`slot`, ...). Returns the
+        chosen candidate unmodified, or None when empty."""
+        if not candidates:
+            return None
+        if self.policy == "idle":
+            key = lambda c: (c["last_activity"], c["priority"], -c["tokens_remaining"])
+        elif self.policy == "tokens":
+            key = lambda c: (-c["tokens_remaining"], c["priority"], c["last_activity"])
+        else:  # "priority": lowest priority, then longest-idle, then most-remaining
+            key = lambda c: (c["priority"], c["last_activity"], -c["tokens_remaining"])
+        return min(candidates, key=key)
+
+    # -- offload / restore bookkeeping --------------------------------------
+
+    def offload(self, snap: KVSnapshot, seconds: float = 0.0) -> None:
+        with self._lock:
+            self._snaps.append(snap)
+            self._last_preempt_at = max(self._last_preempt_at, snap.preempted_at)
+            self.preempted_total += 1
+            self.offload_bytes_total += int(snap.nbytes)
+            self.offload_seconds_total += max(0.0, float(seconds))
+
+    def preempted_count(self) -> int:
+        with self._lock:
+            return len(self._snaps)
+
+    def has_preempted(self) -> bool:
+        return self.preempted_count() > 0
+
+    def peek_restore(self) -> KVSnapshot | None:
+        """The snapshot next in line for restore (highest priority, then
+        longest-preempted), without removing it."""
+        with self._lock:
+            if not self._snaps:
+                return None
+            return min(self._snaps, key=lambda s: (-s.priority, s.preempted_at))
+
+    def pop_restore(self) -> KVSnapshot | None:
+        with self._lock:
+            if not self._snaps:
+                return None
+            snap = min(self._snaps, key=lambda s: (-s.priority, s.preempted_at))
+            self._snaps.remove(snap)
+            return snap
+
+    def requeue(self, snap: KVSnapshot) -> None:
+        """Put back a popped snapshot untouched (restore deferred by the
+        fairness rule or by a missing free slot) — no counter moves."""
+        with self._lock:
+            self._snaps.append(snap)
+
+    def discard(self, snap: KVSnapshot) -> None:
+        """Drop a snapshot without restoring (owner aborted/finished)."""
+        with self._lock:
+            try:
+                self._snaps.remove(snap)
+            except ValueError:
+                pass
+
+    def note_restored(self, snap: KVSnapshot, seconds: float = 0.0) -> None:
+        with self._lock:
+            self.restored_total += 1
+            self.restore_seconds_total += max(0.0, float(seconds))
+
+    def note_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self.shed_total += int(n)
+
+    def drain(self) -> list[KVSnapshot]:
+        """Remove and return every held snapshot (abort/shutdown paths: the
+        engine errors each snapshot's waiter)."""
+        with self._lock:
+            snaps, self._snaps = self._snaps, []
+            return snaps
+
+    # -- telemetry -----------------------------------------------------------
+
+    def stats(self) -> dict[str, float]:
+        with self._lock:
+            held = len(self._snaps)
+            held_bytes = sum(int(s.nbytes) for s in self._snaps)
+            return {
+                "policy_" + self.policy: 1.0,  # which policy is live, greppable
+                "watermark": float(self.watermark),
+                "hbm_bytes": float(self.hbm_bytes()),
+                "bytes_per_slot": float(self.bytes_per_slot),
+                "preempted_held": float(held),
+                "preempted_held_bytes": float(held_bytes),
+                "preempted_total": float(self.preempted_total),
+                "restored_total": float(self.restored_total),
+                "shed_total": float(self.shed_total),
+                "offload_bytes_total": float(self.offload_bytes_total),
+                "offload_seconds_total": self.offload_seconds_total,
+                "restore_seconds_total": self.restore_seconds_total,
+            }

@@ -142,6 +142,23 @@ class TokenBudgetScheduler:
         self.last_budget = budget
         return budget
 
+    def drain_estimate_s(
+        self,
+        n_waiting: int,
+        mean_tokens: float,
+        decode_chunk: int,
+        max_slots: int,
+    ) -> float:
+        """EMA-costed estimate of seconds until `n_waiting` queued requests
+        could start: waves of `max_slots` requests, each running
+        `mean_tokens / decode_chunk` decode rounds at the observed round
+        EMA. Feeds the API's shed path (`Retry-After` on 429) — a coarse
+        but finite, self-tuning number beats a constant."""
+        waves = math.ceil(max(1, int(n_waiting)) / max(1, int(max_slots)))
+        rounds = max(1.0, float(mean_tokens) / max(1, int(decode_chunk)))
+        round_s = self.decode_round_s if self.decode_round_s > 0 else 0.05
+        return waves * rounds * round_s
+
     def stats(self) -> dict[str, float]:
         return {
             "prefill_token_budget": float(self.last_budget),

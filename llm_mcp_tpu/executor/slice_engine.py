@@ -74,6 +74,13 @@ from ..models.llama import llama_prefill_chunk_batch
 from ..ops.sampling import sample_tokens, spec_verify
 from .common import pow2_bucket
 from .drafter import NGramDrafter
+from .memory import (
+    KVPool,
+    KVSnapshot,
+    RESTORE_AGING_TTFT_MULT,
+    bucket_len,
+    pytree_nbytes,
+)
 from .scheduler import TokenBudgetScheduler
 from .tokenizer import Tokenizer, load_tokenizer
 
@@ -196,6 +203,9 @@ class SliceRequest:
     top_k: int = 0
     top_p: float = 1.0
     stop: list[str] = field(default_factory=list)
+    # KV-pool preemption rank (memory.py): higher survives longer; only
+    # read when TPU_KV_HOST_OFFLOAD is on (GenRequest parity)
+    priority: int = 0
     out: "queue.Queue[Any]" = field(default_factory=queue.Queue)
 
 
@@ -207,6 +217,9 @@ class _Slot:
     text: str = ""
     pending: bytes = b""
     spec: Any = None  # NGramDrafter when speculation is on (leader-only)
+    # KV pool victim signals (stamped only when the pool is on)
+    active_at: float = 0.0
+    last_emit: float = 0.0
 
 
 @dataclass
@@ -438,10 +451,63 @@ class SliceEngine:
             )
             return n_acc, final, ck, cv
 
+        # KV pool preempt/restore (memory.py), mirrored as leader commands.
+        # Both jits are built in EVERY process (identical by the same
+        # contract as every other constructor argument) and trace lazily —
+        # a slice that never preempts compiles neither.
+
+        @partial(jax.jit, static_argnames=("bucket",),
+                 out_shardings=(repl, repl))
+        def snapshot_fn(ck, cv, slot, bucket):
+            """A slot's committed KV rows [0, bucket), REPLICATED so every
+            process device_gets its own full host copy (the restore command
+            then ships only (slot, snap_id) — no KV over the channel). No
+            donation: the cache stays live for the next round."""
+
+            def cut(c):
+                return jax.lax.dynamic_slice(
+                    c, (0, slot, 0, 0, 0),
+                    (c.shape[0], 1, c.shape[2], bucket, c.shape[4]),
+                )
+
+            return cut(ck), cut(cv)
+
+        @partial(jax.jit, donate_argnums=(0, 1), out_shardings=cache_out)
+        def restore_fn(ck, cv, pk, pv, slot):
+            """Write a snapshot's rows back into `slot` (the admit insert
+            path, single-row flavor). Writing the full pow2 bucket is exact:
+            rows past the committed length are dead and the first
+            post-restore decode round overwrites position `length` before
+            any read attends there."""
+            start = (0, slot, 0, 0, 0)
+            ck = jax.lax.dynamic_update_slice(ck, pk.astype(ck.dtype), start)
+            cv = jax.lax.dynamic_update_slice(cv, pv.astype(cv.dtype), start)
+            return ck, cv
+
         self._decode_fn = decode_fn
         self._admit_fn = admit_fn
         self._chunk_fn = chunk_fn
         self._verify_fn = verify_fn
+        self._snapshot_fn = snapshot_fn
+        self._restore_fn = restore_fn
+        # per-process host copies of offloaded rows, keyed by snap_id (the
+        # follower side of the mirrored preempt/restore commands; the leader
+        # keeps its copy here too)
+        self._snaps: dict[int, tuple[Any, Any]] = {}
+        self._snap_ctr = 0
+        # Leader-side admission/preemption policy: same KVPool as
+        # GenerationEngine. TPU_KV_HOST_OFFLOAD=0 (default) never
+        # constructs it — the leader loop's pool hooks are all guarded.
+        self._pool: KVPool | None = None
+        if os.environ.get("TPU_KV_HOST_OFFLOAD", "0") not in ("", "0", "false", "no", "off"):
+            self._pool = KVPool(
+                max_slots=max_slots,
+                max_seq_len=max_seq_len,
+                bytes_per_slot=pytree_nbytes({"k": self._ck, "v": self._cv})
+                // max(1, max_slots),
+                watermark=float(os.environ.get("TPU_ADMIT_WATERMARK", "") or 1.5),
+                policy=os.environ.get("TPU_PREEMPT_POLICY", "") or "priority",
+            )
 
         # leader-side bookkeeping
         self._queue: "queue.Queue[Any]" = queue.Queue()
@@ -565,6 +631,25 @@ class SliceEngine:
                             starts, nvalid, drafts, ndraft, temps, topks,
                             topps, ctr, int(skey),
                         )
+                elif op == "preempt":
+                    # KV-pool offload: slice the victim's committed rows
+                    # (replicated) and keep a HOST copy keyed by snap_id —
+                    # the matching "restore" ships no KV payload
+                    _, slot, bucket, snap_id = cmd
+                    with self.mesh:
+                        kr, vr = self._snapshot_fn(
+                            self._ck, self._cv, np.int32(slot), int(bucket)
+                        )
+                    self._snaps[int(snap_id)] = (
+                        jax.device_get(kr), jax.device_get(vr)
+                    )
+                elif op == "restore":
+                    _, slot, snap_id = cmd
+                    kr, vr = self._snaps.pop(int(snap_id))
+                    with self.mesh:
+                        self._ck, self._cv = self._restore_fn(
+                            self._ck, self._cv, kr, vr, np.int32(slot)
+                        )
                 else:  # pragma: no cover
                     raise ValueError(f"unknown slice command {op!r}")
         finally:
@@ -604,11 +689,12 @@ class SliceEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         stop: list[str] | None = None,
+        priority: int = 0,
     ) -> Iterator[dict[str, Any]]:
         ids = self.tokenizer.encode(prompt)
         req = SliceRequest(
             prompt_ids=ids, max_tokens=max_tokens, temperature=temperature,
-            top_k=top_k, top_p=top_p, stop=stop or [],
+            top_k=top_k, top_p=top_p, stop=stop or [], priority=priority,
         )
         req._t0 = time.time()  # type: ignore[attr-defined]
         self.submit(req)
@@ -679,6 +765,48 @@ class SliceEngine:
             "tok_per_call": (self.spec_emitted / calls) if calls else 0.0,
         }
 
+    def _offered_load(self) -> int:
+        return (
+            self.slots_in_use()
+            + len(self._prefills)
+            + self._queue.qsize()
+            + (self._pool.preempted_count() if self._pool is not None else 0)
+        )
+
+    def memory_stats(self) -> dict[str, float]:
+        """KV pool observability (GenerationEngine parity)."""
+        pool = self._pool
+        if pool is None:
+            return {"enabled": 0.0}
+        out = pool.stats()
+        out["enabled"] = 1.0
+        offered = self._offered_load()
+        out["offered"] = float(offered)
+        out["headroom"] = pool.headroom(offered)
+        return out
+
+    def admission_state(self) -> tuple[bool, float]:
+        """(shed, retry_after_s) — side-effect free (GenerationEngine
+        parity; see engine.admission_state)."""
+        pool = self._pool
+        if pool is None:
+            return False, 0.0
+        offered = self._offered_load()
+        if pool.admit_ok(offered):
+            return False, 0.0
+        mean_tokens = (
+            self.total_tokens / self.total_requests if self.total_requests else 64.0
+        )
+        n_waiting = self._queue.qsize() + pool.preempted_count()
+        retry = self._sched.drain_estimate_s(
+            max(1, n_waiting), mean_tokens, self.decode_chunk, self.max_slots
+        )
+        return True, min(600.0, max(1.0, retry))
+
+    def note_shed(self, n: int = 1) -> None:
+        if self._pool is not None:
+            self._pool.note_shed(n)
+
     def ttft_percentiles(self) -> tuple[float, float, int]:
         if not self._ttfts:
             return 0.0, 0.0, 0
@@ -719,6 +847,136 @@ class SliceEngine:
             if s is None and i not in self._prefills
         ]
 
+    # -- KV pool: preemption with host offload (leader-side policy) --------
+
+    def _aging_s(self) -> float:
+        return RESTORE_AGING_TTFT_MULT * self.target_ttft_ms / 1000.0
+
+    def _peek_queue_head(self) -> SliceRequest | None:
+        # the leader loop is the queue's only consumer, so peeking is stable
+        try:
+            return self._queue.queue[0]
+        except IndexError:
+            return None
+
+    def _maybe_preempt(self) -> bool:
+        """At most one eviction per loop iteration, mirrored as a "preempt"
+        command: every process slices the victim's committed rows and keeps
+        its own host copy under snap_id. The loop is fully synchronous, so
+        _lens/_toks are committed-exact — no pipeline drain needed (the
+        single-host engine's extra step)."""
+        pool = self._pool
+        if self._queue.empty() or not pool.may_preempt():
+            return False
+        live = [
+            (b, s) for b, s in enumerate(self._slots) if s is not None
+        ]
+        if not live or self._free_slots():
+            return False
+        head = self._peek_queue_head()
+        if head is None:
+            return False
+        min_pri = min(s.req.priority for _, s in live)
+        head_t0 = getattr(head, "_t0", None)
+        aged = head_t0 is not None and time.time() - head_t0 > self._aging_s()
+        if head.priority <= min_pri and not aged:
+            return False
+        victim = pool.pick_victim([
+            {
+                "slot": b,
+                "priority": s.req.priority,
+                "last_activity": s.last_emit or s.active_at,
+                "tokens_remaining": max(0, s.req.max_tokens - s.generated),
+            }
+            for b, s in live
+        ])
+        if victim is None:
+            return False
+        b = victim["slot"]
+        s = self._slots[b]
+        L = int(self._lens[b])
+        Lb = bucket_len(L, self.max_seq_len)
+        snap_id = self._snap_ctr
+        self._snap_ctr += 1
+        t0 = time.perf_counter()
+        cmd = ("preempt", np.int32(b), np.int32(Lb), np.int32(snap_id))
+        if self._leader_ch is not None:
+            self._leader_ch.send(cmd)
+        with self.mesh:
+            kr, vr = self._snapshot_fn(
+                self._ck, self._cv, np.int32(b), int(Lb)
+            )
+        rows = (jax.device_get(kr), jax.device_get(vr))
+        dt = time.perf_counter() - t0
+        self._snaps[snap_id] = rows
+        snap = KVSnapshot(
+            req_id="",
+            priority=s.req.priority,
+            length=L,
+            bucket=Lb,
+            last_tok=int(self._toks[b]),
+            temperature=float(self._temps[b]),
+            top_k=int(self._topks[b]),
+            top_p=float(self._topps[b]),
+            k_rows=None,  # rows live in _snaps[snap_id] on EVERY process
+            v_rows=None,
+            nbytes=pytree_nbytes(rows[0]) + pytree_nbytes(rows[1]),
+            preempted_at=time.time(),
+            slot_obj=s,
+            snap_id=snap_id,
+        )
+        pool.offload(snap, dt)
+        # release the slot WITHOUT terminal events (the request is
+        # suspended); the stale length mirror is harmless — decode rounds
+        # exclude the row via active0, and restore rewrites the rows
+        self._slots[b] = None
+        log.info(
+            "slice preempted slot %d (%d tokens, %.1f MB, snap %d)",
+            b, L, snap.nbytes / (1 << 20), snap_id,
+        )
+        return True
+
+    def _maybe_restore(self) -> bool:
+        """Restore at most one offloaded snapshot into a free slot,
+        mirrored as a "restore" command carrying only (slot, snap_id)."""
+        pool = self._pool
+        if not pool.has_preempted():
+            return False
+        free = self._free_slots()
+        if not free:
+            return False
+        snap = pool.pop_restore()
+        if snap is None:
+            return False
+        s = snap.slot_obj
+        head = self._peek_queue_head()
+        aged = time.time() - snap.preempted_at > self._aging_s()
+        if head is not None and head.priority >= snap.priority and not aged:
+            pool.requeue(snap)
+            return False
+        b = free[0]
+        t0 = time.perf_counter()
+        cmd = ("restore", np.int32(b), np.int32(snap.snap_id))
+        if self._leader_ch is not None:
+            self._leader_ch.send(cmd)
+        kr, vr = self._snaps.pop(snap.snap_id)
+        with self.mesh:
+            self._ck, self._cv = self._restore_fn(
+                self._ck, self._cv, kr, vr, np.int32(b)
+            )
+        self._slots[b] = s
+        self._toks[b] = snap.last_tok
+        self._lens[b] = snap.length
+        self._temps[b] = snap.temperature
+        self._topks[b] = snap.top_k
+        self._topps[b] = snap.top_p
+        pool.note_restored(snap, time.perf_counter() - t0)
+        log.info(
+            "slice restored snap %d into slot %d (%d tokens) after %.1f s",
+            snap.snap_id, b, snap.length, time.time() - snap.preempted_at,
+        )
+        return True
+
     def _drain_requests(self, msg: str) -> None:
         """Fail every active slot, mid-prefill reservation, and queued
         request with a terminal event. Caller holds _dead_lock (both the
@@ -735,6 +993,15 @@ class SliceEngine:
             st.req.out.put(_DONE)
         self._prefills.clear()
         self._prefill_q.clear()
+        if self._pool is not None:
+            # preempted-and-offloaded requests wait on a restore that will
+            # never come — their consumers must not hang either
+            for snap in self._pool.drain():
+                s = snap.slot_obj
+                if s is not None:
+                    s.req.out.put({"type": "error", "error": msg})
+                    s.req.out.put(_DONE)
+            self._snaps.clear()
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -746,7 +1013,15 @@ class SliceEngine:
     def _engine_loop(self) -> None:
         try:
             while not self._shutdown.is_set():
+                pooled = False
+                if self._pool is not None:
+                    # budgeted: at most ONE restore then ONE preempt per
+                    # iteration, mirrored to followers as commands — pool
+                    # traffic never crowds out the decode cadence
+                    pooled = self._maybe_restore()
                 admitted = self._try_admit()
+                if self._pool is not None and self._maybe_preempt():
+                    pooled = True
                 # stage speculation FIRST so its chunk positions can be
                 # reserved out of this iteration's prefill token budget
                 # (verify rides the same chunk machinery as prompt chunks)
@@ -763,7 +1038,7 @@ class SliceEngine:
                     decoded = self._try_verify(spec_entries)
                 else:
                     decoded = self._try_decode()
-                if not (admitted or prefilled or decoded):
+                if not (admitted or prefilled or decoded or pooled):
                     if self._leader_ch is not None:
                         self._leader_ch.ping_if_idle()
                     time.sleep(0.002)
@@ -861,7 +1136,7 @@ class SliceEngine:
             raise
         now = time.time()
         for i, (b, r, ids) in enumerate(batch):
-            slot = _Slot(req=r, prompt_len=int(lengths[i]))
+            slot = _Slot(req=r, prompt_len=int(lengths[i]), active_at=now)
             if self.spec_enabled:
                 # seed the drafter with the prompt BEFORE the first emit so
                 # tok0 lands on top of the prompt history
@@ -999,7 +1274,7 @@ class SliceEngine:
             ))[0])
             self._prefill_q.remove(slot)
             del self._prefills[slot]
-            new_slot = _Slot(req=r, prompt_len=len(st.ids))
+            new_slot = _Slot(req=r, prompt_len=len(st.ids), active_at=now)
             if self.spec_enabled:
                 new_slot.spec = NGramDrafter(
                     self.spec_min_ngram, self.spec_max_ngram
@@ -1210,6 +1485,8 @@ class SliceEngine:
                     break
             if text and finish is None:
                 req.out.put({"type": "token", "text": text})
+                if self._pool is not None:
+                    slot.last_emit = time.time()
         if finish is None and slot.generated >= req.max_tokens:
             finish = "length"
         if finish is not None:

@@ -52,6 +52,17 @@ class DeviceLimitSpec:
         }
 
 
+def device_headroom(tags: dict | None) -> float:
+    """Shed-free KV-pool headroom a device advertises in its `kv_headroom`
+    tag (server.register_local_device), in [0, 1]. Devices without the tag
+    (no pool, older executors) read as 1.0 — fully admittable — so the
+    router's saturation de-ranking only ever acts on devices that opted in."""
+    try:
+        return float((tags or {}).get("kv_headroom", 1.0))
+    except (TypeError, ValueError):
+        return 1.0
+
+
 def derive_device_limits(hbm_gb: float, chips: int = 1) -> DeviceLimitSpec:
     """HBM budget → capability caps for a TPU device (slice).
 

@@ -26,7 +26,7 @@ from ..state.db import Database
 from ..telemetry import tracing
 from ..utils.config import getenv
 from .circuit import CircuitBreaker
-from .limits import LimitsEngine
+from .limits import LimitsEngine, device_headroom
 
 log = logging.getLogger("router")
 
@@ -200,6 +200,14 @@ class Router:
         )
         model_row = self.catalog.get_model(model) if self.catalog else None
         ctx_k = int(model_row["context_k"]) if model_row else 0
+        # Saturated devices (kv_headroom tag ≤ 0: their KV pool is at the
+        # shed watermark and new requests would 429) rank behind everything
+        # else regardless of benchmark tps. Stable sort keeps the SQL
+        # tps/latency/freshness order within each class, so a saturated
+        # device is still reachable when it's the only one with the model.
+        rows = sorted(
+            rows, key=lambda r: device_headroom(Database.from_json(r["tags"], {})) <= 0.0
+        )
         for r in rows:
             dev_id = r["id"]
             if not self.circuit.allow(dev_id):

@@ -160,6 +160,35 @@ class Metrics:
             ["engine"],
             registry=r,
         )
+        # HBM-aware KV pool (executor/memory.py, TPU_KV_HOST_OFFLOAD):
+        # headroom is the fraction of shed-free capacity left (0 = the API
+        # is shedding); the counters are advanced by delta from the engines'
+        # cumulative totals in api/server.py engines_info, like the
+        # scheduler/speculation bridges above.
+        self.kv_pool_headroom = Gauge(
+            "llmtpu_kv_pool_headroom",
+            "Fraction of admission capacity remaining before load shedding",
+            ["engine"],
+            registry=r,
+        )
+        self.kv_preempted = Counter(
+            "llmtpu_kv_preempt_total",
+            "Slots preempted and offloaded to host memory",
+            ["engine"],
+            registry=r,
+        )
+        self.kv_restored = Counter(
+            "llmtpu_kv_restore_total",
+            "Preempted slots restored from host memory",
+            ["engine"],
+            registry=r,
+        )
+        self.kv_shed = Counter(
+            "llmtpu_kv_shed_total",
+            "Requests shed above the admission watermark (429 or deferred claim)",
+            ["engine"],
+            registry=r,
+        )
 
     def render(self) -> tuple[bytes, str]:
         return generate_latest(self.registry), CONTENT_TYPE_LATEST

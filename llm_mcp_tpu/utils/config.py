@@ -54,7 +54,6 @@ class Config:
     http_addr: str = field(default_factory=lambda: getenv("CORE_HTTP_ADDR", ":8080"))
     grpc_addr: str = field(default_factory=lambda: getenv("CORE_GRPC_ADDR", ":9090"))
     db_path: str = field(default_factory=lambda: getenv("DB_PATH", "llmmcp.sqlite3"))
-    db_dsn: str = field(default_factory=lambda: getenv("DB_DSN", ""))
 
     # Discovery
     discovery_interval_s: int = field(default_factory=lambda: getenv_int("DISCOVERY_INTERVAL", 60))
@@ -151,6 +150,32 @@ class Config:
     tpu_spec: bool = field(default_factory=lambda: getenv("TPU_SPEC", "1") != "0")
     tpu_spec_k: int = field(default_factory=lambda: getenv_int("TPU_SPEC_K", 7))
     tpu_spec_min_ngram: int = field(default_factory=lambda: getenv_int("TPU_SPEC_MIN_NGRAM", 2))
+    # HBM-aware KV pool (executor/memory.py): TPU_KV_HOST_OFFLOAD=1 enables
+    # slot preemption with host offload + watermark admission; default off is
+    # a true no-op (the pool is never constructed — byte-identical scheduler
+    # decisions vs the pool-less engine). TPU_ADMIT_WATERMARK is the offered
+    # load multiple of max_slots above which the API sheds (429+Retry-After,
+    # deferred job claims); TPU_PREEMPT_POLICY ∈ priority|idle|tokens picks
+    # the eviction victim ordering. Engines read the env directly at
+    # construction (TPU_PIPELINE_DEPTH pattern); these fields surface the
+    # knobs in config dumps.
+    tpu_kv_host_offload: bool = field(default_factory=lambda: getenv_bool("TPU_KV_HOST_OFFLOAD"))
+    tpu_admit_watermark: float = field(default_factory=lambda: getenv_float("TPU_ADMIT_WATERMARK", 1.5))
+    tpu_preempt_policy: str = field(default_factory=lambda: getenv("TPU_PREEMPT_POLICY", "priority"))
+    # extra local API ports for discovery probing (comma-separated; the
+    # OLLAMA_PORTS pattern) — multiple executor processes on one host get
+    # probed automatically instead of only the pinned self port
+    tpu_extra_ports: str = field(default_factory=lambda: getenv("TPU_EXTRA_PORTS", ""))
+
+    def __post_init__(self) -> None:
+        # DB_DSN was documented but never read by any backend (the store is
+        # sqlite at DB_PATH, full stop). A silently inert knob is an operator
+        # trap — fail loud instead of letting a configured DSN be ignored.
+        if os.environ.get("DB_DSN", ""):
+            raise RuntimeError(
+                "DB_DSN is set but unsupported: the only storage backend is "
+                "sqlite at DB_PATH. Unset DB_DSN (or set DB_PATH) to proceed."
+            )
 
     def has_openai(self) -> bool:
         return bool(self.openai_api_key)

@@ -42,7 +42,9 @@ regressed always fails.
 
 from __future__ import annotations
 
+import glob
 import json
+import os
 import sys
 
 # relative tolerances (fraction of baseline)
@@ -57,6 +59,8 @@ HIGHER_BETTER = (
     "mean_completion_tokens",
     "spec_accept_rate",
     "spec_tok_per_call",
+    "embed_per_s_nomic-embed-text_b1_tpu",
+    "embed_per_s_qwen3-embedding-8b-int8_b64_d1024_tpu",
 )
 LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms")
 
@@ -71,6 +75,13 @@ ABS_MIN = {
     # pass is pure overhead over plain decode
     "spec_accept_rate": 0.05,
     "spec_tok_per_call": 1.0,
+    # embedding throughput drifted down unnoticed across rounds (nomic b1
+    # 9.3 → 7.9 /s, qwen3-8b-int8 b64 98 → 90.5 /s between r4 and r5);
+    # these floors are well under the worst observed value — they catch a
+    # collapse (broken kernel path, silent CPU fallback), while the
+    # cross-round best-prior warning in main() catches gradual drift
+    "embed_per_s_nomic-embed-text_b1_tpu": 6.5,
+    "embed_per_s_qwen3-embedding-8b-int8_b64_d1024_tpu": 80.0,
 }
 ABS_MAX = {"p95_ttft_ms": 5000.0, "window_errors": 0.0}
 
@@ -162,6 +173,27 @@ def check(cand: dict, base: dict) -> list[tuple[str, str, str]]:
     return results
 
 
+def best_prior_headline(candidate_path: str) -> tuple[float, str] | None:
+    """Best headline `value` among sibling BENCH_r*.json captures (excluding
+    the candidate itself). The pairwise baseline check only sees ONE prior
+    round — a slow leak (each round 10% under the last) passes every gate
+    while compounding; comparing against the best-ever round surfaces it."""
+    best: tuple[float, str] | None = None
+    pattern = os.path.join(os.path.dirname(os.path.abspath(candidate_path)), "BENCH_r*.json")
+    for path in sorted(glob.glob(pattern)):
+        if os.path.abspath(path) == os.path.abspath(candidate_path):
+            continue
+        try:
+            with open(path) as f:
+                rec = extract_record(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue
+        v = metric(rec, "value")
+        if v is not None and (best is None or v > best[0]):
+            best = (v, os.path.basename(path))
+    return best
+
+
 def main(argv: list[str]) -> int:
     if len(argv) != 2:
         print(__doc__)
@@ -188,6 +220,18 @@ def main(argv: list[str]) -> int:
         print(
             "perf_gate: WARNING metrics absent from candidate, not gated: "
             + ", ".join(skipped),
+            file=sys.stderr,
+        )
+    # cross-round drift check: warn (never fail — the best round may have
+    # run on beefier hardware) when the headline is >20% under the best
+    # prior BENCH_r*.json next to the candidate
+    prior = best_prior_headline(argv[0])
+    cand_value = metric(cand, "value")
+    if prior is not None and cand_value is not None and cand_value < 0.8 * prior[0]:
+        print(
+            f"perf_gate: WARNING headline value {cand_value:.1f} is "
+            f"{100 * (1 - cand_value / prior[0]):.0f}% below best prior round "
+            f"({prior[0]:.1f} in {prior[1]}) — cross-round drift",
             file=sys.stderr,
         )
     if failed:

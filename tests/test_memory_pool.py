@@ -1,0 +1,428 @@
+"""HBM-aware KV pool (executor/memory.py + engine/slice_engine wiring).
+
+Three layers of coverage:
+
+  1. KVPool unit semantics — accounting, watermark admission, victim
+     ordering per policy, restore ordering, thrash guards. Pure host-side,
+     no engine.
+  2. Engine integration on the CPU backend — a high-priority arrival
+     preempts a low-priority stream (offload → free → restore) and the
+     preempted stream's greedy output is TOKEN-IDENTICAL to an
+     uncontended run, for the bf16, int8-KV, MLA, and MLA+int8-latent
+     cache layouts. Plus the TPU_KV_HOST_OFFLOAD=0 no-op contract and a
+     threaded admit/preempt/finish soak asserting no deadlock and no slot
+     double-assignment.
+  3. SliceEngine mirrored-command variant — the same preempt/restore
+     cycle through the leader loop's budgeted "preempt"/"restore"
+     commands (single-process leader over the virtual dp×tp mesh).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_mcp_tpu.executor.memory import (
+    PREEMPT_MIN_INTERVAL_S,
+    KVPool,
+    KVSnapshot,
+    bucket_len,
+    pytree_nbytes,
+)
+
+
+# -- 1. pool unit semantics --------------------------------------------------
+
+
+def test_pytree_nbytes_layouts():
+    plain = np.zeros((2, 3, 4), np.float32)
+    assert pytree_nbytes(plain) == 2 * 3 * 4 * 4
+    kv8 = {"q": np.zeros((2, 8), np.int8), "s": np.zeros((2,), np.float32)}
+    assert pytree_nbytes(kv8) == 16 + 8
+    assert pytree_nbytes([plain, kv8]) == 96 + 24
+    assert pytree_nbytes({"a": (plain,), "b": None}) == 96
+
+
+def test_bucket_len_pow2():
+    assert bucket_len(0, 128) == 1
+    assert bucket_len(1, 128) == 1
+    assert bucket_len(3, 128) == 4
+    assert bucket_len(64, 128) == 64
+    assert bucket_len(65, 128) == 128
+    assert bucket_len(500, 128) == 128  # capped
+
+
+def _snap(priority=0, preempted_at=0.0, nbytes=0, slot_obj=None):
+    return KVSnapshot(
+        req_id="r", priority=priority, length=4, bucket=4, last_tok=1,
+        temperature=0.0, top_k=0, top_p=1.0, k_rows=None, v_rows=None,
+        nbytes=nbytes, preempted_at=preempted_at, slot_obj=slot_obj,
+    )
+
+
+def test_pool_admission_watermark():
+    pool = KVPool(max_slots=4, max_seq_len=128, bytes_per_slot=1000,
+                  watermark=1.5)
+    assert pool.hbm_bytes() == 4000
+    # capacity = 1.5 * 4 = 6 offered
+    assert pool.admit_ok(5)
+    assert not pool.admit_ok(6)
+    assert not pool.admit_ok(7)
+    assert pool.headroom(0) == 1.0
+    assert pool.headroom(6) == 0.0
+    assert 0.0 < pool.headroom(3) < 1.0
+    # watermark clamps to >= 1.0 (can never shed below full slots)
+    clamped = KVPool(max_slots=4, max_seq_len=128, bytes_per_slot=1,
+                     watermark=0.25)
+    assert clamped.admit_ok(3)
+    assert not clamped.admit_ok(4)
+
+
+def test_pool_rejects_unknown_policy():
+    with pytest.raises(ValueError):
+        KVPool(max_slots=2, max_seq_len=64, bytes_per_slot=1, policy="lru")
+
+
+def _cand(slot, pri, idle_at, remaining):
+    return {"slot": slot, "priority": pri, "last_activity": idle_at,
+            "tokens_remaining": remaining}
+
+
+def test_pick_victim_policy_priority():
+    pool = KVPool(max_slots=4, max_seq_len=64, bytes_per_slot=1,
+                  policy="priority")
+    assert pool.pick_victim([]) is None
+    cands = [_cand(0, 5, 10.0, 3), _cand(1, 0, 20.0, 3), _cand(2, 0, 10.0, 3)]
+    # lowest priority first, then longest-idle (smallest last_activity)
+    assert pool.pick_victim(cands)["slot"] == 2
+    # tie on priority+idle → most tokens remaining
+    cands = [_cand(0, 0, 10.0, 3), _cand(1, 0, 10.0, 9)]
+    assert pool.pick_victim(cands)["slot"] == 1
+
+
+def test_pick_victim_policy_idle_and_tokens():
+    idle = KVPool(max_slots=4, max_seq_len=64, bytes_per_slot=1, policy="idle")
+    cands = [_cand(0, 0, 5.0, 1), _cand(1, 9, 1.0, 1)]
+    assert idle.pick_victim(cands)["slot"] == 1  # idle ignores priority first
+    tok = KVPool(max_slots=4, max_seq_len=64, bytes_per_slot=1, policy="tokens")
+    cands = [_cand(0, 0, 1.0, 100), _cand(1, 0, 1.0, 5)]
+    assert tok.pick_victim(cands)["slot"] == 0  # most remaining evicts first
+
+
+def test_pool_restore_order_and_counters():
+    pool = KVPool(max_slots=4, max_seq_len=64, bytes_per_slot=1)
+    a = _snap(priority=0, preempted_at=1.0, nbytes=10)
+    b = _snap(priority=2, preempted_at=5.0, nbytes=20)
+    c = _snap(priority=2, preempted_at=3.0, nbytes=30)
+    for s in (a, b, c):
+        pool.offload(s, seconds=0.1)
+    assert pool.preempted_count() == 3
+    assert pool.preempted_total == 3
+    assert pool.offload_bytes_total == 60
+    # highest priority first, then longest-preempted among equals
+    assert pool.pop_restore() is c
+    assert pool.peek_restore() is b
+    assert pool.pop_restore() is b
+    pool.requeue(b)  # deferred restore puts it back without counter moves
+    assert pool.preempted_count() == 2
+    assert pool.restored_total == 0
+    pool.note_restored(b, seconds=0.2)
+    assert pool.restored_total == 1
+    pool.discard(a)
+    assert pool.preempted_count() == 1
+    st = pool.stats()
+    assert st["preempted_total"] == 3.0
+    assert st["preempted_held"] == 1.0
+    assert st["policy_priority"] == 1.0
+    assert pool.drain() == [b]
+    assert pool.preempted_count() == 0
+
+
+def test_pool_thrash_guards():
+    pool = KVPool(max_slots=2, max_seq_len=64, bytes_per_slot=1,
+                  max_preempted=1)
+    assert pool.may_preempt(now=100.0)
+    pool.offload(_snap(preempted_at=100.0))
+    # host-memory bound: max_preempted snapshots held
+    assert not pool.may_preempt(now=200.0)
+    pool.pop_restore()
+    # rate limit: one preemption per PREEMPT_MIN_INTERVAL_S
+    assert not pool.may_preempt(now=100.0 + PREEMPT_MIN_INTERVAL_S / 2)
+    assert pool.may_preempt(now=100.0 + PREEMPT_MIN_INTERVAL_S)
+
+
+def test_pool_shed_is_explicit():
+    pool = KVPool(max_slots=1, max_seq_len=64, bytes_per_slot=1, watermark=1.0)
+    assert not pool.admit_ok(1)
+    assert pool.shed_total == 0  # admit_ok is side-effect free
+    pool.note_shed()
+    pool.note_shed(2)
+    assert pool.shed_total == 3
+
+
+# -- 2. engine integration ---------------------------------------------------
+
+
+def _pooled_engine(monkeypatch, model="tiny-llm", **kw):
+    from llm_mcp_tpu.executor import GenerationEngine
+
+    monkeypatch.setenv("TPU_KV_HOST_OFFLOAD", "1")
+    kw.setdefault("max_slots", 2)
+    # 128, not 64: generations are ≤ 56 committed rows, and the cap must
+    # never bind — near max_seq_len the retire check can trip at different
+    # chunk boundaries across a preempt/restore, truncating the tail
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("dtype", jnp.float32)
+    kw.setdefault("decode_chunk", 4)
+    return GenerationEngine(model, **kw).start()
+
+
+def _preempt_cycle(eng, prompt="preempt me please", low_tokens=64):
+    """Fill both slots with low-priority greedy streams, fire one
+    high-priority request, wait for a full preempt → restore cycle, and
+    return the preempted-generation texts keyed by prompt.
+
+    Each low-priority client opens its own root span (the wire context is
+    thread-local), so the cycle also pins the engine.preempt /
+    engine.restore span names on the victim's trace."""
+    from llm_mcp_tpu.telemetry import tracing
+
+    tracer = tracing.get_tracer()
+    seen: list[str] = []
+    obs = lambda span: seen.append(span.name)
+    tracer.add_observer(obs)
+    results: dict[str, dict] = {}
+    lock = threading.Lock()
+
+    def low(p):
+        with tracer.span("test.preempt.root"):
+            r = eng.generate(p, max_tokens=low_tokens, temperature=0.0,
+                             priority=0)
+        with lock:
+            results[p] = r
+
+    try:
+        other = "second low priority stream"
+        threads = [
+            threading.Thread(target=low, args=(p,), daemon=True)
+            for p in (prompt, other)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 60
+        while eng.slots_in_use() < 2 and time.time() < deadline:
+            time.sleep(0.005)
+        assert eng.slots_in_use() == 2, "low-priority streams never filled slots"
+        hi = eng.generate("urgent request", max_tokens=8, temperature=0.0,
+                          priority=5)
+        assert hi["usage"]["completion_tokens"] >= 1
+        for t in threads:
+            t.join(timeout=120)
+    finally:
+        tracer.remove_observer(obs)
+    assert not any(t.is_alive() for t in threads), "preempted stream hung"
+    st = eng.memory_stats()
+    assert st["enabled"] == 1.0
+    assert st["preempted_total"] >= 1, "no preemption happened"
+    assert st["restored_total"] >= 1, "offloaded slot never restored"
+    assert st["preempted_held"] == 0.0
+    assert "engine.preempt" in seen
+    assert "engine.restore" in seen
+    return results
+
+
+@pytest.mark.parametrize(
+    "model,kv_quant",
+    [
+        ("tiny-llm", ""),        # bf16/f32 5-D cache
+        ("tiny-llm", "int8"),    # {"q": int8, "s": scale} dict cache
+        ("tiny-mla", ""),        # latent cache, asymmetric k/v last dims
+        ("tiny-mla", "int8"),    # int8 latents
+    ],
+)
+def test_preempt_restore_token_identical(monkeypatch, model, kv_quant):
+    """The acceptance bar: greedy output is token-identical across a
+    preempt → host offload → restore cycle, per cache layout."""
+    kw = {"kv_quant": kv_quant} if kv_quant else {}
+    eng = _pooled_engine(monkeypatch, model=model, **kw)
+    try:
+        prompt = f"token identity probe for {model}"
+        contended = _preempt_cycle(eng, prompt=prompt)
+        # uncontended reference on the same engine, same executables
+        ref = eng.generate(prompt, max_tokens=64, temperature=0.0)
+        assert contended[prompt]["text"] == ref["text"]
+        assert eng.total_errors == 0
+    finally:
+        eng.shutdown()
+
+
+def test_offload_disabled_is_noop(monkeypatch):
+    """TPU_KV_HOST_OFFLOAD=0 (and unset): no pool object exists, the
+    admission/memory surfaces report inert values, and generation runs the
+    pre-pool path."""
+    from llm_mcp_tpu.executor import GenerationEngine
+
+    monkeypatch.delenv("TPU_KV_HOST_OFFLOAD", raising=False)
+    eng = GenerationEngine("tiny-llm", max_slots=2, max_seq_len=64,
+                           dtype=jnp.float32, decode_chunk=4).start()
+    try:
+        assert eng._pool is None
+        assert eng.memory_stats() == {"enabled": 0.0}
+        assert eng.admission_state() == (False, 0.0)
+        eng.note_shed()  # must not raise, must not invent a pool
+        assert eng._pool is None
+        out = eng.generate("noop check", max_tokens=6, temperature=0.0)
+        assert out["usage"]["completion_tokens"] >= 1
+    finally:
+        eng.shutdown()
+
+
+def test_admission_state_sheds_above_watermark(monkeypatch):
+    """Offered load at the watermark → (True, finite retry estimate); the
+    API layer turns this into 429 + Retry-After, jobs into deferred
+    claims."""
+    monkeypatch.setenv("TPU_ADMIT_WATERMARK", "1.0")
+    eng = _pooled_engine(monkeypatch, max_slots=1)
+    try:
+        assert eng.admission_state() == (False, 0.0)  # idle: admit
+        hold = threading.Event()
+        done = []
+
+        def long_gen():
+            done.append(eng.generate("hold the only slot", max_tokens=48,
+                                     temperature=0.0))
+            hold.set()
+
+        t = threading.Thread(target=long_gen, daemon=True)
+        t.start()
+        deadline = time.time() + 60
+        shed, retry = False, 0.0
+        while time.time() < deadline:
+            shed, retry = eng.admission_state()
+            if shed:
+                break
+            time.sleep(0.002)
+        assert shed, "engine never reported shed at watermark 1.0"
+        assert 1.0 <= retry <= 600.0
+        before = eng.memory_stats()["shed_total"]
+        eng.note_shed()
+        assert eng.memory_stats()["shed_total"] == before + 1
+        hold.wait(timeout=120)
+        t.join(timeout=10)
+    finally:
+        eng.shutdown()
+
+
+def test_soak_no_deadlock_no_double_assignment(monkeypatch):
+    """Race admit/preempt/finish under mixed priorities: every request
+    completes (no deadlock), no slot object is ever installed twice, and
+    no offloaded snapshot's slot object is simultaneously active."""
+    eng = _pooled_engine(monkeypatch, max_slots=2, max_seq_len=64)
+    stop = threading.Event()
+    violations: list[str] = []
+
+    def invariant_watch():
+        while not stop.is_set():
+            slots = list(eng._slots)  # snapshot under the GIL
+            ids = [id(s) for s in slots if s is not None]
+            if len(ids) != len(set(ids)):
+                violations.append("slot object installed in two slots")
+            pool = eng._pool
+            if pool is not None:
+                with pool._lock:
+                    held = [id(s.slot_obj) for s in pool._snaps]
+                if set(held) & set(ids):
+                    violations.append("offloaded slot object also active")
+            time.sleep(0.001)
+
+    watcher = threading.Thread(target=invariant_watch, daemon=True)
+    watcher.start()
+    results: list[dict] = []
+    lock = threading.Lock()
+
+    def client(i):
+        for r in range(2):
+            out = eng.generate(
+                f"soak client {i} round {r}",
+                max_tokens=10 + (i * 7 + r) % 30,
+                temperature=0.0,
+                priority=i % 3,
+            )
+            with lock:
+                results.append(out)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    stop.set()
+    watcher.join(timeout=10)
+    assert not any(t.is_alive() for t in threads), "soak deadlocked"
+    assert len(results) == 12
+    assert all(r["usage"]["completion_tokens"] >= 1 for r in results)
+    assert violations == []
+    assert eng.slots_in_use() == 0
+    assert eng.memory_stats()["preempted_held"] == 0.0
+    eng.shutdown()
+
+
+# -- 3. SliceEngine mirrored-command variant ---------------------------------
+
+
+def test_slice_engine_preempt_restore_token_identical(monkeypatch):
+    """The SliceEngine runs the same cycle through its leader loop, where
+    preempt/restore are mirrored commands ((slot, bucket, snap_id) — no KV
+    bytes) and the snapshot/restore jits run under the global mesh."""
+    from llm_mcp_tpu.executor import SliceEngine
+    from llm_mcp_tpu.parallel.mesh import make_mesh
+
+    monkeypatch.setenv("TPU_KV_HOST_OFFLOAD", "1")
+    # max_slots must divide over dp, and tiny-llm's 2 KV heads cap tp at 2
+    mesh = make_mesh("dp=4,tp=2")
+    eng = SliceEngine(
+        "tiny-llm", mesh=mesh, cmd_addr="127.0.0.1:0", max_slots=4,
+        max_seq_len=128, dtype=jnp.float32, decode_chunk=4,
+    ).start()
+    try:
+        assert eng._pool is not None
+        results: dict[str, dict] = {}
+        lock = threading.Lock()
+        prompt = "slice preempt identity probe"
+
+        def low(p):
+            r = eng.generate(p, max_tokens=48, temperature=0.0, priority=0)
+            with lock:
+                results[p] = r
+
+        threads = [
+            threading.Thread(target=low, args=(p,), daemon=True)
+            for p in (prompt, "slice filler one", "slice filler two",
+                      "slice filler three")
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 60
+        while eng.slots_in_use() < 4 and time.time() < deadline:
+            time.sleep(0.005)
+        assert eng.slots_in_use() == 4
+        hi = eng.generate("slice urgent", max_tokens=8, temperature=0.0,
+                          priority=5)
+        assert hi["usage"]["completion_tokens"] >= 1
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads)
+        st = eng.memory_stats()
+        assert st["preempted_total"] >= 1
+        assert st["restored_total"] >= 1
+        assert not eng._snaps  # every snapshot's host rows were consumed
+        ref = eng.generate(prompt, max_tokens=48, temperature=0.0)
+        assert results[prompt]["text"] == ref["text"]
+        assert eng.total_errors == 0
+    finally:
+        eng.shutdown()
