@@ -81,6 +81,23 @@ class DashboardAPI:
             for name, i in engines.items()
             if isinstance(i.get("memory"), dict)
         }
+        # condensed paged-KV view (full stats under engines[name]["paging"]):
+        # block occupancy, how much prefix sharing is multiplying capacity,
+        # and the leak audit (anything nonzero is a refcount bug)
+        paging = {
+            name: {
+                "blocks_used": int(i["paging"].get("blocks_used", 0.0)),
+                "blocks_total": int(i["paging"].get("blocks_total", 0.0)),
+                "sharing_ratio": round(i["paging"].get("sharing_ratio", 1.0), 3),
+                "peak_sharing": round(
+                    i["paging"].get("peak_sharing_ratio", 1.0), 3
+                ),
+                "cow_copies": int(i["paging"].get("cow_copies_total", 0.0)),
+                "leaks": int(i["paging"].get("leaks", 0.0)),
+            }
+            for name, i in engines.items()
+            if isinstance(i.get("paging"), dict)
+        }
         resp.write_json(
             {
                 "ts": time.time(),
@@ -97,6 +114,7 @@ class DashboardAPI:
                 "engines": engines,
                 "speculation": speculation,
                 "memory": memory,
+                "paging": paging,
                 "issues": issues,
             }
         )

@@ -73,6 +73,8 @@ class CoreServer:
         self._spec_counts: dict[str, dict[str, float]] = {}
         # and for the KV-pool preempt/restore/shed counters
         self._pool_counts: dict[str, dict[str, float]] = {}
+        # and the paged-KV copy-on-write counter (cumulative per engine)
+        self._paging_counts: dict[str, float] = {}
         self.limits = LimitsEngine(self.db, strict=self.cfg.strict_model_limits)
         self.circuit = CircuitBreaker()
         self.router = Router(
@@ -310,6 +312,26 @@ class CoreServer:
                         k: float(ms.get(k, 0.0))
                         for k in ("preempted_total", "restored_total", "shed_total")
                     }
+            pst = getattr(e, "paging_stats", None)
+            if pst is not None:
+                ps = pst()
+                info[name]["paging"] = ps
+                self.metrics.kv_blocks_used.labels(engine=name).set(
+                    ps.get("blocks_used", 0.0)
+                )
+                self.metrics.kv_block_sharing.labels(engine=name).set(
+                    ps.get("sharing_ratio", 1.0)
+                )
+                self.metrics.kv_block_leaks.labels(engine=name).set(
+                    ps.get("leaks", 0.0)
+                )
+                prev_b = self._paging_counts.get(name, 0.0)
+                cur_b = float(ps.get("cow_copies_total", 0.0))
+                if cur_b > prev_b:
+                    self.metrics.kv_cow_copies.labels(engine=name).inc(
+                        cur_b - prev_b
+                    )
+                self._paging_counts[name] = cur_b
         for name, e in self.embed_engines.items():
             info[name] = {
                 "kind": "embed",

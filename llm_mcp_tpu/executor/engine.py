@@ -69,8 +69,10 @@ from .memory import (
     bucket_len,
     pytree_nbytes,
 )
+from .paging import PagedKVManager
 from .scheduler import TokenBudgetScheduler
 from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
+from ..utils.locks import OrderedLock
 
 log = logging.getLogger("engine")
 
@@ -127,6 +129,12 @@ class _Slot:
     # KV pool: last emission wall time, the "idle" preemption policy's
     # victim signal. Only stamped when the pool is on (hot-path no-op rule).
     last_emit: float = 0.0
+    # Paged KV: when admitted off a prefix-cache hit, the entry and its
+    # stored length — a preemption of this slot snapshots only the rows
+    # past shared_len (the shared blocks stay pinned in the paging ledger
+    # and restore re-inserts them from the entry's device arrays).
+    shared_entry: Any = None
+    shared_len: int = 0
 
 
 @dataclass
@@ -164,6 +172,10 @@ class _PrefillState:
     # terminal error already delivered by the stall watchdog — activation
     # and chunk failure paths must not double-publish
     aborted: bool = False
+    # Paged KV: prefix-cache hit provenance, carried through the chunked
+    # suffix prefill onto the activated _Slot (see _start_cached)
+    shared_entry: Any = None
+    shared_len: int = 0
 
 
 @dataclass
@@ -592,6 +604,41 @@ class GenerationEngine:
             ck, cv = jax.lax.fori_loop(0, slots.shape[0], body, (ck, cv))
             return ck, cv
 
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def insert_at_fn(ck, cv, pk, pv, slot, start):
+            """Paged restore, private tail: write pk/pv [L, 1, Hkv, R, hd]
+            (int8 {"q","s"} pytree when the cache is) into slot's rows
+            [start, start+R). R is EXACT — never pow2-padded — because a
+            padded R with start+R > S would make dynamic_update_slice CLAMP
+            the start index backwards and overwrite the shared prefix rows
+            just re-inserted below it. Restore guarantees start+R = bucket
+            <= S, so the traced start is never clamped."""
+            if kv_q:
+                ck = {
+                    "q": jax.lax.dynamic_update_slice(
+                        ck["q"], pk["q"], (0, slot, 0, start, 0)
+                    ),
+                    "s": jax.lax.dynamic_update_slice(
+                        ck["s"], pk["s"].astype(ck["s"].dtype), (0, slot, 0, start)
+                    ),
+                }
+                cv = {
+                    "q": jax.lax.dynamic_update_slice(
+                        cv["q"], pv["q"], (0, slot, 0, start, 0)
+                    ),
+                    "s": jax.lax.dynamic_update_slice(
+                        cv["s"], pv["s"].astype(cv["s"].dtype), (0, slot, 0, start)
+                    ),
+                }
+                return ck, cv
+            ck = jax.lax.dynamic_update_slice(
+                ck, pk.astype(ck.dtype), (0, slot, 0, start, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cv, pv.astype(cv.dtype), (0, slot, 0, start, 0)
+            )
+            return ck, cv
+
         @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("skey",))
         def prefill_chunk_fn(params, ck, cv, tokens, slots, starts, nvalid, skey):
             return llama_prefill_chunk_batch(
@@ -600,6 +647,7 @@ class GenerationEngine:
 
         self._admit_fn = admit_fn
         self._insert_cached_fn = insert_cached_fn
+        self._insert_at_fn = insert_at_fn
         self._prefill_chunk_fn = prefill_chunk_fn
         # Prompt-prefix KV cache (vLLM-style prefix reuse, exact-prefix
         # match): production chat traffic repeats long shared prefixes
@@ -722,6 +770,25 @@ class GenerationEngine:
                 self._pool.policy,
             )
 
+        # Paged KV ledger (paging.py): refcounted block tables + COW prefix
+        # sharing over the slot arena. Pure host bookkeeping (no device
+        # calls), so it is ALWAYS constructed — the block economy feeds
+        # telemetry unconditionally, and when the pool is on, admission's
+        # offered load becomes unique-block accounting (_offered_load).
+        cache_bytes = pytree_nbytes({"k": self._ck, "v": self._cv})
+        self._paging = PagedKVManager(
+            max_slots=max_slots,
+            max_seq_len=max_seq_len,
+            bytes_per_token=cache_bytes // max(1, max_slots * max_seq_len),
+            prefix_budget_bytes=self._prefix_budget,
+        )
+        self._snap_ctr = 0  # KVSnapshot ids for the paging ledger's parked pins
+        log.info(
+            "paged KV: %d-token blocks, %d/slot, %d arena + %d prefix blocks",
+            self._paging.block_tokens, self._paging.blocks_per_slot,
+            self._paging.slot_partition, self._paging.prefix_partition,
+        )
+
         self._admit: "queue.Queue[GenRequest]" = queue.Queue()
         self._stop_evt = threading.Event()
         self._wake = threading.Event()
@@ -758,8 +825,10 @@ class GenerationEngine:
                 target=self._watchdog, name="engine-watchdog", daemon=True
             ).start()
 
-        # rolling stats for dashboard/benchmarks
-        self.stats_lock = threading.Lock()
+        # rolling stats for dashboard/benchmarks. Rank 10 (doc/concurrency.md):
+        # lowest rank, so holding it permits taking the pool/paging locks but
+        # never the reverse — today no engine path nests it with either.
+        self.stats_lock = OrderedLock("engine.stats", rank=10)
         self.total_tokens = 0
         self.total_requests = 0
         # requests failed with an error event (poisoned rounds, failed
@@ -1014,6 +1083,7 @@ class GenerationEngine:
                     self.stall_seconds() > self.stall_timeout_s
                 ):
                     for snap in self._pool.drain():
+                        self._paging.drop_snap(snap.snap_id)
                         s = snap.slot_obj
                         if s is None or s.aborted or s.done:
                             continue
@@ -1192,13 +1262,35 @@ class GenerationEngine:
             "tok_per_call": (self.spec_emitted / calls) if calls else 0.0,
         }
 
-    def _offered_load(self) -> int:
-        """Offered load the admission watermark compares against: occupied
-        slots + queued-but-unadmitted requests + offloaded snapshots (they
-        re-enter through the same slots). Only meaningful with the pool on."""
+    def _offered_load(self) -> float:
+        """Offered load the admission watermark compares against, in
+        slot-equivalents. Only meaningful with the pool on.
+
+        Paged accounting (paging.py:offered_blocks): unique blocks
+        referenced by live tables and parked snapshots count ONCE — shared
+        prefixes are paid for once no matter how many slots pin them — plus
+        each request's committed decode growth (`wants`: length + tokens
+        remaining + one decode chunk, the promise admission already made),
+        snapshot restore needs, and the admit queue priced at the EMA
+        private-block cost. With zero sharing this reduces exactly to the
+        old integer `occupied + queued + preempted` accounting."""
         queued = self._admit.qsize()
-        preempted = self._pool.preempted_count() if self._pool is not None else 0
-        return self.slots_in_use() + queued + preempted
+        if self._pool is None:
+            return float(self.slots_in_use() + queued)
+        mgr = self._paging
+        S = self.max_seq_len
+        K = self.decode_chunk
+        wants: dict[int, int] = {}
+        for b, s in enumerate(self._slots):
+            if s is None or s.done or s.aborted:
+                continue
+            rem = max(0, s.req.max_tokens - s.generated)
+            wants[b] = min(int(self._lengths[b]) + rem + K, S)
+        for slot, st in list(self._prefills.items()):
+            if st.aborted:
+                continue
+            wants[slot] = min(len(st.ids) + max(0, st.req.max_tokens) + K, S)
+        return mgr.offered_blocks(wants, queued) / max(1, mgr.blocks_per_slot)
 
     def memory_stats(self) -> dict[str, float]:
         """KV pool observability (engines_info memory block + dashboard +
@@ -1212,6 +1304,15 @@ class GenerationEngine:
         offered = self._offered_load()
         out["offered"] = float(offered)
         out["headroom"] = pool.headroom(offered)
+        return out
+
+    def paging_stats(self) -> dict[str, float]:
+        """Paged-KV block economy (engines_info paging block + dashboard +
+        llmtpu_kv_block* metrics). Always available — the ledger is pure
+        host bookkeeping and runs regardless of the pool."""
+        out = self._paging.stats()
+        out["enabled"] = 1.0
+        out["leaks"] = float(self._paging.leak_count())
         return out
 
     def admission_state(self) -> tuple[bool, float]:
@@ -1335,6 +1436,7 @@ class GenerationEngine:
                 self._free_now(i)
         for slot in list(self._prefills):
             st = self._prefills.pop(slot)
+            self._paging.free_slot(slot)
             self._count_error()
             st.req.out.put({"type": "error", "error": error})
             st.req.out.put(_DONE)
@@ -1343,6 +1445,7 @@ class GenerationEngine:
             # offloaded snapshots were waiting on a restore that will never
             # come (their KV rows on device are gone with everyone else's)
             for snap in self._pool.drain():
+                self._paging.drop_snap(snap.snap_id)
                 s = snap.slot_obj
                 if s is None or s.aborted or s.done:
                     continue
@@ -1398,18 +1501,20 @@ class GenerationEngine:
             time.time() - head.created_at > self._aging_s()
         )
 
-    def _snapshot_rows(self, b: int, Lb: int):
-        """Host copies of slot b's committed KV rows [0, Lb) — one slice per
-        cache tree ("q"+"s" for kv8; k/v last dims differ under MLA but the
-        seq axis is ALWAYS axis 3, so the same slice covers every layout."""
+    def _snapshot_rows(self, b: int, Lb: int, start: int = 0):
+        """Host copies of slot b's committed KV rows [start, Lb) — one slice
+        per cache tree ("q"+"s" for kv8; k/v last dims differ under MLA but
+        the seq axis is ALWAYS axis 3, so the same slice covers every
+        layout. start > 0 is the paged private-only snapshot: rows [0, start)
+        are a shared prefix whose blocks stay pinned in the paging ledger."""
 
         def cut(arr):
             if isinstance(arr, dict):
                 return {
-                    "q": jax.device_get(arr["q"][:, b : b + 1, :, :Lb]),
-                    "s": jax.device_get(arr["s"][:, b : b + 1, :, :Lb]),
+                    "q": jax.device_get(arr["q"][:, b : b + 1, :, start:Lb]),
+                    "s": jax.device_get(arr["s"][:, b : b + 1, :, start:Lb]),
                 }
-            return jax.device_get(arr[:, b : b + 1, :, :Lb])
+            return jax.device_get(arr[:, b : b + 1, :, start:Lb])
 
         return cut(self._ck), cut(self._cv)
 
@@ -1438,8 +1543,16 @@ class GenerationEngine:
         L = int(self._lengths[b])
         t0 = time.perf_counter()
         Lb = bucket_len(L, self.max_seq_len)
-        k_rows, v_rows = self._snapshot_rows(b, Lb)
+        # Paged private-only offload: a slot admitted off a prefix hit only
+        # snapshots rows [shared_len, Lb) — the shared rows' blocks stay
+        # pinned (ids, zero bytes) and restore re-inserts them from the
+        # entry's device arrays. shared_len < Lb always holds (a hit is a
+        # STRICT prefix and both are pow2), but guard anyway.
+        p0 = s.shared_len if (0 < s.shared_len < Lb and s.shared_entry) else 0
+        k_rows, v_rows = self._snapshot_rows(b, Lb, start=p0)
         dt = time.perf_counter() - t0
+        snap_id = self._snap_ctr
+        self._snap_ctr += 1
         snap = KVSnapshot(
             req_id=s.req.request_id,
             priority=s.req.priority,
@@ -1454,8 +1567,14 @@ class GenerationEngine:
             nbytes=pytree_nbytes(k_rows) + pytree_nbytes(v_rows),
             preempted_at=time.time(),
             slot_obj=s,
+            snap_id=snap_id,
+            shared_len=p0,
+            shared_entry=s.shared_entry if p0 else None,
         )
         pool.offload(snap, dt)
+        # ledger: park the shared pins under snap_id, free the private tail
+        # — BEFORE _free_now, whose free_slot would drop the whole table
+        self._paging.preempt_slot(b, snap_id)
         # free WITHOUT terminal events: the request is suspended, not dead —
         # its consumer stays blocked in out.get() until restore resumes
         # emission. (Post-drain there are no rounds in flight, so this sets
@@ -1492,7 +1611,10 @@ class GenerationEngine:
                 break
             s = snap.slot_obj
             if s is None or s.done or s.aborted:
-                continue  # terminal events already delivered; drop the rows
+                # terminal events already delivered; drop the rows and the
+                # ledger's parked shared pins
+                self._paging.drop_snap(snap.snap_id)
+                continue
             aged = time.time() - snap.preempted_at > self._aging_s()
             head = None
             try:
@@ -1510,6 +1632,9 @@ class GenerationEngine:
                 self._restore_snapshot(slot, snap)
             except Exception as e:
                 log.exception("restore of preempted slot failed")
+                # the ledger still parks this snap's pins (restore_slot runs
+                # only after the device inserts succeed) — release them
+                self._paging.drop_snap(snap.snap_id)
                 s.aborted = True
                 self._count_error()
                 s.req.out.put({"type": "error", "error": str(e)})
@@ -1534,13 +1659,32 @@ class GenerationEngine:
                 return {k: jax.device_put(v) for k, v in rows.items()}
             return jax.device_put(rows)
 
-        # one executable per (bucket, group=1) — same cache as prefix-hit
-        # admission, so a restore compiles nothing the serve loop hasn't
-        self._note_exec_shape("restore", snap.bucket)
-        self._ck, self._cv = self._insert_cached_fn(
-            self._ck, self._cv, up(snap.k_rows), up(snap.v_rows),
-            jnp.asarray([b], dtype=jnp.int32), np.int32(1),
-        )
+        if snap.shared_len and snap.shared_entry is not None:
+            # Paged two-stage restore: the shared prefix rows come back from
+            # the prefix-cache entry's device arrays (zero host bytes moved
+            # for them — the snapshot holds only the private tail), then the
+            # private rows land at start=shared_len. R is exact, never
+            # padded (insert_at_fn docstring: padding would clamp the start).
+            ent = snap.shared_entry
+            self._note_exec_shape("restore", snap.shared_len)
+            self._ck, self._cv = self._insert_cached_fn(
+                self._ck, self._cv, ent["k"], ent["v"],
+                jnp.asarray([b], dtype=jnp.int32), np.int32(1),
+            )
+            R = snap.bucket - snap.shared_len
+            self._note_exec_shape("restore_at", R)
+            self._ck, self._cv = self._insert_at_fn(
+                self._ck, self._cv, up(snap.k_rows), up(snap.v_rows),
+                np.int32(b), np.int32(snap.shared_len),
+            )
+        else:
+            # one executable per (bucket, group=1) — same cache as prefix-hit
+            # admission, so a restore compiles nothing the serve loop hasn't
+            self._note_exec_shape("restore", snap.bucket)
+            self._ck, self._cv = self._insert_cached_fn(
+                self._ck, self._cv, up(snap.k_rows), up(snap.v_rows),
+                jnp.asarray([b], dtype=jnp.int32), np.int32(1),
+            )
         # device sampling rows + token ring, then host mirrors (the source
         # of truth for recovery), then the table entry
         self._d_temp = self._d_temp.at[b].set(snap.temperature)
@@ -1553,6 +1697,8 @@ class GenerationEngine:
         self._topk[b] = snap.top_k
         self._topp[b] = snap.top_p
         self._slots[b] = s
+        # ledger: re-table the parked shared pins + a fresh private tail
+        self._paging.restore_slot(b, snap.snap_id, snap.length)
         dt = time.perf_counter() - t0
         self._pool.note_restored(snap, dt)
         if s.req.trace_ctx:
@@ -1869,6 +2015,10 @@ class GenerationEngine:
                     # per-chip work.
                     self._prefills[slot] = _PrefillState(req=req, ids=list(ids))
                     self._prefill_q.append(slot)
+                    # ledger: reserve the prompt's blocks for the whole
+                    # chunked prefill (the rows are written incrementally
+                    # but the commitment is made now)
+                    self._paging.admit_slot(slot, len(ids))
                     continue
                 reserved.add(slot)
                 batch.append((slot, req, list(ids)))
@@ -1879,6 +2029,7 @@ class GenerationEngine:
                     log.exception("prefix-cache admission failed")
                     for slot, req, _ in group:
                         self._prefills.pop(slot, None)
+                        self._paging.free_slot(slot)
                         try:
                             self._prefill_q.remove(slot)
                         except ValueError:
@@ -1963,9 +2114,20 @@ class GenerationEngine:
         self._ck, self._cv = self._insert_cached_fn(
             self._ck, self._cv, ent["k"], ent["v"], jnp.asarray(slots), np.int32(n)
         )
+        key = ent.get("key")
         for slot, req, ids in group:
-            self._prefills[slot] = _PrefillState(req=req, ids=list(ids), done=ent["P"])
+            self._prefills[slot] = _PrefillState(
+                req=req, ids=list(ids), done=ent["P"],
+                shared_entry=ent, shared_len=ent["P"],
+            )
             self._prefill_q.append(slot)
+            # ledger: pin the entry's blocks (refcount++, zero allocation
+            # for the shared prefix), COW the boundary block if the stored
+            # length isn't block-aligned, extend privately to the prompt
+            if key is not None:
+                self._paging.admit_shared(slot, key, len(ids))
+            else:  # entry predates the ledger (tests poke entries in raw)
+                self._paging.admit_slot(slot, len(ids))
 
     def _maybe_store_prefix(self, slot: int, ids: list[int]) -> None:
         """At activation: if this prompt shares a long prefix with recent
@@ -1994,6 +2156,15 @@ class GenerationEngine:
         key = t[:p0]
         if key in self._prefix_cache:
             return
+        # Single HBM ledger (paging.py): the entry claims blocks from the
+        # manager's prefix partition BEFORE storing — evict LRU entries
+        # until it fits; a partition too small for the entry ever skips the
+        # store. (The byte counter below stays authoritative too: tests
+        # shrink _prefix_budget at runtime and expect byte-LRU eviction.)
+        while not self._paging.prefix_can_fit(p0) and self._prefix_cache:
+            self._evict_lru_prefix()
+        if self._paging.prefix_register(key, p0) is None:
+            return
         if isinstance(self._ck, dict):
             pk = {
                 "q": self._ck["q"][:, slot : slot + 1, :, :p0],
@@ -2007,22 +2178,29 @@ class GenerationEngine:
             pk = self._ck[:, slot : slot + 1, :, :p0]
             pv = self._cv[:, slot : slot + 1, :, :p0]
         nbytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves((pk, pv)))
-        ent = {"P": p0, "k": pk, "v": pv, "bytes": nbytes}
+        ent = {"P": p0, "k": pk, "v": pv, "bytes": nbytes, "key": key}
         self._prefix_cache[key] = ent
         self._prefix_by_len.setdefault(p0, {})[key] = ent
         self._prefix_cache_bytes += nbytes
         while self._prefix_cache_bytes > self._prefix_budget and self._prefix_cache:
-            old_key, old = self._prefix_cache.popitem(last=False)  # LRU evict
-            self._prefix_cache_bytes -= old["bytes"]
-            bucket_d = self._prefix_by_len.get(old["P"])
-            if bucket_d is not None:
-                bucket_d.pop(old_key, None)
-                if not bucket_d:
-                    del self._prefix_by_len[old["P"]]
+            self._evict_lru_prefix()
         log.info(
             "prefix cache: stored %d-token prefix (%.1f MB, %d entries)",
             p0, nbytes / 1e6, len(self._prefix_cache),
         )
+
+    def _evict_lru_prefix(self) -> None:
+        """Evict the least-recently-used prefix entry: byte counter, ledger
+        registration (blocks stay alive while live tables still pin them),
+        and the by-length index."""
+        old_key, old = self._prefix_cache.popitem(last=False)
+        self._prefix_cache_bytes -= old["bytes"]
+        self._paging.prefix_release(old.get("key", old_key))
+        bucket_d = self._prefix_by_len.get(old["P"])
+        if bucket_d is not None:
+            bucket_d.pop(old_key, None)
+            if not bucket_d:
+                del self._prefix_by_len[old["P"]]
 
     def _start_batch(self, batch: list[tuple[int, GenRequest, list[int]]]) -> None:
         """Admit up to admit_batch short prompts with ONE batched prefill
@@ -2068,6 +2246,20 @@ class GenerationEngine:
         self._maybe_store_prefix(slot, ids)
         self._recent_prompts.append(tuple(ids))
         s = _Slot(req=req, prompt_len=P, first_token_at=time.time())
+        # prefix-hit provenance rides the _PrefillState onto the live slot
+        # (still present here — _finish_prefill_group deletes it after);
+        # preemption uses it to snapshot only the private rows
+        st = self._prefills.get(slot)
+        if st is not None and st.shared_len:
+            s.shared_entry = st.shared_entry
+            s.shared_len = st.shared_len
+        # ledger: batch-path admissions create their table here; the
+        # chunked/prefix-hit paths already reserved one (ensure extends it)
+        mgr = self._paging
+        mgr.ensure_slot(slot, P)
+        want = min(P + max(0, req.max_tokens) + self.decode_chunk, self.max_seq_len)
+        shared_full = s.shared_len // mgr.block_tokens if s.shared_len else 0
+        mgr.note_admit_cost(mgr.blocks_for(want) - shared_full)
         self._slots[slot] = s
         self._lengths[slot] = P
         self._last_tok[slot] = tok0
@@ -2164,6 +2356,7 @@ class GenerationEngine:
         ]:
             self._prefill_q.remove(slot)
             del self._prefills[slot]
+            self._paging.free_slot(slot)
         if not self._prefill_q:
             self._sched.decide(0, n_active, 0.0)
             return None
@@ -2322,6 +2515,8 @@ class GenerationEngine:
                 s = self._slots[slot]
                 if s is not None and s.req is st.req:
                     self._free_now(slot)
+                else:  # reserved-not-activated: release the ledger table
+                    self._paging.free_slot(slot)
                 if not st.aborted:  # watchdog may have terminated it already
                     self._count_error()
                     st.req.out.put({"type": "error", "error": str(e)})
@@ -2418,6 +2613,7 @@ class GenerationEngine:
         before = self.total_tokens
         drafted_round = 0
         accepted_round = 0
+        blk_wants: dict[int, int] = {}
         for i, (b, d) in enumerate(entries):
             s = self._slots[b]
             if s is None or s.done:
@@ -2454,6 +2650,9 @@ class GenerationEngine:
                 # drafts); `final`'s KV is written by the next round
                 self._lengths[b] = base_b + 1 + na
                 self._last_tok[b] = int(final[i])
+                blk_wants[b] = base_b + 1 + na
+        if blk_wants:
+            self._paging.extend_many(blk_wants)
         self.spec_calls += 1
         self.spec_drafted += drafted_round
         self.spec_accepted += accepted_round
@@ -2582,6 +2781,9 @@ class GenerationEngine:
         for b in active:
             self._lengths[b] = min(int(base[b]) + self.decode_chunk,
                                    self.max_seq_len)
+        # ledger: grow block tables to cover the advanced lengths (batched —
+        # one lock acquisition per round; a no-op inside a block)
+        self._paging.extend_many({b: int(self._lengths[b]) for b in active})
         self._rid_dispatched += 1
         return _DispatchedRound(
             out=out, entries=entries, base=base, t0=round_t0,
@@ -2662,6 +2864,9 @@ class GenerationEngine:
         entry) has been fetched."""
         self._slots[b] = None
         self._lengths[b] = self.max_seq_len  # park
+        # ledger: drop the slot's block table (idempotent no-op when the
+        # table is already gone — e.g. preempt parked it under a snap_id)
+        self._paging.free_slot(b)
         if self._rid_dispatched > self._rid_fetched:
             self._cooling[b] = self._rid_dispatched
 

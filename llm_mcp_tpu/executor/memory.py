@@ -33,10 +33,11 @@ mutates while API threads read `stats()`/`admission` concurrently.
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any
+
+from ..utils.locks import OrderedLock
 
 __all__ = ["KVPool", "KVSnapshot", "pytree_nbytes", "bucket_len"]
 
@@ -107,6 +108,14 @@ class KVSnapshot:
     # rows keyed by this id, so the "restore" command ships (slot, snap_id)
     # instead of the KV payload over the command channel. -1 = single-host.
     snap_id: int = -1
+    # Paged KV (executor/paging.py): when the victim was admitted off a
+    # shared prefix, `k_rows`/`v_rows` hold only the PRIVATE rows
+    # `[shared_len, bucket)` — the shared rows stay pinned as block ids in
+    # the paging ledger and are re-inserted on restore from `shared_entry`
+    # (the prefix-cache entry's device arrays, kept alive by this
+    # reference even across an eviction). 0 = whole-bucket snapshot.
+    shared_len: int = 0
+    shared_entry: Any = None
 
 
 class KVPool:
@@ -129,7 +138,7 @@ class KVPool:
         self.policy = policy
         # bound host memory: never hold more offloaded snapshots than slots
         self.max_preempted = int(max_preempted) if max_preempted else self.max_slots
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("kvpool", rank=20)
         self._snaps: list[KVSnapshot] = []
         self._last_preempt_at = 0.0
         # cumulative counters (engines_info bridges deltas into Prometheus)
@@ -145,13 +154,17 @@ class KVPool:
     def hbm_bytes(self) -> int:
         return self.max_slots * self.bytes_per_slot
 
-    def admit_ok(self, offered: int) -> bool:
-        """True while offered load (active + queued + preempted) is under
-        the oversubscription watermark. Side-effect free — callers that act
-        on a shed decision record it via `note_shed()`."""
+    def admit_ok(self, offered: float) -> bool:
+        """True while offered load is under the oversubscription watermark.
+        `offered` is in slot-equivalents: historically the integer count
+        active + queued + preempted; with the paged-KV ledger it is the
+        unique-block offered load / blocks_per_slot (executor/paging.py
+        `offered_blocks`), which reduces to the same integer when nothing
+        is shared. Side-effect free — callers that act on a shed decision
+        record it via `note_shed()`."""
         return offered < self.watermark * self.max_slots
 
-    def headroom(self, offered: int) -> float:
+    def headroom(self, offered: float) -> float:
         """Fraction of shed-free capacity remaining, in [0, 1]. Advertised
         through device tags so the router de-ranks saturated devices."""
         cap = self.watermark * self.max_slots

@@ -81,6 +81,7 @@ from .memory import (
     bucket_len,
     pytree_nbytes,
 )
+from .paging import PagedKVManager
 from .scheduler import TokenBudgetScheduler
 from .tokenizer import Tokenizer, load_tokenizer
 
@@ -509,6 +510,22 @@ class SliceEngine:
                 policy=os.environ.get("TPU_PREEMPT_POLICY", "") or "priority",
             )
 
+        # Paged-KV ledger (executor/paging.py): constructed in EVERY process
+        # from the same constructor arguments, so the follower mirror starts
+        # identical. The leader buffers every mutator's op list and flushes
+        # one ("blk", ops) command per loop iteration — ops carry block ids,
+        # never KV bytes — and followers replay them via apply_ops. The
+        # slice has no prefix cache, so the prefix partition is zero and
+        # every admission allocates private blocks.
+        self._paging = PagedKVManager(
+            max_slots=max_slots,
+            max_seq_len=max_seq_len,
+            bytes_per_token=pytree_nbytes({"k": self._ck, "v": self._cv})
+            // max(1, max_slots * max_seq_len),
+            prefix_budget_bytes=0,
+        )
+        self._blk_ops: list[tuple] = []
+
         # leader-side bookkeeping
         self._queue: "queue.Queue[Any]" = queue.Queue()
         self._slots: list[_Slot | None] = [None] * max_slots
@@ -650,6 +667,11 @@ class SliceEngine:
                         self._ck, self._cv = self._restore_fn(
                             self._ck, self._cv, kr, vr, np.int32(slot)
                         )
+                elif op == "blk":
+                    # mirrored paging-ledger mutations: block ids only, no
+                    # KV bytes — replayed so every process can answer block
+                    # economy queries and audit for leaks identically
+                    self._paging.apply_ops(cmd[1])
                 else:  # pragma: no cover
                     raise ValueError(f"unknown slice command {op!r}")
         finally:
@@ -765,13 +787,35 @@ class SliceEngine:
             "tok_per_call": (self.spec_emitted / calls) if calls else 0.0,
         }
 
-    def _offered_load(self) -> int:
-        return (
-            self.slots_in_use()
-            + len(self._prefills)
-            + self._queue.qsize()
-            + (self._pool.preempted_count() if self._pool is not None else 0)
-        )
+    def _offered_load(self) -> float:
+        """Offered load in slot-equivalents. With the pool on, this is the
+        paging ledger's unique-block accounting (engine.py parity): live
+        tables and parked snapshot pins count once, plus committed decode
+        growth, snapshot restore needs, and the EMA-priced admit queue."""
+        queued = self._queue.qsize()
+        if self._pool is None:
+            return float(self.slots_in_use() + len(self._prefills) + queued)
+        mgr = self._paging
+        K = self.decode_chunk
+        wants: dict[int, int] = {}
+        for b, s in enumerate(self._slots):
+            if s is None:
+                continue
+            rem = max(0, s.req.max_tokens - s.generated)
+            wants[b] = min(int(self._lens[b]) + rem + K, self.max_seq_len)
+        for slot, st in list(self._prefills.items()):
+            wants[slot] = min(
+                len(st.ids) + max(0, st.req.max_tokens) + K, self.max_seq_len
+            )
+        return mgr.offered_blocks(wants, queued) / max(1, mgr.blocks_per_slot)
+
+    def paging_stats(self) -> dict[str, float]:
+        """Paged-KV block economy (GenerationEngine parity — engines_info
+        paging block, dashboard, llmtpu_kv_block* metrics)."""
+        out = self._paging.stats()
+        out["enabled"] = 1.0
+        out["leaks"] = float(self._paging.leak_count())
+        return out
 
     def memory_stats(self) -> dict[str, float]:
         """KV pool observability (GenerationEngine parity)."""
@@ -926,6 +970,9 @@ class SliceEngine:
             snap_id=snap_id,
         )
         pool.offload(snap, dt)
+        # park the ledger's view under snap_id (no shared pins on the slice
+        # — the whole table is private and its rows are in the snapshot)
+        self._blk_ops += self._paging.preempt_slot(b, snap_id)
         # release the slot WITHOUT terminal events (the request is
         # suspended); the stale length mirror is harmless — decode rounds
         # exclude the row via active0, and restore rewrites the rows
@@ -970,6 +1017,7 @@ class SliceEngine:
         self._temps[b] = snap.temperature
         self._topks[b] = snap.top_k
         self._topps[b] = snap.top_p
+        self._blk_ops += self._paging.restore_slot(b, snap.snap_id, snap.length)
         pool.note_restored(snap, time.perf_counter() - t0)
         log.info(
             "slice restored snap %d into slot %d (%d tokens) after %.1f s",
@@ -988,20 +1036,24 @@ class SliceEngine:
                 s.req.out.put({"type": "error", "error": msg})
                 s.req.out.put(_DONE)
                 self._slots[b] = None
-        for st in self._prefills.values():
+            self._paging.free_slot(b)  # ops discarded: the mirror is dying too
+        for slot, st in self._prefills.items():
             st.req.out.put({"type": "error", "error": msg})
             st.req.out.put(_DONE)
+            self._paging.free_slot(slot)
         self._prefills.clear()
         self._prefill_q.clear()
         if self._pool is not None:
             # preempted-and-offloaded requests wait on a restore that will
             # never come — their consumers must not hang either
             for snap in self._pool.drain():
+                self._paging.drop_snap(snap.snap_id)
                 s = snap.slot_obj
                 if s is not None:
                     s.req.out.put({"type": "error", "error": msg})
                     s.req.out.put(_DONE)
             self._snaps.clear()
+        self._blk_ops.clear()
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -1038,6 +1090,7 @@ class SliceEngine:
                     decoded = self._try_verify(spec_entries)
                 else:
                     decoded = self._try_decode()
+                self._flush_blk_ops()
                 if not (admitted or prefilled or decoded or pooled):
                     if self._leader_ch is not None:
                         self._leader_ch.ping_if_idle()
@@ -1057,6 +1110,15 @@ class SliceEngine:
                     self._leader_ch.send(("stop",))
                 except OSError:
                     pass
+
+    def _flush_blk_ops(self) -> None:
+        """Broadcast this iteration's buffered paging-ledger mutations as
+        ONE compact ("blk", ops) command. The single TCP stream preserves
+        order against the data-plane commands; the ledger is metadata only,
+        so relative timing vs. the KV dispatches doesn't matter."""
+        ops, self._blk_ops = self._blk_ops, []
+        if ops and self._leader_ch is not None:
+            self._leader_ch.send(("blk", ops))
 
     def _try_admit(self) -> bool:
         free = self._free_slots()
@@ -1092,6 +1154,7 @@ class SliceEngine:
                 )
                 self._prefill_q.append(slot)
                 self._lens[slot] = self.max_seq_len
+                self._blk_ops += self._paging.admit_slot(slot, len(ids))
                 reserved = True
                 continue
             batch.append((slot, r, ids))
@@ -1135,7 +1198,14 @@ class SliceEngine:
                 r.out.put(_DONE)
             raise
         now = time.time()
+        mgr = self._paging
         for i, (b, r, ids) in enumerate(batch):
+            self._blk_ops += mgr.admit_slot(b, len(ids))
+            want = min(
+                len(ids) + max(0, r.max_tokens) + self.decode_chunk,
+                self.max_seq_len,
+            )
+            mgr.note_admit_cost(mgr.blocks_for(want))
             slot = _Slot(req=r, prompt_len=int(lengths[i]), active_at=now)
             if self.spec_enabled:
                 # seed the drafter with the prompt BEFORE the first emit so
@@ -1252,6 +1322,7 @@ class SliceEngine:
                     self._prefill_q.remove(slot)
                 except ValueError:
                     pass
+                self._paging.free_slot(slot)
                 st.req.out.put({"type": "error", "error": repr(e)})
                 st.req.out.put(_DONE)
             raise
@@ -1274,6 +1345,12 @@ class SliceEngine:
             ))[0])
             self._prefill_q.remove(slot)
             del self._prefills[slot]
+            self._blk_ops += self._paging.ensure_slot(slot, len(st.ids))
+            want = min(
+                len(st.ids) + max(0, r.max_tokens) + self.decode_chunk,
+                self.max_seq_len,
+            )
+            self._paging.note_admit_cost(self._paging.blocks_for(want))
             new_slot = _Slot(req=r, prompt_len=len(st.ids), active_at=now)
             if self.spec_enabled:
                 new_slot.spec = NGramDrafter(
@@ -1379,6 +1456,7 @@ class SliceEngine:
         self._sched.observe_verify(total, time.perf_counter() - t0)
         K = self.decode_chunk
         drafted_round = accepted_round = emitted_round = 0
+        blk_wants: dict[int, int] = {}
         for i, (b, d) in enumerate(entries):
             s = self._slots[b]
             if s is None:
@@ -1397,8 +1475,11 @@ class SliceEngine:
                 # by the next round at the rolled-forward length
                 self._lens[b] = base_b + 1 + na
                 self._toks[b] = np.int32(final[i])
+                blk_wants[b] = base_b + 1 + na
                 if int(self._lens[b]) + K > self.max_seq_len - 1:
                     self._finish_slot(b, "length")
+        if blk_wants:
+            self._blk_ops += self._paging.extend_many(blk_wants)
         self._tps_marks.append((time.time(), emitted_round))
         self.spec_calls += 1
         self.spec_drafted += drafted_round
@@ -1444,6 +1525,11 @@ class SliceEngine:
         # round START (its `active` is constant through the scan)
         adv = np.where(active0, K, 0).astype(np.int32)
         self._lens = self._lens + adv
+        self._blk_ops += self._paging.extend_many({
+            b: int(self._lens[b])
+            for b in range(self.max_slots)
+            if active0[b] and self._slots[b] is not None
+        })
         # a round writes K/V at positions lens..lens+K-1: a slot without a
         # full round of headroom must finish NOW — an out-of-bounds cache
         # write would be clamped/dropped and the tokens sampled from that
@@ -1511,3 +1597,4 @@ class SliceEngine:
         })
         req.out.put(_DONE)
         self._slots[b] = None
+        self._blk_ops += self._paging.free_slot(b)

@@ -189,6 +189,35 @@ class Metrics:
             ["engine"],
             registry=r,
         )
+        # Paged KV block economy (executor/paging.py, TPU_KV_BLOCK_TOKENS):
+        # gauges read straight from paging_stats(); the COW counter is
+        # bridged by delta like the pool counters above. sharing_ratio is
+        # logical/physical blocks — >1 means prefix sharing is multiplying
+        # capacity; leaks must stay 0 (perf_gate hard-fails on it).
+        self.kv_blocks_used = Gauge(
+            "llmtpu_kv_blocks_used",
+            "Physical KV blocks with a live refcount",
+            ["engine"],
+            registry=r,
+        )
+        self.kv_block_sharing = Gauge(
+            "llmtpu_kv_block_sharing_ratio",
+            "Logical / physical KV blocks (prefix-sharing multiplier)",
+            ["engine"],
+            registry=r,
+        )
+        self.kv_cow_copies = Counter(
+            "llmtpu_kv_cow_copies_total",
+            "Boundary blocks copied-on-write at shared-prefix admission",
+            ["engine"],
+            registry=r,
+        )
+        self.kv_block_leaks = Gauge(
+            "llmtpu_kv_block_leaks",
+            "Blocks the paging ledger audit flags as leaked/double-freed (must be 0)",
+            ["engine"],
+            registry=r,
+        )
 
     def render(self) -> tuple[bytes, str]:
         return generate_latest(self.registry), CONTENT_TYPE_LATEST

@@ -1,0 +1,93 @@
+"""Lock-ordering discipline for the engine/watchdog/HTTP thread state.
+
+The serving process runs four kinds of threads against shared state: the
+engine loop, its watchdog, HTTP handler threads (stats surfaces, admission
+checks), and — under a mesh — the slice leader's command channel. The soak
+tests guard against deadlock empirically; this module audits the ordering
+rule itself (the ROADMAP A2 gap): every lock carries a global *rank*, and a
+thread may only acquire a lock of strictly higher rank than any lock it
+already holds. Rank assignments live in doc/concurrency.md; violations
+raise immediately instead of deadlocking some unlucky soak run later.
+
+OrderedLock is a drop-in for threading.Lock (acquire/release/context
+manager/locked), so call sites and tests that poke `pool._lock` directly
+keep working.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_tls = threading.local()
+
+
+def _held() -> list[tuple[int, str]]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def held_ranks() -> list[tuple[int, str]]:
+    """(rank, name) of locks the calling thread currently holds, in
+    acquisition order — for assertions in tests and debug dumps."""
+    return list(_held())
+
+
+class LockOrderError(RuntimeError):
+    """A thread tried to acquire a lock out of rank order (potential
+    deadlock with any thread taking the same locks in the opposite
+    order)."""
+
+
+class OrderedLock:
+    """threading.Lock plus a process-wide rank discipline.
+
+    Acquiring a lock whose rank is <= the highest rank the thread already
+    holds raises LockOrderError (this also rejects re-entrant acquisition,
+    which would deadlock a plain Lock anyway). The check is per-thread
+    bookkeeping only — no extra synchronization on the hot path.
+    """
+
+    def __init__(self, name: str, rank: int):
+        self.name = name
+        self.rank = rank
+        self._lock = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        stack = _held()
+        if stack and stack[-1][0] >= self.rank:
+            raise LockOrderError(
+                f"lock order violation: acquiring {self.name!r} (rank "
+                f"{self.rank}) while holding {stack[-1][1]!r} (rank "
+                f"{stack[-1][0]}); see doc/concurrency.md"
+            )
+        ok = (
+            self._lock.acquire(blocking, timeout)
+            if timeout != -1
+            else self._lock.acquire(blocking)
+        )
+        if ok:
+            stack.append((self.rank, self.name))
+        return ok
+
+    def release(self) -> None:
+        stack = _held()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == (self.rank, self.name):
+                del stack[i]
+                break
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "OrderedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"OrderedLock({self.name!r}, rank={self.rank})"

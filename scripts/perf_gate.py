@@ -33,6 +33,14 @@ Checks, in order of authority:
      spec_accept_rate >= 0.05 and spec_tok_per_call >= 1.0 — below
      either, drafting is pure verify-pass overhead and TPU_SPEC=0
      beats shipping it.
+  4. Paged-KV floors, when the record carries them: the shared-prompt
+     oversubscription sweep must show paged_admit_ratio >= 3.0 (the
+     ISSUE 6 acceptance bar: >= 3x the slots at equal HBM budget when 90%
+     of prompts share a prefix) and cow_copies_per_req <= 2.0 (more means
+     boundary blocks are churning — check TPU_KV_BLOCK_TOKENS against the
+     stored prefix lengths). paged_block_leaks is an exact check like
+     window_errors: any nonzero end-of-run leak/double-free count from the
+     ledger audit fails the gate outright.
 
 Missing metrics are reported as [SKIP] with a stderr warning but never
 fail the gate (older records predate newer fields — a KeyError here
@@ -61,8 +69,9 @@ HIGHER_BETTER = (
     "spec_tok_per_call",
     "embed_per_s_nomic-embed-text_b1_tpu",
     "embed_per_s_qwen3-embedding-8b-int8_b64_d1024_tpu",
+    "paged_admit_ratio",
 )
-LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms")
+LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms", "cow_copies_per_req")
 
 # absolute floors/ceilings applied regardless of baseline coverage (only
 # ever read with .get(): a floor for a metric the record lacks must skip,
@@ -82,8 +91,18 @@ ABS_MIN = {
     # cross-round best-prior warning in main() catches gradual drift
     "embed_per_s_nomic-embed-text_b1_tpu": 6.5,
     "embed_per_s_qwen3-embedding-8b-int8_b64_d1024_tpu": 80.0,
+    # paged KV: the oversubscribed 90%-shared sweep must multiply admitted
+    # slots at least 3x at equal HBM budget (peak logical/physical blocks)
+    "paged_admit_ratio": 3.0,
 }
-ABS_MAX = {"p95_ttft_ms": 5000.0, "window_errors": 0.0}
+ABS_MAX = {
+    "p95_ttft_ms": 5000.0,
+    "window_errors": 0.0,
+    # more than ~2 copy-on-write blocks per completed request means the
+    # block size fights the stored prefix lengths instead of sharing them
+    "cow_copies_per_req": 2.0,
+    "paged_block_leaks": 0.0,
+}
 
 
 def extract_record(doc: dict) -> dict:
@@ -170,6 +189,17 @@ def check(cand: dict, base: dict) -> list[tuple[str, str, str]]:
         )
     else:
         results.append(("window_errors", "absent from candidate", "skip"))
+    # exact check, no baseline leniency: a leaked or double-freed block is
+    # a refcount bug whatever the previous round leaked
+    c = metric(cand, "paged_block_leaks")
+    if c is not None:
+        ok = c <= ABS_MAX.get("paged_block_leaks", 0.0)
+        results.append(
+            ("paged_block_leaks", f"{c:.0f} (must be 0)",
+             "pass" if ok else "fail")
+        )
+    else:
+        results.append(("paged_block_leaks", "absent from candidate", "skip"))
     return results
 
 

@@ -393,3 +393,25 @@ def test_gate_skips_unmeasured_ttft(tmp_path):
     cand = {"value": 2400.0, "p95_ttft_ms": -1.0, "window_errors": 0.0}
     (tmp_path / "cand.json").write_text(json.dumps(cand))
     assert gate.main([str(tmp_path / "cand.json"), _bench("BASELINE.json")]) == 0
+
+
+def test_gate_paged_kv_floors(tmp_path):
+    """ISSUE 6 floors: admit ratio >= 3.0, cow copies <= 2.0/req, and the
+    end-of-run block-leak counter is an exact zero check (no baseline
+    leniency — a leaked block is a refcount bug whatever last round did)."""
+    import json
+
+    good = {"value": 2400.0, "window_errors": 0.0,
+            "paged_admit_ratio": 3.4, "cow_copies_per_req": 0.2,
+            "paged_block_leaks": 0.0}
+    low_ratio = dict(good, paged_admit_ratio=2.1)
+    churny = dict(good, cow_copies_per_req=5.0)
+    leaky = dict(good, paged_block_leaks=2.0)
+    for n, doc in (("good", good), ("low_ratio", low_ratio),
+                   ("churny", churny), ("leaky", leaky)):
+        (tmp_path / f"{n}.json").write_text(json.dumps(doc))
+    base = str(tmp_path / "good.json")
+    assert gate.main([base, _bench("BASELINE.json")]) == 0
+    assert gate.main([str(tmp_path / "low_ratio.json"), base]) == 1
+    assert gate.main([str(tmp_path / "churny.json"), base]) == 1
+    assert gate.main([str(tmp_path / "leaky.json"), base]) == 1
