@@ -23,12 +23,20 @@ import time
 
 
 def raw_decode_tps(
-    model: str, B: int, S: int, K: int, rounds: int, kv_int8: bool = False
+    model: str,
+    B: int,
+    S: int,
+    K: int,
+    rounds: int,
+    kv_int8: bool = False,
+    stats: dict | None = None,
 ) -> float:
     """Steady-state tok/s of the jitted decode loop (chunked scan with
     fused sampling — the same decode program GenerationEngine dispatches
     per chunk, minus the engine's host-side admission/emission work, which
-    the serving-path metric measures separately)."""
+    the serving-path metric measures separately). When `stats` is passed,
+    "weight_bytes" is filled in so the caller can derive the layer pass's
+    achieved weight-stream bandwidth (layers_gbps = bytes x steps/s)."""
     from functools import partial
 
     import jax
@@ -37,7 +45,11 @@ def raw_decode_tps(
 
     from llm_mcp_tpu.kernels.attention import resolve_decode_impl
     from llm_mcp_tpu.models import get_config, init_kv_cache, llama_decode_step
-    from llm_mcp_tpu.models.quant import init_llama_params_quantized
+    from llm_mcp_tpu.models.quant import (
+        fuse_layer_weights,
+        init_llama_params_quantized,
+        quantized_bytes,
+    )
     from llm_mcp_tpu.ops.sampling import sample_tokens
 
     cfg = get_config(model)
@@ -46,6 +58,12 @@ def raw_decode_tps(
     # direct int8 init: 8B bf16 (16 GB) cannot be materialized-then-quantized
     # on one v5e chip, so the quantized tree is built in place
     params = init_llama_params_quantized(cfg, jax.random.PRNGKey(0), scale_dtype=dtype)
+    if os.environ.get("LLM_MCP_TPU_FUSE_QKV", "1") != "0":
+        # the single-chip wqkv/w13 fusion GenerationEngine applies — the raw
+        # loop must measure the production layer pass, not the unfused one
+        params = fuse_layer_weights(params)
+    if stats is not None:
+        stats["weight_bytes"] = float(quantized_bytes(params)[0])
     cache = init_kv_cache(cfg, B, S, dtype=dtype, quantized=kv_int8)
     impl = resolve_decode_impl(quantized=kv_int8)
 
@@ -679,11 +697,45 @@ def main() -> None:
             the fallback headline can never drift apart."""
             tps = 0.0
             try:
-                tps = round(raw_decode_tps(model, 112, S, 64, rounds=4, kv_int8=True), 1)
+                st: dict[str, float] = {}
+                tps = round(
+                    raw_decode_tps(model, 112, S, 64, rounds=4, kv_int8=True, stats=st),
+                    1,
+                )
                 secondary[f"raw_decode_tok_per_s_{model}-int8_kv8_b112_{platform}"] = tps
+                if st.get("weight_bytes"):
+                    # achieved weight-stream bandwidth of the layer pass: the
+                    # batch shares one weight read per step, so GB/s =
+                    # weight bytes x (tok rate / B). r05 measured ~570 of the
+                    # v5e's 819 GB/s; the wqkv/w13 fusion + scan unroll target
+                    # 650+ (scripts/kernel_bench.py re-measures at any shape)
+                    secondary["layers_gbps"] = round(
+                        st["weight_bytes"] * (tps / 112) / 1e9, 1
+                    )
             except Exception as e:  # a failure must not eat the bench line
                 print(f"# raw-decode sweep failed: {e!r}", flush=True)
                 secondary["raw_decode_error"] = 0.0
+            gc.collect()
+            try:
+                # attention-dispatch microbench at the headline shape: µs per
+                # DMA cell of the fused blocked q8 arm (scripts/kernel_bench
+                # is the sweep tool; this single point rides the bench record
+                # so cross-round drift in per-cell overhead is visible)
+                import importlib.util as _ilu
+
+                _kb_spec = _ilu.spec_from_file_location(
+                    "kernel_bench",
+                    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "scripts", "kernel_bench.py"),
+                )
+                _kb = _ilu.module_from_spec(_kb_spec)
+                _kb_spec.loader.exec_module(_kb)
+                pt = _kb.bench_attn("q8_gqa", 112, S, 0.5, arm="blocked", iters=10)
+                secondary["attn_us_per_cell"] = pt["attn_us_per_cell"]
+                secondary["attn_dma_per_cell"] = float(pt["dma_per_cell"])
+            except Exception as e:
+                print(f"# attn microbench failed: {e!r}", flush=True)
+                secondary["attn_cell_error"] = 0.0
             gc.collect()  # drop the B=112 sweep's weights+cache before re-building
             # run even when the B=112 sweep failed: the small B=8 config can
             # survive an OOM that killed the big one, and it is the only
@@ -1194,6 +1246,15 @@ def main() -> None:
             for ek in (
                 f"embed_per_s_nomic-embed-text_b1_{platform}",
                 f"embed_per_s_qwen3-embedding-8b-int8_b64_d1024_{platform}",
+                # raw-decode kernel evidence, promoted so the perf_gate
+                # floors and the cross-round drift warning can see them: the
+                # headline-shape B=112 sweep (the 6000 tok/s climb of
+                # record) and the S=32k int8-latent MLA sweep (the blocked
+                # s8 kernel's only on-hardware number)
+                f"raw_decode_tok_per_s_{model}-int8_kv8_b112_{platform}",
+                f"raw_decode_tok_per_s_mla-8b-int8_kv8_b4_s32768_{platform}",
+                "layers_gbps",
+                "attn_us_per_cell",
             ):
                 if ek in secondary:
                     # promoted top-level under the exact perf_gate key names:

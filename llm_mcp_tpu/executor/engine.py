@@ -352,6 +352,18 @@ class GenerationEngine:
             from ..models.quant import quantize_params
 
             params = quantize_params(params)  # no-op on already-int8 trees
+        if (
+            self.quant == "int8"
+            and mesh is None
+            and os.environ.get("LLM_MCP_TPU_FUSE_QKV", "1") != "0"
+        ):
+            # w8a8 layer-pass restructure: concat wq|wk|wv and w1|w3
+            # post-quantization (bitwise-exact — models/quant.py:
+            # fuse_layer_weights). Single-chip only: the fused output axis
+            # interleaves head groups and cannot shard over tp.
+            from ..models.quant import fuse_layer_weights
+
+            params = fuse_layer_weights(params)
         if mesh is not None:
             specs = llama_param_specs(self.cfg)
             if self.quant == "int8":
@@ -443,12 +455,21 @@ class GenerationEngine:
                 self.sp = axes["sp"]
 
         kv_q = self.kv_quant == "int8"
+        # quantized GQA caches use the FUSED single-payload layout
+        # (models/llama.py:init_kv_cache): cache["v"] is the empty dict and
+        # V rides cache["k"]'s head axis. MLA int8 keeps its two-dict latent
+        # layout; bf16 keeps bare arrays.
+        fused_kv = kv_q and not self.cfg.kv_lora_rank
         dtype_ = dtype
 
         def _maybe_quant_kv(ks, vs):
             # quantize prompt KV INSIDE the prefill jit: the bf16 KV of a
             # batched admission (A × bucket rows × L layers) never
             # materializes in HBM outside the fused program
+            if fused_kv:
+                from ..models.llama import fuse_prompt_kv
+
+                return fuse_prompt_kv(ks, vs, scale_dtype=dtype_), {}
             if kv_q:
                 return (
                     quantize_kv(ks, scale_dtype=dtype_),
@@ -479,11 +500,26 @@ class GenerationEngine:
                 )
 
         def _insert_row(ck, cv, ks, vs, i, slot):
-            # ks/vs: batched prompt KV [L, A, Hkv, bucket, hd] (already int8
-            # {"q","s"} when the cache is) → write row `i` at
-            # [:, slot, :, :bucket]. `i`/`slot` are traced scalars; the
-            # dynamic_update_slice form updates the donated cache in place
-            # (an advanced-index scatter would copy the full cache payload).
+            # ks/vs: batched prompt KV [L, A, Hkv, bucket, hd] (already in
+            # cache-entry form when the cache is quantized: fused
+            # payload+scales for GQA, {"q","s"} per side for MLA) → write
+            # row `i` at [:, slot, :, :bucket]. `i`/`slot` are traced
+            # scalars; the dynamic_update_slice form updates the donated
+            # cache in place (an advanced-index scatter would copy the full
+            # cache payload).
+            if fused_kv:
+                ck = {
+                    "q": jax.lax.dynamic_update_slice(
+                        ck["q"], jax.lax.dynamic_slice_in_dim(ks["q"], i, 1, 1),
+                        (0, slot, 0, 0, 0),
+                    ),
+                    "s": jax.lax.dynamic_update_slice(
+                        ck["s"],
+                        jax.lax.dynamic_slice_in_dim(ks["s"], i, 1, 1).astype(ck["s"].dtype),
+                        (0, slot, 0, 0),
+                    ),
+                }
+                return ck, cv
             if kv_q:
                 ck = {
                     "q": jax.lax.dynamic_update_slice(
@@ -613,6 +649,16 @@ class GenerationEngine:
             the start index backwards and overwrite the shared prefix rows
             just re-inserted below it. Restore guarantees start+R = bucket
             <= S, so the traced start is never clamped."""
+            if fused_kv:
+                ck = {
+                    "q": jax.lax.dynamic_update_slice(
+                        ck["q"], pk["q"], (0, slot, 0, start, 0)
+                    ),
+                    "s": jax.lax.dynamic_update_slice(
+                        ck["s"], pk["s"].astype(ck["s"].dtype), (0, slot, 0, start)
+                    ),
+                }
+                return ck, cv
             if kv_q:
                 ck = {
                     "q": jax.lax.dynamic_update_slice(
@@ -1510,6 +1556,8 @@ class GenerationEngine:
 
         def cut(arr):
             if isinstance(arr, dict):
+                if not arr:  # fused GQA: "v" is the empty-dict placeholder
+                    return {}
                 return {
                     "q": jax.device_get(arr["q"][:, b : b + 1, :, start:Lb]),
                     "s": jax.device_get(arr["s"][:, b : b + 1, :, start:Lb]),
@@ -2170,10 +2218,16 @@ class GenerationEngine:
                 "q": self._ck["q"][:, slot : slot + 1, :, :p0],
                 "s": self._ck["s"][:, slot : slot + 1, :, :p0],
             }
-            pv = {
-                "q": self._cv["q"][:, slot : slot + 1, :, :p0],
-                "s": self._cv["s"][:, slot : slot + 1, :, :p0],
-            }
+            # fused GQA caches carry V inside pk's head axis; "v" stays the
+            # empty-dict placeholder through store and re-insert
+            pv = (
+                {}
+                if not self._cv
+                else {
+                    "q": self._cv["q"][:, slot : slot + 1, :, :p0],
+                    "s": self._cv["s"][:, slot : slot + 1, :, :p0],
+                }
+            )
         else:
             pk = self._ck[:, slot : slot + 1, :, :p0]
             pv = self._cv[:, slot : slot + 1, :, :p0]

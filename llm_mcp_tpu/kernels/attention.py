@@ -101,21 +101,24 @@ def decode_pallas_max_seq(
 ) -> int:
     """Longest cache row the whole-S decode kernels can stream through VMEM.
 
-    Both decode kernels load a full [.., S, hd] K/V tile per grid cell (plus
-    f32 score/prob tiles), double-buffered by the pipeline. Beyond this cap
-    the kernel would fail AT RUNTIME on a real chip with a VMEM allocation
-    error — the resolver must reject it at config time instead
-    (VERDICT r1 #8: nothing enforced the boundary).
+    Both whole-S decode arms load a full [.., S, hd] K/V tile per grid cell
+    (plus f32 score/prob tiles), double-buffered by the pipeline. Beyond
+    this cap a whole-S pallas_call would fail AT RUNTIME on a real chip
+    with a VMEM allocation error — `decode_attend_q8`/`decode_attend_bf16`
+    must pick their BLOCKED arm statically instead (VERDICT r1 #8: nothing
+    enforced the boundary).
 
-      q8 kernel (one cell = one batch row, all KV heads):
-        2 × Hkv·hd int8 payload (k+v, double-buffered) + Hkv·2 scales
-        + 2 × H f32 score/prob rows            per cache position
+      q8 kernel (one cell = one batch row, all KV heads; the fused-layout
+      BlockSpec reads only the 2·Hkv payload heads, never the packed
+      scale row):
+        2 × 2·Hkv·hd int8 payload (k+v fused, double-buffered)
+        + 2·Hkv scale bytes + 2 × H f32 score/prob rows   per cache position
       bf16 kernel (one cell = one (row, head)):
         2 × hd·2 bf16 payload (k+v, double-buffered) + G·4 scores
     """
     budget = 12 * 1024 * 1024  # of ~16 MB VMEM; headroom for q/out/temps
     if quantized:
-        per_pos = 2 * (2 * n_kv_heads * head_dim) + 4 * n_kv_heads + 2 * 4 * n_heads
+        per_pos = 2 * (2 * n_kv_heads * head_dim) + 8 * n_kv_heads + 2 * 4 * n_heads
     else:
         g = max(1, n_heads // n_kv_heads)
         per_pos = 2 * (2 * head_dim * 2) + 4 * g
@@ -133,41 +136,34 @@ def resolve_decode_impl(
 ) -> str:
     """Attention impl for the DECODE step (prefill keeps resolve_attn_impl).
 
-    For the bf16 cache the default is the XLA einsum path even on TPU: with
-    the cache carried through the layer scan, XLA fuses the layer
-    dynamic-slice into the attention einsums and scatters the new token in
-    place — measured 6.2 ms/step (B=32) vs 10.4 ms for the sliced Pallas
-    kernel (the pallas_call operand forces a materialized [B, Hkv, S, hd]
-    copy per layer) and 89 ms for the full-cache-operand kernel (XLA copies
-    the whole carried buffer around the custom call).
-
     For the INT8 cache the default on TPU is the `decode_attend_q8` Pallas
     kernel: XLA's int8 einsum path materializes a bf16 copy of the
     dequantized cache (measured 236 GB/s effective at 8B B=64 — slower than
     the bf16 cache), while the kernel streams the int8 payload into s8 MXU
-    dots with no bulk converts. env LLM_MCP_TPU_ATTN still forces either
-    path for tests."""
+    dots with no bulk converts.
+
+    The bf16 cache now defaults to Pallas on a single TPU chip too:
+    `decode_attend_bf16` runs the same scan-invariant-cache + post-scan
+    batched-append structure as the q8 path (the structure that made q8
+    fast), with a runtime whole-S/blocked hybrid. The old in-scan sliced
+    kernel this resolver used to reject in favor of XLA (measured 10.4 vs
+    6.2 ms/step at B=32) is gone from the decode routing. There is no seq
+    cap either way anymore: past `decode_pallas_max_seq` both dtypes pick
+    their blocked arm statically (HBM streaming, no VMEM cliff).
+    env LLM_MCP_TPU_ATTN still forces either path for tests; the
+    `head_dim`/`n_kv_heads`/`n_heads`/`seq_len` kwargs stay for callers
+    and tests probing the VMEM budget."""
+    del seq_len, head_dim, n_kv_heads, n_heads  # cap moved into the hybrids
     if mesh is not None and mesh.size > 1:
         # Same rule as resolve_attn_impl: the unwrapped pallas_call must not
         # trace over GSPMD-sharded cache operands (the einsum path partitions
         # cleanly; the q8 kernel would force replication or fail to compile).
         return "xla"
-    if (
-        seq_len
-        and not quantized
-        and seq_len > decode_pallas_max_seq(head_dim, n_kv_heads, n_heads, quantized)
-    ):
-        # bf16 cache rows exceed the whole-S kernel's VMEM budget:
-        # long-context decode takes the XLA einsum path (no VMEM cliff).
-        # The int8 path has no cap — beyond the budget decode_attend_q8
-        # streams cache blocks from HBM with a dynamic trip count.
-        return "xla"
     mode = os.environ.get("LLM_MCP_TPU_ATTN", "auto")
     if mode in ("pallas", "xla"):
         return mode
-    if quantized:
-        return "pallas" if _on_tpu() else "xla"
-    return "xla"
+    del quantized  # both cache dtypes default to the pallas hybrids on-chip
+    return "pallas" if _on_tpu() else "xla"
 
 
 def _interpret() -> bool:
@@ -331,90 +327,6 @@ def _decode_attn_kernel(
     o_ref[0, 0] = (ctx / l).astype(o_ref.dtype)
 
 
-def _decode_attn_cache_kernel(
-    li_ref,  # [1] int32 (scalar prefetch) — layer index
-    lengths_ref,  # [B] int32 (scalar prefetch)
-    q_ref,  # [1, 1, G, hd]
-    k_ref,  # [1, 1, 1, S, hd]
-    v_ref,  # [1, 1, 1, S, hd]
-    o_ref,  # [1, 1, G, hd]
-    *,
-    scale: float,
-):
-    b = pl.program_id(0)
-    valid_len = lengths_ref[b]
-    S = k_ref.shape[3]
-
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, hd]
-    k = k_ref[0, 0, 0].astype(jnp.float32)  # [S, hd]
-    v = v_ref[0, 0, 0].astype(jnp.float32)
-
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # [G, S]
-    pos = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
-    s = jnp.where(pos <= valid_len, s, NEG_INF)
-
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    ctx = jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )  # [G, hd]
-    o_ref[0, 0] = (ctx / l).astype(o_ref.dtype)
-
-
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def decode_attention_cache(
-    q: jnp.ndarray,  # [B, Hkv, G, hd]
-    cache_k: jnp.ndarray,  # [L, B, Hkv, S, hd] — FULL stacked cache
-    cache_v: jnp.ndarray,  # [L, B, Hkv, S, hd]
-    layer: jnp.ndarray,  # scalar int32 — which layer's cache to attend over
-    lengths: jnp.ndarray,  # [B] int32
-    *,
-    interpret: bool | None = None,
-) -> jnp.ndarray:
-    """decode_attention reading the full [L, ...] cache at a traced layer
-    index (scalar-prefetch BlockSpec indexing). Inside the layer scan a
-    `dynamic_index_in_dim` slice of the carried cache materializes a
-    [B, Hkv, S, hd] copy per layer per step — measured ~3.8 ms/step of the
-    10.4 ms decode step at B=32 S=1024 (llama-3.2-1b). Indexing the L axis
-    in the kernel's index_map makes the DMA read the carried buffer
-    directly: no slice, no copy."""
-    B, Hkv, G, hd = q.shape
-    S = cache_k.shape[3]
-    interp = _interpret() if interpret is None else interpret
-
-    if not _HAS_PLTPU:  # pragma: no cover — CPU builds without pallas-tpu
-        ck = jax.lax.dynamic_index_in_dim(cache_k, layer, 0, keepdims=False)
-        cv = jax.lax.dynamic_index_in_dim(cache_v, layer, 0, keepdims=False)
-        return decode_attention(q, ck, cv, lengths, interpret=interp)
-
-    kernel = functools.partial(_decode_attn_cache_kernel, scale=hd**-0.5)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # layer [1], lengths [B]
-        grid=(B, Hkv),
-        in_specs=[
-            pl.BlockSpec((1, 1, G, hd), lambda b, h, li, lens: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, 1, S, hd), lambda b, h, li, lens: (li[0], b, h, 0, 0)),
-            pl.BlockSpec((1, 1, 1, S, hd), lambda b, h, li, lens: (li[0], b, h, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, G, hd), lambda b, h, li, lens: (b, h, 0, 0)),
-    )
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype),
-        interpret=interp,
-    )(
-        jnp.reshape(layer, (1,)).astype(jnp.int32),
-        lengths.astype(jnp.int32),
-        q,
-        cache_k,
-        cache_v,
-    )
-
-
 def _attend_q8_kernel(
     li_ref,  # [1] int32 (scalar prefetch) — layer index
     ids_ref,  # [Ba] int32 (scalar prefetch) — cache row per batch position
@@ -422,15 +334,20 @@ def _attend_q8_kernel(
     q_ref,  # [1, Hkv, G, hd]
     nk_ref,  # [1, Hkv, 1, hd] — this step's K vectors (post-rope)
     nv_ref,  # [1, Hkv, 1, hd]
-    k_ref,  # [1, 1, Hkv, S, hd] int8 — this layer's K payload, all heads
-    ks_ref,  # [1, 1, Hkv, S] — K scales
-    v_ref,  # [1, 1, Hkv, S, hd] int8
-    vs_ref,  # [1, 1, Hkv, S]
+    kv_ref,  # [1, 1, 2*Hkv, S, hd] int8 — fused K|V payload, all heads
+    s_ref,  # [1, 1, 2*Hkv, S] — fused K|V dequant scales
     o_ref,  # [1, Hkv, G, hd] — attention output
     *,
     scale: float,
 ):
     """One grid cell = one batch row, all KV heads.
+
+    The cache rides the FUSED layout (models/llama.py:init_kv_cache): K
+    heads [0, Hkv), V heads [Hkv, 2*Hkv) of one int8 payload array, so the
+    pipeline issues ONE payload DMA + one scales DMA per cell instead of
+    four. The padded packed-scale pseudo-head (head 2*Hkv, blocked-kernel
+    fuel) is excluded by the BlockSpec — this kernel reads the plain "s"
+    rows.
 
     Perf-critical invariant: the int8 K/V payloads feed the MXU *as int8*
     (s8 x s8 -> s32 dots). Converting them elementwise would bottleneck on
@@ -440,23 +357,25 @@ def _attend_q8_kernel(
     """
     b = pl.program_id(0)
     w = lengths_ref[b]  # this step's position; attend to 0..w inclusive
-    Hkv, S = k_ref.shape[2], k_ref.shape[3]
+    S = kv_ref.shape[3]
+    Hkv = q_ref.shape[1]
     G = q_ref.shape[2]
 
     nk = nk_ref[0, :, 0].astype(jnp.float32)  # [Hkv, hd]
     nv = nv_ref[0, :, 0].astype(jnp.float32)
     q = q_ref[0].astype(jnp.float32)  # [Hkv, G, hd]
-    kss = ks_ref[0, 0].astype(jnp.float32)  # [Hkv, S]
-    vss = vs_ref[0, 0].astype(jnp.float32)
+    ss = s_ref[0, 0].astype(jnp.float32)  # [2*Hkv, S]
+    kss, vss = ss[:Hkv], ss[Hkv:]
 
     # quantize q per (h, g) row; fold the attention scale into the q scales
     qa = jnp.max(jnp.abs(q), axis=-1)  # [Hkv, G]
     qsc = jnp.maximum(qa / 127.0, 1e-30)
     q8 = jnp.round(q / qsc[..., None]).astype(jnp.int8)
 
+    kvq = kv_ref[0, 0]  # [2*Hkv, S, hd] int8 — k rows then v rows
     s_i = jax.lax.dot_general(
         q8,
-        k_ref[0, 0],
+        kvq[:Hkv],
         (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.int32,
     )  # [Hkv, G, S]
@@ -482,12 +401,23 @@ def _attend_q8_kernel(
     p8 = jnp.round(pv / psc[..., None]).astype(jnp.int8)
     ctx_i = jax.lax.dot_general(
         p8,
-        v_ref[0, 0],
+        kvq[Hkv:],
         (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.int32,
     )  # [Hkv, G, hd]
     ctx = ctx_i.astype(jnp.float32) * psc[..., None] + p_w * nv[:, None, :]
     o_ref[0] = (ctx / l).astype(o_ref.dtype)
+
+
+def _unpack_scale_lanes(srow, n_heads: int, scale_dtype):
+    """In-kernel inverse of models/quant.py:pack_scales for one landed
+    block: [BS, hd] int8 scale-row bytes -> [n_heads, BS] scales. Byte
+    layout parity with pack_scales is pinned by the fused-layout parity
+    tests (a drifting layout would desync every dequant)."""
+    it = jnp.dtype(scale_dtype).itemsize
+    raw = srow[:, : n_heads * it].reshape(srow.shape[0], n_heads, it)
+    s = jax.lax.bitcast_convert_type(raw, scale_dtype)  # [BS, n_heads]
+    return jnp.swapaxes(s, 0, 1)
 
 
 def _attend_q8_blocked_kernel(
@@ -497,20 +427,21 @@ def _attend_q8_blocked_kernel(
     q_ref,  # [1, Hkv, G, hd] VMEM
     nk_ref,  # [1, Hkv, 1, hd] VMEM — this step's K vectors (post-rope)
     nv_ref,  # [1, Hkv, 1, hd] VMEM
-    kq_hbm,  # [L, B, Hkv, S, hd] int8 — stays in HBM (ANY), DMA'd per block
-    ks_hbm,  # [L, B, Hkv, S]
-    vq_hbm,  # [L, B, Hkv, S, hd] int8
-    vs_hbm,  # [L, B, Hkv, S]
+    pay_hbm,  # [L, B, 2*Hkv + p, S, hd] int8 — fused K|V(|packed scales)
+    #           payload, stays in HBM (ANY), DMA'd per block
+    s_hbm,  # [L, B, 2*Hkv, S] — plain scales (read only when packed=False)
     o_ref,  # [1, Hkv, G, hd] VMEM out
-    k_buf,  # VMEM scratch [2, Hkv, BS, hd] int8 (double buffer)
-    ks_buf,  # [2, Hkv, BS]
-    v_buf,  # [2, Hkv, BS, hd] int8
-    vs_buf,  # [2, Hkv, BS]
-    sems,  # DMA semaphores [2, 4]
+    pay_buf,  # VMEM scratch [2, Hh, BS, hd] int8 (double buffer);
+    #           Hh = 2*Hkv + 1 when packed else 2*Hkv
+    s_buf,  # [2, 2*Hkv, BS] (unused when packed — tiny, kept so both modes
+    #        share one scratch list)
+    sems,  # DMA semaphores [2, 2]
     *,
     scale: float,
     block_s: int,
     seq_len: int,
+    packed: bool,
+    scale_dtype,
 ):
     """Dynamic-length decode attention: only the cache blocks that contain
     attended positions ([0, w]) ever leave HBM.
@@ -522,13 +453,28 @@ def _attend_q8_blocked_kernel(
     DYNAMIC trip count (ceil((w+1)/BS)) streams exactly the attended prefix,
     flash-style online softmax accumulating across blocks. Same s8-MXU dot
     discipline and exact current-position override as `_attend_q8_kernel`.
+
+    DMA count per (row, block) cell is the r05-measured bottleneck
+    (~2.5 µs of issue latency per cell regardless of bytes): the fused
+    layout collapses the old 4 copies (kq/ks/vq/vs) to
+
+      packed=True  — ONE copy: K, V and a bit-packed per-position scale
+        pseudo-head travel in the same [2*Hkv+1, BS, hd] int8 block; the
+        scales are unpacked in VMEM (`_unpack_scale_lanes`).
+      packed=False — TWO copies: the [2*Hkv, BS, hd] payload head-slice
+        plus one [2*Hkv, BS] block of the plain scales array. This is the
+        fallback when the scale bytes don't fit one head row
+        (2*Hkv*itemsize > hd) or LLM_MCP_TPU_Q8_SCALE_PACK=0. Unlike the
+        r05-rejected per-cache single-row [2, BS] loads, a [2*Hkv, BS]
+        slice of the head-major scales array is a (sublane, lane)-tileable
+        copy Mosaic accepts.
     """
     b = pl.program_id(0)
     li = li_ref[0]
     row = ids_ref[b]  # cache row for this batch position (compaction)
     w = lengths_ref[b]
     BS = block_s
-    Hkv = k_buf.shape[1]
+    Hkv = q_ref.shape[1]
     nblk_max = seq_len // BS
     nblk = jnp.clip((w + BS) // BS, 1, nblk_max)
     # parked/free rows (w >= S, engine convention) produce discarded output:
@@ -537,18 +483,25 @@ def _attend_q8_blocked_kernel(
     nblk = jnp.where(w >= seq_len, 1, nblk)
 
     def copies(j, slot):
+        if packed:
+            # one DMA: full head axis (K | V | packed-scale pseudo-head)
+            return (
+                pltpu.make_async_copy(
+                    pay_hbm.at[li, row, :, pl.ds(j * BS, BS), :],
+                    pay_buf.at[slot],
+                    sems.at[slot, 0],
+                ),
+            )
         return (
             pltpu.make_async_copy(
-                kq_hbm.at[li, row, :, pl.ds(j * BS, BS), :], k_buf.at[slot], sems.at[slot, 0]
+                pay_hbm.at[li, row, pl.ds(0, 2 * Hkv), pl.ds(j * BS, BS), :],
+                pay_buf.at[slot],
+                sems.at[slot, 0],
             ),
             pltpu.make_async_copy(
-                ks_hbm.at[li, row, :, pl.ds(j * BS, BS)], ks_buf.at[slot], sems.at[slot, 1]
-            ),
-            pltpu.make_async_copy(
-                vq_hbm.at[li, row, :, pl.ds(j * BS, BS), :], v_buf.at[slot], sems.at[slot, 2]
-            ),
-            pltpu.make_async_copy(
-                vs_hbm.at[li, row, :, pl.ds(j * BS, BS)], vs_buf.at[slot], sems.at[slot, 3]
+                s_hbm.at[li, row, :, pl.ds(j * BS, BS)],
+                s_buf.at[slot],
+                sems.at[slot, 1],
             ),
         )
 
@@ -585,8 +538,14 @@ def _attend_q8_blocked_kernel(
             start(j + 1, 1 - slot)
 
         wait(j, slot)
-        k = k_buf[slot]  # [Hkv, BS, hd] int8
-        kss = ks_buf[slot].astype(jnp.float32)  # [Hkv, BS]
+        buf = pay_buf[slot]  # [Hh, BS, hd] int8 — k rows, v rows(, scales)
+        k = buf[:Hkv]  # [Hkv, BS, hd] int8
+        if packed:
+            ss = _unpack_scale_lanes(buf[2 * Hkv], 2 * Hkv, scale_dtype)
+        else:
+            ss = s_buf[slot]
+        ss = ss.astype(jnp.float32)  # [2*Hkv, BS]
+        kss, vss = ss[:Hkv], ss[Hkv:]
         s_i = jax.lax.dot_general(
             q8, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.int32
         )  # [Hkv, G, BS]
@@ -600,13 +559,15 @@ def _attend_q8_blocked_kernel(
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         p_w = jnp.sum(jnp.where(pos == w, p, 0.0), axis=-1, keepdims=True)
-        vss = vs_buf[slot].astype(jnp.float32)
         pv = jnp.where(pos == w, 0.0, p * vss[:, None, :])
         pa = jnp.max(pv, axis=-1)
         psc = jnp.maximum(pa / 127.0, 1e-30)
         p8 = jnp.round(pv / psc[..., None]).astype(jnp.int8)
         ctx_i = jax.lax.dot_general(
-            p8, v_buf[slot], (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.int32
+            p8,
+            buf[Hkv : 2 * Hkv],
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32,
         )  # [Hkv, G, hd]
         acc_new = (
             acc * alpha + ctx_i.astype(jnp.float32) * psc[..., None] + p_w * nv[:, None, :]
@@ -617,20 +578,31 @@ def _attend_q8_blocked_kernel(
     o_ref[0] = (acc / l).astype(o_ref.dtype)
 
 
+def fused_q8_heads(cache_k: dict) -> tuple[int, int]:
+    """(Hkv, p) of a FUSED int8 GQA cache: the payload carries 2*Hkv K|V
+    heads plus p ∈ {0, 1} packed-scale pseudo-heads; the plain "s" array
+    always has exactly 2*Hkv."""
+    Hs = cache_k["s"].shape[2]
+    return Hs // 2, cache_k["q"].shape[2] - Hs
+
+
 def _decode_attend_q8_fallback(
     q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids=None
 ):
-    """Exact-f32 mirror of the q8 kernels' math (no q/prob requant). Used on
-    CPU builds without pallas-tpu and for cache lengths no int8-tileable
-    block size divides."""
+    """Exact-f32 mirror of the q8 kernels' math (no q/prob requant) over the
+    FUSED cache layout. Used on CPU builds without pallas-tpu and for cache
+    lengths no int8-tileable block size divides. `cache_v` is the fused
+    layout's empty-dict placeholder (V lives in cache_k's head axis)."""
+    del cache_v
     S = cache_k["q"].shape[3]
-    kf = jax.lax.dynamic_index_in_dim(cache_k["q"], layer, 0, keepdims=False)
-    vf = jax.lax.dynamic_index_in_dim(cache_v["q"], layer, 0, keepdims=False)
-    kss = jax.lax.dynamic_index_in_dim(cache_k["s"], layer, 0, keepdims=False)
-    vss = jax.lax.dynamic_index_in_dim(cache_v["s"], layer, 0, keepdims=False)
+    Hkv, _ = fused_q8_heads(cache_k)
+    pay = jax.lax.dynamic_index_in_dim(cache_k["q"], layer, 0, keepdims=False)
+    ss = jax.lax.dynamic_index_in_dim(cache_k["s"], layer, 0, keepdims=False)
     if slot_ids is not None:
-        kf, vf = jnp.take(kf, slot_ids, 0), jnp.take(vf, slot_ids, 0)
-        kss, vss = jnp.take(kss, slot_ids, 0), jnp.take(vss, slot_ids, 0)
+        pay = jnp.take(pay, slot_ids, 0)
+        ss = jnp.take(ss, slot_ids, 0)
+    kf, vf = pay[:, :Hkv], pay[:, Hkv : 2 * Hkv]
+    kss, vss = ss[:, :Hkv], ss[:, Hkv:]
     qf = q.astype(jnp.float32) * sc
     s = jnp.einsum("bhgd,bhsd->bhgs", qf, kf.astype(jnp.float32)) * kss.astype(
         jnp.float32
@@ -653,8 +625,8 @@ def decode_attend_q8(
     q: jnp.ndarray,  # [Ba, Hkv, G, hd] — COMPACT batch (active rows only)
     new_k: jnp.ndarray,  # [Ba, Hkv, hd] — post-rope K for this step
     new_v: jnp.ndarray,  # [Ba, Hkv, hd]
-    cache_k: dict,  # {"q": int8 [L,B,Hkv,S,hd], "s": [L,B,Hkv,S]} PRE-append
-    cache_v: dict,
+    cache_k: dict,  # FUSED: {"q": int8 [L,B,2*Hkv+p,S,hd], "s": [L,B,2*Hkv,S]}
+    cache_v: dict,  # {} — V rides cache_k's head axis (layout invariant)
     layer: jnp.ndarray,  # scalar int32
     lengths: jnp.ndarray,  # [Ba] int32 — this step's position per row
     *,
@@ -662,7 +634,10 @@ def decode_attend_q8(
     scale: float = 0.0,  # query scale (0 = head_dim**-0.5)
     interpret: bool | None = None,
 ) -> jnp.ndarray:
-    """Attention over the int8 KV cache for one layer of the decode step.
+    """Attention over the FUSED int8 KV cache for one layer of the decode
+    step (layout: models/llama.py:init_kv_cache — K heads, V heads, and an
+    optional bit-packed scale pseudo-head share one payload array, PRE-
+    append).
 
     The int8 payload streams from HBM straight into s8 x s8 -> s32 MXU dots
     (XLA's einsum path materializes a dequantized bf16 copy and runs ~2x
@@ -679,6 +654,7 @@ def decode_attend_q8(
     S = cache_k["q"].shape[3]
     interp = _interpret() if interpret is None else interpret
     sc = scale or hd**-0.5
+    _, p = fused_q8_heads(cache_k)
 
     if not _HAS_PLTPU:  # pragma: no cover — CPU builds without pallas-tpu
         return _decode_attend_q8_fallback(
@@ -697,6 +673,8 @@ def decode_attend_q8(
         return _decode_attend_q8_fallback(
             q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids
         )
+    # 1-DMA packed blocks need the scale pseudo-head present in the layout
+    packed = p == 1 and os.environ.get("LLM_MCP_TPU_Q8_SCALE_PACK", "1") != "0"
     ids = (
         jnp.arange(B, dtype=jnp.int32)
         if slot_ids is None
@@ -711,14 +689,14 @@ def decode_attend_q8(
         nv4,
         cache_k["q"],
         cache_k["s"],
-        cache_v["q"],
-        cache_v["s"],
     )
     out_shape = jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype)
 
     def run_whole():
-        # whole-S tiles fit VMEM: one big DMA per tensor per cell, pipelined
-        # across grid cells — the cheaper shape once rows are mostly full
+        # whole-S tiles fit VMEM: one payload + one scales DMA per cell,
+        # pipelined across grid cells — the cheaper shape once rows are
+        # mostly full. The payload block stops at head 2*Hkv: the packed
+        # scale pseudo-head is blocked-arm fuel and never enters VMEM here.
         kernel = functools.partial(_attend_q8_kernel, scale=sc)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,  # layer [1], slot ids [Ba], lengths [Ba]
@@ -728,18 +706,14 @@ def decode_attend_q8(
                 pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
                 pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
                 # cache tiles follow the compaction indirection: batch cell b
-                # reads cache row ids[b]
+                # reads cache row ids[b]. Head-block index 0 of the ragged
+                # (2*Hkv + p) head axis covers exactly the 2*Hkv payload rows.
                 pl.BlockSpec(
-                    (1, 1, Hkv, S, hd), lambda b, li, ids, lens: (li[0], ids[b], 0, 0, 0)
+                    (1, 1, 2 * Hkv, S, hd),
+                    lambda b, li, ids, lens: (li[0], ids[b], 0, 0, 0),
                 ),
                 pl.BlockSpec(
-                    (1, 1, Hkv, S), lambda b, li, ids, lens: (li[0], ids[b], 0, 0)
-                ),
-                pl.BlockSpec(
-                    (1, 1, Hkv, S, hd), lambda b, li, ids, lens: (li[0], ids[b], 0, 0, 0)
-                ),
-                pl.BlockSpec(
-                    (1, 1, Hkv, S), lambda b, li, ids, lens: (li[0], ids[b], 0, 0)
+                    (1, 1, 2 * Hkv, S), lambda b, li, ids, lens: (li[0], ids[b], 0, 0)
                 ),
             ],
             out_specs=pl.BlockSpec(
@@ -753,11 +727,17 @@ def decode_attend_q8(
     def run_blocked():
         # rows stream blockwise from HBM with a dynamic trip count — no
         # VMEM cliff at any S, and only the attended prefix [0, w] is ever
-        # read. Pays ~2.5 µs/cell of DMA-issue latency (measured: ~9 ms of
-        # fixed cost at 8B B=112), so it wins at LOW fill and loses to the
-        # whole-S pipeline once rows are mostly full.
+        # read. The r05 layout paid ~2.5 µs/cell of DMA-issue latency over
+        # FOUR copies (measured: ~9 ms of fixed cost at 8B B=112); the
+        # fused layout issues ONE copy per cell (packed) or two (unpacked).
+        Hh = 2 * Hkv + 1 if packed else 2 * Hkv
         kernel = functools.partial(
-            _attend_q8_blocked_kernel, scale=sc, block_s=BS, seq_len=S
+            _attend_q8_blocked_kernel,
+            scale=sc,
+            block_s=BS,
+            seq_len=S,
+            packed=packed,
+            scale_dtype=cache_k["s"].dtype,
         )
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,  # layer [1], slot ids [Ba], lengths [Ba]
@@ -766,20 +746,16 @@ def decode_attend_q8(
                 pl.BlockSpec((1, Hkv, G, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
                 pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
                 pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
-                pl.BlockSpec(memory_space=pl.ANY),  # K payload [L,B,Hkv,S,hd]
-                pl.BlockSpec(memory_space=pl.ANY),  # K scales
-                pl.BlockSpec(memory_space=pl.ANY),  # V payload
-                pl.BlockSpec(memory_space=pl.ANY),  # V scales
+                pl.BlockSpec(memory_space=pl.ANY),  # fused payload
+                pl.BlockSpec(memory_space=pl.ANY),  # plain scales
             ],
             out_specs=pl.BlockSpec(
                 (1, Hkv, G, hd), lambda b, li, ids, lens: (b, 0, 0, 0)
             ),
             scratch_shapes=[
-                pltpu.VMEM((2, Hkv, BS, hd), jnp.int8),
-                pltpu.VMEM((2, Hkv, BS), cache_k["s"].dtype),
-                pltpu.VMEM((2, Hkv, BS, hd), jnp.int8),
-                pltpu.VMEM((2, Hkv, BS), cache_v["s"].dtype),
-                pltpu.SemaphoreType.DMA((2, 4)),
+                pltpu.VMEM((2, Hh, BS, hd), jnp.int8),
+                pltpu.VMEM((2, 2 * Hkv, BS), cache_k["s"].dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
             ],
         )
         return pl.pallas_call(
@@ -795,20 +771,373 @@ def decode_attend_q8(
         return run_blocked()
     if BS == 0 or interp:
         # interpret mode keeps the static whole-S choice: a runtime cond
-        # would emulate BOTH kernels per call in tests
+        # would emulate BOTH kernels per call in tests. Parity tests force
+        # the blocked arm via LLM_MCP_TPU_Q8_DECODE=blocked instead.
         return run_whole()
-    # Runtime hybrid (both executables compile once): measured at 8B B=112
-    # S=1024, the blocked kernel wins below ~40% traffic ratio (20.5 vs
-    # 24.4 ms/step empty — cache reads scale with actual lengths) and the
-    # whole-S pipeline wins once rows are mostly full (24.4 vs 29.2 at 88%).
+    # Runtime hybrid (both executables compile once). The r05 4-DMA layout
+    # measured the crossover at ~40% traffic ratio (8B B=112 S=1024: 20.5
+    # vs 24.4 ms/step empty, 29.2 vs 24.4 at 88% fill); the fused layout
+    # cuts the blocked arm's per-cell fixed cost ~4x, so its win region
+    # extends to higher fills — default threshold 0.55 (projected from the
+    # r05 fixed-cost split, to be re-measured on hardware; the env knob is
+    # the re-tuning surface).
     # Compare the kernels' ACTUAL traffic: whole-S DMAs all B rows in full
     # (parked/pad rows included), blocked streams the attended prefix per
     # active row and ONE block per parked row — so the ratio denominator is
     # B·S, not active·S (normalizing by active rows would overestimate the
     # whole-S path exactly in the low-occupancy regime blocked wins).
+    thr = float(os.environ.get("LLM_MCP_TPU_Q8_HYBRID", "0.55"))
     w_eff = jnp.where(lengths < S, jnp.minimum(lengths + 1, S), BS)
     ratio = jnp.sum(w_eff.astype(jnp.float32)) / (B * S)
-    return jax.lax.cond(ratio < 0.4, run_blocked, run_whole)
+    return jax.lax.cond(ratio < thr, run_blocked, run_whole)
+
+
+def blocked_dma_count(layout: str, packed: bool = True) -> int:
+    """Cache copies per (row, block) cell issued by the blocked decode arms
+    (static layout property; `scripts/kernel_bench.py` and the parity-guard
+    tests read it rather than re-deriving the copy structure).
+
+      q8_gqa   — 1 packed (K|V|scale pseudo-head in one fused int8 block) or
+                 2 unpacked (payload head-slice + plain-scales block)
+      bf16_gqa — 2 (split K and V arrays; no scales to carry)
+      q8_mla   — 1 (latent payload with inlined rope rows; per-position
+                 scales fold via the absorbed-query trick, r05 layout)
+
+    The r05 pre-fusion GQA layout issued 4 (kq/ks/vq/vs)."""
+    if layout == "q8_gqa":
+        return 1 if packed else 2
+    if layout == "bf16_gqa":
+        return 2
+    if layout == "q8_mla":
+        return 1
+    raise ValueError(f"unknown blocked layout: {layout!r}")
+
+
+def _attend_bf16_kernel(
+    li_ref,  # [1] int32 (scalar prefetch) — layer index
+    ids_ref,  # [Ba] int32 (scalar prefetch) — cache row per batch position
+    lengths_ref,  # [Ba] int32 (scalar prefetch) — this step's position per row
+    q_ref,  # [1, 1, G, hd]
+    nk_ref,  # [1, 1, 1, hd] — this step's K vector (post-rope)
+    nv_ref,  # [1, 1, 1, hd]
+    k_ref,  # [1, 1, 1, S, hd] — cache tile, PRE-append
+    v_ref,  # [1, 1, 1, S, hd]
+    o_ref,  # [1, 1, G, hd]
+    *,
+    scale: float,
+):
+    """Whole-S bf16 decode attention, one grid cell = one (batch row, KV
+    head) — the bf16 sibling of `_attend_q8_kernel`, with the same
+    compaction indirection (slot ids), traced layer index, and exact
+    current-position override. A per-(row, head) cell keeps the VMEM
+    per-position cost at ~2·hd·2 bytes so the whole-S arm reaches the same
+    ~12K-position cap as the q8 arm (`decode_pallas_max_seq`)."""
+    b = pl.program_id(0)
+    w = lengths_ref[b]
+    S = k_ref.shape[3]
+
+    k = k_ref[0, 0, 0]  # [S, hd] cache dtype — fed to the MXU un-upcast
+    v = v_ref[0, 0, 0]
+    q = q_ref[0, 0]  # [G, hd]
+    nk = nk_ref[0, 0, 0].astype(jnp.float32)  # [hd]
+    nv = nv_ref[0, 0, 0].astype(jnp.float32)
+
+    s = (
+        jax.lax.dot_general(
+            q.astype(k.dtype), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )  # [G, S]
+    pos = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+    # the tile holds the PRE-append cache — position w's score/value come
+    # from the exact new vectors (append happens outside the kernel)
+    s_new = (
+        jnp.sum(q.astype(jnp.float32) * nk[None, :], axis=-1, keepdims=True) * scale
+    )  # [G, 1]
+    s = jnp.where(pos == w, s_new, s)
+    s = jnp.where(pos <= w, s, NEG_INF)
+
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    p_w = jnp.sum(jnp.where(pos == w, p, 0.0), axis=-1, keepdims=True)  # [G, 1]
+    pv = jnp.where(pos == w, 0.0, p)
+    ctx = jax.lax.dot_general(
+        pv.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [G, hd]
+    ctx = ctx + p_w * nv[None, :]
+    o_ref[0, 0] = (ctx / l).astype(o_ref.dtype)
+
+
+def _attend_bf16_blocked_kernel(
+    li_ref,  # [1] int32 (scalar prefetch) — layer index
+    ids_ref,  # [Ba] int32 (scalar prefetch) — cache row per batch position
+    lengths_ref,  # [Ba] int32 (scalar prefetch) — this step's position per row
+    q_ref,  # [1, Hkv, G, hd] VMEM
+    nk_ref,  # [1, Hkv, 1, hd] VMEM
+    nv_ref,  # [1, Hkv, 1, hd] VMEM
+    k_hbm,  # [L, B, Hkv, S, hd] — stays in HBM (ANY), DMA'd per block
+    v_hbm,  # [L, B, Hkv, S, hd]
+    o_ref,  # [1, Hkv, G, hd] VMEM out
+    k_buf,  # VMEM scratch [2, Hkv, BS, hd] cache dtype (double buffer)
+    v_buf,
+    sems,  # DMA semaphores [2, 2]
+    *,
+    scale: float,
+    block_s: int,
+    seq_len: int,
+):
+    """Blocked bf16 decode attention — the GQA bf16 sibling of
+    `_attend_q8_blocked_kernel`: dynamic trip count streams only the
+    attended prefix [0, w], flash-style online softmax across blocks, one
+    grid cell = one batch row (all KV heads). Two DMAs per cell (split K and
+    V arrays — `blocked_dma_count("bf16_gqa")`); the bf16 cache keeps its
+    bare split layout because there are no scale rows to fuse."""
+    b = pl.program_id(0)
+    li = li_ref[0]
+    row = ids_ref[b]
+    w = lengths_ref[b]
+    BS = block_s
+    Hkv = q_ref.shape[1]
+    nblk_max = seq_len // BS
+    nblk = jnp.clip((w + BS) // BS, 1, nblk_max)
+    # parked/free rows (w >= S, engine convention): stream one block
+    nblk = jnp.where(w >= seq_len, 1, nblk)
+
+    def copies(j, slot):
+        return (
+            pltpu.make_async_copy(
+                k_hbm.at[li, row, :, pl.ds(j * BS, BS), :],
+                k_buf.at[slot],
+                sems.at[slot, 0],
+            ),
+            pltpu.make_async_copy(
+                v_hbm.at[li, row, :, pl.ds(j * BS, BS), :],
+                v_buf.at[slot],
+                sems.at[slot, 1],
+            ),
+        )
+
+    def start(j, slot):
+        for c in copies(j, slot):
+            c.start()
+
+    def wait(j, slot):
+        for c in copies(j, slot):
+            c.wait()
+
+    start(0, 0)
+
+    q = q_ref[0]  # [Hkv, G, hd]
+    nk = nk_ref[0, :, 0].astype(jnp.float32)  # [Hkv, hd]
+    nv = nv_ref[0, :, 0].astype(jnp.float32)
+    qc = q.astype(k_buf.dtype)
+    s_new = (
+        jnp.sum(q.astype(jnp.float32) * nk[:, None, :], axis=-1, keepdims=True) * scale
+    )  # [Hkv, G, 1]
+
+    G = q_ref.shape[2]
+    hd = q_ref.shape[3]
+    acc0 = jnp.zeros((Hkv, G, hd), jnp.float32)
+    m0 = jnp.full((Hkv, G, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hkv, G, 1), jnp.float32)
+
+    def body(j, carry):
+        acc, m, l = carry
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nblk)
+        def _prefetch():
+            start(j + 1, 1 - slot)
+
+        wait(j, slot)
+        k = k_buf[slot]  # [Hkv, BS, hd]
+        v = v_buf[slot]
+        s = (
+            jax.lax.dot_general(
+                qc, k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [Hkv, G, BS]
+        pos = j * BS + jax.lax.broadcasted_iota(jnp.int32, (1, 1, BS), 2)
+        s = jnp.where(pos == w, s_new, s)
+        s = jnp.where(pos <= w, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(pos <= w, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        p_w = jnp.sum(jnp.where(pos == w, p, 0.0), axis=-1, keepdims=True)
+        pv = jnp.where(pos == w, 0.0, p)
+        ctx = jax.lax.dot_general(
+            pv.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [Hkv, G, hd]
+        acc_new = acc * alpha + ctx + p_w * nv[:, None, :]
+        return acc_new, m_new, l_new
+
+    acc, m, l = jax.lax.fori_loop(0, nblk, body, (acc0, m0, l0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _decode_attend_bf16_fallback(
+    q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids=None
+):
+    """Exact-f32 einsum mirror of the bf16 kernels' math (whole-S reference
+    for the parity tests; the serving path on CPU / multi-chip meshes)."""
+    S = cache_k.shape[3]
+    k = jax.lax.dynamic_index_in_dim(cache_k, layer, 0, keepdims=False)
+    v = jax.lax.dynamic_index_in_dim(cache_v, layer, 0, keepdims=False)
+    if slot_ids is not None:
+        k = jnp.take(k, slot_ids, 0)
+        v = jnp.take(v, slot_ids, 0)
+    qf = q.astype(jnp.float32)
+    s = jnp.einsum("bhgd,bhsd->bhgs", qf, k.astype(jnp.float32)) * sc
+    pos = jnp.arange(S)[None, None, None, :]
+    w = lengths[:, None, None, None]
+    s_new = jnp.einsum("bhgd,bhd->bhg", qf, new_k.astype(jnp.float32)) * sc
+    s = jnp.where(pos == w, s_new[..., None], s)
+    s = jnp.where(pos <= w, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p_w = jnp.sum(jnp.where(pos == w, p, 0.0), axis=-1)  # [B, Hkv, G]
+    pv = jnp.where(pos == w, 0.0, p)
+    ctx = jnp.einsum("bhgs,bhsd->bhgd", pv, v.astype(jnp.float32))
+    ctx = ctx + p_w[..., None] * new_v.astype(jnp.float32)[:, :, None, :]
+    return ctx.astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "scale"))
+def decode_attend_bf16(
+    q: jnp.ndarray,  # [Ba, Hkv, G, hd] — COMPACT batch (active rows only)
+    new_k: jnp.ndarray,  # [Ba, Hkv, hd] — post-rope K for this step
+    new_v: jnp.ndarray,  # [Ba, Hkv, hd]
+    cache_k: jnp.ndarray,  # [L, B, Hkv, S, hd] — FULL stacked cache, PRE-append
+    cache_v: jnp.ndarray,  # [L, B, Hkv, S, hd]
+    layer: jnp.ndarray,  # scalar int32
+    lengths: jnp.ndarray,  # [Ba] int32 — this step's position per row
+    *,
+    slot_ids: jnp.ndarray | None = None,  # [Ba] int32 cache rows (None = 1:1)
+    scale: float = 0.0,  # query scale (0 = head_dim**-0.5)
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Attention over the bf16 (or f32) split KV cache for one layer of the
+    decode step — the bf16 twin of `decode_attend_q8`: same scan-invariant
+    PRE-append cache contract, compaction indirection, exact
+    current-position override, and runtime whole-S/blocked hybrid
+    (`LLM_MCP_TPU_BF16_DECODE` forces an arm, `LLM_MCP_TPU_BF16_HYBRID`
+    re-tunes the traffic-ratio threshold). Returns ctx [B, Hkv, G, hd]."""
+    B, Hkv, G, hd = q.shape
+    S = cache_k.shape[3]
+    interp = _interpret() if interpret is None else interpret
+    sc = scale or hd**-0.5
+
+    if not _HAS_PLTPU:  # pragma: no cover — CPU builds without pallas-tpu
+        return _decode_attend_bf16_fallback(
+            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids
+        )
+
+    nk4 = new_k.reshape(B, Hkv, 1, hd)
+    nv4 = new_v.reshape(B, Hkv, 1, hd)
+    can_whole = S <= decode_pallas_max_seq(hd, Hkv, Hkv * G, quantized=False)
+    # BS must divide S (a floored block count would silently drop the tail)
+    BS = next((c for c in (256, 128, 64, 32) if S % c == 0), 0)
+    if not can_whole and BS == 0:
+        return _decode_attend_bf16_fallback(
+            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids
+        )
+    ids = (
+        jnp.arange(B, dtype=jnp.int32)
+        if slot_ids is None
+        else slot_ids.astype(jnp.int32)
+    )
+    args = (
+        jnp.reshape(layer, (1,)).astype(jnp.int32),
+        ids,
+        lengths.astype(jnp.int32),
+        q,
+        nk4,
+        nv4,
+        cache_k,
+        cache_v,
+    )
+    out_shape = jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype)
+
+    def run_whole():
+        kernel = functools.partial(_attend_bf16_kernel, scale=sc)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,  # layer [1], slot ids [Ba], lengths [Ba]
+            grid=(B, Hkv),
+            in_specs=[
+                pl.BlockSpec((1, 1, G, hd), lambda b, h, li, ids, lens: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, 1, hd), lambda b, h, li, ids, lens: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, 1, hd), lambda b, h, li, ids, lens: (b, h, 0, 0)),
+                pl.BlockSpec(
+                    (1, 1, 1, S, hd),
+                    lambda b, h, li, ids, lens: (li[0], ids[b], h, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, 1, S, hd),
+                    lambda b, h, li, ids, lens: (li[0], ids[b], h, 0, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, G, hd), lambda b, h, li, ids, lens: (b, h, 0, 0)
+            ),
+        )
+        return pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interp
+        )(*args)
+
+    def run_blocked():
+        kernel = functools.partial(
+            _attend_bf16_blocked_kernel, scale=sc, block_s=BS, seq_len=S
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,  # layer [1], slot ids [Ba], lengths [Ba]
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, Hkv, G, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
+                pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
+                pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, ids, lens: (b, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),  # K cache
+                pl.BlockSpec(memory_space=pl.ANY),  # V cache
+            ],
+            out_specs=pl.BlockSpec(
+                (1, Hkv, G, hd), lambda b, li, ids, lens: (b, 0, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((2, Hkv, BS, hd), cache_k.dtype),
+                pltpu.VMEM((2, Hkv, BS, hd), cache_v.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        )
+        return pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interp
+        )(*args)
+
+    mode = os.environ.get("LLM_MCP_TPU_BF16_DECODE", "auto")
+    if mode == "whole" and can_whole:
+        return run_whole()
+    if mode == "blocked" and BS:
+        return run_blocked()
+    if not can_whole:
+        return run_blocked()
+    if BS == 0 or interp:
+        # interpret mode keeps the static whole-S choice (same reasoning as
+        # decode_attend_q8); parity tests force the blocked arm via
+        # LLM_MCP_TPU_BF16_DECODE=blocked.
+        return run_whole()
+    # Runtime hybrid, same traffic-ratio rule as the q8 path. The bf16
+    # blocked arm pays 2 DMAs/cell (split K/V), so its fixed cost sits
+    # between the fused-q8 1-copy arm and the r05 4-copy layout — start at
+    # the same 0.55 default and re-tune on hardware via the env knob.
+    thr = float(os.environ.get("LLM_MCP_TPU_BF16_HYBRID", "0.55"))
+    w_eff = jnp.where(lengths < S, jnp.minimum(lengths + 1, S), BS)
+    ratio = jnp.sum(w_eff.astype(jnp.float32)) / (B * S)
+    return jax.lax.cond(ratio < thr, run_blocked, run_whole)
 
 
 def _attend_q8_mla_kernel(
@@ -1042,6 +1371,21 @@ def _attend_q8_mla_blocked_kernel(
     o_ref[0] = (acc / l).astype(o_ref.dtype)
 
 
+def mla_block_size(seq_len: int) -> int:
+    """Block size for `_attend_q8_mla_blocked_kernel`, 0 = no blocked arm.
+
+    BS must divide S (a floored trip count would drop the tail — including
+    the current position). The kernel's block loop is a STATIC python
+    unroll (see its docstring), so program size is linear in S//BS: past 64
+    blocks (S=32768 at BS=512 is exactly the boundary) compile time
+    outgrows the win and `decode_attend_q8_mla` falls back to exact f32
+    math instead."""
+    bs = next((c for c in (512, 256, 128) if seq_len % c == 0), 0)
+    if bs and seq_len // bs > 64:
+        return 0
+    return bs
+
+
 def _decode_attend_q8_mla_fallback(
     qt, qr, new_c, new_r, cache_c, cache_r, layer, lengths, scale, slot_ids
 ):
@@ -1114,14 +1458,7 @@ def decode_attend_q8_mla(
     S = cache_c["q"].shape[3]
     interp = _interpret() if interpret is None else interpret
     fits = mla_whole_s_fits(S, R, dr, H)
-    # blocked path: BS must divide S (a floored trip count would drop the
-    # tail — including the current position)
-    BS = next((c for c in (512, 256, 128) if S % c == 0), 0)
-    if BS and S // BS > 64:
-        # the blocked kernel's program size is linear in S//BS (static
-        # unroll — see _attend_q8_mla_blocked_kernel docstring): past 64
-        # blocks (S=32k at BS=512) compile time outgrows the win
-        BS = 0
+    BS = mla_block_size(S)
     if not _HAS_PLTPU or (not fits and BS == 0) or (not interp and R % 128 != 0):
         return _decode_attend_q8_mla_fallback(
             qt, qr, new_c, new_r, cache_c, cache_r, layer, lengths, scale, slot_ids
@@ -1216,8 +1553,12 @@ def decode_attend_q8_mla(
     # (1845 vs 1653 tok/s — the absorbed form is MQA-shaped, so whole-S
     # cells amortize one huge row DMA over ALL heads and the traffic-ratio
     # trade that pays off for GQA does not appear). The blocked kernel's
-    # job is S past the VMEM budget — int8-latent long context (S=32k) on
-    # the MXU instead of the XLA dequant path.
+    # job is S past the VMEM budget — int8-latent long context on the MXU
+    # instead of the XLA dequant path — and it covers a BOUNDED window:
+    # `mla_block_size` zeroes BS past 64 static-unroll blocks (S=32768 at
+    # BS=512 is the last in-window size), after which the early fallback
+    # above already returned exact f32 math. "Whole if it fits, else
+    # blocked" below can therefore assume BS > 0.
     mode = os.environ.get("LLM_MCP_TPU_Q8_DECODE", "auto")
     if mode == "whole" and fits:
         return run_whole()
@@ -1231,16 +1572,14 @@ def _append_q8_kernel(
     ids_ref,  # [Ba] int32 (scalar prefetch) — cache row per batch position
     #          (consumed by the BlockSpec index maps only: grid cell b's
     #          cache tiles are selected at row ids[b], the body never reads it)
-    nk_ref,  # [L, 1, Hkv, hd] — this step's K vectors (post-rope, bf16)
-    nv_ref,  # [L, 1, Hkv, hd]
-    ckq_ref,  # [L, 1, Hkv, BSQ, hd] int8 — payload tile containing position w
-    cks_ref,  # [L, 1, Hkv, BSS] — scales tile containing position w
-    cvq_ref,  # [L, 1, Hkv, BSQ, hd] int8
-    cvs_ref,  # [L, 1, Hkv, BSS]
-    okq_ref,  # outputs — aliased to the cache operands
-    oks_ref,
-    ovq_ref,
-    ovs_ref,
+    pay_ref,  # [L, 1, Hf, hd] int8 — this step's FUSED row: quantized K
+    #           heads, V heads, packed-scale bytes (built by append_kv_q8
+    #           in plain JAX — the kernel only selects, never quantizes)
+    s_ref,  # [L, 1, 2*Hkv] — this step's plain dequant scales
+    cq_ref,  # [L, 1, Hf, BSQ, hd] int8 — payload tile containing position w
+    cs_ref,  # [L, 1, 2*Hkv, BSS] — scales tile containing position w
+    oq_ref,  # outputs — aliased to the cache operands
+    os_ref,
     *,
     block_q: int,  # payload S-tile (32: int8 sublane height)
     block_s: int,  # scales S-tile (128: lane width)
@@ -1252,31 +1591,18 @@ def _append_q8_kernel(
     wq = jnp.minimum(w, seq_len - 1) % block_q  # payload row within its tile
     ws = jnp.minimum(w, seq_len - 1) % block_s  # scale lane within its tile
 
-    def quant(n_ref):
-        f = n_ref[:, 0].astype(jnp.float32)  # [L, Hkv, hd]
-        amax = jnp.max(jnp.abs(f), axis=-1)  # [L, Hkv]
-        s = amax / 127.0
-        q = jnp.where(
-            s[..., None] > 0, jnp.round(f / jnp.maximum(s, 1e-30)[..., None]), 0.0
-        ).astype(jnp.int8)
-        return q, s
-
-    kq, ks = quant(nk_ref)
-    vq, vs = quant(nv_ref)
     rows = jax.lax.broadcasted_iota(jnp.int32, (1, 1, block_q, 1), 2)  # [1,1,BSQ,1]
     hit = live & (rows == wq)
-    okq_ref[:, 0] = jnp.where(hit, kq[:, :, None, :], ckq_ref[:, 0])
-    ovq_ref[:, 0] = jnp.where(hit, vq[:, :, None, :], cvq_ref[:, 0])
+    oq_ref[:, 0] = jnp.where(hit, pay_ref[:, 0][:, :, None, :], cq_ref[:, 0])
     lanes = jax.lax.broadcasted_iota(jnp.int32, (1, 1, block_s), 2)  # [1,1,BSS]
     hit_s = live & (lanes == ws)
-    oks_ref[:, 0] = jnp.where(hit_s, ks[:, :, None].astype(oks_ref.dtype), cks_ref[:, 0])
-    ovs_ref[:, 0] = jnp.where(hit_s, vs[:, :, None].astype(ovs_ref.dtype), cvs_ref[:, 0])
+    os_ref[:, 0] = jnp.where(hit_s, s_ref[:, 0][:, :, None].astype(os_ref.dtype), cs_ref[:, 0])
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def append_kv_q8(
-    cache_k: dict,  # {"q": int8 [L,B,Hkv,S,hd], "s": [L,B,Hkv,S]}
-    cache_v: dict,
+    cache_k: dict,  # FUSED: {"q": int8 [L,B,2*Hkv+p,S,hd], "s": [L,B,2*Hkv,S]}
+    cache_v: dict,  # {} — passed through untouched
     new_k: jnp.ndarray,  # [L, Ba, Hkv, hd] — post-rope K for this step, all layers
     new_v: jnp.ndarray,
     lengths: jnp.ndarray,  # [Ba] int32 — write position per row (>= S: skip)
@@ -1284,8 +1610,8 @@ def append_kv_q8(
     slot_ids: jnp.ndarray | None = None,  # [Ba] int32 cache rows (None = 1:1)
     interpret: bool | None = None,
 ) -> tuple[dict, dict]:
-    """Append one decode step's K/V (all layers at once) into the int8 cache
-    IN PLACE.
+    """Append one decode step's K/V (all layers at once) into the FUSED int8
+    cache IN PLACE.
 
     The XLA scatter alternative (`.at[l_idx, b_idx, h_idx, w_idx].set`)
     copies the entire cache payload per call — measured 6.4 ms of a ~30 ms
@@ -1294,38 +1620,55 @@ def append_kv_q8(
     rewrites only the 32-row (b, w-tile) block holding each row's position:
     ~0.5 GB of tile traffic instead of ~4 GB of full-buffer copies. Parked
     rows (lengths >= S, see executor/engine.py) write nothing.
+
+    Quantization AND scale-packing happen outside the kernel in plain JAX
+    on the tiny [L, Ba, Hkv, hd] step tensors (the bitcast lane-packing of
+    `pack_scales` has no proven in-kernel store form; the kernel body only
+    selects rows), producing one fused [L, Ba, Hf, hd] row per slot whose
+    bytes are written in a single aliased tile pass.
     """
-    L, B, Hkv, S, hd = cache_k["q"].shape
+    L, B, Hf, S, hd = cache_k["q"].shape
+    Hs = cache_k["s"].shape[2]
+    Hkv = Hs // 2
+    p = Hf - Hs
     Ba = new_k.shape[1]
+    sdt = cache_k["s"].dtype
     interp = _interpret() if interpret is None else interpret
     rows = (
         jnp.arange(Ba, dtype=jnp.int32)
         if slot_ids is None
         else slot_ids.astype(jnp.int32)
     )
+    from ..models.llama import quantize_kv  # local import: avoid cycle
+    from ..models.quant import pack_scales
+
+    kq = quantize_kv(new_k, scale_dtype=sdt)
+    vq = quantize_kv(new_v, scale_dtype=sdt)
+    s_new = jnp.concatenate([kq["s"], vq["s"]], axis=2)  # [L, Ba, 2*Hkv]
+    pay = jnp.concatenate([kq["q"], vq["q"]], axis=2)  # [L, Ba, 2*Hkv, hd]
+    if p:
+        # the packed pseudo-head row for this position: [L, Ba, 1, hd]
+        pay = jnp.concatenate([pay, pack_scales(s_new[..., None], hd)[..., 0, :]], 2)
 
     # mosaic int8 stores want full 128-lane rows; small-head test configs
-    # (hd 32/64) take the scatter fallback
-    if not _HAS_PLTPU or interp or hd % 128 != 0 or S % 128 != 0:
+    # (hd 32/64) take the scatter fallback. Interpret mode keeps the kernel
+    # path at lane-aligned shapes so parity tests cover the real tile-
+    # rewrite body.
+    if not _HAS_PLTPU or hd % 128 != 0 or S % 128 != 0:
         # XLA fallback (CPU tests / no pallas-tpu): plain scatter, with OOB
         # (parked) rows dropped by scatter semantics.
-        from ..models.llama import quantize_kv  # local import: avoid cycle
-
         l_idx = jnp.arange(L)[:, None, None]
         b_idx = rows[None, :, None]
-        h_idx = jnp.arange(Hkv)[None, None, :]
         w_idx = lengths[None, :, None]
-        kq = quantize_kv(new_k, scale_dtype=cache_k["s"].dtype)
-        vq = quantize_kv(new_v, scale_dtype=cache_v["s"].dtype)
         ck = {
-            "q": cache_k["q"].at[l_idx, b_idx, h_idx, w_idx].set(kq["q"]),
-            "s": cache_k["s"].at[l_idx, b_idx, h_idx, w_idx].set(kq["s"]),
+            "q": cache_k["q"]
+            .at[l_idx, b_idx, jnp.arange(Hf)[None, None, :], w_idx]
+            .set(pay),
+            "s": cache_k["s"]
+            .at[l_idx, b_idx, jnp.arange(Hs)[None, None, :], w_idx]
+            .set(s_new),
         }
-        cv = {
-            "q": cache_v["q"].at[l_idx, b_idx, h_idx, w_idx].set(vq["q"]),
-            "s": cache_v["s"].at[l_idx, b_idx, h_idx, w_idx].set(vq["s"]),
-        }
-        return ck, cv
+        return ck, cache_v
 
     BSQ = 32  # int8 sublane tile height: smallest in-place payload rewrite
     BSS = 128  # lane width: smallest in-place scales rewrite
@@ -1339,8 +1682,122 @@ def append_kv_q8(
     def blks(lens, b):
         return jnp.minimum(lens[b], S - 1) // BSS
 
-    nk4 = new_k.reshape(L, Ba, Hkv, hd)
-    nv4 = new_v.reshape(L, Ba, Hkv, hd)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # lengths [Ba], cache row ids [Ba]
+        grid=(Ba,),
+        in_specs=[
+            pl.BlockSpec((L, 1, Hf, hd), lambda b, lens, ids: (0, b, 0, 0)),
+            pl.BlockSpec((L, 1, Hs), lambda b, lens, ids: (0, b, 0)),
+            pl.BlockSpec(
+                (L, 1, Hf, BSQ, hd), lambda b, lens, ids: (0, ids[b], 0, blkq(lens, b), 0)
+            ),
+            pl.BlockSpec(
+                (L, 1, Hs, BSS), lambda b, lens, ids: (0, ids[b], 0, blks(lens, b))
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (L, 1, Hf, BSQ, hd), lambda b, lens, ids: (0, ids[b], 0, blkq(lens, b), 0)
+            ),
+            pl.BlockSpec(
+                (L, 1, Hs, BSS), lambda b, lens, ids: (0, ids[b], 0, blks(lens, b))
+            ),
+        ],
+    )
+    oq, os_ = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(cache_k["q"].shape, cache_k["q"].dtype),
+            jax.ShapeDtypeStruct(cache_k["s"].shape, cache_k["s"].dtype),
+        ],
+        # operand indices include the prefetch scalars: lengths=0, ids=1,
+        # pay=2, s_new=3, cq=4, cs=5 → outputs 0..1
+        input_output_aliases={4: 0, 5: 1},
+        interpret=interp,
+    )(
+        lengths.astype(jnp.int32),
+        rows,
+        pay,
+        s_new,
+        cache_k["q"],
+        cache_k["s"],
+    )
+    return {"q": oq, "s": os_}, cache_v
+
+
+def _append_bf16_kernel(
+    lengths_ref,  # [Ba] int32 (scalar prefetch) — this step's position per row
+    ids_ref,  # [Ba] int32 (scalar prefetch) — cache row per batch position
+    nk_ref,  # [L, 1, Hkv, hd] — this step's K vectors (post-rope)
+    nv_ref,  # [L, 1, Hkv, hd]
+    ck_ref,  # [L, 1, Hkv, BQ, hd] — K tile containing position w
+    cv_ref,  # [L, 1, Hkv, BQ, hd]
+    ok_ref,  # outputs — aliased to the cache operands
+    ov_ref,
+    *,
+    block_q: int,  # S-tile (16: bf16 sublane height; also divides f32's 8)
+    seq_len: int,
+):
+    b = pl.program_id(0)
+    w = lengths_ref[b]
+    live = w < seq_len  # parked rows (w >= S) must not write anywhere
+    wq = jnp.minimum(w, seq_len - 1) % block_q
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (1, 1, block_q, 1), 2)  # [1,1,BQ,1]
+    hit = live & (rows == wq)
+    ok_ref[:, 0] = jnp.where(hit, nk_ref[:, 0][:, :, None, :].astype(ok_ref.dtype), ck_ref[:, 0])
+    ov_ref[:, 0] = jnp.where(hit, nv_ref[:, 0][:, :, None, :].astype(ov_ref.dtype), cv_ref[:, 0])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def append_kv_bf16(
+    cache_k: jnp.ndarray,  # [L, B, Hkv, S, hd] bf16/f32
+    cache_v: jnp.ndarray,
+    new_k: jnp.ndarray,  # [L, Ba, Hkv, hd] — post-rope K for this step, all layers
+    new_v: jnp.ndarray,
+    lengths: jnp.ndarray,  # [Ba] int32 — write position per row (>= S: skip)
+    *,
+    slot_ids: jnp.ndarray | None = None,  # [Ba] int32 cache rows (None = 1:1)
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Append one decode step's K/V (all layers at once) into the bf16 cache
+    IN PLACE — the bf16 twin of `append_kv_q8`: aliased cache operands,
+    only the 16-row (b, w-tile) block holding each row's position is
+    rewritten, parked rows (lengths >= S) write nothing. This is what lets
+    `_decode_step_bf16` keep the cache scan-invariant (no per-layer
+    dynamic_update_slice copies inside the scan) and batch the whole
+    append into one pass after the layer scan."""
+    L, B, Hkv, S, hd = cache_k.shape
+    Ba = new_k.shape[1]
+    interp = _interpret() if interpret is None else interpret
+    rows = (
+        jnp.arange(Ba, dtype=jnp.int32)
+        if slot_ids is None
+        else slot_ids.astype(jnp.int32)
+    )
+
+    BQ = 16  # bf16 sublane tile height (f32 needs 8 — 16 covers both)
+    # mosaic stores want full 128-lane rows; small-head test configs take
+    # the scatter fallback. Interpret mode keeps the kernel path at lane-
+    # aligned shapes so parity tests cover the real tile-rewrite body.
+    if not _HAS_PLTPU or hd % 128 != 0 or S % BQ != 0:
+        # XLA fallback (CPU tests / no pallas-tpu): plain scatter, with OOB
+        # (parked) rows dropped by scatter semantics.
+        l_idx = jnp.arange(L)[:, None, None]
+        b_idx = rows[None, :, None]
+        h_idx = jnp.arange(Hkv)[None, None, :]
+        w_idx = lengths[None, :, None]
+        ck = cache_k.at[l_idx, b_idx, h_idx, w_idx].set(new_k.astype(cache_k.dtype))
+        cv = cache_v.at[l_idx, b_idx, h_idx, w_idx].set(new_v.astype(cache_v.dtype))
+        return ck, cv
+
+    kernel = functools.partial(_append_bf16_kernel, block_q=BQ, seq_len=S)
+
+    def blkq(lens, b):
+        # tile holding this row's write position (clamped if parked)
+        return jnp.minimum(lens[b], S - 1) // BQ
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # lengths [Ba], cache row ids [Ba]
         grid=(Ba,),
@@ -1348,57 +1805,41 @@ def append_kv_q8(
             pl.BlockSpec((L, 1, Hkv, hd), lambda b, lens, ids: (0, b, 0, 0)),
             pl.BlockSpec((L, 1, Hkv, hd), lambda b, lens, ids: (0, b, 0, 0)),
             pl.BlockSpec(
-                (L, 1, Hkv, BSQ, hd), lambda b, lens, ids: (0, ids[b], 0, blkq(lens, b), 0)
+                (L, 1, Hkv, BQ, hd), lambda b, lens, ids: (0, ids[b], 0, blkq(lens, b), 0)
             ),
             pl.BlockSpec(
-                (L, 1, Hkv, BSS), lambda b, lens, ids: (0, ids[b], 0, blks(lens, b))
-            ),
-            pl.BlockSpec(
-                (L, 1, Hkv, BSQ, hd), lambda b, lens, ids: (0, ids[b], 0, blkq(lens, b), 0)
-            ),
-            pl.BlockSpec(
-                (L, 1, Hkv, BSS), lambda b, lens, ids: (0, ids[b], 0, blks(lens, b))
+                (L, 1, Hkv, BQ, hd), lambda b, lens, ids: (0, ids[b], 0, blkq(lens, b), 0)
             ),
         ],
         out_specs=[
             pl.BlockSpec(
-                (L, 1, Hkv, BSQ, hd), lambda b, lens, ids: (0, ids[b], 0, blkq(lens, b), 0)
+                (L, 1, Hkv, BQ, hd), lambda b, lens, ids: (0, ids[b], 0, blkq(lens, b), 0)
             ),
             pl.BlockSpec(
-                (L, 1, Hkv, BSS), lambda b, lens, ids: (0, ids[b], 0, blks(lens, b))
-            ),
-            pl.BlockSpec(
-                (L, 1, Hkv, BSQ, hd), lambda b, lens, ids: (0, ids[b], 0, blkq(lens, b), 0)
-            ),
-            pl.BlockSpec(
-                (L, 1, Hkv, BSS), lambda b, lens, ids: (0, ids[b], 0, blks(lens, b))
+                (L, 1, Hkv, BQ, hd), lambda b, lens, ids: (0, ids[b], 0, blkq(lens, b), 0)
             ),
         ],
     )
-    okq, oks, ovq, ovs = pl.pallas_call(
+    ok, ov = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct(cache_k["q"].shape, cache_k["q"].dtype),
-            jax.ShapeDtypeStruct(cache_k["s"].shape, cache_k["s"].dtype),
-            jax.ShapeDtypeStruct(cache_v["q"].shape, cache_v["q"].dtype),
-            jax.ShapeDtypeStruct(cache_v["s"].shape, cache_v["s"].dtype),
+            jax.ShapeDtypeStruct(cache_k.shape, cache_k.dtype),
+            jax.ShapeDtypeStruct(cache_v.shape, cache_v.dtype),
         ],
         # operand indices include the prefetch scalars: lengths=0, ids=1,
-        # nk=2, nv=3, ckq=4, cks=5, cvq=6, cvs=7 → outputs 0..3
-        input_output_aliases={4: 0, 5: 1, 6: 2, 7: 3},
+        # nk=2, nv=3, ck=4, cv=5 → outputs 0..1
+        input_output_aliases={4: 0, 5: 1},
         interpret=interp,
     )(
         lengths.astype(jnp.int32),
         rows,
-        nk4,
-        nv4,
-        cache_k["q"],
-        cache_k["s"],
-        cache_v["q"],
-        cache_v["s"],
+        new_k,
+        new_v,
+        cache_k,
+        cache_v,
     )
-    return {"q": okq, "s": oks}, {"q": ovq, "s": ovs}
+    return ok, ov
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

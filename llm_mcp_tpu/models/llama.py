@@ -33,16 +33,24 @@ import jax
 import jax.numpy as jnp
 
 from ..kernels.attention import (
+    append_kv_bf16,
     append_kv_q8,
+    decode_attend_bf16,
     decode_attend_q8,
-    decode_attention_cache,
     flash_prefill_attention,
 )
 from ..ops.norms import rms_norm as _rms_norm
 from ..ops.rope import rope_tables, apply_rope
 from .configs import ModelConfig
 from .moe import init_moe_layer_params, moe_ffn
-from .quant import embed_lookup, logits_head, qdot
+from .quant import (
+    embed_lookup,
+    logits_head,
+    pack_scales,
+    qdot,
+    scale_pack_width,
+    scan_unroll,
+)
 
 Params = dict[str, Any]
 
@@ -133,9 +141,25 @@ def init_kv_cache(
     are int8, so halving KV bytes buys ~25-40% step time at 8B/B≥32 and
     doubles the (batch × context) that fits beside the weights.
 
-    Quantized entries are {"q": int8 [L,B,Hkv,S,hd], "s": dtype [L,B,Hkv,S]};
-    plain entries are a bare [L,B,Hkv,S,hd] array. Both forms flow through
-    `llama_decode_step` (jit treats them as pytrees).
+    Quantized GQA entries use the FUSED single-payload layout:
+
+        cache["k"] = {"q": int8 [L, B, 2*Hkv + p, S, hd],
+                      "s": dtype [L, B, 2*Hkv, S]}
+        cache["v"] = {}   (V rides cache["k"]'s head axis)
+
+    Payload head rows [0, Hkv) are K, [Hkv, 2*Hkv) are V, and — when the
+    scale bytes fit one head row (p = 1, `models/quant.py:scale_pack_width`)
+    — head 2*Hkv carries the per-position dequant scales BIT-PACKED into
+    int8 lanes. The fusion is what lets the blocked decode kernel issue ONE
+    DMA per (row, block) cell instead of the r05 layout's four (kq/ks/vq/vs
+    as separate arrays — kernels/attention.py:_attend_q8_blocked_kernel);
+    the plain "s" array is dual-written for every consumer that wants
+    arithmetic scales (whole-S kernel, XLA einsum paths, chunked prefill).
+    The seq axis stays axis 3 in both members — the engine's slot machinery
+    (inserts, parking, snapshots) indexes [:, slot, :, pos] unchanged.
+
+    Plain entries are a bare [L,B,Hkv,S,hd] array per side. All forms flow
+    through `llama_decode_step` (jit treats them as pytrees).
 
     MLA configs store latents instead (models/mla.py:init_mla_cache) in the
     same (k, v) pair convention; quantized=True there stores int8 latents
@@ -146,17 +170,20 @@ def init_kv_cache(
 
         return init_mla_cache(cfg, batch, max_seq, dtype=dtype, quantized=quantized)
     hd = cfg.resolved_head_dim
-    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_seq, hd)
+    Hkv = cfg.n_kv_heads
+    shape = (cfg.n_layers, batch, Hkv, max_seq, hd)
     if quantized:
+        p = scale_pack_width(Hkv, hd, dtype)
         return {
             "k": {
-                "q": jnp.zeros(shape, dtype=jnp.int8),
-                "s": jnp.zeros(shape[:-1], dtype=dtype),
+                "q": jnp.zeros(
+                    (cfg.n_layers, batch, 2 * Hkv + p, max_seq, hd), dtype=jnp.int8
+                ),
+                "s": jnp.zeros(
+                    (cfg.n_layers, batch, 2 * Hkv, max_seq), dtype=dtype
+                ),
             },
-            "v": {
-                "q": jnp.zeros(shape, dtype=jnp.int8),
-                "s": jnp.zeros(shape[:-1], dtype=dtype),
-            },
+            "v": {},
         }
     return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
 
@@ -172,6 +199,27 @@ def quantize_kv(kv: jnp.ndarray, scale_dtype=None) -> dict[str, jnp.ndarray]:
         s[..., None] > 0, jnp.round(f / jnp.maximum(s, 1e-30)[..., None]), 0.0
     ).astype(jnp.int8)
     return {"q": q, "s": s.astype(scale_dtype or kv.dtype)}
+
+
+def fuse_prompt_kv(
+    kh: jnp.ndarray,  # [..., Hkv, S, hd] bf16 K rows (head-major)
+    vh: jnp.ndarray,  # [..., Hkv, S, hd]
+    scale_dtype=None,
+) -> dict[str, jnp.ndarray]:
+    """Quantize a prompt's K/V rows into the FUSED cache entry
+    (`init_kv_cache`): one int8 payload carrying K heads | V heads | the
+    optional bit-packed scale pseudo-head, plus the plain "s" scales. The
+    engine's cache "v" member is the empty dict — callers pair the returned
+    dict with `{}`."""
+    hd = kh.shape[-1]
+    Hkv = kh.shape[-3]
+    kq = quantize_kv(kh, scale_dtype=scale_dtype)
+    vq = quantize_kv(vh, scale_dtype=scale_dtype)
+    s = jnp.concatenate([kq["s"], vq["s"]], axis=-2)  # [..., 2*Hkv, S]
+    pay = jnp.concatenate([kq["q"], vq["q"]], axis=-3)  # [..., 2*Hkv, S, hd]
+    if scale_pack_width(Hkv, hd, s.dtype):
+        pay = jnp.concatenate([pay, pack_scales(s, hd)], axis=-3)
+    return {"q": pay, "s": s}
 
 
 def _cache_shape(cache) -> tuple[int, ...]:
@@ -200,13 +248,27 @@ def _qkv(cfg: ModelConfig, lp: Params, x: jnp.ndarray):
     their layout. This is the single seam every attention path (prefill,
     chunked prefill, both decode steps) goes through, so per-family query/
     key transforms live here exactly once."""
-    q = qdot(x, lp["wq"])
-    k = qdot(x, lp["wk"])
-    v = qdot(x, lp["wv"])
-    if cfg.qkv_bias:
-        q = q + lp["bq"]
-        k = k + lp["bk"]
-        v = v + lp["bv"]
+    if "wqkv" in lp:
+        # single-chip fused projection (models/quant.py:fuse_layer_weights):
+        # one qdot quantizes the activation row once and reads one contiguous
+        # int8 weight block instead of three — bitwise-identical outputs,
+        # fewer per-matmul dispatch/epilogue round trips in the layer scan
+        hd = cfg.resolved_head_dim
+        nq, nk = cfg.n_heads * hd, cfg.n_kv_heads * hd
+        qkv = qdot(x, lp["wqkv"])
+        if cfg.qkv_bias:
+            qkv = qkv + lp["bqkv"]
+        q = qkv[..., :nq]
+        k = qkv[..., nq : nq + nk]
+        v = qkv[..., nq + nk :]
+    else:
+        q = qdot(x, lp["wq"])
+        k = qdot(x, lp["wk"])
+        v = qdot(x, lp["wv"])
+        if cfg.qkv_bias:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
     if cfg.qk_norm:
         # Qwen3: per-head RMSNorm over head_dim, applied pre-rope. Weights
         # are one [hd] vector per layer, shared across heads.
@@ -252,6 +314,13 @@ def _ffn_residual(
             else moe_ffn(cfg, lp, flat, valid=fvalid)
         )
         out = out.reshape(*lead, -1)
+    elif "w13" in lp:
+        # single-chip fused gate|up (models/quant.py:fuse_layer_weights) —
+        # same w8a8 epilogue-fusion move as wqkv
+        g13 = qdot(x, lp["w13"])
+        F = g13.shape[-1] // 2
+        gate = _act(cfg, g13[..., :F])
+        out = qdot(gate * g13[..., F:], lp["w2"])
     else:
         gate = _act(cfg, qdot(x, lp["w1"]))
         up = qdot(x, lp["w3"])
@@ -377,11 +446,14 @@ def llama_prefill(
     Returns (last_logits [B, V] f32, k [L, B, Hkv, S, Dh], v [...]) — the
     prompt KV to be inserted into the engine cache at the request's slot.
 
-    `quant_kv=True` quantizes each layer's K/V INSIDE the scan, so the
-    stacked ys are int8 {"q","s"} pytrees and the full bf16 prompt KV never
-    materializes in HBM — at 8B a batch-8 × 256-bucket admission would
-    otherwise stack ~1 GB of bf16 KV before the engine's quantize step,
-    enough memory pressure to collapse serving throughput.
+    `quant_kv=True` quantizes each layer's K/V INSIDE the scan into the
+    FUSED cache entry form (`fuse_prompt_kv` — K|V|packed-scale payload +
+    plain scales, paired with `{}` for v), so the stacked ys are int8
+    pytrees and the full bf16 prompt KV never materializes in HBM — at 8B a
+    batch-8 × 256-bucket admission would otherwise stack ~1 GB of bf16 KV
+    before the engine's quantize step, enough memory pressure to collapse
+    serving throughput. Fusing here means every engine insert path receives
+    cache-layout-ready rows and never re-derives the packed scale bytes.
     """
     if cfg.kv_lora_rank:  # MLA family: latent cache, query-blocked prefill
         from .mla import mla_prefill
@@ -397,7 +469,7 @@ def llama_prefill(
             cfg, lp, h, cos, sin, mask, lengths, attn_impl, window=win
         )
         if quant_kv:
-            return h, (quantize_kv(kh), quantize_kv(vh))
+            return h, (fuse_prompt_kv(kh, vh), {})
         return h, (kh, vh)
 
     h, (ks, vs) = jax.lax.scan(layer, h, (params["layers"], layer_windows(cfg)))
@@ -468,7 +540,9 @@ def _decode_step_q8(
     for every parked slot; the kernels follow the indirection via scalar
     prefetch, so cache traffic also shrinks on the blocked path).
     """
-    L, B, Hkv, S, hd = _cache_shape(cache_k)
+    # fused cache: axis 2 of "q" is 2*Hkv + p, not Hkv — take Hkv from cfg
+    L, B, _, S, hd = _cache_shape(cache_k)
+    Hkv = cfg.n_kv_heads
     Ba = tokens.shape[0]
     H = cfg.n_heads
     h = _embed_in(cfg, params, tokens)  # [Ba, D]
@@ -494,9 +568,67 @@ def _decode_step_q8(
         return (h, li + 1), (k, v)
 
     (h, _), (knew, vnew) = jax.lax.scan(
-        layer, (h, jnp.int32(0)), (params["layers"], layer_windows(cfg))
+        layer,
+        (h, jnp.int32(0)),
+        (params["layers"], layer_windows(cfg)),
+        unroll=scan_unroll(),
     )
     new_k, new_v = append_kv_q8(cache_k, cache_v, knew, vnew, lengths, slot_ids=slot_ids)
+    return _logits(cfg, params, h), new_k, new_v
+
+
+def _decode_step_bf16(
+    cfg: ModelConfig,
+    params: Params,
+    cache_k: jnp.ndarray,  # [L, B, Hkv, S, hd]
+    cache_v: jnp.ndarray,
+    tokens: jnp.ndarray,  # [Ba] int32 (compact batch when slot_ids is given)
+    lengths: jnp.ndarray,  # [Ba] int32
+    slot_ids: jnp.ndarray | None = None,  # [Ba] int32 cache rows (None = 1:1)
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Decode step for the bf16 cache on the pallas path — the structure
+    that made the q8 path fast (`_decode_step_q8`), applied to the split
+    bf16 cache: the cache rides the layer scan as a scan-INVARIANT operand
+    (no per-layer scatter), `decode_attend_bf16` overrides this step's
+    position with the exact in-register vectors, the per-layer K/V rows
+    stack out as scan ys, and ONE `append_kv_bf16` call rewrites just the
+    16-row tiles in place after the scan. Replaces the old in-scan sliced
+    kernel (the since-removed `decode_attention_cache` + per-layer carry
+    scatter) that `resolve_decode_impl` used to reject in favor of XLA."""
+    L, B, Hkv, S, hd = _cache_shape(cache_k)
+    Ba = tokens.shape[0]
+    H = cfg.n_heads
+    h = _embed_in(cfg, params, tokens)  # [Ba, D]
+    cos, sin = rope_tables(cfg, hd, lengths)  # [Ba, hd/2]
+
+    def layer(carry, xs):
+        lp, win = xs
+        h, li = carry
+        x = _norm(cfg, h, lp["attn_norm"])
+        q, k, v = _qkv(cfg, lp, x)
+        q = q.reshape(Ba, H, hd)
+        k = k.reshape(Ba, Hkv, hd)
+        v = v.reshape(Ba, Hkv, hd)
+        q = apply_rope(q[:, None], cos[:, None], sin[:, None])[:, 0]
+        k = apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
+        qg = q.reshape(Ba, Hkv, H // Hkv, hd)
+        ctx = decode_attend_bf16(
+            qg, k, v, cache_k, cache_v, li, lengths,
+            slot_ids=slot_ids, scale=cfg.attn_scale,
+        ).reshape(Ba, H * hd)
+        h = _attn_residual(cfg, lp, ctx, h)
+        h = _ffn_residual(cfg, lp, h, moe_capacity=Ba)
+        return (h, li + 1), (k, v)
+
+    (h, _), (knew, vnew) = jax.lax.scan(
+        layer,
+        (h, jnp.int32(0)),
+        (params["layers"], layer_windows(cfg)),
+        unroll=scan_unroll(),
+    )
+    new_k, new_v = append_kv_bf16(
+        cache_k, cache_v, knew, vnew, lengths, slot_ids=slot_ids
+    )
     return _logits(cfg, params, h), new_k, new_v
 
 
@@ -552,7 +684,9 @@ def llama_prefill_chunk_batch(
             skey=skey, all_logits=all_logits,
         )
     quantized = isinstance(cache_k, dict)
-    L, B, Hkv, S, hd = _cache_shape(cache_k)
+    # fused quantized cache: axis 2 of "q" is 2*Hkv + p — take Hkv from cfg
+    L, B, _, S, hd = _cache_shape(cache_k)
+    Hkv = cfg.n_kv_heads
     H = cfg.n_heads
     G = H // Hkv
     A, C = tokens.shape
@@ -588,34 +722,28 @@ def llama_prefill_chunk_batch(
 
         # ---- reads first: the past rows from the PRE-write cache ----
         if quantized:
-            kp = [
-                jax.lax.dynamic_slice(
-                    ck_all["q"], (li, slots[a], 0, 0, 0), (1, 1, Hkv, Sk, hd)
-                )[0, 0]
-                for a in range(A)
-            ]
-            vp = [
-                jax.lax.dynamic_slice(
-                    cv_all["q"], (li, slots[a], 0, 0, 0), (1, 1, Hkv, Sk, hd)
-                )[0, 0]
-                for a in range(A)
-            ]
-            ksr = jnp.stack(
+            # FUSED layout: K heads [0,Hkv) and V heads [Hkv,2Hkv) share one
+            # payload — one slice per slot covers both (the packed-scale
+            # pseudo-head past 2*Hkv is never read here; the plain "s" rows
+            # carry the arithmetic scales)
+            pays = jnp.stack(
                 [
                     jax.lax.dynamic_slice(
-                        ck_all["s"], (li, slots[a], 0, 0), (1, 1, Hkv, Sk)
+                        ck_all["q"], (li, slots[a], 0, 0, 0), (1, 1, 2 * Hkv, Sk, hd)
                     )[0, 0]
                     for a in range(A)
                 ]
-            )  # [A, Hkv, Sk]
-            vsr = jnp.stack(
+            )  # [A, 2*Hkv, Sk, hd] int8
+            kp, vp = list(pays[:, :Hkv]), list(pays[:, Hkv:])
+            srows = jnp.stack(
                 [
                     jax.lax.dynamic_slice(
-                        cv_all["s"], (li, slots[a], 0, 0), (1, 1, Hkv, Sk)
+                        ck_all["s"], (li, slots[a], 0, 0), (1, 1, 2 * Hkv, Sk)
                     )[0, 0]
                     for a in range(A)
                 ]
-            )
+            )  # [A, 2*Hkv, Sk]
+            ksr, vsr = srows[:, :Hkv], srows[:, Hkv:]
         else:
             kp = [
                 jax.lax.dynamic_slice(
@@ -670,23 +798,17 @@ def llama_prefill_chunk_batch(
 
         # ---- writes last: in-place (write-after-read) ----
         if quantized:
-            kq = quantize_kv(kh, scale_dtype=ck_all["s"].dtype)
-            vq = quantize_kv(vh, scale_dtype=cv_all["s"].dtype)
+            # write the chunk's rows in cache layout: fused payload
+            # (K|V|packed scales) + plain scales, so later readers — decode
+            # kernels included — see a consistent fused entry
+            fused = fuse_prompt_kv(kh, vh, scale_dtype=ck_all["s"].dtype)
             for a in range(A):
                 ck_all = {
                     "q": jax.lax.dynamic_update_slice(
-                        ck_all["q"], kq["q"][a][None, None], (li, slots[a], 0, starts[a], 0)
+                        ck_all["q"], fused["q"][a][None, None], (li, slots[a], 0, starts[a], 0)
                     ),
                     "s": jax.lax.dynamic_update_slice(
-                        ck_all["s"], kq["s"][a][None, None], (li, slots[a], 0, starts[a])
-                    ),
-                }
-                cv_all = {
-                    "q": jax.lax.dynamic_update_slice(
-                        cv_all["q"], vq["q"][a][None, None], (li, slots[a], 0, starts[a], 0)
-                    ),
-                    "s": jax.lax.dynamic_update_slice(
-                        cv_all["s"], vq["s"][a][None, None], (li, slots[a], 0, starts[a])
+                        ck_all["s"], fused["s"][a][None, None], (li, slots[a], 0, starts[a])
                     ),
                 }
         else:
@@ -773,24 +895,21 @@ def llama_decode_step(
             slot_ids=slot_ids, attn_impl=attn_impl,
         )
     quantized = isinstance(cache_k, dict)
-    L, B, Hkv, S, hd = _cache_shape(cache_k)
+    # fused quantized cache: axis 2 of "q" is 2*Hkv + p — take Hkv from cfg
+    L, B, _, S, hd = _cache_shape(cache_k)
+    Hkv = cfg.n_kv_heads
     Ba = tokens.shape[0]
     H = cfg.n_heads
     G = H // Hkv
 
     # Sliding windows / score softcaps aren't implemented in the pallas
-    # decode kernels; those families take the XLA path. For the int8 cache,
-    # "pallas" routes to the s8-MXU kernel (kernels/attention.py:
-    # decode_attend_q8) — the fast path on TPU. The bf16-cache kernel
-    # hardcodes head_dim**-0.5, so query_pre_attn_scalar families also
-    # reroute unless the q8 kernel (which takes cfg.attn_scale) serves them.
+    # decode kernels; those families take the XLA path. Both cache dtypes
+    # otherwise share the scan-invariant + post-scan-append structure:
+    # int8 routes to the s8-MXU hybrid (decode_attend_q8), bf16 to its twin
+    # (decode_attend_bf16) — both take cfg.attn_scale and follow slot_ids,
+    # so query_pre_attn_scalar families and compacted batches stay on the
+    # kernel path now.
     if attn_impl == "pallas" and (cfg.sliding_window or cfg.attn_softcap):
-        attn_impl = "xla"
-    if attn_impl == "pallas" and cfg.query_pre_attn_scalar and not quantized:
-        attn_impl = "xla"
-    # the bf16-cache pallas kernel has no compaction indirection; compacted
-    # bf16 decode takes the (gathering) xla path instead
-    if attn_impl == "pallas" and not quantized and slot_ids is not None:
         attn_impl = "xla"
 
     if quantized and attn_impl == "pallas":
@@ -801,6 +920,12 @@ def llama_decode_step(
         # append_kv_q8). decode_attend_q8 is built for pre-append caches: it
         # overrides position w with the exact new vectors.
         return _decode_step_q8(
+            cfg, params, cache_k, cache_v, tokens, lengths, slot_ids=slot_ids
+        )
+    if attn_impl == "pallas" and not quantized:
+        # same structure for the bf16 cache (new: it used to take the
+        # in-scan sliced kernel, which lost to XLA — the restructure wins)
+        return _decode_step_bf16(
             cfg, params, cache_k, cache_v, tokens, lengths, slot_ids=slot_ids
         )
 
@@ -840,45 +965,40 @@ def llama_decode_step(
         k = apply_rope(k[:, None], cos[:, None], sin[:, None])[:, 0]
 
         qg = q.reshape(Ba, Hkv, G, hd)
-        # Append this step's K/V row to the carry, quantizing when the cache
-        # is int8. The scatter happens BEFORE any kernel read: a scatter
-        # after a pallas read is a write-after-read hazard on the carried
-        # buffer that XLA resolves with a full-cache defensive copy (~10 ms
-        # at 8B B=64).
+        # Append this step's K/V row to the carry, quantizing into the FUSED
+        # layout when the cache is int8. The scatter happens BEFORE the
+        # attention read: write-after-read on the carried buffer would cost
+        # XLA a full-cache defensive copy (~10 ms at 8B B=64).
         if quantized:
             kq = quantize_kv(k, scale_dtype=ck_all["s"].dtype)
-            vq = quantize_kv(v, scale_dtype=cv_all["s"].dtype)
+            vq = quantize_kv(v, scale_dtype=ck_all["s"].dtype)
+            s_new = jnp.concatenate([kq["s"], vq["s"]], axis=1)  # [Ba, 2*Hkv]
+            pay = jnp.concatenate([kq["q"], vq["q"]], axis=1)  # [Ba, 2*Hkv, hd]
+            if ck_all["q"].shape[2] > 2 * Hkv:
+                # keep the packed pseudo-head consistent too: snapshots /
+                # path switches must see one coherent fused entry
+                pay = jnp.concatenate(
+                    [pay, pack_scales(s_new[..., None], hd)[..., 0, :]], axis=1
+                )
+            hf_idx = jnp.arange(pay.shape[1])[None, :]
+            hs_idx = jnp.arange(2 * Hkv)[None, :]
             ck_all = {
-                "q": ck_all["q"].at[li, b_idx, h_idx, w_idx].set(kq["q"]),
-                "s": ck_all["s"].at[li, b_idx, h_idx, w_idx].set(kq["s"]),
-            }
-            cv_all = {
-                "q": cv_all["q"].at[li, b_idx, h_idx, w_idx].set(vq["q"]),
-                "s": cv_all["s"].at[li, b_idx, h_idx, w_idx].set(vq["s"]),
+                "q": ck_all["q"].at[li, b_idx, hf_idx, w_idx].set(pay),
+                "s": ck_all["s"].at[li, b_idx, hs_idx, w_idx].set(s_new),
             }
         else:
             ck_all = ck_all.at[li, b_idx, h_idx, w_idx].set(k.astype(ck_all.dtype))
             cv_all = cv_all.at[li, b_idx, h_idx, w_idx].set(v.astype(cv_all.dtype))
 
-        if quantized and attn_impl == "pallas":
-            # s8-MXU kernel; position w's score/value come from the exact
-            # unquantized vectors (the kernel overrides that column).
-            ctx = decode_attend_q8(
-                qg, k, v, ck_all, cv_all, li, lengths,
-                slot_ids=slot_ids, scale=cfg.attn_scale,
-            ).reshape(Ba, H * hd)
-        elif attn_impl == "pallas":
-            # Kernel indexes the L axis itself (scalar prefetch): no
-            # dynamic-slice copy of the layer's cache. (Never reached with
-            # slot_ids — compaction routes bf16 caches to the xla impl.)
-            ctx = decode_attention_cache(qg, ck_all, cv_all, li, lengths).reshape(
-                Ba, H * hd
+        if quantized:
+            payl = rowsel(
+                jax.lax.dynamic_index_in_dim(ck_all["q"], li, 0, keepdims=False)
             )
-        elif quantized:
-            ck = rowsel(jax.lax.dynamic_index_in_dim(ck_all["q"], li, 0, keepdims=False))
-            cv = rowsel(jax.lax.dynamic_index_in_dim(cv_all["q"], li, 0, keepdims=False))
-            ks = rowsel(jax.lax.dynamic_index_in_dim(ck_all["s"], li, 0, keepdims=False))
-            vs = rowsel(jax.lax.dynamic_index_in_dim(cv_all["s"], li, 0, keepdims=False))
+            ssl = rowsel(
+                jax.lax.dynamic_index_in_dim(ck_all["s"], li, 0, keepdims=False)
+            )
+            ck, cv = payl[:, :Hkv], payl[:, Hkv : 2 * Hkv]
+            ks, vs = ssl[:, :Hkv], ssl[:, Hkv:]
             # int8 K dot in compute dtype; per-key-token dequant scales the
             # SCORES (cheap [Ba,Hkv,G,S] multiply), not the K payload
             scores = jnp.einsum("bhgd,bhsd->bhgs", qg, ck.astype(h.dtype)).astype(
@@ -914,5 +1034,6 @@ def llama_decode_step(
         layer,
         (h, cache_k, cache_v, jnp.int32(0)),
         (params["layers"], layer_windows(cfg)),
+        unroll=scan_unroll(),
     )
     return _logits(cfg, params, h), new_k, new_v

@@ -45,7 +45,7 @@ import jax.numpy as jnp
 from ..ops.norms import rms_norm as _rms_norm
 from ..ops.rope import apply_rope, rope_tables
 from .configs import ModelConfig
-from .quant import qdot
+from .quant import qdot, scan_unroll
 
 # llama.py imports this module only lazily inside its dispatch functions, so
 # pulling the shared decoder helpers in at module level is cycle-free
@@ -625,7 +625,9 @@ def mla_decode_step(
             carry, (cs_d, krs_d) = jax.lax.scan(
                 layer_k, carry, params["dense_layers"]
             )
-        (h, _), (cs, krs) = jax.lax.scan(layer_k, carry, params["layers"])
+        (h, _), (cs, krs) = jax.lax.scan(
+            layer_k, carry, params["layers"], unroll=scan_unroll()
+        )
         if cs_d is not None:
             cs = jnp.concatenate([cs_d, cs], axis=0)
             krs = jnp.concatenate([krs_d, krs], axis=0)
@@ -653,5 +655,7 @@ def mla_decode_step(
         # dense prologue first — the carried layer index li keeps the cache
         # rows aligned with absolute layer position
         carry, _ = jax.lax.scan(layer, carry, params["dense_layers"])
-    (h, cache_c, cache_r, _), _ = jax.lax.scan(layer, carry, params["layers"])
+    (h, cache_c, cache_r, _), _ = jax.lax.scan(
+        layer, carry, params["layers"], unroll=scan_unroll()
+    )
     return _logits(cfg, params, h), cache_c, cache_r

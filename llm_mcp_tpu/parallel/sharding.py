@@ -198,8 +198,18 @@ def kv_cache_specs(quantized: bool = False, latent: bool = False) -> dict[str, A
         return {"k": row, "v": row}
     row = P(None, "dp", "tp", None, None)
     if quantized:
-        entry = {"q": row, "s": P(None, "dp", "tp", None)}
-        return {"k": entry, "v": entry}
+        # Fused GQA layout: one payload block [L, B, 2*Hkv + p, S, hd] holding
+        # K rows, V rows, and (when p == 1) a bit-packed scale pseudo-head.
+        # The head axis is no longer a clean Hkv multiple, so it replicates
+        # over tp and shards batch on dp only (int8 + mesh decodes via the
+        # XLA path, which reads whole heads anyway).
+        return {
+            "k": {
+                "q": P(None, "dp", None, None, None),
+                "s": P(None, "dp", None, None),
+            },
+            "v": {},
+        }
     return {"k": row, "v": row}
 
 

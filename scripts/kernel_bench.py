@@ -1,0 +1,362 @@
+#!/usr/bin/env python3
+"""Decode-kernel microbenchmark: a fill x batch sweep over the attention
+dispatch arms (whole-S / blocked / hybrid) plus the w8a8 layer pass.
+
+    python scripts/kernel_bench.py                       # default sweep
+    python scripts/kernel_bench.py --layout q8_gqa --seq 2048
+    python scripts/kernel_bench.py --layer-pass          # weights GB/s only
+
+Why this exists: bench.py measures end-to-end tok/s, which folds the
+attention kernel, the weight stream, sampling, and the scan together —
+when a layout change moves the needle, the headline can't say WHICH part
+moved. This script times the attention dispatch in isolation per
+(fill, batch) point and reports, per arm:
+
+  us_per_call   — wall time of one jitted attend call (one layer)
+  attn_us_per_cell — us_per_call / DMA cells issued; a cell is one
+                  (row, block) copy set for the blocked arms and one grid
+                  row for whole-S. The r05 4-copy layout paid ~2.5 us of
+                  DMA-issue latency per cell; the fused layout's packed
+                  arm issues ONE copy per cell (blocked_dma_count).
+  gbps          — cache bytes actually streamed / wall time. For blocked
+                  arms only the attended prefix counts (that is the point
+                  of the blocked arm); whole-S always streams B*S rows.
+  dma_per_cell  — static copies-per-cell from blocked_dma_count.
+
+The hybrid arm is timed at every fill point so the crossover against the
+static arms is visible directly — that is the measurement the
+LLM_MCP_TPU_Q8_HYBRID / LLM_MCP_TPU_BF16_HYBRID thresholds encode.
+
+The layer pass (--layer-pass, also in the default sweep) runs the jitted
+decode step minus nothing — the full layer scan — and reports achieved
+weight-stream bandwidth: quantized weight bytes x steps / wall time.
+bench.py derives the same `layers_gbps` number from its B=112 raw sweep;
+this script exists to re-measure it quickly at other shapes.
+
+CPU-safe: off-TPU every arm runs the same XLA fallback math, so the
+numbers only order kernels on a real chip; the sweep still runs (small
+shapes) as a smoke test of the dispatch plumbing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _rand_fused_q8_cache(rng, L, B, Hkv, S, hd, dtype):
+    import jax.numpy as jnp
+
+    from llm_mcp_tpu.models.quant import pack_scales, scale_pack_width
+
+    pay = jnp.asarray(rng.integers(-127, 128, (L, B, 2 * Hkv, S, hd), dtype="int8"))
+    s = jnp.asarray(rng.random((L, B, 2 * Hkv, S), dtype="float32") * 0.02).astype(
+        dtype
+    )
+    if scale_pack_width(Hkv, hd, dtype):
+        pay = jnp.concatenate([pay, pack_scales(s, hd)], axis=2)
+    return {"q": pay, "s": s}, {}
+
+
+def _rand_bf16_cache(rng, L, B, Hkv, S, hd, dtype):
+    import jax.numpy as jnp
+
+    k = jnp.asarray(rng.standard_normal((L, B, Hkv, S, hd)), dtype)
+    v = jnp.asarray(rng.standard_normal((L, B, Hkv, S, hd)), dtype)
+    return k, v
+
+
+def _cells(lengths, S: int, BS: int, whole: bool) -> int:
+    """DMA cells one attend call issues: grid rows for whole-S, per-row
+    ceil(prefix / BS) for the blocked arms (parked rows stream 1 block)."""
+    import numpy as np
+
+    lens = np.asarray(lengths)
+    if whole:
+        return int(lens.shape[0])
+    w = np.where(lens < S, np.minimum(lens + 1, S), BS)
+    return int(np.sum(np.ceil(w / BS)))
+
+
+def bench_attn(
+    layout: str,
+    B: int,
+    S: int,
+    fill: float,
+    *,
+    arm: str = "auto",
+    Hkv: int = 8,
+    G: int = 4,
+    hd: int = 128,
+    R: int = 512,
+    dr: int = 64,
+    iters: int = 20,
+    seed: int = 0,
+) -> dict[str, float]:
+    """Time one jitted attend call for `layout` at (B, S, fill).
+
+    arm: "whole" | "blocked" | "auto" (the runtime hybrid). Forced via the
+    kernels' own env knobs so the measured dispatch is the production one.
+    """
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import llm_mcp_tpu.kernels.attention as A
+
+    rng = np.random.default_rng(seed)
+    dtype = jnp.bfloat16 if jax.devices()[0].platform == "tpu" else jnp.float32
+    lengths = jnp.full((B,), int(fill * (S - 1)), jnp.int32)
+    BS = next((c for c in (256, 128, 64, 32) if S % c == 0), 0)
+    layer = jnp.int32(0)
+
+    env = {"q8_gqa": "LLM_MCP_TPU_Q8_DECODE", "bf16_gqa": "LLM_MCP_TPU_BF16_DECODE"}
+    old = None
+    if layout in env:
+        old = os.environ.get(env[layout])
+        os.environ[env[layout]] = arm if arm != "auto" else "auto"
+
+    try:
+        if layout == "q8_gqa":
+            ck, cv = _rand_fused_q8_cache(rng, 1, B, Hkv, S, hd, dtype)
+            q = jnp.asarray(rng.standard_normal((B, Hkv, G, hd)), dtype)
+            nk = jnp.asarray(rng.standard_normal((B, Hkv, hd)), dtype)
+            nv = jnp.asarray(rng.standard_normal((B, Hkv, hd)), dtype)
+            A.decode_attend_q8.clear_cache()  # env knob is read at trace time
+            fn = lambda: A.decode_attend_q8(q, nk, nv, ck, cv, layer, lengths)
+            # bytes one call streams: int8 payload rows + scale rows over the
+            # attended prefix (blocked) or the full S extent (whole-S)
+            row_bytes = 2 * Hkv * hd + 2 * Hkv * jnp.dtype(dtype).itemsize
+        elif layout == "bf16_gqa":
+            ck, cv = _rand_bf16_cache(rng, 1, B, Hkv, S, hd, dtype)
+            q = jnp.asarray(rng.standard_normal((B, Hkv, G, hd)), dtype)
+            nk = jnp.asarray(rng.standard_normal((B, Hkv, hd)), dtype)
+            nv = jnp.asarray(rng.standard_normal((B, Hkv, hd)), dtype)
+            fn = lambda: A.decode_attend_bf16(q, nk, nv, ck, cv, layer, lengths)
+            row_bytes = 2 * Hkv * hd * jnp.dtype(dtype).itemsize
+        elif layout == "q8_mla":
+            H = Hkv * G
+            cc = {
+                "q": jnp.asarray(
+                    rng.integers(-127, 128, (1, B, 1, S, R), dtype="int8")
+                ),
+                "s": jnp.asarray(rng.random((1, B, 1, S), dtype="float32") * 0.02),
+            }
+            cr = {
+                "q": jnp.asarray(
+                    rng.integers(-127, 128, (1, B, 1, S, dr), dtype="int8")
+                ),
+                "s": jnp.asarray(rng.random((1, B, 1, S), dtype="float32") * 0.02),
+            }
+            ck = cc  # for the packed-layout probe below (MLA is never packed)
+            qt = jnp.asarray(rng.standard_normal((B, H, R)), dtype)
+            qr = jnp.asarray(rng.standard_normal((B, H, dr)), dtype)
+            nc = jnp.asarray(rng.standard_normal((B, R)), dtype)
+            nr = jnp.asarray(rng.standard_normal((B, dr)), dtype)
+            sc = (R + dr) ** -0.5
+            # the MLA dispatch is jitted by its callers, not at def site
+            mla_call = jax.jit(
+                lambda qt, qr, nc, nr, cc, cr, lens: A.decode_attend_q8_mla(
+                    qt, qr, nc, nr, cc, cr, layer, lens, scale=sc
+                )
+            )
+            fn = lambda: mla_call(qt, qr, nc, nr, cc, cr, lengths)
+            row_bytes = (R + dr) + 2 * 4  # int8 latent+rope + two f32 scales
+        else:
+            raise SystemExit(f"unknown layout {layout!r}")
+
+        out = fn()
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+    finally:
+        if layout in env:
+            if old is None:
+                os.environ.pop(env[layout], None)
+            else:
+                os.environ[env[layout]] = old
+
+    whole = arm == "whole"
+    cells = _cells(lengths, S, BS or S, whole)
+    lens = np.asarray(lengths)
+    if whole:
+        streamed = B * S * row_bytes
+    else:
+        w = np.where(lens < S, np.minimum(lens + 1, S), BS or S)
+        streamed = float(np.sum(np.ceil(w / (BS or S)) * (BS or S))) * row_bytes
+    packed = (
+        layout == "q8_gqa"
+        and isinstance(ck, dict)
+        and ck["q"].shape[2] > 2 * Hkv
+    )
+    # whole-S cells issue one pipelined copy per cache operand in the grid
+    # spec: fused payload + plain scales (q8), split K + V (bf16), latent +
+    # rope payloads with their scale rows (mla)
+    whole_dma = {"q8_gqa": 2, "bf16_gqa": 2, "q8_mla": 4}
+    return {
+        "layout": layout,
+        "arm": arm,
+        "B": B,
+        "S": S,
+        "fill": fill,
+        "us_per_call": round(dt * 1e6, 2),
+        "attn_us_per_cell": round(dt * 1e6 / max(cells, 1), 3),
+        "gbps": round(streamed / dt / 1e9, 2),
+        "dma_per_cell": (
+            whole_dma[layout] if whole else A.blocked_dma_count(layout, packed=packed)
+        ),
+    }
+
+
+def bench_layer_pass(
+    model: str = "tiny-llm", B: int = 8, S: int = 256, K: int = 16, rounds: int = 2
+) -> dict[str, float]:
+    """Achieved weight-stream bandwidth of the full decode layer pass:
+    quantized weight bytes x decode steps / wall time. The batch shares
+    one weight stream per step, so GB/s = bytes x (tok_rate / B). Applies
+    the same single-chip weight fusion the engine uses (wqkv / w13 —
+    quant.fuse_layer_weights) so the measured pass is the production one."""
+    import os
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_mcp_tpu.kernels.attention import resolve_decode_impl
+    from llm_mcp_tpu.models import get_config, init_kv_cache, llama_decode_step
+    from llm_mcp_tpu.models.quant import (
+        fuse_layer_weights,
+        init_llama_params_quantized,
+        quantized_bytes,
+    )
+    from llm_mcp_tpu.ops.sampling import sample_tokens
+
+    cfg = get_config(model)
+    dtype = jnp.bfloat16 if jax.devices()[0].platform == "tpu" else jnp.float32
+    params = init_llama_params_quantized(cfg, jax.random.PRNGKey(0), scale_dtype=dtype)
+    if os.environ.get("LLM_MCP_TPU_FUSE_QKV", "1") != "0":
+        params = fuse_layer_weights(params)
+    w_bytes, _ = quantized_bytes(params)
+    cache = init_kv_cache(cfg, B, S, dtype=dtype, quantized=True)
+    impl = resolve_decode_impl(quantized=True)
+
+    @partial(jax.jit, donate_argnums=(1, 2))
+    def decode_chunk(params, ck, cv, tokens, lengths, rng):
+        def step(carry, _):
+            ck, cv, toks, lens, rng = carry
+            logits, ck, cv = llama_decode_step(
+                cfg, params, ck, cv, toks, lens, attn_impl=impl
+            )
+            rng, sub = jax.random.split(rng)
+            new = sample_tokens(
+                logits,
+                sub,
+                jnp.full((toks.shape[0],), 0.7, dtype=jnp.float32),
+                jnp.zeros((toks.shape[0],), dtype=jnp.int32),
+                jnp.ones((toks.shape[0],), dtype=jnp.float32),
+            )
+            return (ck, cv, new, lens + 1, rng), new
+
+        (ck, cv, toks, lens, rng), out = jax.lax.scan(
+            step, (ck, cv, tokens, lengths, rng), None, length=K
+        )
+        return out, ck, cv, toks, lens
+
+    ck, cv = cache["k"], cache["v"]
+    toks = jnp.zeros((B,), jnp.int32)
+    lens = jnp.zeros((B,), jnp.int32)
+    rng = jax.random.PRNGKey(1)
+    out, ck, cv, toks, lens = decode_chunk(params, ck, cv, toks, lens, rng)
+    np.asarray(out)
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        out, ck, cv, toks, lens = decode_chunk(params, ck, cv, toks, lens, rng)
+    np.asarray(out)
+    dt = time.perf_counter() - t0
+    steps = rounds * K
+    tps = steps * B / dt
+    return {
+        "model": model,
+        "B": B,
+        "weight_bytes": float(w_bytes),
+        "tok_per_s": round(tps, 1),
+        "layers_gbps": round(w_bytes * (tps / B) / 1e9, 1),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--layout", default="all", help="q8_gqa | bf16_gqa | q8_mla | all")
+    ap.add_argument("--seq", type=int, default=0, help="cache rows (0 = platform default)")
+    ap.add_argument("--batches", default="", help="comma list (default platform-sized)")
+    ap.add_argument("--fills", default="0.0,0.4,0.9", help="comma list of fill fractions")
+    ap.add_argument("--iters", type=int, default=0, help="timed calls per point")
+    ap.add_argument("--layer-pass", action="store_true", help="layer pass only")
+    ap.add_argument("--model", default="", help="layer-pass model (default by platform)")
+    args = ap.parse_args()
+
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    S = args.seq or (1024 if on_tpu else 256)
+    batches = [int(b) for b in args.batches.split(",") if b] or (
+        [8, 32, 112] if on_tpu else [4]
+    )
+    fills = [float(f) for f in args.fills.split(",") if f]
+    iters = args.iters or (20 if on_tpu else 3)
+    model = args.model or ("llama-3.1-8b" if on_tpu else "tiny-llm")
+
+    if not args.layer_pass:
+        layouts = (
+            ["q8_gqa", "bf16_gqa", "q8_mla"]
+            if args.layout == "all"
+            else [args.layout]
+        )
+        for layout in layouts:
+            if layout == "q8_mla":
+                # the MLA dispatch picks its own arm (whole-S under the VMEM
+                # budget, blocked past it) with no forcing knob: time it once
+                arms = ["auto"]
+            else:
+                arms = ["whole", "blocked"] + (["auto"] if on_tpu else [])
+            for B in batches:
+                for fill in fills:
+                    for arm in arms:
+                        try:
+                            print(
+                                json.dumps(
+                                    bench_attn(layout, B, S, fill, arm=arm, iters=iters)
+                                ),
+                                flush=True,
+                            )
+                        except Exception as e:
+                            print(
+                                json.dumps(
+                                    {
+                                        "layout": layout,
+                                        "arm": arm,
+                                        "B": B,
+                                        "fill": fill,
+                                        "error": repr(e),
+                                    }
+                                ),
+                                flush=True,
+                            )
+    lp = bench_layer_pass(model, B=(112 if on_tpu else 4), S=S, K=(64 if on_tpu else 8))
+    print(json.dumps(lp), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,6 +41,13 @@ Checks, in order of authority:
      stored prefix lengths). paged_block_leaks is an exact check like
      window_errors: any nonzero end-of-run leak/double-free count from the
      ledger audit fails the gate outright.
+  5. Raw-decode kernel floors, when the record carries them: the B=112
+     headline-shape sweep >= 5600 tok/s (the pre-fusion starting line —
+     the fused-layout work climbs FROM here), the MLA S=32k int8-latent
+     sweep >= 150 tok/s, and layers_gbps >= 500 (achieved weight-stream
+     bandwidth of the w8a8 layer pass; r05 measured ~570 of 819 GB/s).
+     attn_us_per_cell gates relatively (latency-class) when a baseline
+     carries it.
 
 Missing metrics are reported as [SKIP] with a stderr warning but never
 fail the gate (older records predate newer fields — a KeyError here
@@ -70,8 +77,12 @@ HIGHER_BETTER = (
     "embed_per_s_nomic-embed-text_b1_tpu",
     "embed_per_s_qwen3-embedding-8b-int8_b64_d1024_tpu",
     "paged_admit_ratio",
+    "raw_decode_tok_per_s_llama-3.1-8b-int8_kv8_b112_tpu",
+    "raw_decode_tok_per_s_mla-8b-int8_kv8_b4_s32768_tpu",
+    "layers_gbps",
 )
-LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms", "cow_copies_per_req")
+LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms", "cow_copies_per_req",
+                "attn_us_per_cell")
 
 # absolute floors/ceilings applied regardless of baseline coverage (only
 # ever read with .get(): a floor for a metric the record lacks must skip,
@@ -94,6 +105,19 @@ ABS_MIN = {
     # paged KV: the oversubscribed 90%-shared sweep must multiply admitted
     # slots at least 3x at equal HBM budget (peak logical/physical blocks)
     "paged_admit_ratio": 3.0,
+    # raw-decode kernel floors (promoted top-level by bench.py). The b112
+    # headline-shape sweep measured 5609 tok/s pre-fusion (r5): the fused
+    # cache layout + wqkv/w13 layer pass must never regress BELOW that
+    # starting line — the whole point of the restructure is to climb from
+    # it toward 6000. The MLA S=32k int8-latent sweep (199 tok/s in r5) is
+    # the blocked s8 kernel's only on-hardware evidence; 150 catches a
+    # collapse (silent fallback) without flaking on round-to-round noise.
+    "raw_decode_tok_per_s_llama-3.1-8b-int8_kv8_b112_tpu": 5600.0,
+    "raw_decode_tok_per_s_mla-8b-int8_kv8_b4_s32768_tpu": 150.0,
+    # achieved weight-stream bandwidth of the w8a8 layer pass: r05 measured
+    # ~570 GB/s of the v5e's 819; 500 is the collapse floor (a drop below
+    # means the fused pass re-materializes weights or lost the s8 MXU path)
+    "layers_gbps": 500.0,
 }
 ABS_MAX = {
     "p95_ttft_ms": 5000.0,

@@ -266,34 +266,48 @@ def test_quantize_kv_roundtrip():
     assert float(jnp.abs(z["q"]).max()) == 0.0
 
 
-def _rand_q8_cache(rng, L, B, Hkv, S, hd):
+def _rand_fused_q8_cache(rng, L, B, Hkv, S, hd):
+    """Random FUSED int8 GQA cache: payload [L,B,2*Hkv+p,S,hd] carrying K
+    heads, V heads, and (when p == 1) the bit-packed scale pseudo-head,
+    plus the plain scale array [L,B,2*Hkv,S]. cache_v is {}."""
     import jax.numpy as jnp
 
-    return {
-        "q": jnp.asarray(rng.integers(-127, 128, (L, B, Hkv, S, hd), dtype="int8")),
-        "s": jnp.asarray(rng.random((L, B, Hkv, S), dtype="float32") * 0.02),
-    }
+    from llm_mcp_tpu.models.quant import pack_scales, scale_pack_width
+
+    pay = jnp.asarray(
+        rng.integers(-127, 128, (L, B, 2 * Hkv, S, hd), dtype="int8")
+    )
+    s = jnp.asarray(rng.random((L, B, 2 * Hkv, S), dtype="float32") * 0.02)
+    if scale_pack_width(Hkv, hd, jnp.float32):
+        pay = jnp.concatenate([pay, pack_scales(s, hd)], axis=2)
+    return {"q": pay, "s": s}, {}
 
 
+@pytest.mark.parametrize("pack", ["0", "1"])
 @pytest.mark.parametrize("compact", [False, True])
-def test_blocked_long_context_q8_kernel(monkeypatch, compact):
+def test_blocked_long_context_q8_kernel(monkeypatch, compact, pack):
     """The blocked (manual-DMA, dynamic-trip-count) long-context decode
     kernel matches the exact-f32 fallback — VERDICT r2 weak #4: this was
     the highest-risk kernel in the repo with zero coverage. Forcing the
     path via the VMEM threshold keeps shapes CPU-small while exercising
     the real kernel in interpret mode (double-buffered DMA emulation),
     including lengths at block boundaries and the slot_ids indirection
-    (compaction reads cache row ids[b], not b)."""
+    (compaction reads cache row ids[b], not b). Runs both DMA modes:
+    pack=1 reads scales from the fused pseudo-head (1 DMA/cell), pack=0
+    issues the separate scale-block copy (2 DMAs/cell)."""
     import jax.numpy as jnp
     import numpy as np
 
     import llm_mcp_tpu.kernels.attention as A
 
     monkeypatch.setattr(A, "decode_pallas_max_seq", lambda *a, **k: 64)
+    monkeypatch.setenv("LLM_MCP_TPU_Q8_SCALE_PACK", pack)
+    # the env knob is read at trace time: drop cached traces so both DMA
+    # modes actually compile (same shapes would otherwise reuse one trace)
+    A.decode_attend_q8.clear_cache()
     rng = np.random.default_rng(1)
     L, B, Hkv, S, hd, G = 2, 4, 2, 512, 64, 2
-    ck = _rand_q8_cache(rng, L, B, Hkv, S, hd)
-    cv = _rand_q8_cache(rng, L, B, Hkv, S, hd)
+    ck, cv = _rand_fused_q8_cache(rng, L, B, Hkv, S, hd)
     q = jnp.asarray(rng.standard_normal((B, Hkv, G, hd)), jnp.float32)
     nk = jnp.asarray(rng.standard_normal((B, Hkv, hd)), jnp.float32)
     nv = jnp.asarray(rng.standard_normal((B, Hkv, hd)), jnp.float32)
@@ -323,8 +337,7 @@ def test_blocked_q8_kernel_parked_rows(monkeypatch):
     monkeypatch.setattr(A, "decode_pallas_max_seq", lambda *a, **k: 64)
     rng = np.random.default_rng(2)
     L, B, Hkv, S, hd, G = 1, 2, 2, 512, 64, 2
-    ck = _rand_q8_cache(rng, L, B, Hkv, S, hd)
-    cv = _rand_q8_cache(rng, L, B, Hkv, S, hd)
+    ck, cv = _rand_fused_q8_cache(rng, L, B, Hkv, S, hd)
     q = jnp.asarray(rng.standard_normal((B, Hkv, G, hd)), jnp.float32)
     nk = jnp.asarray(rng.standard_normal((B, Hkv, hd)), jnp.float32)
     nv = jnp.asarray(rng.standard_normal((B, Hkv, hd)), jnp.float32)
