@@ -1138,6 +1138,39 @@ def main() -> None:
                 else:
                     os.environ["TPU_KV_HOST_OFFLOAD"] = prior_offload
             gc.collect()
+        if serve and os.environ.get("BENCH_MIGRATE", "1") != "0" and not over_budget(
+            0.845, "migration sweep", "migrate_skipped"
+        ):
+            # 2-engine KV-migration sweep: the oversubscribed workload of
+            # the pool sweep, but with an idle second replica the
+            # MigrationCoordinator can drain into. perf_gate floors: at
+            # least one snapshot/requeue actually moved (migration_count
+            # >= 1) and the drained leg's admitted p95 TTFT no worse than
+            # shedding-only (migrate_ttft_gain >= 1.0). Two replicas means
+            # 2x weights resident — a quarter of the headline's clients
+            # and short sequences keep the sweep inside one chip's HBM.
+            try:
+                mg = migration_sweep(
+                    model,
+                    n_clients=max(4, B // 4),
+                    max_tokens=min(32, bench_max_tokens),
+                    max_slots=max(1, B // 16),
+                    max_seq_len=min(S, 1024),
+                    decode_chunk=headline_chunk,
+                    quant="int8", kv_quant="int8",
+                )
+                if "migrate_single_device" in mg:
+                    secondary.update(mg)  # gated keys absent: [SKIP] + warn
+                elif mg.get("migrate_requests", 0.0) >= 1.0:
+                    secondary.update(mg)
+                else:
+                    secondary["migrate_zero_window"] = 0.0
+                    print("# migration sweep window degenerate; not recorded",
+                          flush=True)
+            except Exception as e:
+                print(f"# migration sweep failed: {e!r}", flush=True)
+                secondary["migrate_sweep_error"] = 0.0
+            gc.collect()
         if (
             serve
             and os.environ.get("BENCH_COLDSTART", "1") != "0"
@@ -1243,6 +1276,20 @@ def main() -> None:
                     "paged_block_leaks", 0.0
                 )
                 line["paged_tok_per_s"] = secondary.get("paged_tok_per_s", 0.0)
+            if "migration_count" in secondary:
+                # the 2-engine migration sweep's gated metrics, promoted
+                # into the line of record where scripts/perf_gate.py reads
+                # them (count floor 1, TTFT-gain floor 1.0)
+                line["migration_count"] = secondary["migration_count"]
+                line["migrated_kv_mb"] = secondary.get("migrated_kv_mb", 0.0)
+                line["migrate_p95_ttft_ms"] = secondary.get(
+                    "migrate_p95_ttft_ms", -1.0
+                )
+                line["migrate_off_p95_ttft_ms"] = secondary.get(
+                    "migrate_off_p95_ttft_ms", -1.0
+                )
+                if "migrate_ttft_gain" in secondary:
+                    line["migrate_ttft_gain"] = secondary["migrate_ttft_gain"]
             for ek in (
                 f"embed_per_s_nomic-embed-text_b1_{platform}",
                 f"embed_per_s_qwen3-embedding-8b-int8_b64_d1024_{platform}",
@@ -1341,6 +1388,38 @@ def main() -> None:
                     ),
                     "paged_block_leaks": pgs.get("paged_block_leaks", 0.0),
                 }))
+            if os.environ.get("BENCH_MIGRATE", "1") != "0":
+                # 2-engine migration smoke: drives the coordinator's
+                # queued-steal + snapshot-drain paths end to end on CPU —
+                # the harness self-test for the TPU migration sweep
+                gc.collect()
+                mgs = migration_sweep(
+                    "tiny-llm", n_clients=6, rounds=2, max_tokens=24,
+                    max_slots=2, max_seq_len=512, decode_chunk=4,
+                )
+                if "migrate_single_device" in mgs:
+                    print(json.dumps({
+                        "metric": "serve_migrate_skipped_tiny-llm_cpu",
+                        "value": 0.0, "unit": "marker", "vs_baseline": 0.0,
+                    }))
+                else:
+                    print(json.dumps({
+                        "metric": "serve_migrate_ttft_gain_tiny-llm_cpu",
+                        "value": mgs.get("migrate_ttft_gain", -1.0),
+                        "unit": "ratio",
+                        "vs_baseline": 0.0,
+                        "migration_count": mgs.get("migration_count", 0.0),
+                        "migrated_kv_mb": mgs.get("migrated_kv_mb", 0.0),
+                        "migrate_p95_ttft_ms": mgs.get(
+                            "migrate_p95_ttft_ms", -1.0
+                        ),
+                        "migrate_off_p95_ttft_ms": mgs.get(
+                            "migrate_off_p95_ttft_ms", -1.0
+                        ),
+                        "migrate_window_errors": mgs.get(
+                            "migrate_window_errors", 0.0
+                        ),
+                    }))
             return
         model, B, S, K = "tiny-llm", 8, 256, 32
         tps = raw_decode_tps(model, B, S, K, rounds=2)
@@ -1355,6 +1434,224 @@ def main() -> None:
     if secondary:
         line["secondary"] = secondary
     print(json.dumps(line))
+
+
+def migration_sweep(
+    model: str, *, n_clients: int = 8, rounds: int = 2, max_tokens: int = 32,
+    max_slots: int = 2, max_seq_len: int = 512, decode_chunk: int = 4,
+    quant: str = "", kv_quant: str = "", target_ttft_ms: float = 250.0,
+) -> dict[str, float]:
+    """2-engine oversubscribed migration sweep: every client hits engine A
+    (slots << clients, KV pool armed) while an identical engine B sits idle
+    beside it. The ON leg runs a MigrationCoordinator on a tight interval,
+    so queued-behind-a-long-tail requests get re-homed to B and offloaded
+    snapshots drain to it; the OFF leg applies the same pressure with
+    queueing/shedding only. Reports both admitted p95 TTFTs plus the
+    migration counters — `migration_count` and `migrate_ttft_gain`
+    (OFF p95 ÷ ON p95) carry scripts/perf_gate.py floors.
+
+    Clients replicate the serve path's admission gate (api/inference.py):
+    poll `admission_state()` and honor the Retry-After backoff before
+    submitting, so TTFT includes the shed penalty exactly as an HTTP
+    client would pay it. That is where migration wins: the coordinator
+    drains A's queue/offloads into B, A's offered load falls back under
+    the watermark, and the gate reopens — avoided backoff sleep, which
+    holds even when both engines share one accelerator's silicon.
+
+    Drives the engines directly (generate_stream), not the HTTP serve
+    path: the coordinator re-homes each request's consumer queue across
+    engines in-process, which is exactly the drain path api/server.py
+    wires up — and two model replicas behind one CoreServer would measure
+    the router, not the migration."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from llm_mcp_tpu.executor import GenerationEngine
+    from llm_mcp_tpu.executor.migration import MigrationCoordinator
+    from llm_mcp_tpu.parallel import make_mesh
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
+    if len(devices) < 2:
+        # one accelerator = zero-sum silicon: the second engine's rounds
+        # would interleave with the first's on the same device and the
+        # TTFT comparison measures contention, not migration. Emit a
+        # marker instead of the gated keys — perf_gate [SKIP]s them with
+        # a warning, per the single-engine escape hatch.
+        print("# migration sweep needs >= 2 devices; skipping", flush=True)
+        return {"migrate_single_device": 0.0}
+    if platform == "cpu":
+        try:
+            cores = len(os.sched_getaffinity(0))
+        except AttributeError:
+            cores = os.cpu_count() or 1
+        if cores < 2:
+            # XLA host "devices" share one core pool: on a single core the
+            # second engine's decode serializes with the first's, so the
+            # ON leg measures contention + coordinator overhead, never
+            # added capacity. Same escape hatch as the single-device case.
+            print(
+                "# migration sweep needs >= 2 cores for additive capacity;"
+                " skipping", flush=True,
+            )
+            return {"migrate_single_device": 0.0}
+    meshes = [make_mesh("", [devices[0]]), make_mesh("", [devices[1]])]
+
+    def leg(migrate: bool) -> dict[str, float]:
+        # engines read TPU_MIGRATE / TPU_KV_HOST_OFFLOAD at construction;
+        # restore whatever the operator had set once both replicas exist
+        prior = {k: os.environ.get(k)
+                 for k in ("TPU_MIGRATE", "TPU_KV_HOST_OFFLOAD")}
+        os.environ["TPU_KV_HOST_OFFLOAD"] = "1"
+        if migrate:
+            os.environ["TPU_MIGRATE"] = "1"
+        else:
+            os.environ.pop("TPU_MIGRATE", None)
+        try:
+            def mk(mesh) -> "GenerationEngine":
+                # each replica on its OWN 1-device mesh: B's capacity must
+                # be additive, not interleaved with A's on one device
+                # tight TTFT target on both replicas: the token-budget
+                # scheduler's deadline pacing otherwise EQUALIZES both
+                # legs — it delays admission toward the (default 2 s)
+                # deadline whenever there is slack, absorbing exactly the
+                # headroom migration frees. With pacing off the critical
+                # path, the comparison measures queueing + shed backoff.
+                return GenerationEngine(
+                    model, mesh=mesh, max_slots=max_slots,
+                    max_seq_len=max_seq_len, dtype=dtype,
+                    decode_chunk=decode_chunk, quant=quant,
+                    kv_quant=kv_quant, target_ttft_ms=target_ttft_ms,
+                ).start()
+
+            a, b = mk(meshes[0]), mk(meshes[1])
+        finally:
+            for k, v in prior.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        coord = None
+        lock = threading.Lock()
+        ttfts: list[float] = []
+        errors = [0]
+        try:
+            # warm BOTH engines with the measured workload's shapes —
+            # prefill bucket AND decode batches 1..max_slots. B only ever
+            # sees traffic via migration, so without this its first
+            # compiles land inside the window and get charged to the ON
+            # leg's TTFTs.
+            def _warm_one(eng: "GenerationEngine", i: int) -> None:
+                eng.generate(
+                    f"migration sweep warmup {i}: write one plain line"
+                    " about queueing.",
+                    max_tokens=max_tokens, temperature=0.0,
+                )
+
+            for eng in (a, b):
+                ws = [
+                    threading.Thread(
+                        target=_warm_one, args=(eng, i), daemon=True
+                    )
+                    for i in range(max_slots)
+                ]
+                for t in ws:
+                    t.start()
+                for t in ws:
+                    t.join(timeout=300.0)
+            if migrate:
+                coord = MigrationCoordinator(
+                    {"bench-src": a, "bench-dst": b}, burst=4,
+                    interval_s=0.05,
+                ).start()
+
+            def client(cid: int) -> None:
+                for r in range(rounds):
+                    t0 = time.perf_counter()
+                    # the serve path's load-shedding gate (api/inference.py
+                    # 429 + Retry-After), honored like the HTTP clients do —
+                    # capped so one pessimistic drain estimate can't eat the
+                    # whole window. The shed sleep is INSIDE the TTFT.
+                    while True:
+                        shed, retry = a.admission_state()
+                        if not shed:
+                            break
+                        a.note_shed()
+                        if coord is not None:
+                            coord.note_pressure()
+                        time.sleep(min(2.0, max(0.25, retry)))
+                    got = False
+                    for evt in a.generate_stream(
+                        f"migration sweep client {cid} round {r}: write"
+                        " one plain line about queueing.",
+                        max_tokens=max_tokens, temperature=0.0,
+                    ):
+                        if evt["type"] == "token" and not got:
+                            got = True
+                            with lock:
+                                ttfts.append(
+                                    (time.perf_counter() - t0) * 1000.0
+                                )
+                        elif evt["type"] == "error":
+                            with lock:
+                                errors[0] += 1
+                        elif evt["type"] == "done":
+                            break
+
+            threads = [
+                threading.Thread(target=client, args=(i,), daemon=True)
+                for i in range(n_clients)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600.0)
+            out = {
+                "p95_ttft_ms": (
+                    sorted(ttfts)[max(0, int(len(ttfts) * 0.95) - 1)]
+                    if ttfts else -1.0
+                ),
+                "requests": float(len(ttfts)),
+                "errors": float(errors[0]),
+            }
+            if coord is not None:
+                cst = coord.stats()
+                out["migration_count"] = (
+                    cst["snapshots_moved_total"] + cst["requeues_total"]
+                )
+                out["migrated_kv_mb"] = cst["bytes_total"] / (1 << 20)
+                out["migrate_failed"] = cst["failed_total"]
+                out["migrated_in"] = b.migration_stats().get(
+                    "migrated_in_total", 0.0
+                )
+            return out
+        finally:
+            if coord is not None:
+                coord.stop()
+            a.shutdown()
+            b.shutdown()
+            gc.collect()
+
+    on = leg(True)
+    off = leg(False)
+    res = {
+        "migrate_p95_ttft_ms": round(on["p95_ttft_ms"], 1),
+        "migrate_off_p95_ttft_ms": round(off["p95_ttft_ms"], 1),
+        "migration_count": on.get("migration_count", 0.0),
+        "migrated_kv_mb": round(on.get("migrated_kv_mb", 0.0), 3),
+        "migrate_window_errors": on["errors"] + off["errors"],
+        "migrate_requests": on["requests"],
+    }
+    if on.get("migrate_failed", 0.0):
+        res["migrate_failed"] = on["migrate_failed"]
+    if on["p95_ttft_ms"] > 0 and off["p95_ttft_ms"] > 0:
+        res["migrate_ttft_gain"] = round(
+            off["p95_ttft_ms"] / on["p95_ttft_ms"], 3
+        )
+    return res
 
 
 def real_ckpt_metrics(ckpt_dir: str) -> dict[str, float]:

@@ -98,6 +98,25 @@ class DashboardAPI:
             for name, i in engines.items()
             if isinstance(i.get("paging"), dict)
         }
+        # condensed migration view (full counters under
+        # engines[name]["migration"]): snapshots moved each way and the
+        # wire volume — only present while TPU_MIGRATE is on
+        migration = {
+            name: {
+                "out": int(i["migration"].get("migrated_out_total", 0.0)),
+                "in": int(i["migration"].get("migrated_in_total", 0.0)),
+                "out_mb": round(
+                    i["migration"].get("migrate_out_bytes_total", 0.0) / 2**20, 2
+                ),
+                "in_mb": round(
+                    i["migration"].get("migrate_in_bytes_total", 0.0) / 2**20, 2
+                ),
+                "outbox": int(i["migration"].get("outbox_depth", 0.0)),
+                "inbox": int(i["migration"].get("inbox_depth", 0.0)),
+            }
+            for name, i in engines.items()
+            if isinstance(i.get("migration"), dict)
+        }
         resp.write_json(
             {
                 "ts": time.time(),
@@ -115,6 +134,7 @@ class DashboardAPI:
                 "speculation": speculation,
                 "memory": memory,
                 "paging": paging,
+                "migration": migration,
                 "issues": issues,
             }
         )

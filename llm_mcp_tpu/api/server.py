@@ -47,6 +47,8 @@ _SPAN_STAGES = {
     "engine.decode": "decode",
     "engine.preempt": "preempt",
     "engine.restore": "restore",
+    "engine.migrate_out": "migrate_out",
+    "engine.migrate_in": "migrate_in",
 }
 
 
@@ -75,6 +77,9 @@ class CoreServer:
         self._pool_counts: dict[str, dict[str, float]] = {}
         # and the paged-KV copy-on-write counter (cumulative per engine)
         self._paging_counts: dict[str, float] = {}
+        # and the KV migration out/in/bytes counters (cumulative per engine)
+        self._migration_counts: dict[str, dict[str, float]] = {}
+        self._migration_requeues = 0.0
         self.limits = LimitsEngine(self.db, strict=self.cfg.strict_model_limits)
         self.circuit = CircuitBreaker()
         self.router = Router(
@@ -155,6 +160,35 @@ class CoreServer:
             gen_engines=self.gen_engines,
         )
 
+        # KV migration (executor/migration.py). The coordinator only exists
+        # when TPU_MIGRATE is on — with it off the engines never allocate
+        # migration queues and none of the paths below run (true no-op).
+        self.role = os.environ.get("TPU_ROLE", "both").strip().lower() or "both"
+        self.migration = None
+        if self.gen_engines and any(
+            getattr(e, "_migrate_outbox", None) is not None
+            for e in self.gen_engines.values()
+        ):
+            from ..executor.migration import MigrationCoordinator
+
+            self.migration = MigrationCoordinator(
+                self.gen_engines,
+                role=self.role,
+                drain_low=float(os.environ.get("TPU_MIGRATE_DRAIN_LOW", "0.25")),
+                drain_high=float(os.environ.get("TPU_MIGRATE_DRAIN_HIGH", "0.5")),
+                burst=int(os.environ.get("TPU_MIGRATE_BURST", "2")),
+            )
+            # TPU_MIGRATE_PEER=host:port[,host:port...] — remote decode-role
+            # engines reachable over the KV transfer RPC (disaggregation
+            # across processes). Lazy import: grpc stays optional.
+            peers = os.environ.get("TPU_MIGRATE_PEER", "").strip()
+            if peers:
+                from ..rpc.client import RemoteMigrationTarget
+
+                for addr in (p.strip() for p in peers.split(",")):
+                    if addr:
+                        self.migration.add_remote(addr, RemoteMigrationTarget(addr))
+
     # -- KV-pool admission bridge ------------------------------------------
 
     def _jobs_overload_check(self) -> tuple[bool, float]:
@@ -167,6 +201,10 @@ class CoreServer:
             shed, retry = getattr(e, "admission_state", lambda: (False, 0.0))()
             if shed:
                 e.note_shed()
+                if self.migration is not None:
+                    # a shed is exactly the imbalance migration exists to
+                    # fix — kick the drain tick instead of waiting it out
+                    self.migration.note_pressure()
                 return True, retry
         return False, 0.0
 
@@ -211,6 +249,13 @@ class CoreServer:
         if headroom is not None:
             # router de-ranks saturated devices on this tag (router.py)
             tags["kv_headroom"] = round(headroom, 4)
+        if self.role != "both":
+            tags["role"] = self.role
+        if self.migration is not None:
+            # router prefers migration-capable devices among saturated
+            # candidates (routing/router.py banding): a saturated device
+            # that can drain itself recovers faster than one that sheds
+            tags["migration"] = True
         self.catalog.upsert_device(
             self.device_id,
             name=self.device_id,
@@ -332,6 +377,39 @@ class CoreServer:
                         cur_b - prev_b
                     )
                 self._paging_counts[name] = cur_b
+            mgs = getattr(e, "migration_stats", None)
+            if mgs is not None:
+                mg = mgs()
+                if mg.get("enabled"):
+                    info[name]["migration"] = mg
+                    prev_m = self._migration_counts.get(name, {})
+                    for key, counter in (
+                        ("migrated_out_total", self.metrics.kv_migrated_out),
+                        ("migrated_in_total", self.metrics.kv_migrated_in),
+                        ("migrate_out_bytes_total", self.metrics.kv_migrate_bytes),
+                    ):
+                        cur_m = float(mg.get(key, 0.0))
+                        if cur_m > prev_m.get(key, 0.0):
+                            counter.labels(engine=name).inc(
+                                cur_m - prev_m.get(key, 0.0)
+                            )
+                    self._migration_counts[name] = {
+                        k: float(mg.get(k, 0.0))
+                        for k in (
+                            "migrated_out_total",
+                            "migrated_in_total",
+                            "migrate_out_bytes_total",
+                        )
+                    }
+        if self.migration is not None:
+            cst = self.migration.stats()
+            self.metrics.kv_migration_headroom_delta.set(
+                cst.get("headroom_delta", 0.0)
+            )
+            cur_r = float(cst.get("requeues_total", 0.0))
+            if cur_r > self._migration_requeues:
+                self.metrics.kv_migrate_requeues.inc(cur_r - self._migration_requeues)
+                self._migration_requeues = cur_r
         for name, e in self.embed_engines.items():
             info[name] = {
                 "kind": "embed",
@@ -627,6 +705,8 @@ class CoreServer:
         # register AFTER the addr is known so peers can proxy to us
         self.register_local_device()
         self.limits.apply_specs()
+        if self.migration is not None:
+            self.migration.start()
         # background tickers: limits re-apply + discovery (main.go:56-67,101-112)
         t = threading.Thread(target=self._ticker, name="core-tickers", daemon=True)
         t.start()
@@ -690,6 +770,8 @@ class CoreServer:
     def shutdown(self) -> None:
         self.tracer.remove_observer(self._observe_span)
         self._bg_stop.set()
+        if self.migration is not None:
+            self.migration.stop()
         self.api.shutdown()
         for e in self.gen_engines.values():
             e.shutdown()

@@ -30,6 +30,7 @@ the aiohttp layer bridges to SSE without head-of-line blocking.
 
 from __future__ import annotations
 
+import base64
 import logging
 import os
 import queue
@@ -69,6 +70,7 @@ from .memory import (
     bucket_len,
     pytree_nbytes,
 )
+from . import migration
 from .paging import PagedKVManager
 from .scheduler import TokenBudgetScheduler
 from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
@@ -105,6 +107,15 @@ class GenRequest:
     # (the loop thread never blocks on the tracer)
     trace_ctx: str = ""
     admitted_at: float = 0.0  # stamped when the loop pops the request
+    # KV migration (migration.py): export this request's KV the moment its
+    # prefill lands, instead of decoding here — the disaggregated-mode
+    # handoff (TPU_ROLE=prefill). Only read when TPU_MIGRATE is on.
+    migrate_after_prefill: bool = False
+    # hop count: how many times this request has already been re-homed.
+    # The coordinator refuses to move a request twice — without the cap a
+    # drain can ping-pong the queue head between two engines whose headroom
+    # recovers alternately, and the bounced request starves.
+    migrations: int = 0
 
 
 @dataclass
@@ -835,6 +846,27 @@ class GenerationEngine:
             self._paging.slot_partition, self._paging.prefix_partition,
         )
 
+        # KV migration (migration.py): engine-to-engine snapshot transfer.
+        # TPU_MIGRATE=0 (default) keeps both queues None — every hot-path
+        # touch point is guarded `is not None`, so the off state is a true
+        # no-op exactly like the pool's. The outbox carries wire payloads a
+        # MigrationCoordinator ships out; the inbox carries decoded
+        # snapshots the run loop restores into free slots.
+        self._migrate_outbox: "queue.Queue[dict] | None" = None
+        self._migrate_in: "queue.Queue[tuple] | None" = None
+        # engine-level prefill-role flag: a coordinator sets it (or tests
+        # do) so every admitted request exports after its prefill lands;
+        # per-request GenRequest.migrate_after_prefill overrides ad hoc
+        self.migrate_after_prefill = False
+        self.migrated_out_total = 0
+        self.migrated_in_total = 0
+        self.migrate_out_bytes_total = 0
+        self.migrate_in_bytes_total = 0
+        if os.environ.get("TPU_MIGRATE", "0") not in ("", "0", "false", "no", "off"):
+            self._migrate_outbox = queue.Queue()
+            self._migrate_in = queue.Queue()
+            log.info("KV migration enabled (TPU_MIGRATE)")
+
         self._admit: "queue.Queue[GenRequest]" = queue.Queue()
         self._stop_evt = threading.Event()
         self._wake = threading.Event()
@@ -1178,6 +1210,15 @@ class GenerationEngine:
                 break
             req.out.put({"type": "error", "error": "engine shutdown"})
             req.out.put(_DONE)
+        while self._migrate_in is not None and not self._migrate_in.empty():
+            # migrated-in snapshots never restored: their consumers block
+            # on queues this engine now owns — error them like queued work
+            try:
+                _snap, _header, _nbytes, s = self._migrate_in.get_nowait()
+            except queue.Empty:
+                break
+            s.req.out.put({"type": "error", "error": "engine shutdown"})
+            s.req.out.put(_DONE)
 
     # -- public API --------------------------------------------------------
 
@@ -1396,6 +1437,10 @@ class GenerationEngine:
 
     def slots_in_use(self) -> int:
         return sum(1 for s in self._slots if s is not None) + len(self._prefills)
+
+    def queue_depth(self) -> int:
+        """Requests accepted by submit() but not yet admitted to a slot."""
+        return self._admit.qsize()
 
     # -- engine loop -------------------------------------------------------
 
@@ -1745,20 +1790,30 @@ class GenerationEngine:
         self._topk[b] = snap.top_k
         self._topp[b] = snap.top_p
         self._slots[b] = s
-        # ledger: re-table the parked shared pins + a fresh private tail
-        self._paging.restore_slot(b, snap.snap_id, snap.length)
+        # ledger: re-table the parked shared pins + a fresh private tail.
+        # A MIGRATED snapshot has no parked pins on this engine — when its
+        # shared-prefix key matched our own cache, the blocks pin through
+        # the ordinary admit_shared path instead, the same refcount++ a
+        # local prefix hit performs (re-pin, never copy).
+        if snap.migrated and snap.shared_len and snap.shared_key is not None:
+            self._paging.admit_shared(b, snap.shared_key, snap.length)
+        else:
+            self._paging.restore_slot(b, snap.snap_id, snap.length)
         dt = time.perf_counter() - t0
-        self._pool.note_restored(snap, dt)
+        if self._pool is not None and not snap.migrated:
+            self._pool.note_restored(snap, dt)
         if s.req.trace_ctx:
             now = time.time()
             tracing.get_tracer().record(
-                "engine.restore", now - dt, now,
+                "engine.migrate_in" if snap.migrated else "engine.restore",
+                now - dt, now,
                 parent=s.req.trace_ctx,
                 attrs={
                     "request_id": s.req.request_id,
                     "slot": b,
                     "kv_tokens": snap.length,
                     "preempted_s": round(now - snap.preempted_at, 3),
+                    **({"bytes": snap.nbytes} if snap.migrated else {}),
                 },
             )
         log.info(
@@ -1766,6 +1821,275 @@ class GenerationEngine:
             s.req.request_id[:8], b, snap.length,
             time.time() - snap.preempted_at,
         )
+
+    # -- KV migration: engine-to-engine transfer (migration.py) ------------
+
+    def _host_tree(self, x):
+        """Host copy of a cache subtree — dict-aware ({} is the fused int8
+        layout's live sentinel, not absence)."""
+        if isinstance(x, dict):
+            if not x:
+                return {}
+            return {k: jax.device_get(v) for k, v in x.items()}
+        return jax.device_get(x)
+
+    def _wire_item(self, snap: KVSnapshot, source: str) -> dict[str, Any]:
+        """Serialize a host-side snapshot into an outbox item. When the
+        snapshot is paged private-only, the shared prefix ships as its
+        token KEY (the destination re-pins matching blocks out of its own
+        prefix cache via admit_shared) plus the entry's rows as a fallback
+        for destinations that never saw the prefix. Records the
+        engine.migrate_out span + counters."""
+        s = snap.slot_obj
+        req = s.req
+        t0 = time.perf_counter()
+        shared_k = shared_v = None
+        if snap.shared_len and snap.shared_entry is not None:
+            key = snap.shared_entry.get("key")
+            if key is None:
+                # entry predates the ledger (tests poke entries in raw):
+                # fold into a whole-bucket snapshot, nothing to re-pin
+                snap.k_rows = migration.merge_shared_rows(
+                    self._host_tree(snap.shared_entry["k"]), snap.k_rows
+                )
+                snap.v_rows = migration.merge_shared_rows(
+                    self._host_tree(snap.shared_entry["v"]), snap.v_rows
+                )
+                snap.shared_len = 0
+            else:
+                snap.shared_key = key
+                shared_k = self._host_tree(snap.shared_entry["k"])
+                shared_v = self._host_tree(snap.shared_entry["v"])
+        header = migration.snapshot_header(snap, req, s)
+        payload = migration.encode_payload(
+            header,
+            {"k": snap.k_rows, "v": snap.v_rows,
+             "shared_k": shared_k, "shared_v": shared_v},
+        )
+        dt = time.perf_counter() - t0
+        with self.stats_lock:
+            self.migrated_out_total += 1
+            self.migrate_out_bytes_total += len(payload)
+        if req.trace_ctx:
+            now = time.time()
+            tracing.get_tracer().record(
+                "engine.migrate_out", now - dt, now,
+                parent=req.trace_ctx,
+                attrs={
+                    "request_id": req.request_id,
+                    "kv_tokens": snap.length,
+                    "bytes": len(payload),
+                    "source": source,
+                },
+            )
+        log.info(
+            "migrate-out %s: %d tokens, %.1f KB (%s) in %.1f ms",
+            req.request_id[:8], snap.length, len(payload) / 1024, source, dt * 1e3,
+        )
+        return {"payload": payload, "out": req.out, "req_id": req.request_id}
+
+    def _migrate_export_slot(self, b: int, s: _Slot) -> None:
+        """Disaggregated-mode export, engine thread, straight after
+        activation: the slot's rows [0, P) are committed (the activating
+        dispatch was fetched) and no in-flight round touches this slot (it
+        was not active when any was dispatched), so the snapshot is
+        committed-exact by the same argument as a drained preempt. The
+        first token was already emitted from the prefill logits here; the
+        destination resumes at position `length` with `last_tok`."""
+        L = int(self._lengths[b])
+        Lb = bucket_len(L, self.max_seq_len)
+        p0 = s.shared_len if (0 < s.shared_len < Lb and s.shared_entry) else 0
+        k_rows, v_rows = self._snapshot_rows(b, Lb, start=p0)
+        snap = KVSnapshot(
+            req_id=s.req.request_id,
+            priority=s.req.priority,
+            length=L,
+            bucket=Lb,
+            last_tok=int(self._last_tok[b]),
+            temperature=float(self._temp[b]),
+            top_k=int(self._topk[b]),
+            top_p=float(self._topp[b]),
+            k_rows=k_rows,
+            v_rows=v_rows,
+            nbytes=pytree_nbytes(k_rows) + pytree_nbytes(v_rows),
+            preempted_at=time.time(),
+            slot_obj=s,
+            shared_len=p0,
+            shared_entry=s.shared_entry if p0 else None,
+        )
+        item = self._wire_item(snap, source="prefill")
+        # free WITHOUT terminal events: the request is handed off, not dead
+        # — its consumer stays blocked in out.get() until the destination
+        # resumes emission into the same queue
+        self._free_now(b)
+        self._migrate_outbox.put(item)
+
+    def migrate_export_one(self) -> dict[str, Any] | None:
+        """Coordinator-thread drain hook: pop one offloaded snapshot from
+        the pool and serialize it for transfer. The snapshot's rows already
+        live on host (the preempt path device_get them), so no engine-loop
+        coordination is needed — pool pops are atomic, and a parked slot is
+        touched by nobody until whoever popped its snapshot restores it."""
+        if self._migrate_outbox is None or self._pool is None:
+            return None
+        snap = self._pool.pop_restore()
+        if snap is None:
+            return None
+        s = snap.slot_obj
+        if s is None or s.done or s.aborted:
+            # terminal events already delivered — drop rows + parked pins
+            self._paging.drop_snap(snap.snap_id)
+            return None
+        item = self._wire_item(snap, source="pool")
+        # the rows (shared fallback included) ride the wire: release the
+        # parked shared pins this engine was holding for the restore that
+        # will now happen elsewhere
+        self._paging.drop_snap(snap.snap_id)
+        return item
+
+    def migrate_steal_queued(self) -> GenRequest | None:
+        """Coordinator-thread drain hook: pop the oldest queued-but-not-
+        admitted request (the one stuck longest behind the long tail). It
+        holds no KV — re-homing it is a plain submit on the idle engine,
+        with the consumer queue riding along on the request object."""
+        if self._migrate_outbox is None:
+            return None
+        try:
+            return self._admit.get_nowait()
+        except queue.Empty:
+            return None
+
+    def migrate_import(self, payload: bytes, out: "queue.Queue[Any] | None" = None) -> GenRequest:
+        """Decode a wire payload and queue its snapshot for restore on the
+        engine loop. `out` re-homes an existing consumer queue (local
+        transport: the source engine's request keeps streaming from the
+        same queue object); None creates a fresh one (transfer RPC: the
+        service pumps it back over the response stream). Returns the
+        reconstructed request. Raises when migration is off or the payload
+        cannot run here — callers error the original consumer."""
+        if self._migrate_in is None:
+            raise RuntimeError("KV migration disabled (TPU_MIGRATE=0)")
+        if self._stop_evt.is_set() or self.stalled:
+            raise RuntimeError("engine unavailable for migrate-in")
+        header, snap = migration.wire_to_snapshot(payload)
+        if snap.bucket > self.max_seq_len:
+            raise ValueError(
+                f"snapshot bucket {snap.bucket} exceeds destination "
+                f"max_seq_len {self.max_seq_len}"
+            )
+        req = GenRequest(
+            prompt_ids=[int(t) for t in header["prompt_ids"]],
+            max_tokens=int(header["max_tokens"]),
+            temperature=snap.temperature,
+            top_k=snap.top_k,
+            top_p=snap.top_p,
+            stop=list(header.get("stop") or []),
+            priority=snap.priority,
+            request_id=snap.req_id,
+            created_at=float(header.get("created_at") or time.time()),
+            trace_ctx=header.get("trace_ctx") or "",
+            migrations=int(header.get("migrations") or 0) + 1,
+        )
+        if out is not None:
+            req.out = out
+        now = time.time()
+        s = _Slot(
+            req=req,
+            generated=int(header.get("generated") or 0),
+            text=header.get("text") or "",
+            pending=base64.b64decode(header.get("pending_b64") or ""),
+            prompt_len=int(header.get("prompt_len") or len(req.prompt_ids)),
+            first_token_at=now,
+            last_emit=now,
+        )
+        snap.slot_obj = s
+        self._migrate_in.put((snap, header, len(payload), s))
+        self._wake.set()
+        return req
+
+    def migrate_import_stream(self, payload: bytes) -> Iterator[dict[str, Any]]:
+        """Transfer-RPC adapter: import, then yield the resumed request's
+        events until terminal — the service streams them back to the source
+        host, which pumps them into the original consumer queue."""
+        req = self.migrate_import(payload)
+        while True:
+            evt = req.out.get()
+            if evt is _DONE:
+                return
+            yield evt
+            if evt.get("type") == "done":
+                return
+
+    def _migrate_restore_pending(self) -> bool:
+        """Engine thread: restore migrated-in snapshots into free slots.
+        Peek-then-pop — the engine thread is the inbox's only consumer, so
+        an item stays queued (not requeued) while no slot is free."""
+        restored = False
+        while not self._migrate_in.empty():
+            slot = self._free_slot()
+            if slot is None:
+                break
+            try:
+                snap, header, nbytes, s = self._migrate_in.get_nowait()
+            except queue.Empty:
+                break
+            snap.snap_id = self._snap_ctr
+            self._snap_ctr += 1
+            if snap.shared_len:
+                # paged pin handoff: same key at the same stored length in
+                # OUR prefix cache → adopt the local entry; its blocks
+                # re-pin (refcount++) through admit_shared in
+                # _restore_snapshot instead of copying rows. Otherwise fold
+                # the shipped fallback rows into a whole-bucket restore.
+                ent = (
+                    self._prefix_cache.get(snap.shared_key)
+                    if snap.shared_key is not None
+                    else None
+                )
+                if ent is not None and int(ent["P"]) == snap.shared_len:
+                    snap.shared_entry = ent
+                    self._prefix_cache.move_to_end(snap.shared_key)
+                else:
+                    try:
+                        migration.flatten_to_whole_bucket(snap)
+                    except ValueError as e:
+                        self._count_error()
+                        s.req.out.put({"type": "error", "error": str(e)})
+                        s.req.out.put(_DONE)
+                        continue
+            try:
+                self._restore_snapshot(slot, snap)
+            except Exception as e:
+                log.exception("migrate-in restore failed")
+                self._paging.drop_snap(snap.snap_id)
+                s.aborted = True
+                self._count_error()
+                s.req.out.put({"type": "error", "error": str(e)})
+                s.req.out.put(_DONE)
+                if self._recover_cache():
+                    self._abort_all("kv cache lost in failed migrate-in")
+                break
+            with self.stats_lock:
+                self.migrated_in_total += 1
+                self.migrate_in_bytes_total += int(nbytes)
+            restored = True
+        return restored
+
+    def migration_stats(self) -> dict[str, float]:
+        """Cumulative migration counters for engines_info/dashboard —
+        {"enabled": 0.0} when TPU_MIGRATE is off (mirrors memory_stats)."""
+        if self._migrate_outbox is None:
+            return {"enabled": 0.0}
+        with self.stats_lock:
+            return {
+                "enabled": 1.0,
+                "migrated_out_total": float(self.migrated_out_total),
+                "migrated_in_total": float(self.migrated_in_total),
+                "migrate_out_bytes_total": float(self.migrate_out_bytes_total),
+                "migrate_in_bytes_total": float(self.migrate_in_bytes_total),
+                "outbox_depth": float(self._migrate_outbox.qsize()),
+                "inbox_depth": float(self._migrate_in.qsize()),
+            }
 
     def _run(self) -> None:
         """Pipelined decode loop (depth 1): the next decode round is DISPATCHED
@@ -2004,6 +2328,10 @@ class GenerationEngine:
 
     def _admit_pending(self) -> bool:
         admitted = False
+        if self._migrate_in is not None and not self._migrate_in.empty():
+            # migrated-in snapshots re-enter first: their prefill was spent
+            # on another engine and their consumers have been waiting since
+            admitted = self._migrate_restore_pending() or admitted
         if self._pool is not None and self._pool.has_preempted():
             # offloaded snapshots re-enter ahead of the queue (subject to
             # the fairness/aging rule inside) — they already spent their
@@ -2358,6 +2686,15 @@ class GenerationEngine:
             s.spec.extend(ids)
         # tok0's KV will be written at position P in the first decode round.
         self._emit_token(slot, s, tok0, pos=P - 1)
+        if (
+            self._migrate_outbox is not None
+            and (req.migrate_after_prefill or self.migrate_after_prefill)
+            and not s.done
+            and not s.aborted
+        ):
+            # disaggregated mode: this engine spent the prefill and emitted
+            # the first token; the decode-role peer continues from here
+            self._migrate_export_slot(slot, s)
 
     def _prefill_backlog(self) -> int:
         """Prompt tokens not yet written for live mid-prefill slots."""

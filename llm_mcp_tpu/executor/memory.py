@@ -116,6 +116,16 @@ class KVSnapshot:
     # reference even across an eviction). 0 = whole-bucket snapshot.
     shared_len: int = 0
     shared_entry: Any = None
+    # KV migration (executor/migration.py): the shared prefix's token key —
+    # rides the wire so the DESTINATION engine can re-pin the prefix blocks
+    # out of its own cache (`admit_shared`) instead of copying rows. None
+    # for within-engine preemption, where shared_entry alone suffices.
+    shared_key: Any = None
+    # True for a snapshot that arrived over the transfer endpoint: restore
+    # then records an engine.migrate_in span (not engine.restore), skips
+    # the pool's restored counter, and pins shared blocks via admit_shared
+    # rather than re-tabling parked pins it never had.
+    migrated: bool = False
 
 
 class KVPool:

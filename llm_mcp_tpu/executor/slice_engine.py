@@ -44,6 +44,7 @@ job is the cross-process data plane.
 
 from __future__ import annotations
 
+import base64
 import logging
 import os
 import pickle
@@ -72,6 +73,7 @@ from ..models import (
 from ..models.configs import ModelConfig, resolve_config
 from ..models.llama import llama_prefill_chunk_batch
 from ..ops.sampling import sample_tokens, spec_verify
+from . import migration
 from .common import pow2_bucket
 from .drafter import NGramDrafter
 from .memory import (
@@ -510,6 +512,18 @@ class SliceEngine:
                 policy=os.environ.get("TPU_PREEMPT_POLICY", "") or "priority",
             )
 
+        # KV migration inbox (executor/migration.py): a slice can serve as
+        # a decode-role TARGET — payloads land here from migrate_import
+        # (any thread) and the leader loop restores them into free slots.
+        # Unlike pool restore, followers never saw this KV, so the mirrored
+        # "migin" command ships the rows themselves. TPU_MIGRATE=0 keeps
+        # the inbox None and no migration codepath runs.
+        self._migrate_in: "queue.Queue[tuple] | None" = None
+        self.migrated_in_total = 0
+        self.migrate_in_bytes_total = 0
+        if os.environ.get("TPU_MIGRATE", "0") not in ("", "0", "false", "no", "off"):
+            self._migrate_in = queue.Queue()
+
         # Paged-KV ledger (executor/paging.py): constructed in EVERY process
         # from the same constructor arguments, so the follower mirror starts
         # identical. The leader buffers every mutator's op list and flushes
@@ -663,6 +677,16 @@ class SliceEngine:
                 elif op == "restore":
                     _, slot, snap_id = cmd
                     kr, vr = self._snaps.pop(int(snap_id))
+                    with self.mesh:
+                        self._ck, self._cv = self._restore_fn(
+                            self._ck, self._cv, kr, vr, np.int32(slot)
+                        )
+                elif op == "migin":
+                    # migrated-in KV: the rows were computed on ANOTHER
+                    # engine, so no local host copy exists — the command
+                    # carries them (the only data-plane command that ships
+                    # KV bytes over the channel)
+                    _, slot, kr, vr = cmd
                     with self.mesh:
                         self._ck, self._cv = self._restore_fn(
                             self._ck, self._cv, kr, vr, np.int32(slot)
@@ -1025,6 +1049,107 @@ class SliceEngine:
         )
         return True
 
+    # -- KV migration: decode-role import (executor/migration.py) ----------
+
+    def migrate_import(self, payload: bytes, out: Any = None) -> SliceRequest:
+        """Accept a migration payload from another engine; the leader loop
+        restores it into a free slot and decode resumes at the snapshot's
+        length. Callable from any thread (coordinator tick, rpc transfer
+        handler). The slice has no prefix cache, so shared-prefix payloads
+        always fold their fallback rows into a whole-bucket snapshot."""
+        if self._migrate_in is None:
+            raise RuntimeError("migration disabled (TPU_MIGRATE=0)")
+        header, snap = migration.wire_to_snapshot(payload)
+        if snap.shared_len:
+            migration.flatten_to_whole_bucket(snap)
+        if isinstance(snap.k_rows, dict) or isinstance(snap.v_rows, dict):
+            raise ValueError(
+                "slice engine migration supports bare-array KV only "
+                "(no kv_quant payloads)"
+            )
+        if snap.bucket > self.max_seq_len:
+            raise ValueError(
+                f"snapshot bucket {snap.bucket} exceeds max_seq_len {self.max_seq_len}"
+            )
+        req = SliceRequest(
+            prompt_ids=[int(t) for t in header.get("prompt_ids", [])],
+            max_tokens=int(header["max_tokens"]),
+            temperature=float(header["temperature"]),
+            top_k=int(header["top_k"]),
+            top_p=float(header["top_p"]),
+            stop=list(header.get("stop", [])),
+            priority=int(header.get("priority", 0)),
+        )
+        if out is not None:
+            req.out = out
+        now = time.time()
+        s = _Slot(
+            req=req,
+            prompt_len=int(header["prompt_len"]),
+            generated=int(header["generated"]),
+            text=header.get("text", ""),
+            pending=base64.b64decode(header.get("pending_b64", "")),
+            active_at=now,
+            last_emit=now,
+        )
+        snap.slot_obj = s
+        with self._dead_lock:
+            if self.dead:
+                raise RuntimeError(f"engine dead: {self.dead}")
+            self._migrate_in.put((snap, header, len(payload), s))
+        return req
+
+    def _migrate_restore_pending(self) -> bool:
+        """Leader loop: restore at most the free-slot count of migrated-in
+        snapshots, shipping the rows to followers via "migin"."""
+        did = False
+        while self._migrate_in is not None and not self._migrate_in.empty():
+            free = self._free_slots()
+            if not free:
+                break
+            try:
+                snap, _header, nbytes, s = self._migrate_in.get_nowait()
+            except queue.Empty:
+                break
+            b = free[0]
+            kr, vr = snap.k_rows, snap.v_rows
+            if self._leader_ch is not None:
+                self._leader_ch.send(("migin", np.int32(b), kr, vr))
+            with self.mesh:
+                self._ck, self._cv = self._restore_fn(
+                    self._ck, self._cv, kr, vr, np.int32(b)
+                )
+            self._slots[b] = s
+            self._toks[b] = snap.last_tok
+            self._lens[b] = snap.length
+            self._temps[b] = snap.temperature
+            self._topks[b] = snap.top_k
+            self._topps[b] = snap.top_p
+            # unknown snap_id → the ledger charges a fresh private table
+            self._blk_ops += self._paging.restore_slot(b, -1, snap.length)
+            self.total_requests += 1
+            self.migrated_in_total += 1
+            self.migrate_in_bytes_total += nbytes
+            did = True
+            log.info(
+                "slice imported migrated snapshot into slot %d (%d tokens, %.1f KB)",
+                b, snap.length, nbytes / 1024,
+            )
+        return did
+
+    def migration_stats(self) -> dict[str, float]:
+        if self._migrate_in is None:
+            return {"enabled": 0.0}
+        return {
+            "enabled": 1.0,
+            "migrated_out_total": 0.0,  # slices are import-only targets
+            "migrated_in_total": float(self.migrated_in_total),
+            "migrate_out_bytes_total": 0.0,
+            "migrate_in_bytes_total": float(self.migrate_in_bytes_total),
+            "outbox_depth": 0.0,
+            "inbox_depth": float(self._migrate_in.qsize()),
+        }
+
     def _drain_requests(self, msg: str) -> None:
         """Fail every active slot, mid-prefill reservation, and queued
         request with a terminal event. Caller holds _dead_lock (both the
@@ -1054,6 +1179,13 @@ class SliceEngine:
                     s.req.out.put(_DONE)
             self._snaps.clear()
         self._blk_ops.clear()
+        while self._migrate_in is not None and not self._migrate_in.empty():
+            try:
+                _snap, _header, _nb, s = self._migrate_in.get_nowait()
+            except queue.Empty:
+                break
+            s.req.out.put({"type": "error", "error": msg})
+            s.req.out.put(_DONE)
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -1071,6 +1203,7 @@ class SliceEngine:
                     # iteration, mirrored to followers as commands — pool
                     # traffic never crowds out the decode cadence
                     pooled = self._maybe_restore()
+                migrated = self._migrate_restore_pending()
                 admitted = self._try_admit()
                 if self._pool is not None and self._maybe_preempt():
                     pooled = True
@@ -1091,7 +1224,7 @@ class SliceEngine:
                 else:
                     decoded = self._try_decode()
                 self._flush_blk_ops()
-                if not (admitted or prefilled or decoded or pooled):
+                if not (admitted or prefilled or decoded or pooled or migrated):
                     if self._leader_ch is not None:
                         self._leader_ch.ping_if_idle()
                     time.sleep(0.002)

@@ -63,6 +63,14 @@ def device_headroom(tags: dict | None) -> float:
         return 1.0
 
 
+def device_migration(tags: dict | None) -> bool:
+    """Whether the device advertises KV migration (the `migration` tag,
+    server.register_local_device with TPU_MIGRATE on). A saturated device
+    that can drain its pool to a peer recovers faster than one that can
+    only shed, so the router prefers it within the saturated band."""
+    return bool((tags or {}).get("migration", False))
+
+
 def derive_device_limits(hbm_gb: float, chips: int = 1) -> DeviceLimitSpec:
     """HBM budget → capability caps for a TPU device (slice).
 

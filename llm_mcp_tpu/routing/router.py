@@ -26,7 +26,7 @@ from ..state.db import Database
 from ..telemetry import tracing
 from ..utils.config import getenv
 from .circuit import CircuitBreaker
-from .limits import LimitsEngine, device_headroom
+from .limits import LimitsEngine, device_headroom, device_migration
 
 log = logging.getLogger("router")
 
@@ -202,12 +202,18 @@ class Router:
         ctx_k = int(model_row["context_k"]) if model_row else 0
         # Saturated devices (kv_headroom tag ≤ 0: their KV pool is at the
         # shed watermark and new requests would 429) rank behind everything
-        # else regardless of benchmark tps. Stable sort keeps the SQL
-        # tps/latency/freshness order within each class, so a saturated
-        # device is still reachable when it's the only one with the model.
-        rows = sorted(
-            rows, key=lambda r: device_headroom(Database.from_json(r["tags"], {})) <= 0.0
-        )
+        # else regardless of benchmark tps; among the saturated, devices
+        # advertising KV migration rank first — they can drain to a peer
+        # instead of shedding, so their saturation is transient. Stable
+        # sort keeps the SQL tps/latency/freshness order within each band,
+        # so a saturated device is still reachable when it's the only one
+        # with the model.
+        def _band(r) -> tuple[bool, bool]:
+            tags = Database.from_json(r["tags"], {})
+            saturated = device_headroom(tags) <= 0.0
+            return (saturated and not device_migration(tags), saturated)
+
+        rows = sorted(rows, key=_band)
         for r in rows:
             dev_id = r["id"]
             if not self.circuit.allow(dev_id):

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 from typing import Any, Iterator
 
 import grpc
@@ -19,7 +20,7 @@ import grpc
 from ..telemetry import tracing
 from ..worker.client import TerminalHTTPError
 from .pb import llm_mcp_tpu_pb2 as pb
-from .server import SERVICE_NAME, TERMINAL
+from .server import SERVICE_NAME, TERMINAL, TRANSFER_SERVICE_NAME
 
 log = logging.getLogger("rpc.client")
 
@@ -237,3 +238,72 @@ class GrpcCoreClient:
                 tps=tps,
             ),
         )
+
+
+class GrpcTransferClient:
+    """Client for the KV transfer endpoint (rpc/server.py
+    KVTransferService): ships a raw migration payload, yields the resumed
+    request's events as they stream back. Identity serializers both ways —
+    the payload is already self-describing and each response frame is a
+    JSON-encoded event."""
+
+    def __init__(self, target: str, *, timeout_s: float = 600.0):
+        from .server import KVTransferService
+
+        self.channel = grpc.insecure_channel(
+            target, options=KVTransferService.channel_options()
+        )
+        self.timeout_s = timeout_s
+        self._transfer = self.channel.unary_stream(
+            f"/{TRANSFER_SERVICE_NAME}/Transfer",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+
+    def close(self) -> None:
+        self.channel.close()
+
+    def transfer(self, payload: bytes) -> Iterator[dict[str, Any]]:
+        try:
+            for frame in self._transfer(
+                payload,
+                timeout=self.timeout_s,
+                metadata=GrpcCoreClient._trace_metadata(),
+            ):
+                yield json.loads(frame)
+        except grpc.RpcError as e:
+            raise ConnectionError(f"grpc {e.code().name}: {e.details()}") from e
+
+
+class RemoteMigrationTarget:
+    """Duck-typed migration target for MigrationCoordinator.add_remote: a
+    `migrate_import` that ships the payload over the transfer endpoint and
+    pumps the response stream back into the original consumer's queue on a
+    daemon thread (the coordinator tick must not block on a remote decode).
+    The remote engine raising (migration off, bucket too large) surfaces as
+    the FAILED_PRECONDITION abort → ConnectionError → an error event."""
+
+    def __init__(self, target: str, *, timeout_s: float = 600.0):
+        self.target = target
+        self._client = GrpcTransferClient(target, timeout_s=timeout_s)
+
+    def migrate_import(self, payload: bytes, out: Any = None) -> None:
+        if out is None:
+            raise ValueError("remote migration requires the consumer queue")
+
+        def pump() -> None:
+            terminal = False
+            try:
+                for evt in self._client.transfer(payload):
+                    out.put(evt)
+                    if evt.get("type") in ("done", "error"):
+                        terminal = evt.get("type") == "done"
+            except ConnectionError as e:
+                out.put({"type": "error", "error": str(e)})
+            if not terminal:
+                out.put({"type": "done", "finish_reason": "error", "usage": {}})
+
+        threading.Thread(target=pump, name="kv-migrate-pump", daemon=True).start()
+
+    def close(self) -> None:
+        self._client.close()

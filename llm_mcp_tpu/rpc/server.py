@@ -34,8 +34,10 @@ from .pb import llm_mcp_tpu_pb2 as pb
 log = logging.getLogger("rpc.server")
 
 SERVICE_NAME = "llmmcptpu.v1.Core"
+TRANSFER_SERVICE_NAME = "llmmcptpu.v1.KVTransfer"
 TERMINAL = ("done", "error", "canceled")
 STREAM_MAX_S = 600.0  # same bound as the HTTP SSE twin (api/jobs.py SSE_MAX_S)
+TRANSFER_MAX_BYTES = 1 << 30  # refuse absurd payloads before decoding
 
 
 def job_to_pb(job: Job) -> pb.Job:
@@ -83,6 +85,13 @@ class GrpcCoreServer:
         # to half the pool so Claim/Heartbeat/Complete always have threads
         # (16 parked streams would otherwise starve heartbeats → lease loss).
         self._stream_slots = threading.BoundedSemaphore(max(1, max_workers // 2))
+
+    def enable_kv_transfer(self, import_stream: Callable[[bytes], Any]) -> None:
+        """Register the KV transfer service on this server — must run
+        before start() (gRPC handlers are fixed at server start)."""
+        self._server.add_generic_rpc_handlers(
+            (KVTransferService(import_stream).handler(),)
+        )
 
     # -- service wiring (hand-rolled: no grpc_tools plugin in the env) -----
 
@@ -342,3 +351,84 @@ class GrpcCoreServer:
             self.circuit.record(str(dev), ok=ok)
         if ok:
             record_benchmark_from_job(self.catalog, job)
+
+
+class KVTransferService:
+    """Engine-to-engine KV transfer endpoint (executor/migration.py).
+
+    One unary-stream RPC: the request is a raw migration wire payload, the
+    response stream is the resumed request's events as JSON frames (token /
+    done / error), ending with the terminal event — the source host pumps
+    them into the original consumer's queue, so a migrated request streams
+    transparently across machines.
+
+    Raw bytes with identity serializers instead of protobuf messages: the
+    pb module is a compiled descriptor (no protoc in the env to extend it),
+    and the payload is already a self-describing format — wrapping it in a
+    `bytes` field would only add a copy. The gRPC max-message default (4 MB)
+    is raised to fit whole-bucket snapshots.
+    """
+
+    def __init__(self, import_stream: Callable[[bytes], Any]):
+        # import_stream: engine.migrate_import_stream — payload in, iterator
+        # of event dicts out (raises on a payload this engine cannot run)
+        self._import_stream = import_stream
+        self._server: grpc.Server | None = None
+        self.port = 0
+
+    def handler(self) -> grpc.GenericRpcHandler:
+        def transfer(payload: bytes, ctx):
+            if len(payload) > TRANSFER_MAX_BYTES:
+                ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "payload too large")
+            tp = GrpcCoreServer._traceparent(ctx)
+            span = (
+                tracing.get_tracer().span(
+                    "rpc.Transfer", parent=tp, attrs={"bytes": len(payload)}
+                )
+                if tp
+                else nullcontext()
+            )
+            with span:
+                try:
+                    events = self._import_stream(payload)
+                except (ValueError, RuntimeError) as e:
+                    ctx.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+                for evt in events:
+                    yield json.dumps(evt).encode()
+
+        handlers = {
+            "Transfer": grpc.unary_stream_rpc_method_handler(
+                transfer,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            )
+        }
+        return grpc.method_handlers_generic_handler(TRANSFER_SERVICE_NAME, handlers)
+
+    @staticmethod
+    def channel_options() -> list[tuple[str, int]]:
+        return [
+            ("grpc.max_receive_message_length", TRANSFER_MAX_BYTES),
+            ("grpc.max_send_message_length", TRANSFER_MAX_BYTES),
+        ]
+
+    def start(self, addr: str = "127.0.0.1:0", max_workers: int = 4) -> "KVTransferService":
+        """Standalone server for engine-only hosts (no job queue). Engines
+        co-hosted with a GrpcCoreServer can instead register `handler()` on
+        that server via `GrpcCoreServer.enable_kv_transfer`."""
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=self.channel_options(),
+        )
+        self._server.add_generic_rpc_handlers((self.handler(),))
+        self.port = self._server.add_insecure_port(addr)
+        if self.port == 0:
+            raise RuntimeError(f"grpc bind failed for {addr!r} (port in use or bad address)")
+        self._server.start()
+        log.info("kv transfer endpoint on port %d", self.port)
+        return self
+
+    def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            self._server.stop(grace)
+            self._server = None

@@ -219,5 +219,35 @@ class Metrics:
             registry=r,
         )
 
+        # -- KV migration (executor/migration.py) --
+        self.kv_migrated_out = Counter(
+            "llmtpu_kv_migrate_out_total",
+            "Snapshots exported to another engine (drain or prefill handoff)",
+            ["engine"],
+            registry=r,
+        )
+        self.kv_migrated_in = Counter(
+            "llmtpu_kv_migrate_in_total",
+            "Snapshots imported and restored from another engine",
+            ["engine"],
+            registry=r,
+        )
+        self.kv_migrate_bytes = Counter(
+            "llmtpu_kv_migrate_bytes_total",
+            "Wire bytes of exported KV migration payloads",
+            ["engine"],
+            registry=r,
+        )
+        self.kv_migrate_requeues = Counter(
+            "llmtpu_kv_migrate_requeue_total",
+            "Queued requests re-homed to an idle engine without KV transfer",
+            registry=r,
+        )
+        self.kv_migration_headroom_delta = Gauge(
+            "llmtpu_kv_migration_headroom_delta",
+            "Max-min kv_headroom spread across local engines (drain trigger signal)",
+            registry=r,
+        )
+
     def render(self) -> tuple[bytes, str]:
         return generate_latest(self.registry), CONTENT_TYPE_LATEST

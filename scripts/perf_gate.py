@@ -41,7 +41,14 @@ Checks, in order of authority:
      stored prefix lengths). paged_block_leaks is an exact check like
      window_errors: any nonzero end-of-run leak/double-free count from the
      ledger audit fails the gate outright.
-  5. Raw-decode kernel floors, when the record carries them: the B=112
+  5. KV-migration floors, when the record carries them: the 2-engine
+     oversubscribed sweep must have moved at least one snapshot or
+     queued request (migration_count >= 1) and its admitted p95 TTFT
+     must beat (or tie) the shedding-only leg (migrate_ttft_gain >=
+     1.0). Records from hosts that cannot give each engine its own
+     silicon (one device, or a single-core CPU) carry neither key
+     and [SKIP].
+  6. Raw-decode kernel floors, when the record carries them: the B=112
      headline-shape sweep >= 5600 tok/s (the pre-fusion starting line —
      the fused-layout work climbs FROM here), the MLA S=32k int8-latent
      sweep >= 150 tok/s, and layers_gbps >= 500 (achieved weight-stream
@@ -77,6 +84,8 @@ HIGHER_BETTER = (
     "embed_per_s_nomic-embed-text_b1_tpu",
     "embed_per_s_qwen3-embedding-8b-int8_b64_d1024_tpu",
     "paged_admit_ratio",
+    "migration_count",
+    "migrate_ttft_gain",
     "raw_decode_tok_per_s_llama-3.1-8b-int8_kv8_b112_tpu",
     "raw_decode_tok_per_s_mla-8b-int8_kv8_b4_s32768_tpu",
     "layers_gbps",
@@ -105,6 +114,13 @@ ABS_MIN = {
     # paged KV: the oversubscribed 90%-shared sweep must multiply admitted
     # slots at least 3x at equal HBM budget (peak logical/physical blocks)
     "paged_admit_ratio": 3.0,
+    # KV migration: the 2-engine oversubscribed sweep must actually move
+    # work (at least one snapshot or queued-steal) and the drained leg's
+    # admitted p95 TTFT must be no worse than shedding-only — a gain under
+    # 1.0 means the coordinator ships bytes without relieving the queue
+    # and TPU_MIGRATE=0 beats shipping it
+    "migration_count": 1.0,
+    "migrate_ttft_gain": 1.0,
     # raw-decode kernel floors (promoted top-level by bench.py). The b112
     # headline-shape sweep measured 5609 tok/s pre-fusion (r5): the fused
     # cache layout + wqkv/w13 layer pass must never regress BELOW that
