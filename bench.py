@@ -115,6 +115,26 @@ class _SkipDirect(Exception):
     pass
 
 
+def recorder_append_cost_s(n: int = 100_000) -> float:
+    """Measured wall per FlightRecorder.event() append — a tight loop on a
+    private ring running the exact code path the serve-path singleton runs.
+    Multiplied by a window's event count it prices the recorder's share of
+    serve wall (the <1% acceptance bar). 0.0 when TPU_FLIGHT=0 disables
+    the ring (the no-op path costs one env read per call)."""
+    import tempfile
+
+    from llm_mcp_tpu.telemetry.recorder import FlightRecorder
+
+    with tempfile.TemporaryDirectory(prefix="llmtpu-flight-bench-") as td:
+        rec = FlightRecorder(capacity=4096, dump_dir=td)
+        if not rec.enabled:
+            return 0.0
+        t0 = time.perf_counter()
+        for i in range(n):
+            rec.event("decode", rows=8, i=i)
+        return (time.perf_counter() - t0) / n
+
+
 def serve_efficiency(serve: dict) -> float | None:
     """serve tok/s ÷ engine-direct tok/s, the serving-layer tax as ONE
     first-class tracked number (scripts/perf_gate.py gates on it): 1.0
@@ -265,6 +285,9 @@ def serve_path_metrics(
             break
         time.sleep(0.5)
 
+    from llm_mcp_tpu.telemetry.recorder import get_recorder
+
+    rec = get_recorder()
     with eng.stats_lock:
         tok0, err0 = eng.total_tokens, eng.total_errors
         fin0, ftok0 = eng.finished_requests, eng.finished_tokens
@@ -272,6 +295,7 @@ def serve_path_metrics(
     sp0 = eng.speculation_stats()
     ms0 = eng.memory_stats()
     pg0 = eng.paging_stats()
+    ev0, dr0 = rec.events_total(), rec.dropped_events
     m0 = time.time()
     time.sleep(measure_s)
     with eng.stats_lock:
@@ -281,6 +305,7 @@ def serve_path_metrics(
     sp1 = eng.speculation_stats()
     ms1 = eng.memory_stats()
     pg1 = eng.paging_stats()
+    ev1, dr1 = rec.events_total(), rec.dropped_events
     m1 = time.time()
     # engine-loop budget over the window: where each wall-clock second of
     # the serve loop went (fetch = device round wait, dispatch = staging,
@@ -420,6 +445,15 @@ def serve_path_metrics(
     if finished > 0:
         out["cow_copies_per_req"] = cow / finished
     out["paged_block_leaks"] = float(pg_end.get("leaks", 0.0))
+    # flight-recorder cost over the window (telemetry/recorder.py): how many
+    # step events the serve path appended, how many were dropped during dump
+    # freezes (must stay 0 — perf_gate hard-fails on any), and the appends'
+    # share of window wall priced by a measured per-event cost (<1% bar)
+    out["recorder_events"] = float(ev1 - ev0)
+    out["recorder_dropped_events"] = float(dr1 - dr0)
+    per_ev = recorder_append_cost_s()
+    out["recorder_events_per_s"] = round(1.0 / per_ev, 0) if per_ev > 0 else 0.0
+    out["recorder_overhead_pct"] = round(100.0 * (ev1 - ev0) * per_ev / wall, 4)
     if ttfts:
         out["p50_ttft_ms"] = statistics.median(ttfts)
         out["p95_ttft_ms"] = sorted(ttfts)[max(0, int(len(ttfts) * 0.95) - 1)]
@@ -1308,6 +1342,16 @@ def main() -> None:
                     # nested under "secondary" the ABS_MIN embed floors can
                     # never fire (metric() only reads flat keys)
                     line[ek] = secondary[ek]
+            if "recorder_dropped_events" in serve:
+                # flight-recorder health over the headline window, promoted
+                # where scripts/perf_gate.py reads it (exact-zero drops, like
+                # paged_block_leaks) plus the measured overhead share
+                line["recorder_dropped_events"] = serve[
+                    "recorder_dropped_events"
+                ]
+                line["recorder_overhead_pct"] = serve.get(
+                    "recorder_overhead_pct", 0.0
+                )
             if "phase_pct" in serve:
                 # where the engine loop's wall-clock went during the window
                 line["serve_phase_pct"] = serve["phase_pct"]
@@ -1342,7 +1386,22 @@ def main() -> None:
                 smoke_line["spec_tok_per_call"] = round(
                     serve["spec_tok_per_call"], 2
                 )
+            smoke_line["recorder_dropped_events"] = serve.get(
+                "recorder_dropped_events", 0.0
+            )
+            smoke_line["recorder_overhead_pct"] = serve.get(
+                "recorder_overhead_pct", 0.0
+            )
             print(json.dumps(smoke_line))
+            if smoke_line["recorder_dropped_events"] > 0:
+                # the smoke IS the recorder's no-drop proof: a drop here
+                # means dumps are freezing the ring long enough to lose
+                # serve-path events on an idle box — a recorder bug
+                raise SystemExit(
+                    "bench: flight recorder dropped "
+                    f"{smoke_line['recorder_dropped_events']:.0f} events "
+                    "during the CPU smoke window"
+                )
             if os.environ.get("BENCH_SPEC", "1") != "0":
                 # repetitive greedy smoke: exercises the n-gram drafter +
                 # fused verify end to end through the serve path on CPU

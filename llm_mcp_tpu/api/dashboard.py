@@ -20,6 +20,7 @@ from ..routing import Router
 from ..state.catalog import Catalog
 from ..state.db import Database
 from ..state.queue import JobQueue
+from ..telemetry import recorder as _flight
 from ..utils.config import Config
 from .http import Request, Response
 
@@ -117,6 +118,31 @@ class DashboardAPI:
             for name, i in engines.items()
             if isinstance(i.get("migration"), dict)
         }
+        # condensed flight-recorder view (full stats under
+        # engines[name]["flight"], raw ring via /v1/debug/flight): anomaly
+        # dump history per engine plus watchdog transition counts — the
+        # "has anything weird happened" row of the dashboard
+        anomalies = {
+            name: {
+                "dumps": int(
+                    (i["flight"].get("anomaly") or {}).get("dumps_total", 0.0)
+                ),
+                "by_detector": (i["flight"].get("anomaly") or {}).get(
+                    "by_detector"
+                )
+                or {},
+                "last": (i["flight"].get("anomaly") or {}).get("last") or {},
+                "watchdog": i["flight"].get("watchdog_transitions") or {},
+                "dropped_events": int(i["flight"].get("dropped_events", 0.0)),
+            }
+            for name, i in engines.items()
+            if isinstance(i.get("flight"), dict)
+        }
+        # condensed compile-ledger view (full table via /v1/debug/compiles):
+        # the ledger is process-wide — one block, costliest shapes first,
+        # so cold-boot compile spend is visible without grepping logs
+        led = _flight.get_compile_ledger()
+        compiles = {"stats": led.stats(), "top": led.table()[:8]}
         resp.write_json(
             {
                 "ts": time.time(),
@@ -135,6 +161,8 @@ class DashboardAPI:
                 "memory": memory,
                 "paging": paging,
                 "migration": migration,
+                "anomalies": anomalies,
+                "compiles": compiles,
                 "issues": issues,
             }
         )
@@ -208,16 +236,36 @@ class DashboardAPI:
         ]
         if stale:
             issues.append(f"Online devices not seen for >10min: {', '.join(sorted(stale))}.")
-        stalled = [
-            name
-            for name, info in (engines if engines is not None else self.engines_info()).items()
-            if info.get("stalled")
-        ]
+        eng = engines if engines is not None else self.engines_info()
+        stalled = [name for name, info in eng.items() if info.get("stalled")]
         if stalled:
             issues.append(
                 "Local engine(s) STALLED — accelerator link unresponsive, "
                 f"requests failing over: {', '.join(sorted(stalled))}."
             )
+        dropped = sum(
+            int(i["flight"].get("dropped_events", 0.0))
+            for i in eng.values()
+            if isinstance(i.get("flight"), dict)
+        )
+        if dropped:
+            issues.append(
+                f"Flight recorder dropped {dropped} events during dump "
+                "freezes — raise TPU_FLIGHT_RING or TPU_FLIGHT_DUMP_INTERVAL_S."
+            )
+        recent = [
+            (name, i["flight"]["anomaly"]["last"])
+            for name, i in eng.items()
+            if isinstance(i.get("flight"), dict)
+            and (i["flight"].get("anomaly") or {}).get("last")
+        ]
+        for name, last in recent:
+            if time.time() - float(last.get("ts", 0.0)) < 900:
+                issues.append(
+                    f"Engine {name} anomaly in the last 15min: "
+                    f"{last.get('detector', '?')} — {last.get('reason', '')} "
+                    f"(journal: {last.get('journal') or 'n/a'})."
+                )
         return issues
 
     # -- debug -------------------------------------------------------------

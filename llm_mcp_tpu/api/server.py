@@ -29,6 +29,7 @@ from ..state.catalog import Catalog, sync_cloud_catalog
 from ..state.db import Database
 from ..state.queue import JobQueue
 from ..telemetry import Metrics, tracing
+from ..telemetry import recorder as flight
 from ..utils.config import Config
 from .dashboard import DashboardAPI
 from .http import HTTPApi, Request, Response
@@ -80,6 +81,12 @@ class CoreServer:
         # and the KV migration out/in/bytes counters (cumulative per engine)
         self._migration_counts: dict[str, dict[str, float]] = {}
         self._migration_requeues = 0.0
+        # flight recorder / anomaly / watchdog bridges: events_total is
+        # process-wide (one ring), anomaly dumps and watchdog transitions
+        # are cumulative per engine+detector/state
+        self._flight_events = 0.0
+        self._anomaly_counts: dict[str, dict[str, float]] = {}
+        self._watchdog_counts: dict[str, dict[str, float]] = {}
         self.limits = LimitsEngine(self.db, strict=self.cfg.strict_model_limits)
         self.circuit = CircuitBreaker()
         self.router = Router(
@@ -401,6 +408,46 @@ class CoreServer:
                             "migrate_out_bytes_total",
                         )
                     }
+            fst = getattr(e, "flight_stats", None)
+            if fst is not None:
+                fs = fst()
+                info[name]["flight"] = fs
+                by_det = (fs.get("anomaly") or {}).get("by_detector") or {}
+                prev_a = self._anomaly_counts.get(name, {})
+                for det, cur_a in by_det.items():
+                    if float(cur_a) > prev_a.get(det, 0.0):
+                        self.metrics.anomaly_dumps.labels(
+                            engine=name, detector=det
+                        ).inc(float(cur_a) - prev_a.get(det, 0.0))
+                self._anomaly_counts[name] = {
+                    det: float(v) for det, v in by_det.items()
+                }
+                wts = fs.get("watchdog_transitions") or {}
+                prev_w = self._watchdog_counts.get(name, {})
+                for state, cur_w in wts.items():
+                    if float(cur_w) > prev_w.get(state, 0.0):
+                        self.metrics.watchdog_transitions.labels(
+                            engine=name, state=state
+                        ).inc(float(cur_w) - prev_w.get(state, 0.0))
+                self._watchdog_counts[name] = {
+                    state: float(v) for state, v in wts.items()
+                }
+        # Process-wide flight ring + compile ledger (telemetry/recorder.py
+        # singletons shared by every engine in this process): events advance
+        # by delta, drops are a gauge (perf_gate hard-fails >0), and each
+        # fresh ledger entry feeds the compile histogram exactly once.
+        rec = flight.get_recorder()
+        cur_ev = float(rec.events_total())
+        if cur_ev > self._flight_events:
+            self.metrics.flight_events.inc(cur_ev - self._flight_events)
+            self._flight_events = cur_ev
+        self.metrics.flight_dropped.set(float(rec.dropped_events))
+        for entry in flight.get_compile_ledger().drain_fresh():
+            self.metrics.compile_seconds.labels(
+                engine=self.device_id,
+                phase=entry["phase"],
+                hit="hit" if entry["hit"] else "miss",
+            ).observe(float(entry["wall_s"]))
         if self.migration is not None:
             cst = self.migration.stats()
             self.metrics.kv_migration_headroom_delta.set(
@@ -464,6 +511,10 @@ class CoreServer:
         r("GET", "/v1/debug/actions", self.dashboard.handle_actions)
         r("GET", "/v1/debug/capacity", self.dashboard.handle_capacity)
         r("POST", "/v1/debug/test", self.dashboard.handle_smoke_test)
+        r("GET", "/v1/debug/flight", self.handle_debug_flight)
+        r("GET", "/v1/debug/compiles", self.handle_debug_compiles)
+        r("GET", "/v1/debug/profile", self.handle_debug_profile)
+        r("POST", "/v1/debug/profile", self.handle_debug_profile_start)
 
         # knowledge
         r("POST", "/v1/knowledge/ingest", self.handle_knowledge_ingest)
@@ -539,6 +590,89 @@ class CoreServer:
             resp.write_error("trace not found", 404)
             return
         resp.write_json({"trace_id": trace_id, "spans": spans})
+
+    # -- flight recorder / compile ledger / profiler (doc/observability.md) --
+
+    def handle_debug_flight(self, req: Request, resp: Response) -> None:
+        """Live tail of the flight-recorder ring plus anomaly-dump history.
+        `?limit=N` bounds the event tail, `?etype=X` filters by event type,
+        `?dump=1` forces a journal dump (rate-limit bypassed) — the manual
+        equivalent of an anomaly trigger, for capturing a healthy baseline."""
+        try:
+            limit = int(req.query.get("limit") or 100)
+        except ValueError:
+            resp.write_error("limit must be an integer", 400)
+            return
+        rec = flight.get_recorder()
+        out: dict[str, Any] = {
+            "recorder": rec.stats(),
+            "events": rec.snapshot(limit=limit, etype=req.query.get("etype") or ""),
+            "anomalies": {
+                name: e.anomaly_history()
+                for name, e in self.gen_engines.items()
+                if getattr(e, "anomaly_history", None) is not None
+            },
+        }
+        if req.query.get("dump") in ("1", "true", "yes"):
+            out["dump_path"] = rec.dump("manual", detector="api", force=True)
+        resp.write_json(out)
+
+    def handle_debug_compiles(self, req: Request, resp: Response) -> None:
+        """Queryable compile ledger: per-shape aggregates (costliest first)
+        and the raw first-sighting entries behind llmtpu_compile_seconds."""
+        try:
+            limit = int(req.query.get("limit") or 100)
+        except ValueError:
+            resp.write_error("limit must be an integer", 400)
+            return
+        led = flight.get_compile_ledger()
+        resp.write_json(
+            {
+                "stats": led.stats(),
+                "table": led.table(),
+                "entries": led.entries(limit=limit),
+            }
+        )
+
+    def handle_debug_profile(self, req: Request, resp: Response) -> None:
+        resp.write_json(
+            {
+                name: e.profile_status()
+                for name, e in self.gen_engines.items()
+                if getattr(e, "profile_status", None) is not None
+            }
+        )
+
+    def handle_debug_profile_start(self, req: Request, resp: Response) -> None:
+        """Arm a jax.profiler capture for the next N engine-loop steps:
+        body {"engine": name?, "steps": N?, "trace_dir": path?}. Defaults to
+        the sole generation engine; the engine thread starts/stops the
+        capture at loop boundaries (engine._profile_tick)."""
+        try:
+            body = req.json() or {}
+        except Exception:
+            resp.write_error("invalid JSON body", 400)
+            return
+        candidates = {
+            name: e
+            for name, e in self.gen_engines.items()
+            if getattr(e, "start_profile", None) is not None
+        }
+        if not candidates:
+            resp.write_error("no profiling-capable engine", 404)
+            return
+        name = body.get("engine") or next(iter(candidates))
+        eng = candidates.get(name)
+        if eng is None:
+            resp.write_error(f"unknown engine {name!r}", 404)
+            return
+        try:
+            steps = int(body.get("steps") or 20)
+        except (TypeError, ValueError):
+            resp.write_error("steps must be an integer", 400)
+            return
+        status = eng.start_profile(steps, trace_dir=str(body.get("trace_dir") or ""))
+        resp.write_json({"engine": name, **status})
 
     def handle_models(self, req: Request, resp: Response) -> None:
         models = self.catalog.list_models(kind=req.query.get("kind"))

@@ -34,6 +34,7 @@ import base64
 import logging
 import os
 import queue
+import tempfile
 import threading
 import time
 import uuid
@@ -60,6 +61,7 @@ from ..models.llama import (
 )
 from ..ops.sampling import sample_tokens, spec_verify
 from ..parallel.sharding import llama_param_specs, kv_cache_specs, shard_pytree
+from ..telemetry import recorder as flight
 from ..telemetry import tracing
 from .common import fine_bucket, pow2_bucket
 from .drafter import NGramDrafter
@@ -872,6 +874,38 @@ class GenerationEngine:
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
 
+        # Flight recorder + anomaly detectors + compile ledger
+        # (telemetry/recorder.py, TPU_FLIGHT knobs; doc/observability.md).
+        # The recorder/ledger are process-shared (like the tracer) so all
+        # engines land events in one ring; the anomaly monitor is per-engine
+        # because its detectors watch THIS engine's cadence/TTFT/leaks.
+        self._flight = flight.get_recorder()
+        self._ledger = flight.get_compile_ledger()
+        self._anomaly = flight.AnomalyMonitor(
+            self._flight, target_ttft_ms=self.target_ttft_ms
+        )
+        # watchdog/compile-grace state transition counts (satellite of the
+        # shed-while-compiling post-mortem gap): bridged to
+        # llmtpu_watchdog_transitions_total{state=...} by engines_info
+        self.watchdog_transitions: dict[str, int] = {}
+        self._last_round_ts = time.time()  # decode-cadence stall signal
+        # On-demand jax.profiler capture (/v1/debug/profile, or auto-armed
+        # for the next N loop steps after an anomaly dump when
+        # TPU_FLIGHT_PROFILE_STEPS > 0). All state transitions happen on
+        # the engine thread; other threads only set the pending request.
+        self._profile_pending: tuple[int, str] | None = None
+        self._profile_left = 0
+        self._profile_dir = ""
+        _psteps = int(os.environ.get("TPU_FLIGHT_PROFILE_STEPS", "0") or 0)
+        if _psteps > 0:
+            self._flight.add_dump_callback(
+                lambda info, n=_psteps: self.start_profile(n)
+            )
+        # paged ledger tap: COW / pin / unpin / snapshot ops become flight
+        # events (the callback runs under the paging lock — the recorder's
+        # lock-free append is the only thing it may do)
+        self._paging.on_ops = self._paging_event
+
         # Stall watchdog: a wedged accelerator link (observed in the field:
         # the remote-TPU tunnel's session lock held by a dead client — even
         # jax.devices() blocks forever) leaves the engine thread stuck in a
@@ -1096,10 +1130,12 @@ class GenerationEngine:
     def _watchdog(self) -> None:
         poll = min(30.0, max(1.0, self.stall_timeout_s / 4))
         while not self._stop_evt.wait(timeout=poll):
+            self.check_anomalies()  # decode-cadence stall, paged-leak growth
             age = self.stall_seconds()
             if age > self.stall_timeout_s:
                 if not self.stalled:
                     self.stalled = True
+                    self._watchdog_transition("stalled")
                     log.error(
                         "engine stalled: no loop progress for %.0f s "
                         "(wedged device call?); shedding queued load", age,
@@ -1122,6 +1158,7 @@ class GenerationEngine:
                     req.out.put(_DONE)
                     drained += 1
                 if drained:
+                    self._watchdog_transition("shed")
                     log.error("engine watchdog errored %d queued requests", drained)
                 # In-flight consumers must not hang forever either: deliver
                 # their terminal errors now. The wedged loop cannot race us
@@ -1174,6 +1211,7 @@ class GenerationEngine:
                         s.req.out.put(_DONE)
             elif self.stalled:
                 self.stalled = False
+                self._watchdog_transition("recovered")
                 log.warning("engine loop recovered after stall")
 
     def _next_counter(self) -> int:
@@ -1427,6 +1465,13 @@ class GenerationEngine:
         deferred job claim)."""
         if self._pool is not None:
             self._pool.note_shed(n)
+        in_grace = time.time() < self._compile_grace_until
+        self._flight.event("shed", n=n, in_grace=in_grace)
+        if in_grace:
+            # the post-mortem distinction this PR exists for: work dropped
+            # because a compile held the loop, not because of a real wedge
+            self._watchdog_transition("shed_in_grace")
+        self._anomaly.signal("shed_in_grace", in_grace=in_grace, shed=n)
 
     def current_tps(self, window_s: float = 10.0) -> float:
         now = time.time()
@@ -1505,14 +1550,169 @@ class GenerationEngine:
         with self.stats_lock:
             self.total_errors += n
 
-    def _note_exec_shape(self, *key) -> None:
+    def _note_exec_shape(self, *key) -> bool:
         """Record a dispatch shape; first sighting opens a compile-grace
-        window equal to the stall timeout (see __init__)."""
-        if key not in self._seen_exec_shapes:
-            self._seen_exec_shapes.add(key)
-            self._compile_grace_until = max(
-                self._compile_grace_until, time.time() + self.stall_timeout_s
+        window equal to the stall timeout (see __init__). Returns True on
+        first sighting — the caller times that dispatch into the compile
+        ledger (_compile_obs): jit traces+compiles synchronously inside the
+        first call of a shape, so its wall time IS the compile time."""
+        if key in self._seen_exec_shapes:
+            return False
+        self._seen_exec_shapes.add(key)
+        now = time.time()
+        in_grace = now < self._compile_grace_until
+        self._compile_grace_until = max(
+            self._compile_grace_until, now + self.stall_timeout_s
+        )
+        if not in_grace:
+            # one transition per grace EPISODE, not per shape — overlapping
+            # first sightings extend the same open window
+            self._watchdog_transition("compile_grace")
+        return True
+
+    def _watchdog_transition(self, state: str) -> None:
+        """Count a watchdog/compile-grace state transition and journal it:
+        `llmtpu_watchdog_transitions_total{state=...}` + a recorder event,
+        so "shed while compiling" is distinguishable from a real wedge in
+        post-mortems. Called from the engine loop, the watchdog thread, and
+        the API's shed path — hence stats_lock."""
+        with self.stats_lock:
+            self.watchdog_transitions[state] = (
+                self.watchdog_transitions.get(state, 0) + 1
             )
+        self._flight.event("watchdog", state=state)
+
+    def _compile_obs(self, phase: str, key: tuple, wall_s: float) -> None:
+        """First dispatch of an executable shape → compile ledger entry +
+        recorder event (the ROADMAP item-5 cold-start measurement)."""
+        ks = ":".join(str(p) for p in key)
+        e = self._ledger.observe(phase, ks, wall_s)
+        self._flight.event(
+            "compile", phase=phase, key=ks,
+            wall_ms=round(wall_s * 1e3, 1), hit=e["hit"],
+        )
+
+    def _paging_event(self, ops: list[tuple]) -> None:
+        """Paged-ledger observer (paging.py on_ops): sharing-relevant block
+        ops → flight events. Runs under the rank-30 paging lock, so it only
+        performs lock-free recorder appends."""
+        for op in ops:
+            kind = op[0]
+            if kind == "pin":
+                self._flight.event("pin", slot=op[1], blocks=len(op[2]))
+            elif kind == "cow":
+                self._flight.event("cow", slot=op[1], src=op[2], dst=op[3])
+            elif kind == "free":
+                self._flight.event("unpin", slot=op[1], blocks=len(op[2]))
+            elif kind == "snap":
+                self._flight.event(
+                    "snap", snap_id=op[1], slot=op[2],
+                    shared=len(op[3]), private=len(op[4]),
+                )
+
+    @staticmethod
+    def _tid(req: "GenRequest") -> str:
+        """Request's 32-hex trace id for recorder events — a flight dump
+        stitches into /v1/traces through it ("" when the request arrived
+        without trace context)."""
+        ids = tracing.parse_traceparent(req.trace_ctx)
+        return ids[0] if ids else ""
+
+    def check_anomalies(self) -> None:
+        """Feed the poll-style anomaly detectors (decode-cadence stall,
+        paged-leak growth). Read-only over host state, so safe from any
+        thread; called by the watchdog loop and engines_info refreshes.
+        Event-style detectors (TTFT burn, spec collapse, ping-pong,
+        shed-in-grace) are fed at their hot-path sites instead."""
+        now = time.time()
+        if now >= self._compile_grace_until:
+            # inside grace a first-time shape may legitimately be compiling
+            # for minutes — cadence gaps there are not stalls
+            busy = sum(1 for s in self._slots if s is not None)
+            self._anomaly.signal(
+                "decode_stall",
+                gap_s=now - self._last_round_ts,
+                ema_s=self._sched.decode_round_s,
+                busy=busy,
+            )
+        self._anomaly.signal("paged_leak", leak_count=self._paging.leak_count())
+
+    def flight_stats(self) -> dict[str, Any]:
+        """Flight-recorder observability block (engines_info + dashboard):
+        ring health, anomaly dump counts, watchdog transition counts, and
+        the compile ledger's summary."""
+        rec = self._flight.stats()
+        with self.stats_lock:
+            transitions = dict(self.watchdog_transitions)
+        return {
+            "enabled": 1.0 if self._flight.enabled else 0.0,
+            "events_total": float(rec["events_total"]),
+            "dropped_events": float(rec["dropped_events"]),
+            "dumps": float(rec["dumps"]),
+            "last_dump_path": rec["last_dump_path"],
+            "anomaly": self._anomaly.stats(),
+            "watchdog_transitions": transitions,
+            "compile": self._ledger.stats(),
+        }
+
+    def anomaly_history(self, limit: int = 20) -> list[dict[str, Any]]:
+        return self._anomaly.history(limit)
+
+    # -- on-demand profiler capture (/v1/debug/profile) --------------------
+
+    def start_profile(self, steps: int, trace_dir: str = "") -> dict[str, Any]:
+        """Arm a jax.profiler capture for the next `steps` engine-loop
+        iterations. Callable from any thread (API handler, anomaly dump
+        callback); the engine thread performs the actual start/stop so the
+        capture brackets real device work. Idempotent while one is armed
+        or running."""
+        steps = max(1, int(steps))
+        d = trace_dir or os.environ.get("TPU_FLIGHT_PROFILE_DIR") or os.path.join(
+            tempfile.gettempdir(), "llmtpu-profile"
+        )
+        if self._profile_left > 0 or self._profile_pending is not None:
+            return self.profile_status()
+        self._profile_pending = (steps, d)
+        self._wake.set()
+        return self.profile_status()
+
+    def profile_status(self) -> dict[str, Any]:
+        pending = self._profile_pending
+        return {
+            "active": self._profile_left > 0,
+            "steps_left": int(self._profile_left),
+            "pending_steps": int(pending[0]) if pending else 0,
+            "trace_dir": self._profile_dir or (pending[1] if pending else ""),
+        }
+
+    def _profile_tick(self) -> None:
+        """Engine-thread-only: start a pending capture, count down a live
+        one, stop at zero. jax.profiler failures (unsupported backend, dir
+        permissions) disarm quietly — profiling must never take the serve
+        loop down."""
+        if self._profile_pending is not None:
+            steps, d = self._profile_pending
+            self._profile_pending = None
+            try:
+                os.makedirs(d, exist_ok=True)
+                jax.profiler.start_trace(d)
+            except Exception:
+                log.exception("jax.profiler start failed; capture disarmed")
+                return
+            self._profile_left = steps
+            self._profile_dir = d
+            self._flight.event("profile", action="start", steps=steps, dir=d)
+            log.info("profiler capture started: %d steps -> %s", steps, d)
+            return
+        if self._profile_left > 0:
+            self._profile_left -= 1
+            if self._profile_left == 0:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    log.exception("jax.profiler stop failed")
+                self._flight.event("profile", action="stop", dir=self._profile_dir)
+                log.info("profiler capture finished -> %s", self._profile_dir)
 
     def _abort_all(self, error: str) -> None:
         """Fail every in-flight request — decoding slots AND mid-prefill
@@ -1685,6 +1885,11 @@ class GenerationEngine:
                     "policy": pool.policy,
                 },
             )
+        self._flight.event(
+            "preempt", trace_id=self._tid(s.req),
+            request_id=s.req.request_id[:8], slot=b, kv_tokens=L,
+            offload_bytes=snap.nbytes, wall_ms=round(dt * 1e3, 1),
+        )
         log.info(
             "preempted slot %d (req %s, %d tokens, %.1f MB) in %.1f ms",
             b, s.req.request_id[:8], L, snap.nbytes / (1 << 20), dt * 1e3,
@@ -1759,13 +1964,13 @@ class GenerationEngine:
             # private rows land at start=shared_len. R is exact, never
             # padded (insert_at_fn docstring: padding would clamp the start).
             ent = snap.shared_entry
-            self._note_exec_shape("restore", snap.shared_len)
+            first = self._note_exec_shape("restore", snap.shared_len)
             self._ck, self._cv = self._insert_cached_fn(
                 self._ck, self._cv, ent["k"], ent["v"],
                 jnp.asarray([b], dtype=jnp.int32), np.int32(1),
             )
             R = snap.bucket - snap.shared_len
-            self._note_exec_shape("restore_at", R)
+            first = self._note_exec_shape("restore_at", R) or first
             self._ck, self._cv = self._insert_at_fn(
                 self._ck, self._cv, up(snap.k_rows), up(snap.v_rows),
                 np.int32(b), np.int32(snap.shared_len),
@@ -1773,7 +1978,7 @@ class GenerationEngine:
         else:
             # one executable per (bucket, group=1) — same cache as prefix-hit
             # admission, so a restore compiles nothing the serve loop hasn't
-            self._note_exec_shape("restore", snap.bucket)
+            first = self._note_exec_shape("restore", snap.bucket)
             self._ck, self._cv = self._insert_cached_fn(
                 self._ck, self._cv, up(snap.k_rows), up(snap.v_rows),
                 jnp.asarray([b], dtype=jnp.int32), np.int32(1),
@@ -1800,8 +2005,17 @@ class GenerationEngine:
         else:
             self._paging.restore_slot(b, snap.snap_id, snap.length)
         dt = time.perf_counter() - t0
+        if first:
+            self._compile_obs(
+                "restore", (snap.bucket, snap.shared_len), dt
+            )
         if self._pool is not None and not snap.migrated:
             self._pool.note_restored(snap, dt)
+        self._flight.event(
+            "migrate_in" if snap.migrated else "restore",
+            trace_id=self._tid(s.req), request_id=s.req.request_id[:8],
+            slot=b, kv_tokens=snap.length, wall_ms=round(dt * 1e3, 1),
+        )
         if s.req.trace_ctx:
             now = time.time()
             tracing.get_tracer().record(
@@ -1870,6 +2084,11 @@ class GenerationEngine:
         with self.stats_lock:
             self.migrated_out_total += 1
             self.migrate_out_bytes_total += len(payload)
+        self._flight.event(
+            "migrate_out", trace_id=self._tid(req),
+            request_id=req.request_id[:8], kv_tokens=snap.length,
+            wire_bytes=len(payload), source=source,
+        )
         if req.trace_ctx:
             now = time.time()
             tracing.get_tracer().record(
@@ -2003,6 +2222,9 @@ class GenerationEngine:
             last_emit=now,
         )
         snap.slot_obj = s
+        # each import is one hop for this request — the ping-pong detector
+        # fires when the drain policy shuttles the same KV back and forth
+        self._anomaly.signal("migration_pingpong", request_id=req.request_id)
         self._migrate_in.put((snap, header, len(payload), s))
         self._wake.set()
         return req
@@ -2155,7 +2377,10 @@ class GenerationEngine:
             self.last_progress = time.time()
             if self.stalled:
                 self.stalled = False
+                self._watchdog_transition("recovered")
                 log.warning("engine loop resumed; clearing stall flag")
+            if self._profile_pending is not None or self._profile_left > 0:
+                self._profile_tick()
             if self._pool is not None and self._preempt_wanted():
                 # Preemption needs committed-exact host mirrors: lengths
                 # advance optimistically at dispatch and last_tok updates at
@@ -2608,14 +2833,17 @@ class GenerationEngine:
         ipack[3 * Ab + 1] = self._next_counter()
         # ONE fused dispatch: prefill + cache inserts + device sampling-param
         # rows + first-token sample (see admit_fn)
-        self._note_exec_shape("admit", Ab, bucket)
+        first = self._note_exec_shape("admit", Ab, bucket)
+        t0c = time.perf_counter()
         (self._ck, self._cv, self._d_temp, self._d_topk, self._d_topp,
          self._d_last_tok, toks0) = self._admit_fn(
             self.params, self._ck, self._cv,
             self._d_temp, self._d_topk, self._d_topp, self._d_last_tok,
             jnp.asarray(tokens), jnp.asarray(ipack), jnp.asarray(fpack),
         )
-        toks0 = np.asarray(toks0)
+        toks0 = np.asarray(toks0)  # host sync: first-call wall ≈ compile time
+        if first:
+            self._compile_obs("admit", (Ab, bucket), time.perf_counter() - t0c)
         for i, (slot, req, ids) in enumerate(batch):
             self._activate_state(slot, req, ids, int(toks0[i]))
 
@@ -2648,11 +2876,15 @@ class GenerationEngine:
         self._temp[slot] = req.temperature
         self._topk[slot] = req.top_k
         self._topp[slot] = req.top_p
+        ttft_ms = (s.first_token_at - req.created_at) * 1000.0
         with self.stats_lock:
             self.total_requests += 1
-            self._ttft_window.append(
-                (s.first_token_at, (s.first_token_at - req.created_at) * 1000.0)
-            )
+            self._ttft_window.append((s.first_token_at, ttft_ms))
+        self._flight.event(
+            "admit", trace_id=self._tid(req), request_id=req.request_id[:8],
+            slot=slot, prompt_tokens=P, ttft_ms=round(ttft_ms, 1),
+        )
+        self._anomaly.signal("ttft_burn", ttft_ms=ttft_ms)
         if req.trace_ctx:
             # retroactive spans from timestamps already stamped: the caller's
             # trace gets engine.admit (submit→pop) and engine.prefill
@@ -2831,16 +3063,24 @@ class GenerationEngine:
             maybe_fail(
                 "engine.prefill", f"slots={[s for s, _, _ in group.metas]}"
             )
-            self._note_exec_shape("chunk", group.tokens.shape[0],
-                                  group.bucket, group.skey)
+            first = self._note_exec_shape("chunk", group.tokens.shape[0],
+                                          group.bucket, group.skey)
             t0 = time.perf_counter()
             group.logits, self._ck, self._cv = self._prefill_chunk_fn(
                 self.params, self._ck, self._cv, group.tokens,
                 group.slots_arr, group.starts_arr, group.nv_arr, group.skey,
             )
             jax.block_until_ready(self._ck)
-            self._sched.observe_prefill(
-                group.n_tokens, time.perf_counter() - t0
+            wall = time.perf_counter() - t0
+            if first:
+                self._compile_obs(
+                    "chunk",
+                    (group.tokens.shape[0], group.bucket, group.skey), wall,
+                )
+            self._sched.observe_prefill(group.n_tokens, wall)
+            self._flight.event(
+                "chunk", rows=len(group.metas), tokens=group.n_tokens,
+                bucket=group.bucket, wall_ms=round(wall * 1e3, 2),
             )
         except Exception as e:
             self._fail_prefill_group(group, e)
@@ -2989,7 +3229,7 @@ class GenerationEngine:
             pow2_bucket(int(starts_arr[:n].max()), self.max_seq_len),
             self.max_seq_len,
         )
-        self._note_exec_shape("verify", A, C, skey)
+        first = self._note_exec_shape("verify", A, C, skey)
         n_acc, final, self._ck, self._cv, self._d_last_tok = self._verify_fn(
             self.params, self._ck, self._cv, self._d_last_tok,
             self._d_temp, self._d_topk, self._d_topp,
@@ -3000,6 +3240,8 @@ class GenerationEngine:
         )
         n_acc = np.asarray(n_acc)  # the round's host sync point
         final = np.asarray(final)
+        if first:
+            self._compile_obs("verify", (A, C, skey), time.perf_counter() - t0)
         self._sched.observe_verify(total, time.perf_counter() - t0)
         before = self.total_tokens
         drafted_round = 0
@@ -3047,6 +3289,13 @@ class GenerationEngine:
         self.spec_calls += 1
         self.spec_drafted += drafted_round
         self.spec_accepted += accepted_round
+        self._last_round_ts = time.time()  # verify rounds are cadence too
+        self._flight.event(
+            "verify", rows=n, drafted=drafted_round, accepted=accepted_round,
+        )
+        self._anomaly.signal(
+            "spec_collapse", drafted=drafted_round, accepted=accepted_round
+        )
         if drafted_round and accepted_round * 4 < drafted_round:
             # drafts aren't landing (workload shifted away from its own
             # history): a verify round still emits >=1 token per slot, but a
@@ -3129,10 +3378,11 @@ class GenerationEngine:
             maybe_fail(
                 "engine.prefill", f"slots={[s for s, _, _ in group.metas]}"
             )
-            self._note_exec_shape(
+            first = self._note_exec_shape(
                 "fused", Ba, compact, group.tokens.shape[0],
                 group.bucket, group.skey,
             )
+            t0c = time.perf_counter()
             (out, group.logits, self._ck, self._cv,
              self._d_last_tok) = self._fused_fn(
                 self.params,
@@ -3150,8 +3400,18 @@ class GenerationEngine:
                 compact=compact,
                 skey=group.skey,
             )
+            if first:
+                # dispatch is async but jit trace+compile is synchronous —
+                # the first call's wall time is dominated by the compile
+                self._compile_obs(
+                    "fused",
+                    (Ba, compact, group.tokens.shape[0], group.bucket,
+                     group.skey),
+                    time.perf_counter() - t0c,
+                )
         else:
-            self._note_exec_shape("decode", Ba, compact)
+            first = self._note_exec_shape("decode", Ba, compact)
+            t0c = time.perf_counter()
             out, self._ck, self._cv, self._d_last_tok = self._decode_fn(
                 self.params,
                 self._ck,
@@ -3163,6 +3423,10 @@ class GenerationEngine:
                 self._d_last_tok,
                 compact=compact,
             )
+            if first:
+                self._compile_obs(
+                    "decode", (Ba, compact), time.perf_counter() - t0c
+                )
         entries = [
             (b, self._slots[b], (i if compact else b)) for i, b in enumerate(active)
         ]
@@ -3176,6 +3440,11 @@ class GenerationEngine:
         # one lock acquisition per round; a no-op inside a block)
         self._paging.extend_many({b: int(self._lengths[b]) for b in active})
         self._rid_dispatched += 1
+        self._flight.event(
+            "fused" if group is not None else "decode",
+            rid=self._rid_dispatched, rows=len(active),
+            prefill_tokens=group.n_tokens if group is not None else 0,
+        )
         return _DispatchedRound(
             out=out, entries=entries, base=base, t0=round_t0,
             rid=self._rid_dispatched,
@@ -3194,6 +3463,7 @@ class GenerationEngine:
         implies an emission finish on the same tokens; emission stays
         authoritative for events, usage, and text."""
         out = np.asarray(disp.out)  # [K, Ba] — the only host sync per round
+        self._last_round_ts = time.time()  # decode-cadence stall signal
         # feed the token-budget scheduler's cost model: prefill-free rounds
         # teach the decode-round EMA; fused rounds attribute their time over
         # that EMA to the chunk group's prompt tokens

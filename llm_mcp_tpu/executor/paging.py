@@ -46,7 +46,7 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Any, Iterable
+from typing import Any, Callable, Iterable
 
 from ..utils.locks import OrderedLock
 
@@ -141,6 +141,14 @@ class PagedKVManager:
         # accounting
         self._ema_admit_blocks = float(self.blocks_per_slot)
 
+        # Observability tap (telemetry/recorder.py flight events): the
+        # engine injects a callback that receives each sharing-relevant ops
+        # list (pin / cow / snap / restore / drop / free-of-shared). The
+        # callback runs UNDER the rank-30 paging lock, so it must be
+        # non-blocking and must never take a ranked lock — the flight
+        # recorder's append satisfies both. None (the default) is free.
+        self.on_ops: "Callable[[list[tuple]], None] | None" = None
+
     # -- allocator core (callers hold self._lock) ---------------------------
 
     def blocks_for(self, n_tokens: int) -> int:
@@ -204,6 +212,18 @@ class PagedKVManager:
             if ratio > self.peak_sharing_ratio:
                 self.peak_sharing_ratio = ratio
 
+    def _notify(self, ops: list[tuple]) -> list[tuple]:
+        """Hand a mutation's ops to the injected observer (see on_ops in
+        __init__) and return them unchanged, so callers tack it onto their
+        return statement. Observer exceptions never break the ledger."""
+        cb = self.on_ops
+        if cb is not None and ops:
+            try:
+                cb(ops)
+            except Exception:  # noqa: BLE001
+                pass
+        return ops
+
     # -- slot lifecycle -----------------------------------------------------
 
     def admit_slot(self, slot: int, n_tokens: int) -> list[tuple]:
@@ -255,7 +275,7 @@ class PagedKVManager:
                 self.admit_total += 1
                 self.admit_shared_total += 1
                 self._note_peak()
-                return ops
+                return self._notify(ops)
         return self.admit_slot(slot, n_tokens)
 
     def ensure_slot(self, slot: int, n_tokens: int) -> list[tuple]:
@@ -295,7 +315,7 @@ class PagedKVManager:
         admitted, or already preempted) is a no-op — _free_now is the
         engine's single release chokepoint and may fire after preempt."""
         with self._lock:
-            return self._free_slot_locked(slot)
+            return self._notify(self._free_slot_locked(slot))
 
     def _free_slot_locked(self, slot: int) -> list[tuple]:
         table = self._tables.pop(slot, None)
@@ -331,7 +351,9 @@ class PagedKVManager:
                 self._decref(bid)
             self._snap_pins[snap_id] = shared
             self._snap_need[snap_id] = len(private)
-            return [("snap", snap_id, slot, list(shared), list(private))]
+            return self._notify(
+                [("snap", snap_id, slot, list(shared), list(private))]
+            )
 
     def restore_slot(self, slot: int, snap_id: int, n_tokens: int) -> list[tuple]:
         """Re-table the parked shared pins and allocate a fresh private
@@ -347,7 +369,7 @@ class PagedKVManager:
             self._tables[slot] = table
             self._shared_n[slot] = len(pinned)
             ops.append(("restore", snap_id, slot, list(extra)))
-            return ops
+            return self._notify(ops)
 
     def drop_snap(self, snap_id: int) -> list[tuple]:
         """Discard a snapshot's parked pins (request aborted/finished while
@@ -359,7 +381,7 @@ class PagedKVManager:
                 return []
             for bid in pins or ():
                 self._decref(bid)
-            return [("drop", snap_id)]
+            return self._notify([("drop", snap_id)])
 
     # -- prefix partition (the folded prefix budget) -------------------------
 

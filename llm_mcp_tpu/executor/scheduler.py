@@ -39,6 +39,8 @@ from __future__ import annotations
 
 import math
 
+from ..telemetry.recorder import get_recorder
+
 __all__ = ["TokenBudgetScheduler"]
 
 _EMA = 0.7  # keep-fraction; matches the engine's old decode-time smoothing
@@ -129,17 +131,30 @@ class TokenBudgetScheduler:
             # whole backlog back-to-back (the stale-budget bug this replaces
             # paced cold bursts in arbitrary 50 ms wall-clock slices)
             self.last_budget = backlog_tokens
+            get_recorder().event(
+                "budget", budget=backlog_tokens, backlog=backlog_tokens,
+                n_active=0, starved=False,
+            )
             return backlog_tokens
         cap = self.fair_cap()
         headroom_s = max(self.target_ttft_s - oldest_wait_s, self.decode_round_s)
         rounds_left = max(1.0, headroom_s / max(self.decode_round_s, 1e-6))
         need = int(math.ceil(backlog_tokens / rounds_left))
-        if need > cap:
+        starved = need > cap
+        if starved:
             self.starved_rounds += 1
         budget = max(self.min_budget, min(need, cap))
         if reserved_tokens > 0:
             budget = max(0, budget - int(reserved_tokens))
         self.last_budget = budget
+        # flight-recorder step event (telemetry/recorder.py): the decision
+        # a post-mortem needs to explain a TTFT burn or a decode stall —
+        # what budget was granted against what backlog, and whether the
+        # deadline was already unreachable (starved)
+        get_recorder().event(
+            "budget", budget=budget, backlog=backlog_tokens,
+            n_active=n_active, starved=starved,
+        )
         return budget
 
     def drain_estimate_s(

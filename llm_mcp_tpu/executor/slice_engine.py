@@ -71,6 +71,7 @@ from ..models import (
     llama_prefill,
 )
 from ..models.configs import ModelConfig, resolve_config
+from ..telemetry import recorder as _flight
 from ..models.llama import llama_prefill_chunk_batch
 from ..ops.sampling import sample_tokens, spec_verify
 from . import migration
@@ -559,6 +560,14 @@ class SliceEngine:
             target_ttft_ms=self.target_ttft_ms,
             min_budget=min(64, self.prefill_chunk) if self.prefill_chunk else 1,
         )
+        # Flight recorder + compile ledger (telemetry/recorder.py): leader
+        # methods record dispatch events and first-sighting compile walls
+        # into the SAME process-wide singletons GenerationEngine feeds —
+        # followers construct the references but never call them (all hooks
+        # live in leader-only methods).
+        self._flight = _flight.get_recorder()
+        self._ledger = _flight.get_compile_ledger()
+        self._seen_exec_shapes: set[tuple] = set()
         self._shutdown = threading.Event()
         self._thread: threading.Thread | None = None
         self._leader_ch: CmdLeader | None = None
@@ -1253,6 +1262,23 @@ class SliceEngine:
         if ops and self._leader_ch is not None:
             self._leader_ch.send(("blk", ops))
 
+    def _note_shape(self, *key) -> bool:
+        """First sighting of a dispatch shape on this slice: the first call
+        of a shape pays jit trace + compile synchronously, so its wall IS
+        the compile time (GenerationEngine._note_exec_shape contract)."""
+        if key in self._seen_exec_shapes:
+            return False
+        self._seen_exec_shapes.add(key)
+        return True
+
+    def _compile_obs(self, phase: str, key: tuple, wall_s: float) -> None:
+        ks = ":".join(str(p) for p in key)
+        e = self._ledger.observe(phase, ks, wall_s)
+        self._flight.event(
+            "compile", phase=phase, key=ks,
+            wall_ms=round(wall_s * 1000.0, 3), hit=e["hit"],
+        )
+
     def _try_admit(self) -> bool:
         free = self._free_slots()
         if not free:
@@ -1313,6 +1339,8 @@ class SliceEngine:
         self._counter += 1
         cmd = ("admit", tokens, lengths, slots, np.int32(A), temps, topks,
                topps, np.int32(ctr))
+        first = self._note_shape("admit", A, bucket)
+        t0c = time.perf_counter()
         try:
             if self._leader_ch is not None:
                 self._leader_ch.send(cmd)
@@ -1322,6 +1350,8 @@ class SliceEngine:
                     np.int32(A), temps, topks, topps, np.int32(ctr),
                 )
             toks0 = np.asarray(toks0)
+            if first:
+                self._compile_obs("admit", (A, bucket), time.perf_counter() - t0c)
         except Exception as e:
             # these requests were already popped off the queue — the loop's
             # crash handler can no longer see them, so fail them HERE or
@@ -1433,6 +1463,7 @@ class SliceEngine:
             nv_arr[i] = nv_arr[0]
         cmd = ("chunk", tokens, slots_arr, starts_arr, nv_arr,
                np.int32(f_skey))
+        first = self._note_shape("chunk", Ab, f_bucket, f_skey)
         try:
             if self._leader_ch is not None:
                 self._leader_ch.send(cmd)
@@ -1443,9 +1474,15 @@ class SliceEngine:
                     slots_arr, starts_arr, nv_arr, int(f_skey),
                 )
             jax.block_until_ready(self._ck)
-            self._sched.observe_prefill(
-                sum(n for _, _, n in metas), time.perf_counter() - t0
+            wall = time.perf_counter() - t0
+            if first:
+                self._compile_obs("chunk", (Ab, f_bucket, f_skey), wall)
+            self._flight.event(
+                "chunk", rows=len(group),
+                tokens=sum(n for _, _, n in metas), bucket=f_bucket,
+                wall_ms=round(wall * 1e3, 1),
             )
+            self._sched.observe_prefill(sum(n for _, _, n in metas), wall)
         except Exception as e:
             # fail the group's waiters HERE (the loop's crash handler drains
             # the rest): the donated cache died with the dispatch
@@ -1575,6 +1612,7 @@ class SliceEngine:
         self._counter += 1
         cmd = ("verify", tokens, slots_arr, starts_arr, nv_arr, drafts_arr,
                nd_arr, temps, topks, topps, np.int32(ctr), np.int32(skey))
+        first = self._note_shape("verify", A, C, skey)
         t0 = time.perf_counter()
         if self._leader_ch is not None:
             self._leader_ch.send(cmd)
@@ -1586,6 +1624,8 @@ class SliceEngine:
             )
         n_acc = np.asarray(n_acc)  # replicated: local fetch
         final = np.asarray(final)
+        if first:
+            self._compile_obs("verify", (A, C, skey), time.perf_counter() - t0)
         self._sched.observe_verify(total, time.perf_counter() - t0)
         K = self.decode_chunk
         drafted_round = accepted_round = emitted_round = 0
@@ -1618,6 +1658,9 @@ class SliceEngine:
         self.spec_drafted += drafted_round
         self.spec_accepted += accepted_round
         self.spec_emitted += emitted_round
+        self._flight.event(
+            "verify", rows=n, drafted=drafted_round, accepted=accepted_round,
+        )
         if drafted_round and accepted_round * 4 < drafted_round:
             # drafts aren't landing: a verify round emits >=1 token per slot
             # where a decode round emits K — back off before re-probing
@@ -1634,6 +1677,7 @@ class SliceEngine:
         cmd = ("decode", self._toks.copy(), self._lens.copy(), active0.copy(),
                self._temps.copy(), self._topks.copy(), self._topps.copy(),
                np.int32(ctr))
+        first = self._note_shape("decode", self.max_slots, self.decode_chunk)
         if self._leader_ch is not None:
             self._leader_ch.send(cmd)
         with self.mesh:
@@ -1642,10 +1686,16 @@ class SliceEngine:
                 active0, self._temps, self._topks, self._topps, np.int32(ctr),
             )
         out = np.asarray(out)  # [K, B] replicated
+        if first:
+            self._compile_obs(
+                "decode", (self.max_slots, self.decode_chunk),
+                time.perf_counter() - t_round,
+            )
         # decode rounds here are never fused with prefill, so every round
         # teaches the scheduler's decode-round EMA directly
         self._sched.observe_decode(time.perf_counter() - t_round)
         K = out.shape[0]
+        self._flight.event("decode", rows=int(active0.sum()))
         self._tps_marks.append((time.time(), int(active0.sum()) * K))
         for k in range(K):
             for b in range(self.max_slots):

@@ -1,16 +1,32 @@
 from .alerts import AlertMonitor, snapshot_status
 from .metrics import Metrics
+from .recorder import (
+    AnomalyMonitor,
+    CompileLedger,
+    FlightRecorder,
+    get_compile_ledger,
+    get_recorder,
+    set_compile_ledger,
+    set_recorder,
+)
 from .telegram import TelegramGateway
 from .tracing import Span, Tracer, current_traceparent, get_tracer, set_tracer
 
 __all__ = [
     "AlertMonitor",
+    "AnomalyMonitor",
+    "CompileLedger",
+    "FlightRecorder",
     "Metrics",
     "Span",
     "TelegramGateway",
     "Tracer",
     "current_traceparent",
+    "get_compile_ledger",
+    "get_recorder",
     "get_tracer",
+    "set_compile_ledger",
+    "set_recorder",
     "set_tracer",
     "snapshot_status",
 ]

@@ -249,5 +249,44 @@ class Metrics:
             registry=r,
         )
 
+        # -- Flight recorder / anomaly dumps / compile ledger --
+        # (telemetry/recorder.py, TPU_FLIGHT knobs; doc/observability.md).
+        # The recorder itself is stdlib-only, so all Prometheus bridging
+        # happens here + in api/server.py engines_info, by delta like the
+        # pool/paging/migration counters above.
+        self.flight_events = Counter(
+            "llmtpu_flight_events_total",
+            "Step events accepted into the flight-recorder ring (process-wide)",
+            registry=r,
+        )
+        self.flight_dropped = Gauge(
+            "llmtpu_flight_dropped_events",
+            "Events dropped while the ring was frozen mid-dump (must be 0)",
+            registry=r,
+        )
+        self.anomaly_dumps = Counter(
+            "llmtpu_anomaly_dumps_total",
+            "Anomaly-triggered flight-ring journal dumps",
+            ["engine", "detector"],
+            registry=r,
+        )
+        self.watchdog_transitions = Counter(
+            "llmtpu_watchdog_transitions_total",
+            "Engine watchdog state transitions "
+            "(compile_grace / stalled / shed / shed_in_grace / recovered)",
+            ["engine", "state"],
+            registry=r,
+        )
+        # Fed from CompileLedger.drain_fresh() at engines_info refresh:
+        # one observation per jit/bucket compile on the serve path. hit is
+        # the persistent-cache heuristic (wall < TPU_COMPILE_HIT_S).
+        self.compile_seconds = Histogram(
+            "llmtpu_compile_seconds",
+            "Wall time of serve-path executable compiles, per phase and cache outcome",
+            ["engine", "phase", "hit"],
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 20, 40, 80, 160),
+            registry=r,
+        )
+
     def render(self) -> tuple[bytes, str]:
         return generate_latest(self.registry), CONTENT_TYPE_LATEST

@@ -1,0 +1,638 @@
+"""Flight recorder, anomaly-triggered dumps, and the compile ledger.
+
+Traces (tracing.py) answer "where did *this request* spend its time";
+Prometheus (metrics.py) answers "how is the fleet doing on average".
+Neither answers the post-mortem question: *what was the serve loop doing,
+step by step, in the seconds before it misbehaved?*  This module is that
+missing layer — a bounded ring of structured step events that every hot
+subsystem appends to, frozen and journaled to disk the moment an anomaly
+detector fires, plus a compile ledger that records every jit/bucket
+compile (the ROADMAP item-5 cold-start baseline).
+
+Like tracing.py this module is deliberately dependency-free (stdlib only)
+and must never import `executor`, `api`, `jax`, or any other subsystem:
+the instrumented layers import *us*; consumers (alert pipeline, on-demand
+profiler capture) attach via callbacks instead of being imported here.
+`tests/test_recorder.py` pins that contract.
+
+Model
+-----
+An *event* is a tuple ``(seq, ts, etype, trace_id, fields)``:
+
+  seq       monotonic step sequence (process-wide, from itertools.count —
+            a single CPython bytecode op, so the hot path needs no lock)
+  ts        wall-clock seconds
+  etype     short event kind: admit / budget / chunk / verify / decode /
+            fused / preempt / offload / restore / cow / pin / unpin /
+            migrate_out / migrate_in / shed / watchdog / compile /
+            anomaly / profile
+  trace_id  the request's 32-hex trace id ("" for engine-global events) —
+            a dump stitches directly into /v1/traces
+  fields    flat dict of scalars (or None)
+
+The ring is a preallocated list; `event()` writes one slot with a single
+item-assignment (atomic under the GIL) and never blocks, allocates
+bounded memory, and never touches a lock.  `dump()` freezes appends just
+long enough to copy the ring (microseconds), then journals the copy as
+JSONL off to disk; events arriving while frozen are *counted as dropped*
+rather than queued — the dropped counter is the health signal bench.py
+and the perf gate watch (`recorder_dropped_events` must stay 0).
+
+Enablement follows tracing.py: on by default, `TPU_FLIGHT=0` disables
+(checked per event, so the knob works on a live process and `=0` is a
+true no-op — no ring writes, no dumps, no detector state).
+
+Knobs: `TPU_FLIGHT` (default 1), `TPU_FLIGHT_RING` (ring capacity,
+default 8192), `TPU_FLIGHT_DIR` (journal directory), and
+`TPU_FLIGHT_DUMP_INTERVAL_S` (min seconds between anomaly dumps,
+default 10).  `TPU_COMPILE_HIT_S` tunes the compile ledger's
+cache-hit heuristic.  `TPU_FLIGHT_PROFILE_STEPS` is read by the engine
+(the jax.profiler hook lives there, not here).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Callable
+
+__all__ = [
+    "AnomalyMonitor",
+    "CompileLedger",
+    "DecodeStallDetector",
+    "FlightRecorder",
+    "PagedLeakDetector",
+    "PingPongDetector",
+    "ShedDuringGraceDetector",
+    "SpecCollapseDetector",
+    "TTFTBurnDetector",
+    "get_compile_ledger",
+    "get_recorder",
+    "set_compile_ledger",
+    "set_recorder",
+]
+
+DEFAULT_RING = 8192
+DEFAULT_DUMP_INTERVAL_S = 10.0
+# Persistent-compilation-cache hits deserialize in well under this; real
+# XLA compiles of serve-path executables take multiples of it.
+DEFAULT_HIT_THRESHOLD_S = 0.25
+
+EVENT_KEYS = ("seq", "ts", "etype", "trace_id", "fields")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class FlightRecorder:
+    """Bounded lock-free ring of step events + freeze-and-journal dumps."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        dump_dir: str | None = None,
+        dump_interval_s: float | None = None,
+    ):
+        self.capacity = max(16, capacity if capacity is not None
+                            else _env_int("TPU_FLIGHT_RING", DEFAULT_RING))
+        self.dump_dir = dump_dir or os.environ.get("TPU_FLIGHT_DIR") or os.path.join(
+            tempfile.gettempdir(), "llmtpu-flight"
+        )
+        self.dump_interval_s = (
+            dump_interval_s if dump_interval_s is not None
+            else _env_float("TPU_FLIGHT_DUMP_INTERVAL_S", DEFAULT_DUMP_INTERVAL_S)
+        )
+        # Preallocated ring. The hot path does ONE item-assignment into it;
+        # list item assignment is atomic under the GIL, so no lock and no
+        # allocation beyond the event tuple itself.
+        self._ring: list[tuple | None] = [None] * self.capacity
+        self._seq = itertools.count()  # next(counter) is a single atomic op
+        self._frozen = False           # set only inside dump()'s copy window
+        self._dropped = 0
+        self._dumps = 0
+        self._last_dump_ts = 0.0
+        self._last_dump_path = ""
+        self._dump_lock = threading.Lock()   # dump/snapshot only — never event()
+        self._on_dump: list[Callable[[dict], None]] = []
+
+    # -- enablement --------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        """Dynamic so TPU_FLIGHT can be flipped on a live process."""
+        return os.environ.get("TPU_FLIGHT", "1").strip().lower() not in (
+            "0", "false", "off", "no",
+        )
+
+    # -- hot path ----------------------------------------------------------
+
+    def event(self, etype: str, trace_id: str = "", **fields: Any) -> None:
+        """Append one step event. Never blocks, never raises, never locks:
+        when the ring is frozen mid-dump the event is dropped and counted
+        (the perf gate hard-fails on a nonzero drop count, so the freeze
+        window is sized in microseconds)."""
+        if not self.enabled:
+            return
+        if self._frozen:
+            self._dropped += 1
+            return
+        seq = next(self._seq)
+        self._ring[seq % self.capacity] = (
+            seq, time.time(), etype, trace_id, fields or None,
+        )
+
+    # -- read side ---------------------------------------------------------
+
+    def _copy(self) -> list[tuple]:
+        """Ring contents in sequence order. Tuples are immutable and slots
+        are replaced whole, so a plain list() copy yields only intact
+        events (possibly spanning a wrap — sorting by seq fixes order)."""
+        rows = [r for r in list(self._ring) if r is not None]
+        rows.sort(key=lambda r: r[0])
+        return rows
+
+    def snapshot(self, limit: int = 0, etype: str = "") -> list[dict[str, Any]]:
+        """Newest-last event dicts for /v1/debug/flight (no freeze)."""
+        rows = self._copy()
+        if etype:
+            rows = [r for r in rows if r[2] == etype]
+        if limit > 0:
+            rows = rows[-limit:]
+        return [dict(zip(EVENT_KEYS, r)) for r in rows]
+
+    def events_total(self) -> int:
+        """Sequence high-water mark == events accepted so far."""
+        # itertools.count has no peek; track via a throwaway clone of the
+        # ring head instead: the max seq present, +1. Empty ring → 0.
+        rows = [r for r in list(self._ring) if r is not None]
+        return (max(r[0] for r in rows) + 1) if rows else 0
+
+    @property
+    def dropped_events(self) -> int:
+        return self._dropped
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "capacity": self.capacity,
+            "events_total": self.events_total(),
+            "dropped_events": self._dropped,
+            "dumps": self._dumps,
+            "last_dump_ts": self._last_dump_ts,
+            "last_dump_path": self._last_dump_path,
+        }
+
+    # -- dumps -------------------------------------------------------------
+
+    def add_dump_callback(self, fn: Callable[[dict], None]) -> None:
+        """fn(info) fires after each journal lands on disk. Exceptions are
+        swallowed. The alert pipeline and the engine's on-demand profiler
+        capture attach here so this module stays import-free."""
+        if fn not in self._on_dump:
+            self._on_dump.append(fn)
+
+    def remove_dump_callback(self, fn: Callable[[dict], None]) -> None:
+        if fn in self._on_dump:
+            self._on_dump.remove(fn)
+
+    def dump(self, reason: str, detector: str = "", force: bool = False) -> str | None:
+        """Freeze-copy-unfreeze the ring, then journal the copy as JSONL.
+
+        The freeze covers only the in-memory copy (a list() of the ring),
+        not the disk write — appenders racing the copy are counted as
+        dropped rather than blocked.  Rate-limited by dump_interval_s
+        unless force=True.  Returns the journal path, or None when
+        disabled / rate-limited / the disk said no."""
+        if not self.enabled:
+            return None
+        with self._dump_lock:
+            now = time.time()
+            if not force and now - self._last_dump_ts < self.dump_interval_s:
+                return None
+            self._frozen = True
+            try:
+                rows = self._copy()
+            finally:
+                self._frozen = False
+            self._last_dump_ts = now
+            self._dumps += 1
+            path = os.path.join(
+                self.dump_dir,
+                f"flight-{time.strftime('%Y%m%d-%H%M%S', time.gmtime(now))}"
+                f"-{self._dumps:04d}.jsonl",
+            )
+            header = {
+                "kind": "flight_dump",
+                "ts": now,
+                "reason": reason,
+                "detector": detector,
+                "events": len(rows),
+                "dropped_events": self._dropped,
+                "capacity": self.capacity,
+            }
+            try:
+                os.makedirs(self.dump_dir, exist_ok=True)
+                with open(path, "w", encoding="utf-8") as f:
+                    f.write(json.dumps(header) + "\n")
+                    for r in rows:
+                        f.write(json.dumps(dict(zip(EVENT_KEYS, r))) + "\n")
+            except OSError:
+                return None
+            self._last_dump_path = path
+        info = dict(header, path=path)
+        for fn in list(self._on_dump):
+            try:
+                fn(info)
+            except Exception:  # noqa: BLE001 — callbacks never break dumps
+                pass
+        return path
+
+
+# -- anomaly detectors ------------------------------------------------------
+# Pure state machines over scalar signals: observe(...) returns a reason
+# string on the rising edge and None otherwise. Each latches after firing
+# and re-arms only when its signal recovers, so one anomaly *episode*
+# produces exactly one dump however often the engine polls.
+
+
+class DecodeStallDetector:
+    """Decode cadence stopped while work is in flight. The gap threshold is
+    the larger of an absolute floor and a multiple of the scheduler's
+    decode-round EMA, so slow-but-moving big batches don't false-positive."""
+
+    name = "decode_stall"
+
+    def __init__(self, min_gap_s: float = 2.0, ema_mult: float = 20.0):
+        self.min_gap_s = min_gap_s
+        self.ema_mult = ema_mult
+        self._latched = False
+
+    def observe(self, gap_s: float, ema_s: float, busy: int) -> str | None:
+        stalled = busy > 0 and gap_s > max(self.min_gap_s, self.ema_mult * ema_s)
+        if not stalled:
+            self._latched = False
+            return None
+        if self._latched:
+            return None
+        self._latched = True
+        return (f"decode cadence stalled: {gap_s:.2f}s since last round "
+                f"(ema {ema_s * 1000:.0f}ms, {busy} in flight)")
+
+
+class TTFTBurnDetector:
+    """K consecutive TTFT samples over M× the TPU_TARGET_TTFT_MS SLO."""
+
+    name = "ttft_burn"
+
+    def __init__(self, target_ms: float, mult: float = 3.0, k: int = 4):
+        self.target_ms = target_ms
+        self.mult = mult
+        self.k = max(1, k)
+        self._over = 0
+        self._latched = False
+
+    def observe(self, ttft_ms: float) -> str | None:
+        if self.target_ms <= 0:
+            return None
+        if ttft_ms <= self.mult * self.target_ms:
+            self._over = 0
+            self._latched = False
+            return None
+        self._over += 1
+        if self._over < self.k or self._latched:
+            return None
+        self._latched = True
+        return (f"TTFT SLO burn: {self._over} consecutive samples over "
+                f"{self.mult:g}x target ({ttft_ms:.0f}ms vs {self.target_ms:.0f}ms)")
+
+
+class SpecCollapseDetector:
+    """Speculative accept rate collapsed over a window of verify rounds
+    (the drafter is burning verify budget for nothing)."""
+
+    name = "spec_collapse"
+
+    def __init__(self, window: int = 32, min_rate: float = 0.05,
+                 min_drafted: int = 64):
+        self.window = deque(maxlen=max(4, window))
+        self.min_rate = min_rate
+        self.min_drafted = min_drafted
+        self._latched = False
+
+    def observe(self, drafted: int, accepted: int) -> str | None:
+        if drafted <= 0:
+            return None
+        self.window.append((drafted, accepted))
+        d = sum(w[0] for w in self.window)
+        a = sum(w[1] for w in self.window)
+        if d < self.min_drafted:
+            return None
+        rate = a / d
+        if rate >= self.min_rate:
+            self._latched = False
+            return None
+        if self._latched:
+            return None
+        self._latched = True
+        return (f"speculative accept collapse: {rate:.1%} over last "
+                f"{len(self.window)} verify rounds ({a}/{d})")
+
+
+class PagedLeakDetector:
+    """Paged-block leak count grew (audit() found unreferenced blocks).
+    Re-fires only on further growth, not on a stable nonzero count."""
+
+    name = "paged_leak"
+
+    def __init__(self):
+        self._high = 0
+
+    def observe(self, leak_count: int) -> str | None:
+        if leak_count <= self._high:
+            if leak_count == 0:
+                self._high = 0
+            return None
+        prev, self._high = self._high, leak_count
+        return f"paged block leak growth: {prev} -> {leak_count} leaked blocks"
+
+
+class PingPongDetector:
+    """The same request migrated more than `max_hops` times inside
+    `window_s` — the drain policy is shuttling KV back and forth."""
+
+    name = "migration_pingpong"
+
+    def __init__(self, max_hops: int = 2, window_s: float = 60.0,
+                 max_tracked: int = 512):
+        self.max_hops = max(1, max_hops)
+        self.window_s = window_s
+        self._hops: dict[str, deque] = {}
+        self._order: deque = deque(maxlen=max_tracked)
+        self._fired: set[str] = set()
+
+    def observe(self, request_id: str, now: float | None = None) -> str | None:
+        now = time.time() if now is None else now
+        dq = self._hops.get(request_id)
+        if dq is None:
+            self._hops[request_id] = dq = deque()
+            self._order.append(request_id)
+            while len(self._hops) > self._order.maxlen:
+                old = self._order.popleft()
+                self._hops.pop(old, None)
+                self._fired.discard(old)
+        dq.append(now)
+        while dq and now - dq[0] > self.window_s:
+            dq.popleft()
+        if len(dq) <= self.max_hops or request_id in self._fired:
+            return None
+        self._fired.add(request_id)
+        return (f"migration ping-pong: request {request_id} moved "
+                f"{len(dq)} times in {self.window_s:.0f}s")
+
+
+class ShedDuringGraceDetector:
+    """Load was shed while the watchdog's compile-grace window was active —
+    the engine dropped work because of a *compile*, not a wedge. One fire
+    per grace episode."""
+
+    name = "shed_in_grace"
+
+    def __init__(self):
+        self._latched = False
+
+    def observe(self, in_grace: bool, shed: int) -> str | None:
+        if not in_grace:
+            self._latched = False
+            return None
+        if shed <= 0 or self._latched:
+            return None
+        self._latched = True
+        return f"shed {shed} request(s) during compile grace window"
+
+
+class AnomalyMonitor:
+    """Routes raw engine signals to the detector set; on a rising edge it
+    journals the flight ring, appends to the anomaly history, and fires
+    observer callbacks (the engine bridges these to the alert pipeline
+    and the on-demand profiler)."""
+
+    def __init__(
+        self,
+        recorder: FlightRecorder,
+        detectors: list | None = None,
+        history: int = 64,
+        target_ttft_ms: float | None = None,
+    ):
+        self.recorder = recorder
+        if detectors is None:
+            if target_ttft_ms is None:
+                target_ttft_ms = _env_float("TPU_TARGET_TTFT_MS", 0.0)
+            detectors = [
+                DecodeStallDetector(),
+                TTFTBurnDetector(target_ms=target_ttft_ms),
+                SpecCollapseDetector(),
+                PagedLeakDetector(),
+                PingPongDetector(),
+                ShedDuringGraceDetector(),
+            ]
+        self._detectors = {d.name: d for d in detectors}
+        self._history: deque = deque(maxlen=max(4, history))
+        self._counts: dict[str, int] = {}
+        self._callbacks: list[Callable[[dict], None]] = []
+
+    def add_callback(self, fn: Callable[[dict], None]) -> None:
+        if fn not in self._callbacks:
+            self._callbacks.append(fn)
+
+    def signal(self, kind: str, **fields: Any) -> dict[str, Any] | None:
+        """Feed one signal sample to detector `kind`. Returns the anomaly
+        record on a rising edge, else None. Unknown kinds and disabled
+        recorders are no-ops so call sites need no guards."""
+        det = self._detectors.get(kind)
+        if det is None or not self.recorder.enabled:
+            return None
+        try:
+            reason = det.observe(**fields)
+        except TypeError:
+            return None  # malformed signal never breaks the serve loop
+        if not reason:
+            return None
+        return self._fire(kind, reason)
+
+    def _fire(self, kind: str, reason: str) -> dict[str, Any]:
+        self.recorder.event("anomaly", detector=kind, reason=reason)
+        path = self.recorder.dump(reason=reason, detector=kind)
+        entry = {
+            "ts": time.time(),
+            "detector": kind,
+            "reason": reason,
+            "journal": path or "",
+        }
+        self._history.append(entry)
+        self._counts[kind] = self._counts.get(kind, 0) + 1
+        for fn in list(self._callbacks):
+            try:
+                fn(entry)
+            except Exception:  # noqa: BLE001
+                pass
+        return entry
+
+    def history(self, limit: int = 20) -> list[dict[str, Any]]:
+        items = list(self._history)
+        return items[-max(1, int(limit)):][::-1]
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "dumps_total": sum(self._counts.values()),
+            "by_detector": dict(self._counts),
+            "last": self._history[-1] if self._history else None,
+        }
+
+
+# -- compile ledger ---------------------------------------------------------
+
+
+class CompileLedger:
+    """Every jit/bucket compile on the serve path, as (phase, bucket key,
+    wall seconds, cache hit/miss). Entries land in a bounded deque; per-key
+    aggregates build the queryable table /v1/debug/compiles serves; the
+    metrics layer drains new entries into `llmtpu_compile_seconds`.
+
+    Hit/miss is a wall-time heuristic: jax's persistent compilation cache
+    deserializes in well under `hit_threshold_s` while a real XLA compile
+    of a serve executable takes multiples of it (`TPU_COMPILE_HIT_S`
+    tunes the split; an explicit hit= wins when the caller knows)."""
+
+    def __init__(self, max_entries: int = 512,
+                 hit_threshold_s: float | None = None):
+        self.hit_threshold_s = (
+            hit_threshold_s if hit_threshold_s is not None
+            else _env_float("TPU_COMPILE_HIT_S", DEFAULT_HIT_THRESHOLD_S)
+        )
+        self._lock = threading.Lock()
+        self._entries: deque = deque(maxlen=max(16, max_entries))
+        self._by_key: dict[str, dict[str, Any]] = {}
+        self._fresh: deque = deque(maxlen=max(16, max_entries))
+        self._total_s = 0.0
+
+    def observe(self, phase: str, key: str, wall_s: float,
+                hit: bool | None = None) -> dict[str, Any]:
+        if hit is None:
+            hit = wall_s < self.hit_threshold_s
+        entry = {
+            "ts": time.time(),
+            "phase": phase,
+            "key": key,
+            "wall_s": round(float(wall_s), 6),
+            "hit": bool(hit),
+        }
+        with self._lock:
+            self._entries.append(entry)
+            self._fresh.append(entry)
+            self._total_s += wall_s
+            agg = self._by_key.get(key)
+            if agg is None:
+                self._by_key[key] = agg = {
+                    "key": key, "phase": phase, "count": 0,
+                    "hits": 0, "misses": 0, "total_s": 0.0, "max_s": 0.0,
+                }
+            agg["count"] += 1
+            agg["hits" if hit else "misses"] += 1
+            agg["total_s"] = round(agg["total_s"] + wall_s, 6)
+            agg["max_s"] = round(max(agg["max_s"], wall_s), 6)
+        return entry
+
+    def table(self) -> list[dict[str, Any]]:
+        """Per-bucket aggregates, costliest first."""
+        with self._lock:
+            rows = [dict(v) for v in self._by_key.values()]
+        return sorted(rows, key=lambda r: -r["total_s"])
+
+    def entries(self, limit: int = 100) -> list[dict[str, Any]]:
+        with self._lock:
+            rows = list(self._entries)
+        return rows[-max(1, int(limit)):]
+
+    def drain_fresh(self) -> list[dict[str, Any]]:
+        """Entries observed since the last drain — the metrics bridge feeds
+        these to the llmtpu_compile_seconds histogram exactly once."""
+        with self._lock:
+            rows = list(self._fresh)
+            self._fresh.clear()
+        return rows
+
+    def stats(self) -> dict[str, Any]:
+        with self._lock:
+            n = len(self._entries)
+            hits = sum(1 for e in self._entries if e["hit"])
+            shapes = len(self._by_key)
+            total = self._total_s
+        return {
+            "entries": n,
+            "hits": hits,
+            "misses": n - hits,
+            "shapes": shapes,
+            "total_s": round(total, 6),
+        }
+
+
+# -- module-level defaults --------------------------------------------------
+# One shared recorder + ledger per process so all engines, the API layer,
+# and worker threads land events in the same ring (which /v1/debug/flight
+# serves), mirroring tracing.get_tracer().
+
+_default_recorder: FlightRecorder | None = None
+_default_ledger: CompileLedger | None = None
+_default_lock = threading.Lock()
+
+
+def get_recorder() -> FlightRecorder:
+    global _default_recorder
+    if _default_recorder is None:
+        with _default_lock:
+            if _default_recorder is None:
+                _default_recorder = FlightRecorder()
+    return _default_recorder
+
+
+def set_recorder(recorder: FlightRecorder) -> FlightRecorder:
+    """Swap the process-default recorder (tests use this for isolation).
+    Returns the previous recorder."""
+    global _default_recorder
+    with _default_lock:
+        prev = _default_recorder
+        _default_recorder = recorder
+    return prev if prev is not None else recorder
+
+
+def get_compile_ledger() -> CompileLedger:
+    global _default_ledger
+    if _default_ledger is None:
+        with _default_lock:
+            if _default_ledger is None:
+                _default_ledger = CompileLedger()
+    return _default_ledger
+
+
+def set_compile_ledger(ledger: CompileLedger) -> CompileLedger:
+    global _default_ledger
+    with _default_lock:
+        prev = _default_ledger
+        _default_ledger = ledger
+    return prev if prev is not None else ledger

@@ -1,0 +1,170 @@
+#!/usr/bin/env python3
+"""Render flight-recorder journals (and the live ring) as a timeline.
+
+A journal is what telemetry/recorder.py writes when an anomaly detector
+fires: one header JSON line ({"kind": "flight_dump", "reason", "detector",
+...}) followed by one line per ring event ({"seq", "ts", "etype",
+"trace_id", "fields"}).  This tool turns that into the thing a post-mortem
+actually reads: a per-step timeline with relative timestamps, per-request
+trace-id lanes, and an event-type census — so "what was the serve loop
+doing in the seconds before the stall" is one command, not a jq session.
+
+Usage:
+    python scripts/flight_dump.py /path/to/flight-20260806-*.jsonl
+    python scripts/flight_dump.py --core http://localhost:8080        # live ring
+    python scripts/flight_dump.py dump.jsonl --etype preempt,shed
+    python scripts/flight_dump.py dump.jsonl --trace <32-hex>         # one lane
+    python scripts/flight_dump.py dump.jsonl --tail 200
+
+Timeline lines look like:
+
+    +12.3451s  [a3f9c2d1] preempt   slot=3 kv_tokens=512 wall_ms=8.1
+
+where the +offset is relative to the first rendered event and the bracket
+is the first 8 hex of the request's trace id (engine-global events show
+[--------]); feed the full id to /v1/traces/<id> or scripts/trace_dump.py
+to see the same request's span tree.
+
+Stdlib-only (urllib), so it runs anywhere the core does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from collections import Counter
+from typing import Any
+
+
+def _fetch_json(url: str, timeout: float = 10.0) -> Any:
+    with urllib.request.urlopen(url, timeout=timeout) as r:  # noqa: S310
+        return json.loads(r.read())
+
+
+def load_from_file(path: str) -> tuple[dict, list[dict]]:
+    """(header, events) from a journal; header is {} for a bare JSONL."""
+    header: dict = {}
+    events: list[dict] = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if row.get("kind") == "flight_dump":
+                header = row
+            elif "etype" in row:
+                events.append(row)
+    return header, events
+
+
+def load_from_core(core: str, limit: int) -> tuple[dict, list[dict]]:
+    """(pseudo-header, events) from the live ring via /v1/debug/flight."""
+    doc = _fetch_json(f"{core.rstrip('/')}/v1/debug/flight?limit={limit}")
+    rec = doc.get("recorder") or {}
+    header = {
+        "kind": "flight_live",
+        "reason": "live ring",
+        "detector": "",
+        "events": len(doc.get("events") or []),
+        "dropped_events": rec.get("dropped_events", 0),
+        "capacity": rec.get("capacity", 0),
+    }
+    return header, list(doc.get("events") or [])
+
+
+def _fmt_fields(fields: dict | None) -> str:
+    if not fields:
+        return ""
+    return " ".join(f"{k}={v}" for k, v in fields.items())
+
+
+def render(
+    header: dict,
+    events: list[dict],
+    etypes: set[str] | None,
+    trace: str,
+    tail: int,
+    out=sys.stdout,
+) -> None:
+    if header:
+        w = out.write
+        w(
+            f"# {header.get('kind', 'flight_dump')}: {header.get('reason', '')}"
+            + (f" [{header['detector']}]" if header.get("detector") else "")
+            + "\n"
+        )
+        w(
+            f"# events={header.get('events', len(events))}"
+            f" dropped={header.get('dropped_events', 0)}"
+            f" capacity={header.get('capacity', '?')}\n"
+        )
+    if etypes:
+        events = [e for e in events if e.get("etype") in etypes]
+    if trace:
+        events = [e for e in events if str(e.get("trace_id", "")).startswith(trace)]
+    events.sort(key=lambda e: e.get("seq", 0))
+    if tail > 0:
+        events = events[-tail:]
+    if not events:
+        out.write("(no events match)\n")
+        return
+    census = Counter(e.get("etype", "?") for e in events)
+    out.write(
+        "# census: "
+        + " ".join(f"{k}={n}" for k, n in census.most_common())
+        + "\n\n"
+    )
+    t0 = min(float(e.get("ts", 0.0)) for e in events)
+    lanes: Counter = Counter()
+    for e in events:
+        tid = str(e.get("trace_id") or "")
+        lanes[tid] += 1
+        lane = tid[:8] if tid else "-" * 8
+        out.write(
+            f"+{float(e.get('ts', 0.0)) - t0:9.4f}s  [{lane}]"
+            f" {e.get('etype', '?'):<11}"
+            f" {_fmt_fields(e.get('fields'))}\n".rstrip()
+            + "\n"
+        )
+    named = {t: n for t, n in lanes.items() if t}
+    if named and not trace:
+        out.write("\n# request lanes (full trace ids for /v1/traces/<id>):\n")
+        for tid, n in sorted(named.items(), key=lambda kv: -kv[1]):
+            out.write(f"#   {tid}  {n} events\n")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?", help="flight journal (.jsonl)")
+    ap.add_argument("--core", help="live core base URL instead of a file")
+    ap.add_argument("--etype", default="", help="comma-separated event-type filter")
+    ap.add_argument("--trace", default="", help="trace-id (prefix) filter")
+    ap.add_argument("--tail", type=int, default=0, help="render only the last N events")
+    ap.add_argument(
+        "--limit", type=int, default=2000, help="events to pull with --core"
+    )
+    args = ap.parse_args(argv)
+    if bool(args.path) == bool(args.core):
+        ap.error("exactly one of <path> or --core is required")
+    try:
+        header, events = (
+            load_from_core(args.core, args.limit)
+            if args.core
+            else load_from_file(args.path)
+        )
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    etypes = {t.strip() for t in args.etype.split(",") if t.strip()} or None
+    render(header, events, etypes, args.trace.strip(), args.tail)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -240,6 +240,19 @@ def check(cand: dict, base: dict) -> list[tuple[str, str, str]]:
         )
     else:
         results.append(("paged_block_leaks", "absent from candidate", "skip"))
+    # exact check, no baseline leniency: a dropped flight-recorder event
+    # means a dump froze the ring long enough to lose serve-path history —
+    # the post-mortem tool lying about the incident it exists to capture
+    c = metric(cand, "recorder_dropped_events")
+    if c is not None:
+        results.append(
+            ("recorder_dropped_events", f"{c:.0f} (must be 0)",
+             "pass" if c <= 0.0 else "fail")
+        )
+    else:
+        results.append(
+            ("recorder_dropped_events", "absent from candidate", "skip")
+        )
     return results
 
 
