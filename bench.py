@@ -445,6 +445,18 @@ def serve_path_metrics(
     if finished > 0:
         out["cow_copies_per_req"] = cow / finished
     out["paged_block_leaks"] = float(pg_end.get("leaks", 0.0))
+    # physical block-pool HBM accounting (engine._phys_note_hbm): peak
+    # contiguous-equivalent ÷ physically-resident KV bytes over the run —
+    # the honest "how much HBM did sharing actually save" number (absent
+    # when TPU_PAGED_PHYSICAL gated physical mode off)
+    if pg_end.get("physical", 0.0):
+        out["paged_hbm_bytes_ratio"] = pg_end.get("hbm_bytes_ratio_peak", 1.0)
+        out["paged_hbm_bytes_physical"] = pg_end.get(
+            "hbm_bytes_physical_peak", 0.0
+        )
+        out["paged_hbm_bytes_contiguous_equiv"] = pg_end.get(
+            "hbm_bytes_contiguous_equiv_peak", 0.0
+        )
     # flight-recorder cost over the window (telemetry/recorder.py): how many
     # step events the serve path appended, how many were dropped during dump
     # freezes (must stay 0 — perf_gate hard-fails on any), and the appends'
@@ -767,6 +779,11 @@ def main() -> None:
                 pt = _kb.bench_attn("q8_gqa", 112, S, 0.5, arm="blocked", iters=10)
                 secondary["attn_us_per_cell"] = pt["attn_us_per_cell"]
                 secondary["attn_dma_per_cell"] = float(pt["dma_per_cell"])
+                # same point through the block-indirect gather (half of
+                # every row's blocks table-redirected to the pool): the
+                # per-cell price of physical paging at the headline shape
+                pp = _kb.bench_attn("q8_gqa", 112, S, 0.5, arm="paged", iters=10)
+                secondary["attn_us_per_cell_paged"] = pp["attn_us_per_cell"]
             except Exception as e:
                 print(f"# attn microbench failed: {e!r}", flush=True)
                 secondary["attn_cell_error"] = 0.0
@@ -1157,6 +1174,13 @@ def main() -> None:
                     secondary["paged_p95_ttft_ms"] = round(
                         pg.get("p95_ttft_ms", -1.0), 1
                     )
+                    if "paged_hbm_bytes_ratio" in pg:
+                        secondary["paged_hbm_bytes_ratio"] = round(
+                            pg["paged_hbm_bytes_ratio"], 2
+                        )
+                        secondary["paged_hbm_bytes_physical_mb"] = round(
+                            pg.get("paged_hbm_bytes_physical", 0.0) / 2**20, 1
+                        )
                 else:
                     secondary["paged_zero_window"] = round(
                         pg.get("tok_per_s", 0.0), 1
@@ -1310,6 +1334,12 @@ def main() -> None:
                     "paged_block_leaks", 0.0
                 )
                 line["paged_tok_per_s"] = secondary.get("paged_tok_per_s", 0.0)
+                if "paged_hbm_bytes_ratio" in secondary:
+                    # physical-pool HBM savings (floor 2.5 in perf_gate):
+                    # contiguous-equivalent ÷ physically-resident KV bytes
+                    line["paged_hbm_bytes_ratio"] = secondary[
+                        "paged_hbm_bytes_ratio"
+                    ]
             if "migration_count" in secondary:
                 # the 2-engine migration sweep's gated metrics, promoted
                 # into the line of record where scripts/perf_gate.py reads
@@ -1336,6 +1366,7 @@ def main() -> None:
                 f"raw_decode_tok_per_s_mla-8b-int8_kv8_b4_s32768_{platform}",
                 "layers_gbps",
                 "attn_us_per_cell",
+                "attn_us_per_cell_paged",
             ):
                 if ek in secondary:
                     # promoted top-level under the exact perf_gate key names:

@@ -60,7 +60,9 @@ from ..models.llama import (
     quantize_kv,
 )
 from ..ops.sampling import sample_tokens, spec_verify
-from ..parallel.sharding import llama_param_specs, kv_cache_specs, shard_pytree
+from ..parallel.sharding import (
+    llama_param_specs, kv_cache_specs, kv_pool_specs, shard_pytree,
+)
 from ..telemetry import recorder as flight
 from ..telemetry import tracing
 from .common import fine_bucket, pow2_bucket
@@ -74,6 +76,7 @@ from .memory import (
 )
 from . import migration
 from .paging import PagedKVManager
+from .physical import PhysicalPool, pool_like
 from .scheduler import TokenBudgetScheduler
 from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
 from ..utils.locks import OrderedLock
@@ -81,6 +84,73 @@ from ..utils.locks import OrderedLock
 log = logging.getLogger("engine")
 
 _DONE = object()
+
+
+def _tree2(fn, a, b):
+    """Apply fn(leaf_a, leaf_b) through the cache's dict nesting ({} is the
+    fused int8 layout's live placeholder, not absence)."""
+    if isinstance(a, dict):
+        if not a:
+            return {}
+        return {k: _tree2(fn, a[k], b[k]) for k in a}
+    return fn(a, b)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _cow_block_fn(ck, cv, pk, pv, slot, blk, prow):
+    """Physical copy-on-write: copy ONE prefix-pool block (pool row `prow`)
+    into a slot's arena at block index `blk` — the boundary block of an
+    unaligned prefix hit. Whole-block always (the suffix prefill overwrites
+    the tail past the stored length), so there is exactly one executable no
+    matter where inside the block the prefix ends."""
+
+    def one(arena, pool):
+        z = (0,) * (arena.ndim - 4)
+        bt = pool.shape[3]
+        seg = jax.lax.dynamic_slice(
+            pool, (0, prow, 0, 0) + z,
+            (pool.shape[0], 1, pool.shape[2], bt) + pool.shape[4:],
+        )
+        return jax.lax.dynamic_update_slice(
+            arena, seg.astype(arena.dtype), (0, slot, 0, blk * bt) + z
+        )
+
+    return _tree2(one, ck, pk), _tree2(one, cv, pv)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _pool_put_arena_fn(pk, pv, ck, cv, row, off, prow):
+    """Prefix store: copy one block of arena KV (slot row `row`, token
+    offset `off`) into pool row `prow`."""
+
+    def one(pool, arena):
+        z = (0,) * (arena.ndim - 4)
+        bt = pool.shape[3]
+        seg = jax.lax.dynamic_slice(
+            arena, (0, row, 0, off) + z,
+            (arena.shape[0], 1, arena.shape[2], bt) + arena.shape[4:],
+        )
+        return jax.lax.dynamic_update_slice(
+            pool, seg.astype(pool.dtype), (0, prow, 0, 0) + z
+        )
+
+    return _tree2(one, pk, ck), _tree2(one, pv, cv)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _pool_put_pool_fn(pk, pv, src_row, dst_row):
+    """Prefix store when the storing slot's block itself resolves to the
+    pool (a sharer storing a longer prefix): pool-row → pool-row copy."""
+
+    def one(pool, _):
+        z = (0,) * (pool.ndim - 4)
+        seg = jax.lax.dynamic_slice(
+            pool, (0, src_row, 0, 0) + z,
+            (pool.shape[0], 1, pool.shape[2], pool.shape[3]) + pool.shape[4:],
+        )
+        return jax.lax.dynamic_update_slice(pool, seg, (0, dst_row, 0, 0) + z)
+
+    return _tree2(one, pk, pk), _tree2(one, pv, pv)
 
 
 def _has_safetensors(weights_dir: str) -> bool:
@@ -699,9 +769,13 @@ class GenerationEngine:
             return ck, cv
 
         @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("skey",))
-        def prefill_chunk_fn(params, ck, cv, tokens, slots, starts, nvalid, skey):
+        def prefill_chunk_fn(params, ck, cv, tokens, slots, starts, nvalid, skey,
+                             paged=None):
+            # `paged` rides at the END so the donation indices above never
+            # move; the pool is NOT donated (entries outlive every dispatch)
             return llama_prefill_chunk_batch(
-                cfg_, params, ck, cv, tokens, slots, starts, nvalid, skey=skey
+                cfg_, params, ck, cv, tokens, slots, starts, nvalid, skey=skey,
+                paged=paged,
             )
 
         self._admit_fn = admit_fn
@@ -848,6 +922,54 @@ class GenerationEngine:
             self._paging.slot_partition, self._paging.prefix_partition,
         )
 
+        # Physical half of the paged ledger (physical.py): per-slot device
+        # block tables + a prefix block pool, so prefix-hit admission is
+        # PIN-ONLY (zero row copies — sharers read the one pool copy through
+        # the table) instead of duplicating entry rows into every slot.
+        # TPU_PAGED_PHYSICAL=0 is a true escape hatch: no tables, no pool,
+        # every dispatch takes the exact pre-physical trace. Gated to the
+        # same single-chip + chunked-prefill world as the prefix cache
+        # itself (_prefix_budget > 0 implies all of that), plus block sizes
+        # the attention kernels' paged arms accept.
+        self._phys: PhysicalPool | None = None
+        self._pool_k = self._pool_v = None
+        bt_ = self._paging.block_tokens
+        if (
+            os.environ.get("TPU_PAGED_PHYSICAL", "1")
+            not in ("", "0", "false", "no", "off")
+            and self._prefix_budget > 0
+            and self._paging.prefix_partition >= 1
+            and max_seq_len % bt_ == 0
+            and bt_ in (32, 64, 128, 256)
+        ):
+            self._phys = PhysicalPool(
+                n_slots=max_slots, seq_len=max_seq_len, block_tokens=bt_,
+                pool_rows=self._paging.prefix_partition,
+            )
+            # honest HBM accounting peak (bench.py paged_hbm_bytes_ratio):
+            # contiguous-equivalent bytes ÷ physically-resident bytes,
+            # sampled at every shared admission (the sharing peak)
+            self._phys_hbm_peak_ratio = 1.0
+            self._phys_hbm_peak = (0.0, 0.0)
+            self._pool_k = pool_like(self._ck, self._paging.prefix_partition, bt_)
+            self._pool_v = pool_like(self._cv, self._paging.prefix_partition, bt_)
+            if self.mesh is not None:
+                # size-1 meshes pass the gate; keep the pool's placement
+                # commitment consistent with the arena's (pool-row axis
+                # replicates — rows are a global resource, not dp-sliced)
+                specs = kv_pool_specs(
+                    quantized=self.kv_quant == "int8",
+                    latent=bool(self.cfg.kv_lora_rank),
+                )
+                self._pool_k = shard_pytree(self._pool_k, specs["k"], self.mesh)
+                self._pool_v = shard_pytree(self._pool_v, specs["v"], self.mesh)
+            log.info(
+                "physical paged KV: [%d, %d] block table + %d-row prefix pool"
+                " (%.1f MB)",
+                max_slots, self._phys.nbs, self._phys.pool_rows,
+                pytree_nbytes({"k": self._pool_k, "v": self._pool_v}) / (1 << 20),
+            )
+
         # KV migration (migration.py): engine-to-engine snapshot transfer.
         # TPU_MIGRATE=0 (default) keeps both queues None — every hot-path
         # touch point is guarded `is not None`, so the off state is a true
@@ -975,7 +1097,7 @@ class GenerationEngine:
         base_key = self._base_key
 
         def decode_body(params, ck, cv, packed, d_temp, d_topk, d_topp,
-                        d_last, compact):
+                        d_last, compact, paged=None):
             """One decode round (K fused steps) — traced body shared by
             decode_chunk_fn and fused_step_fn.
 
@@ -1012,7 +1134,7 @@ class GenerationEngine:
                 ck, cv, toks, lens, rng = carry
                 logits, ck, cv = llama_decode_step(
                     cfg, params, ck, cv, toks, lens, attn_impl=impl,
-                    slot_ids=slot_ids,
+                    slot_ids=slot_ids, paged=paged,
                 )
                 if mask is not None:
                     logits = jnp.where(mask, logits, -jnp.inf)
@@ -1040,9 +1162,9 @@ class GenerationEngine:
 
         @partial(jax.jit, donate_argnums=(1, 2, 7), static_argnames=("compact",))
         def decode_chunk_fn(params, ck, cv, packed, d_temp, d_topk, d_topp,
-                            d_last, compact):
+                            d_last, compact, paged=None):
             return decode_body(params, ck, cv, packed, d_temp, d_topk,
-                               d_topp, d_last, compact)
+                               d_topp, d_last, compact, paged=paged)
 
         @partial(
             jax.jit, donate_argnums=(1, 2, 7),
@@ -1050,7 +1172,7 @@ class GenerationEngine:
         )
         def fused_step_fn(params, ck, cv, packed, d_temp, d_topk, d_topp,
                           d_last, p_tokens, p_slots, p_starts, p_nvalid,
-                          compact, skey):
+                          compact, skey, paged=None):
             """Fused scheduler step: one decode round (K steps for the
             active rows) AND one budget-bounded prefill chunk group in the
             SAME dispatch (the token-budget scheduler's stall-free shape —
@@ -1066,11 +1188,11 @@ class GenerationEngine:
             them only when a prompt's last chunk landed."""
             out, ck, cv, d_last = decode_body(
                 params, ck, cv, packed, d_temp, d_topk, d_topp, d_last,
-                compact,
+                compact, paged=paged,
             )
             p_logits, ck, cv = llama_prefill_chunk_batch(
                 cfg, params, ck, cv, p_tokens, p_slots, p_starts, p_nvalid,
-                skey=skey,
+                skey=skey, paged=paged,
             )
             return out, p_logits, ck, cv, d_last
 
@@ -1096,10 +1218,10 @@ class GenerationEngine:
         @partial(jax.jit, donate_argnums=(1, 2, 3), static_argnames=("skey",))
         def verify_fn(params, ck, cv, d_last, d_temp, d_topk, d_topp,
                       tokens, slots, starts, nvalid, drafts, ndraft,
-                      counter, skey):
+                      counter, skey, paged=None):
             logits, ck, cv = llama_prefill_chunk_batch(
                 cfg, params, ck, cv, tokens, slots, starts, nvalid,
-                skey=skey, all_logits=True,
+                skey=skey, all_logits=True, paged=paged,
             )  # [A, C, V]
             if mask is not None:
                 logits = jnp.where(mask, logits, -jnp.inf)
@@ -1209,6 +1331,7 @@ class GenerationEngine:
                              "error": "engine stalled: accelerator unresponsive"}
                         )
                         s.req.out.put(_DONE)
+                    self._phys_sweep()
             elif self.stalled:
                 self.stalled = False
                 self._watchdog_transition("recovered")
@@ -1438,6 +1561,15 @@ class GenerationEngine:
         out = self._paging.stats()
         out["enabled"] = 1.0
         out["leaks"] = float(self._paging.leak_count())
+        if self._phys is not None:
+            out.update(self._phys.stats())
+            out["physical"] = 1.0
+            contig, phys = self._phys_hbm_peak
+            out["hbm_bytes_contiguous_equiv_peak"] = contig
+            out["hbm_bytes_physical_peak"] = phys
+            out["hbm_bytes_ratio_peak"] = self._phys_hbm_peak_ratio
+        else:
+            out["physical"] = 0.0
         return out
 
     def admission_state(self) -> tuple[bool, float]:
@@ -1514,7 +1646,9 @@ class GenerationEngine:
             leaves = jax.tree.leaves(
                 {"k": self._ck, "v": self._cv,
                  "p": (self._d_temp, self._d_topk, self._d_topp,
-                       self._d_last_tok)}
+                       self._d_last_tok),
+                 "x": ({} if self._pool_k is None
+                       else {"k": self._pool_k, "v": self._pool_v})}
             )
             deleted = any(x.is_deleted() for x in leaves)
         except AttributeError:
@@ -1541,6 +1675,26 @@ class GenerationEngine:
             )
         self._ck = cache["k"]
         self._cv = cache["v"]
+        if self._phys is not None:
+            # the physical pools ride the same donation paths (_pool_put_*
+            # donate them; _cow_block_fn donates the arena they feed) — any
+            # prefix entry's pool bytes are now suspect, so drop them all.
+            # _abort_all follows every _recover_cache()=True return and
+            # resets the per-slot tables + sweeps the id map.
+            self._pool_k = pool_like(self._ck, self._paging.prefix_partition,
+                                     self._paging.block_tokens)
+            self._pool_v = pool_like(self._cv, self._paging.prefix_partition,
+                                     self._paging.block_tokens)
+            if self.mesh is not None:
+                pspecs = kv_pool_specs(
+                    quantized=self.kv_quant == "int8",
+                    latent=bool(self.cfg.kv_lora_rank),
+                )
+                self._pool_k = shard_pytree(self._pool_k, pspecs["k"], self.mesh)
+                self._pool_v = shard_pytree(self._pool_v, pspecs["v"], self.mesh)
+            while self._prefix_cache:
+                self._evict_lru_prefix()
+            self._phys.reset_all()
         return True
 
     def _count_error(self, n: int = 1) -> None:
@@ -1609,6 +1763,132 @@ class GenerationEngine:
                     "snap", snap_id=op[1], slot=op[2],
                     shared=len(op[3]), private=len(op[4]),
                 )
+
+    # -- physical paged KV (block tables + prefix pool, physical.py) -------
+
+    def _paged_arg(self) -> dict | None:
+        """The `paged` operand threaded into every model-pass jit call:
+        {"tbl": [B, nbs] i32 device table, "k"/"v": prefix pools} when
+        physical paging is on, None otherwise. The two states have distinct
+        pytree treedefs, so each compiles its own executable — the None
+        trace is bit-identical to the pre-physical one."""
+        if self._phys is None:
+            return None
+        return {
+            "tbl": self._phys.device_table(),
+            "k": self._pool_k,
+            "v": self._pool_v,
+        }
+
+    def _phys_reset(self, slot: int) -> None:
+        """Slot released (free/preempt): its table row back to identity,
+        then reclaim pool rows whose ledger ids just died. Driven from the
+        mutator call sites, never from on_ops — the observer runs under the
+        paging lock and sweep/table_view re-take it."""
+        if self._phys is None:
+            return
+        if self._phys.reset(slot):
+            self._flight.event("pg_tbl", slot=slot, action="reset")
+        self._phys.sweep(self._paging.alive)
+
+    def _phys_sweep(self) -> None:
+        """Reclaim pool rows after a pin-dropping mutation that re-keys no
+        table (drop_snap, prefix_release outside the eviction path)."""
+        if self._phys is not None:
+            self._phys.sweep(self._paging.alive)
+
+    def _phys_rebuild(self, slot: int) -> None:
+        """Re-key one slot's device table row from the ledger's view (after
+        pin / restore mutations)."""
+        if self._phys is None:
+            return
+        ids, sn = self._paging.table_view(slot)
+        if self._phys.rebuild(slot, ids, sn):
+            self._flight.event("pg_tbl", slot=slot, action="rebuild", shared=sn)
+
+    def _phys_admit(self, slot: int, ent: dict, ops: list[tuple]) -> None:
+        """Physical side of a shared admission (prefix hit or migrated-in
+        re-pin): execute the ledger's COW op as ONE whole-block device copy
+        out of the entry's pool row, then rebuild the slot's table row.
+        Exactly one boundary block ever copies — aligned stored lengths
+        copy nothing at all."""
+        if self._phys is None:
+            return
+        for op in ops:
+            if op[0] != "cow":
+                continue
+            prow = self._phys.phys_of(op[2])
+            if prow is None:  # tripwire: unmapped entry block (audited)
+                self._phys.missing_pins += 1
+                continue
+            blk = int(ent["P"]) // self._paging.block_tokens
+            first = self._note_exec_shape("cow")
+            t0 = time.perf_counter()
+            self._ck, self._cv = _cow_block_fn(
+                self._ck, self._cv, self._pool_k, self._pool_v,
+                np.int32(slot), np.int32(blk),
+                np.int32(prow - self._phys.pool_base),
+            )
+            if first:
+                self._compile_obs("cow", (self._paging.block_tokens,),
+                                  time.perf_counter() - t0)
+            self._phys.cow_copies_total += 1
+            self._flight.event("pg_cow", slot=slot, blk=blk,
+                               pool_row=prow - self._phys.pool_base)
+        self._phys_rebuild(slot)
+        self._phys_note_hbm()
+
+    def _phys_note_hbm(self) -> None:
+        """Sample the honest HBM ledger at a shared admission: what the
+        live working set physically occupies (unique blocks — identity
+        homes + pool rows, each resident ONCE) against what the
+        pre-physical contiguous engine held for the same set (every
+        sharer's full row copy, plus the prefix entries' own device rows).
+        The peak ratio is bench.py's `paged_hbm_bytes_ratio` line-of-record
+        metric; perf_gate floors it."""
+        st = self._paging.stats()
+        bb = float(self._paging.bytes_per_block)
+        used = st["blocks_used"]
+        if bb <= 0 or used <= 0:
+            return
+        phys = used * bb
+        contig = st["logical_blocks"] * bb + float(self._prefix_cache_bytes)
+        ratio = contig / phys
+        if ratio > self._phys_hbm_peak_ratio:
+            self._phys_hbm_peak_ratio = ratio
+            self._phys_hbm_peak = (contig, phys)
+
+    def _store_prefix_physical(self, slot: int, key: tuple, p0: int) -> bool:
+        """Copy a freshly-registered prefix entry's blocks [0, p0) into the
+        prefix pool, gathered through the STORING slot's own table (a sharer
+        storing a longer prefix reads its shared blocks from the pool, not
+        its stale arena rows). False → pool rows unavailable; the caller
+        already holds the ledger registration and must release it."""
+        ids = self._paging.prefix_ids(key)
+        if ids is None:
+            return False
+        rows = self._phys.register_prefix(ids)
+        if rows is None:
+            return False
+        srcs = self._phys.row_sources(slot, len(ids))
+        for j, prow in enumerate(rows):
+            in_arena, src_row, off = srcs[j]
+            first = self._note_exec_shape("pool_put", in_arena)
+            t0 = time.perf_counter()
+            if in_arena:
+                self._pool_k, self._pool_v = _pool_put_arena_fn(
+                    self._pool_k, self._pool_v, self._ck, self._cv,
+                    np.int32(src_row), np.int32(off), np.int32(prow),
+                )
+            else:
+                self._pool_k, self._pool_v = _pool_put_pool_fn(
+                    self._pool_k, self._pool_v,
+                    np.int32(src_row), np.int32(prow),
+                )
+            if first:
+                self._compile_obs("pool_put", (in_arena,),
+                                  time.perf_counter() - t0)
+        return True
 
     @staticmethod
     def _tid(req: "GenRequest") -> str:
@@ -1728,6 +2008,7 @@ class GenerationEngine:
         for slot in list(self._prefills):
             st = self._prefills.pop(slot)
             self._paging.free_slot(slot)
+            self._phys_reset(slot)
             self._count_error()
             st.req.out.put({"type": "error", "error": error})
             st.req.out.put(_DONE)
@@ -1744,6 +2025,7 @@ class GenerationEngine:
                 self._count_error()
                 s.req.out.put({"type": "error", "error": error})
                 s.req.out.put(_DONE)
+            self._phys_sweep()
 
     def _free_slot(self, reserved: set[int] | None = None) -> int | None:
         for i, s in enumerate(self._slots):
@@ -1797,19 +2079,40 @@ class GenerationEngine:
         per cache tree ("q"+"s" for kv8; k/v last dims differ under MLA but
         the seq axis is ALWAYS axis 3, so the same slice covers every
         layout. start > 0 is the paged private-only snapshot: rows [0, start)
-        are a shared prefix whose blocks stay pinned in the paging ledger."""
+        are a shared prefix whose blocks stay pinned in the paging ledger.
 
-        def cut(arr):
+        Physical mode: when the snapshot range overlaps the slot's SHARED
+        blocks, their arena rows are stale (the bytes live in the prefix
+        pool) — resolve block-by-block through the table and concatenate.
+        Private blocks are identity homes, so a private-only snapshot
+        (start >= shared tokens) keeps the plain contiguous slice."""
+        srcs = None
+        bt = self._paging.block_tokens
+        if self._phys is not None:
+            _, sn = self._paging.table_view(b)
+            if sn > 0 and start < sn * bt:
+                srcs = self._phys.row_sources(b, -(-Lb // bt))
+
+        def cut(arr, pool):
             if isinstance(arr, dict):
                 if not arr:  # fused GQA: "v" is the empty-dict placeholder
                     return {}
                 return {
-                    "q": jax.device_get(arr["q"][:, b : b + 1, :, start:Lb]),
-                    "s": jax.device_get(arr["s"][:, b : b + 1, :, start:Lb]),
+                    k: cut(arr[k], None if pool is None else pool[k])
+                    for k in arr
                 }
-            return jax.device_get(arr[:, b : b + 1, :, start:Lb])
+            if srcs is None:
+                return jax.device_get(arr[:, b : b + 1, :, start:Lb])
+            parts = [
+                arr[:, row : row + 1, :, off : off + bt]
+                if in_arena
+                else pool[:, row : row + 1]
+                for in_arena, row, off in srcs
+            ]
+            whole = jnp.concatenate(parts, axis=3) if len(parts) > 1 else parts[0]
+            return jax.device_get(whole[:, :, :, start:Lb])
 
-        return cut(self._ck), cut(self._cv)
+        return cut(self._ck, self._pool_k), cut(self._cv, self._pool_v)
 
     def _preempt_one(self) -> bool:
         """Offload one victim slot to host memory and free it. The caller
@@ -1842,6 +2145,13 @@ class GenerationEngine:
         # entry's device arrays. shared_len < Lb always holds (a hit is a
         # STRICT prefix and both are pow2), but guard anyway.
         p0 = s.shared_len if (0 < s.shared_len < Lb and s.shared_entry) else 0
+        if self._phys is not None and p0 % self._paging.block_tokens:
+            # an unaligned boundary's COW tokens live ONLY in this slot's
+            # arena (the entry keeps no row copies to rebuild them from) and
+            # its pool partial-block is NOT pinned by the parked snapshot —
+            # park nothing shared, snapshot the whole bucket instead
+            p0 = 0
+        pool_rows = self._shared_pool_rows(b, p0)
         k_rows, v_rows = self._snapshot_rows(b, Lb, start=p0)
         dt = time.perf_counter() - t0
         snap_id = self._snap_ctr
@@ -1863,6 +2173,7 @@ class GenerationEngine:
             snap_id=snap_id,
             shared_len=p0,
             shared_entry=s.shared_entry if p0 else None,
+            shared_pool_rows=pool_rows,
         )
         pool.offload(snap, dt)
         # ledger: park the shared pins under snap_id, free the private tail
@@ -1912,6 +2223,7 @@ class GenerationEngine:
                 # terminal events already delivered; drop the rows and the
                 # ledger's parked shared pins
                 self._paging.drop_snap(snap.snap_id)
+                self._phys_sweep()
                 continue
             aged = time.time() - snap.preempted_at > self._aging_s()
             head = None
@@ -1930,9 +2242,15 @@ class GenerationEngine:
                 self._restore_snapshot(slot, snap)
             except Exception as e:
                 log.exception("restore of preempted slot failed")
-                # the ledger still parks this snap's pins (restore_slot runs
-                # only after the device inserts succeed) — release them
+                # contiguous path: the ledger still parks this snap's pins
+                # (restore_slot runs only after the device inserts succeed)
+                # — release them. Physical path: the pins may already be
+                # re-tabled (it pins BEFORE the inserts so the boundary COW
+                # lands first) — free the half-built table too.
+                self._paging.free_slot(slot)
+                self._phys_reset(slot)
                 self._paging.drop_snap(snap.snap_id)
+                self._phys_sweep()
                 s.aborted = True
                 self._count_error()
                 s.req.out.put({"type": "error", "error": str(e)})
@@ -1957,18 +2275,37 @@ class GenerationEngine:
                 return {k: jax.device_put(v) for k, v in rows.items()}
             return jax.device_put(rows)
 
+        ledgered = False
         if snap.shared_len and snap.shared_entry is not None:
-            # Paged two-stage restore: the shared prefix rows come back from
-            # the prefix-cache entry's device arrays (zero host bytes moved
-            # for them — the snapshot holds only the private tail), then the
-            # private rows land at start=shared_len. R is exact, never
-            # padded (insert_at_fn docstring: padding would clamp the start).
+            # Paged two-stage restore, private rows at start=shared_len. R
+            # is exact, never padded (insert_at_fn docstring: padding would
+            # clamp the start). The shared prefix comes back two ways:
+            # contiguous entries re-insert their device row copies; PHYSICAL
+            # entries re-pin — the rebuilt table row resolves the shared
+            # blocks into the prefix pool, zero rows move.
             ent = snap.shared_entry
-            first = self._note_exec_shape("restore", snap.shared_len)
-            self._ck, self._cv = self._insert_cached_fn(
-                self._ck, self._cv, ent["k"], ent["v"],
-                jnp.asarray([b], dtype=jnp.int32), np.int32(1),
-            )
+            if "k" in ent:
+                first = self._note_exec_shape("restore", snap.shared_len)
+                self._ck, self._cv = self._insert_cached_fn(
+                    self._ck, self._cv, ent["k"], ent["v"],
+                    jnp.asarray([b], dtype=jnp.int32), np.int32(1),
+                )
+            else:
+                # ledger pins FIRST: a migrated-in adopt with an unaligned
+                # stored length redoes the boundary COW out of the entry's
+                # pool row here, and the private insert below then overwrites
+                # that block's tail from snap.k_rows — order matters
+                if snap.migrated and snap.shared_key is not None:
+                    ops = self._paging.admit_shared(
+                        b, snap.shared_key, snap.length
+                    )
+                else:
+                    ops = self._paging.restore_slot(
+                        b, snap.snap_id, snap.length
+                    )
+                self._phys_admit(b, ent, ops)
+                ledgered = True
+                first = self._note_exec_shape("restore", snap.shared_len)
             R = snap.bucket - snap.shared_len
             first = self._note_exec_shape("restore_at", R) or first
             self._ck, self._cv = self._insert_at_fn(
@@ -2000,10 +2337,14 @@ class GenerationEngine:
         # shared-prefix key matched our own cache, the blocks pin through
         # the ordinary admit_shared path instead, the same refcount++ a
         # local prefix hit performs (re-pin, never copy).
-        if snap.migrated and snap.shared_len and snap.shared_key is not None:
-            self._paging.admit_shared(b, snap.shared_key, snap.length)
-        else:
-            self._paging.restore_slot(b, snap.snap_id, snap.length)
+        if not ledgered:
+            if snap.migrated and snap.shared_len and snap.shared_key is not None:
+                self._paging.admit_shared(b, snap.shared_key, snap.length)
+            else:
+                self._paging.restore_slot(b, snap.snap_id, snap.length)
+            # a whole-bucket physical restore still re-pins parked shared
+            # blocks (forced-unaligned preempts park them) — re-key the row
+            self._phys_rebuild(b)
         dt = time.perf_counter() - t0
         if first:
             self._compile_obs(
@@ -2047,6 +2388,36 @@ class GenerationEngine:
             return {k: jax.device_get(v) for k, v in x.items()}
         return jax.device_get(x)
 
+    def _shared_pool_rows(self, b: int, p0: int) -> list[int] | None:
+        """Pool-row indices backing slot b's shared blocks [0, p0) — read
+        from the live table BEFORE preempt/export frees it (the prefix
+        entry itself may be LRU-evicted later, taking its id list with it
+        while sharer pins keep the rows alive)."""
+        if self._phys is None or p0 <= 0:
+            return None
+        bt = self._paging.block_tokens
+        srcs = self._phys.row_sources(b, p0 // bt)
+        if any(in_arena for in_arena, _, _ in srcs):
+            self._phys.missing_pins += 1  # tripwire: shared block not pooled
+            return None
+        return [row for _, row, _ in srcs]
+
+    def _pool_entry_rows(self, rows: list[int], P0: int):
+        """Host copies of a PHYSICAL prefix entry's KV rows [0, P0), shaped
+        exactly like a contiguous entry's ([L, 1, H, P0, *rest]) — gathered
+        from the prefix pool rows for the migration wire's fallback rows."""
+
+        def cut(pool):
+            if isinstance(pool, dict):
+                if not pool:
+                    return {}
+                return {k: cut(pool[k]) for k in pool}
+            parts = [pool[:, r : r + 1] for r in rows]
+            whole = jnp.concatenate(parts, axis=3) if len(parts) > 1 else parts[0]
+            return jax.device_get(whole[:, :, :, :P0])
+
+        return cut(self._pool_k), cut(self._pool_v)
+
     def _wire_item(self, snap: KVSnapshot, source: str) -> dict[str, Any]:
         """Serialize a host-side snapshot into an outbox item. When the
         snapshot is paged private-only, the shared prefix ships as its
@@ -2070,10 +2441,24 @@ class GenerationEngine:
                     self._host_tree(snap.shared_entry["v"]), snap.v_rows
                 )
                 snap.shared_len = 0
-            else:
+            elif "k" in snap.shared_entry:
                 snap.shared_key = key
                 shared_k = self._host_tree(snap.shared_entry["k"])
                 shared_v = self._host_tree(snap.shared_entry["v"])
+            elif snap.shared_pool_rows is not None:
+                # PHYSICAL entry: no device row copies exist — the fallback
+                # rows gather from the prefix-pool rows captured at snapshot
+                # time (still alive: the parked pins / exporting table hold
+                # their ledger ids)
+                snap.shared_key = key
+                shared_k, shared_v = self._pool_entry_rows(
+                    snap.shared_pool_rows, snap.shared_len
+                )
+            else:
+                # tripwire: physical entry with no resolvable pool rows —
+                # ship the key alone; only a destination with a matching
+                # cache entry can adopt (others fail the restore cleanly)
+                snap.shared_key = key
         header = migration.snapshot_header(snap, req, s)
         payload = migration.encode_payload(
             header,
@@ -2118,6 +2503,9 @@ class GenerationEngine:
         L = int(self._lengths[b])
         Lb = bucket_len(L, self.max_seq_len)
         p0 = s.shared_len if (0 < s.shared_len < Lb and s.shared_entry) else 0
+        if self._phys is not None and p0 % self._paging.block_tokens:
+            p0 = 0  # same unaligned-boundary rule as _preempt_one
+        pool_rows = self._shared_pool_rows(b, p0)
         k_rows, v_rows = self._snapshot_rows(b, Lb, start=p0)
         snap = KVSnapshot(
             req_id=s.req.request_id,
@@ -2135,6 +2523,7 @@ class GenerationEngine:
             slot_obj=s,
             shared_len=p0,
             shared_entry=s.shared_entry if p0 else None,
+            shared_pool_rows=pool_rows,
         )
         item = self._wire_item(snap, source="prefill")
         # free WITHOUT terminal events: the request is handed off, not dead
@@ -2158,12 +2547,14 @@ class GenerationEngine:
         if s is None or s.done or s.aborted:
             # terminal events already delivered — drop rows + parked pins
             self._paging.drop_snap(snap.snap_id)
+            self._phys_sweep()
             return None
         item = self._wire_item(snap, source="pool")
         # the rows (shared fallback included) ride the wire: release the
         # parked shared pins this engine was holding for the restore that
         # will now happen elsewhere
         self._paging.drop_snap(snap.snap_id)
+        self._phys_sweep()
         return item
 
     def migrate_steal_queued(self) -> GenRequest | None:
@@ -2283,7 +2674,10 @@ class GenerationEngine:
                 self._restore_snapshot(slot, snap)
             except Exception as e:
                 log.exception("migrate-in restore failed")
+                self._paging.free_slot(slot)
+                self._phys_reset(slot)
                 self._paging.drop_snap(snap.snap_id)
+                self._phys_sweep()
                 s.aborted = True
                 self._count_error()
                 s.req.out.put({"type": "error", "error": str(e)})
@@ -2631,6 +3025,7 @@ class GenerationEngine:
                     for slot, req, _ in group:
                         self._prefills.pop(slot, None)
                         self._paging.free_slot(slot)
+                        self._phys_reset(slot)
                         try:
                             self._prefill_q.remove(slot)
                         except ValueError:
@@ -2707,15 +3102,18 @@ class GenerationEngine:
         entry's KV rows into every slot; the suffixes then ride the ordinary
         chunked-prefill queue (start=P0) and activate as usual."""
         maybe_fail("engine.prefill", f"prefix-hit slots={[s for s, _, _ in group]}")
-        n = len(group)
-        nb = 1 << (n - 1).bit_length()
-        slots = np.zeros(nb, dtype=np.int32)
-        for i, (slot, _, _) in enumerate(group):
-            slots[i] = slot
-        self._ck, self._cv = self._insert_cached_fn(
-            self._ck, self._cv, ent["k"], ent["v"], jnp.asarray(slots), np.int32(n)
-        )
         key = ent.get("key")
+        if "k" in ent:
+            # contiguous entries (physical paging off, or raw test pokes):
+            # ONE fused dispatch duplicates the rows into every hit slot
+            n = len(group)
+            nb = 1 << (n - 1).bit_length()
+            slots = np.zeros(nb, dtype=np.int32)
+            for i, (slot, _, _) in enumerate(group):
+                slots[i] = slot
+            self._ck, self._cv = self._insert_cached_fn(
+                self._ck, self._cv, ent["k"], ent["v"], jnp.asarray(slots), np.int32(n)
+            )
         for slot, req, ids in group:
             self._prefills[slot] = _PrefillState(
                 req=req, ids=list(ids), done=ent["P"],
@@ -2726,7 +3124,13 @@ class GenerationEngine:
             # for the shared prefix), COW the boundary block if the stored
             # length isn't block-aligned, extend privately to the prompt
             if key is not None:
-                self._paging.admit_shared(slot, key, len(ids))
+                ops = self._paging.admit_shared(slot, key, len(ids))
+                if "k" not in ent:
+                    # PHYSICAL hit admission is pin-only: no row copies at
+                    # all — the slot's table row resolves the shared blocks
+                    # straight into the prefix pool. Only an unaligned
+                    # boundary block copies (once, whole-block, _phys_admit).
+                    self._phys_admit(slot, ent, ops)
             else:  # entry predates the ledger (tests poke entries in raw)
                 self._paging.admit_slot(slot, len(ids))
 
@@ -2766,6 +3170,32 @@ class GenerationEngine:
             self._evict_lru_prefix()
         if self._paging.prefix_register(key, p0) is None:
             return
+        if self._phys is not None:
+            # PHYSICAL store: the entry owns pool rows, not row copies —
+            # copy the slot's blocks [0, p0) into the pool (gathered through
+            # the slot's own table: a sharer's shared blocks live in the
+            # pool already, so those copy pool→pool), and record only the
+            # byte ACCOUNTING the LRU budget needs. Every sharer then reads
+            # the one pool copy through its block table.
+            if not self._store_prefix_physical(slot, key, p0):
+                self._paging.prefix_release(key)
+                self._phys.sweep(self._paging.alive)
+                return
+            nbytes = sum(
+                (x.size // (x.shape[1] * x.shape[3])) * p0 * x.dtype.itemsize
+                for x in jax.tree.leaves((self._ck, self._cv))
+            )
+            ent = {"P": p0, "bytes": nbytes, "key": key}
+            self._prefix_cache[key] = ent
+            self._prefix_by_len.setdefault(p0, {})[key] = ent
+            self._prefix_cache_bytes += nbytes
+            while self._prefix_cache_bytes > self._prefix_budget and self._prefix_cache:
+                self._evict_lru_prefix()
+            log.info(
+                "prefix cache: stored %d-token prefix in pool (%.1f MB, %d entries)",
+                p0, nbytes / 1e6, len(self._prefix_cache),
+            )
+            return
         if isinstance(self._ck, dict):
             pk = {
                 "q": self._ck["q"][:, slot : slot + 1, :, :p0],
@@ -2803,6 +3233,10 @@ class GenerationEngine:
         old_key, old = self._prefix_cache.popitem(last=False)
         self._prefix_cache_bytes -= old["bytes"]
         self._paging.prefix_release(old.get("key", old_key))
+        if self._phys is not None:
+            # pool rows free only once the last sharer pin lets the ledger
+            # id die — an evicted entry stays READABLE for its sharers
+            self._phys.sweep(self._paging.alive)
         bucket_d = self._prefix_by_len.get(old["P"])
         if bucket_d is not None:
             bucket_d.pop(old_key, None)
@@ -2980,6 +3414,7 @@ class GenerationEngine:
             self._prefill_q.remove(slot)
             del self._prefills[slot]
             self._paging.free_slot(slot)
+            self._phys_reset(slot)
         if not self._prefill_q:
             self._sched.decide(0, n_active, 0.0)
             return None
@@ -3064,18 +3499,21 @@ class GenerationEngine:
                 "engine.prefill", f"slots={[s for s, _, _ in group.metas]}"
             )
             first = self._note_exec_shape("chunk", group.tokens.shape[0],
-                                          group.bucket, group.skey)
+                                          group.bucket, group.skey,
+                                          self._phys is not None)
             t0 = time.perf_counter()
             group.logits, self._ck, self._cv = self._prefill_chunk_fn(
                 self.params, self._ck, self._cv, group.tokens,
                 group.slots_arr, group.starts_arr, group.nv_arr, group.skey,
+                paged=self._paged_arg(),
             )
             jax.block_until_ready(self._ck)
             wall = time.perf_counter() - t0
             if first:
                 self._compile_obs(
                     "chunk",
-                    (group.tokens.shape[0], group.bucket, group.skey), wall,
+                    (group.tokens.shape[0], group.bucket, group.skey,
+                     self._phys is not None), wall,
                 )
             self._sched.observe_prefill(group.n_tokens, wall)
             self._flight.event(
@@ -3148,6 +3586,7 @@ class GenerationEngine:
                     self._free_now(slot)
                 else:  # reserved-not-activated: release the ledger table
                     self._paging.free_slot(slot)
+                    self._phys_reset(slot)
                 if not st.aborted:  # watchdog may have terminated it already
                     self._count_error()
                     st.req.out.put({"type": "error", "error": str(e)})
@@ -3229,7 +3668,8 @@ class GenerationEngine:
             pow2_bucket(int(starts_arr[:n].max()), self.max_seq_len),
             self.max_seq_len,
         )
-        first = self._note_exec_shape("verify", A, C, skey)
+        first = self._note_exec_shape("verify", A, C, skey,
+                                      self._phys is not None)
         n_acc, final, self._ck, self._cv, self._d_last_tok = self._verify_fn(
             self.params, self._ck, self._cv, self._d_last_tok,
             self._d_temp, self._d_topk, self._d_topp,
@@ -3237,11 +3677,13 @@ class GenerationEngine:
             jnp.asarray(starts_arr), jnp.asarray(nv_arr),
             jnp.asarray(drafts_arr), jnp.asarray(nd_arr),
             np.int32(self._next_counter()), skey=skey,
+            paged=self._paged_arg(),
         )
         n_acc = np.asarray(n_acc)  # the round's host sync point
         final = np.asarray(final)
         if first:
-            self._compile_obs("verify", (A, C, skey), time.perf_counter() - t0)
+            self._compile_obs("verify", (A, C, skey, self._phys is not None),
+                              time.perf_counter() - t0)
         self._sched.observe_verify(total, time.perf_counter() - t0)
         before = self.total_tokens
         drafted_round = 0
@@ -3380,7 +3822,7 @@ class GenerationEngine:
             )
             first = self._note_exec_shape(
                 "fused", Ba, compact, group.tokens.shape[0],
-                group.bucket, group.skey,
+                group.bucket, group.skey, self._phys is not None,
             )
             t0c = time.perf_counter()
             (out, group.logits, self._ck, self._cv,
@@ -3399,6 +3841,7 @@ class GenerationEngine:
                 group.nv_arr,
                 compact=compact,
                 skey=group.skey,
+                paged=self._paged_arg(),
             )
             if first:
                 # dispatch is async but jit trace+compile is synchronous —
@@ -3406,11 +3849,12 @@ class GenerationEngine:
                 self._compile_obs(
                     "fused",
                     (Ba, compact, group.tokens.shape[0], group.bucket,
-                     group.skey),
+                     group.skey, self._phys is not None),
                     time.perf_counter() - t0c,
                 )
         else:
-            first = self._note_exec_shape("decode", Ba, compact)
+            first = self._note_exec_shape("decode", Ba, compact,
+                                          self._phys is not None)
             t0c = time.perf_counter()
             out, self._ck, self._cv, self._d_last_tok = self._decode_fn(
                 self.params,
@@ -3422,10 +3866,12 @@ class GenerationEngine:
                 self._d_topp,
                 self._d_last_tok,
                 compact=compact,
+                paged=self._paged_arg(),
             )
             if first:
                 self._compile_obs(
-                    "decode", (Ba, compact), time.perf_counter() - t0c
+                    "decode", (Ba, compact, self._phys is not None),
+                    time.perf_counter() - t0c,
                 )
         entries = [
             (b, self._slots[b], (i if compact else b)) for i, b in enumerate(active)
@@ -3526,8 +3972,10 @@ class GenerationEngine:
         self._slots[b] = None
         self._lengths[b] = self.max_seq_len  # park
         # ledger: drop the slot's block table (idempotent no-op when the
-        # table is already gone — e.g. preempt parked it under a snap_id)
+        # table is already gone — e.g. preempt parked it under a snap_id);
+        # physical: the device table row back to identity + pool-row sweep
         self._paging.free_slot(b)
+        self._phys_reset(b)
         if self._rid_dispatched > self._rid_fetched:
             self._cooling[b] = self._rid_dispatched
 

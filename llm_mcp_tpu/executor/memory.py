@@ -126,6 +126,13 @@ class KVSnapshot:
     # the pool's restored counter, and pins shared blocks via admit_shared
     # rather than re-tabling parked pins it never had.
     migrated: bool = False
+    # Physical paged KV (executor/physical.py): the prefix-pool row indices
+    # backing the shared blocks, captured from the victim's live block table
+    # at snapshot time. A PHYSICAL prefix entry keeps no device row copies,
+    # so the migration wire's fallback rows gather from these pool rows —
+    # which stay valid while the parked pins (or the exporting slot's table)
+    # keep the ledger ids alive. None for contiguous entries.
+    shared_pool_rows: Any = None
 
 
 class KVPool:

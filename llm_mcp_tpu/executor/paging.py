@@ -12,14 +12,17 @@ then compares *unique* blocks — shared tokens are paid for once no matter
 how many slots reference them — and preemption snapshots only the private
 tail (the shared pins ride along as ids and are re-pinned on restore).
 
-Scope — this layer is the block *economy*, deliberately decoupled from
-the physical layout: attention kernels still read contiguous per-slot
-rows, so admission still materializes prefix rows into the slot arena in
-one fused dispatch (see doc/performance.md "Paged KV" for the honest
-accounting of what is and isn't copied). That keeps greedy output
-token-identical while HBM admission, preemption payloads, shedding, and
-the prefix budget all move to one refcounted ledger; block-indirect
-kernels can later consume the same tables unchanged.
+Scope — this layer is the block *economy* and stays pure host
+bookkeeping. Since the block-indirect PR the economy is also physical:
+``executor/physical.py`` rebuilds per-slot device block tables from
+``table_view()`` after every re-keying mutation, private blocks are
+identity-homed in the slot arena, and prefix pins resolve to rows of a
+separate device pool — so a prefix-cache hit admits with *zero* row
+copies and attention kernels gather K/V through the table (see
+doc/performance.md "Paged KV" for the honest accounting of what is and
+isn't copied). Pool-row reclamation keys on ``alive()``: a pool row
+outlives its evicted prefix entry for as long as sharer pins keep the
+ledger id referenced.
 
 One ledger (satellite of ISSUE 6): slot-arena blocks and prefix-cache
 blocks are allocated from a single id space sized
@@ -334,6 +337,31 @@ class PagedKVManager:
         with self._lock:
             table = self._tables.get(slot)
             return len(table) * self.block_tokens if table else 0
+
+    def table_view(self, slot: int) -> tuple[list[int], int]:
+        """Ordered block ids plus leading shared-pin count for one slot
+        (copies). The physical layer (executor/physical.py) rebuilds its
+        device block-table row from this after any mutation that re-keys
+        the slot; logical position j in the returned list always covers
+        token range [j*block_tokens, (j+1)*block_tokens)."""
+        with self._lock:
+            table = self._tables.get(slot)
+            return (list(table) if table else [], self._shared_n.get(slot, 0))
+
+    def alive(self, bid: int) -> bool:
+        """True while a block id holds any reference (slot tables, prefix
+        entries, parked snapshot pins). Pool-row reclamation keys on this:
+        an evicted prefix entry's pool rows stay mapped until the last
+        sharer pin lets the ledger id die."""
+        with self._lock:
+            return bid in self._rc
+
+    def prefix_ids(self, key: Any) -> list[int] | None:
+        """Ledger block ids of a registered prefix entry, or None when the
+        key is unknown (raced an eviction)."""
+        with self._lock:
+            ent = self._prefix.get(key)
+            return list(ent[0]) if ent else None
 
     # -- preempt / restore --------------------------------------------------
 

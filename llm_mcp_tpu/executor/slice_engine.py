@@ -532,6 +532,15 @@ class SliceEngine:
         # never KV bytes — and followers replay them via apply_ops. The
         # slice has no prefix cache, so the prefix partition is zero and
         # every admission allocates private blocks.
+        #
+        # Physical paged KV (executor/physical.py): NOT constructed here,
+        # deliberately. With prefix_budget_bytes=0 nothing is ever shared,
+        # so every slot's block table would be the identity map — the
+        # engine's block-indirect gather reduces to exactly the contiguous
+        # read this slice already performs, and the mirror's op stream
+        # ("pin"/"cow" replay below) stays forward-compatible if a future
+        # slice grows a prefix partition. Keeping the pool out keeps the
+        # multi-host dispatch trace bit-identical to pre-physical engines.
         self._paging = PagedKVManager(
             max_slots=max_slots,
             max_seq_len=max_seq_len,

@@ -578,6 +578,212 @@ def _attend_q8_blocked_kernel(
     o_ref[0] = (acc / l).astype(o_ref.dtype)
 
 
+def _attend_q8_paged_kernel(
+    li_ref,  # [1] int32 (scalar prefetch) — layer index
+    lengths_ref,  # [Ba] int32 (scalar prefetch) — this step's position per row
+    tbl_ref,  # [Ba * nbs] int32 (scalar prefetch) — flattened per-row block
+    #          tables: physical block id per logical block (already gathered
+    #          to the compact batch; arena homes < pool_base, pool rows >=)
+    q_ref,  # [1, Hkv, G, hd] VMEM
+    nk_ref,  # [1, Hkv, 1, hd] VMEM
+    nv_ref,  # [1, Hkv, 1, hd] VMEM
+    pay_hbm,  # [L, B, 2*Hkv + p, S, hd] int8 — slot arena (identity homes)
+    s_hbm,  # [L, B, 2*Hkv, S] — arena plain scales (packed=False only)
+    pool_pay_hbm,  # [L, PXB, 2*Hkv + p, bt, hd] int8 — prefix block pool
+    pool_s_hbm,  # [L, PXB, 2*Hkv, bt] — pool plain scales
+    o_ref,  # [1, Hkv, G, hd] VMEM out
+    pay_buf,  # VMEM scratch [2, Hh, BS, hd] int8 (double buffer)
+    s_buf,  # [2, 2*Hkv, BS]
+    sems,  # DMA semaphores [2, 2]
+    *,
+    scale: float,
+    block_s: int,
+    seq_len: int,
+    packed: bool,
+    scale_dtype,
+):
+    """Block-indirect sibling of `_attend_q8_blocked_kernel` (vLLM
+    PagedAttention, Kwon et al. 2023): identical math and double-buffered
+    streaming, but each block's DMA source resolves through the per-row
+    block table instead of a contiguous S-range. BS equals the ledger's
+    block_tokens, so logical block j covers exactly table entry j.
+
+    The one-DMA-per-cell property survives the indirection: per block the
+    kernel still issues one packed copy (or two unpacked) — the table adds
+    a scalar-prefetch lookup and a two-way `pl.when` on the source array
+    (arena home vs. pool row), not extra copies. Both branches land the
+    same block shape in the same scratch buffer, so wait() reconstructs
+    the matching descriptor under the same branch."""
+    b = pl.program_id(0)
+    li = li_ref[0]
+    w = lengths_ref[b]
+    BS = block_s
+    Hkv = q_ref.shape[1]
+    nbs = seq_len // BS
+    pool_base = pay_hbm.shape[1] * nbs
+    nblk = jnp.clip((w + BS) // BS, 1, nbs)
+    # parked/free rows (w >= S, engine convention) stream one block; their
+    # table rows are identity (reset on free), so the lookup is always safe
+    nblk = jnp.where(w >= seq_len, 1, nblk)
+
+    def arena_copies(phys, slot):
+        arow = phys // nbs
+        aoff = (phys % nbs) * BS
+        if packed:
+            return (
+                pltpu.make_async_copy(
+                    pay_hbm.at[li, arow, :, pl.ds(aoff, BS), :],
+                    pay_buf.at[slot],
+                    sems.at[slot, 0],
+                ),
+            )
+        return (
+            pltpu.make_async_copy(
+                pay_hbm.at[li, arow, pl.ds(0, 2 * Hkv), pl.ds(aoff, BS), :],
+                pay_buf.at[slot],
+                sems.at[slot, 0],
+            ),
+            pltpu.make_async_copy(
+                s_hbm.at[li, arow, :, pl.ds(aoff, BS)],
+                s_buf.at[slot],
+                sems.at[slot, 1],
+            ),
+        )
+
+    def pool_copies(phys, slot):
+        prow = phys - pool_base
+        if packed:
+            return (
+                pltpu.make_async_copy(
+                    pool_pay_hbm.at[li, prow], pay_buf.at[slot], sems.at[slot, 0]
+                ),
+            )
+        return (
+            pltpu.make_async_copy(
+                pool_pay_hbm.at[li, prow, pl.ds(0, 2 * Hkv)],
+                pay_buf.at[slot],
+                sems.at[slot, 0],
+            ),
+            pltpu.make_async_copy(
+                pool_s_hbm.at[li, prow], s_buf.at[slot], sems.at[slot, 1]
+            ),
+        )
+
+    def issue(j, slot, op):
+        phys = tbl_ref[b * nbs + j]
+        ina = phys < pool_base
+
+        @pl.when(ina)
+        def _arena():
+            for c in arena_copies(phys, slot):
+                getattr(c, op)()
+
+        @pl.when(jnp.logical_not(ina))
+        def _pool():
+            for c in pool_copies(phys, slot):
+                getattr(c, op)()
+
+    issue(0, 0, "start")
+
+    q = q_ref[0].astype(jnp.float32)  # [Hkv, G, hd]
+    nk = nk_ref[0, :, 0].astype(jnp.float32)  # [Hkv, hd]
+    nv = nv_ref[0, :, 0].astype(jnp.float32)
+    qa = jnp.max(jnp.abs(q), axis=-1)
+    qsc = jnp.maximum(qa / 127.0, 1e-30)
+    q8 = jnp.round(q / qsc[..., None]).astype(jnp.int8)
+    s_new = jnp.sum(q * nk[:, None, :], axis=-1, keepdims=True) * scale  # [Hkv,G,1]
+
+    G = q_ref.shape[2]
+    hd = q_ref.shape[3]
+    acc0 = jnp.zeros((Hkv, G, hd), jnp.float32)
+    m0 = jnp.full((Hkv, G, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hkv, G, 1), jnp.float32)
+
+    def body(j, carry):
+        acc, m, l = carry
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nblk)
+        def _prefetch():
+            issue(j + 1, 1 - slot, "start")
+
+        issue(j, slot, "wait")
+        buf = pay_buf[slot]  # [Hh, BS, hd] int8 — k rows, v rows(, scales)
+        k = buf[:Hkv]  # [Hkv, BS, hd] int8
+        if packed:
+            ss = _unpack_scale_lanes(buf[2 * Hkv], 2 * Hkv, scale_dtype)
+        else:
+            ss = s_buf[slot]
+        ss = ss.astype(jnp.float32)  # [2*Hkv, BS]
+        kss, vss = ss[:Hkv], ss[Hkv:]
+        s_i = jax.lax.dot_general(
+            q8, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.int32
+        )  # [Hkv, G, BS]
+        s = s_i.astype(jnp.float32) * (scale * qsc)[..., None] * kss[:, None, :]
+        pos = j * BS + jax.lax.broadcasted_iota(jnp.int32, (1, 1, BS), 2)
+        s = jnp.where(pos == w, s_new, s)
+        s = jnp.where(pos <= w, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(pos <= w, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        p_w = jnp.sum(jnp.where(pos == w, p, 0.0), axis=-1, keepdims=True)
+        pv = jnp.where(pos == w, 0.0, p * vss[:, None, :])
+        pa = jnp.max(pv, axis=-1)
+        psc = jnp.maximum(pa / 127.0, 1e-30)
+        p8 = jnp.round(pv / psc[..., None]).astype(jnp.int8)
+        ctx_i = jax.lax.dot_general(
+            p8,
+            buf[Hkv : 2 * Hkv],
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32,
+        )  # [Hkv, G, hd]
+        acc_new = (
+            acc * alpha + ctx_i.astype(jnp.float32) * psc[..., None] + p_w * nv[:, None, :]
+        )
+        return acc_new, m_new, l_new
+
+    acc, m, l = jax.lax.fori_loop(0, nblk, body, (acc0, m0, l0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def paged_gather(arena, pool, tables, *, nbs=None):
+    """XLA block-indirect gather: materialize contiguous-equivalent rows by
+    resolving each logical block through the table — the read-side twin of
+    the paged Pallas kernels for every XLA path (CPU serve, chunked-prefill
+    past reads, exact fallbacks, multi-layer snapshot reads).
+
+    arena  [B, Hx, S, *rest]   layer-selected slot arena (identity homes)
+    pool   [PXB, Hx, bt, *rest] layer-selected prefix pool
+    tables [A, nsel] int32     per-row block tables (compact batch); a
+        PREFIX of the full table may be passed (chunked prefill gathers
+        only the blocks covering its static `skey` bound) — then `nbs`
+        must name the full blocks-per-slot so physical ids decode right
+    returns [A, Hx, nsel*bt, *rest] rows as the contiguous layout holds them
+
+    Works shape-generically over trailing dims (absent for int8 scale
+    planes). Cost is one advanced-indexing gather per source plus a
+    `jnp.where` — no full-arena copy beyond the [A, Hx, nsel*bt] result
+    itself, which is exactly what the contiguous row-select produced."""
+    B, Hx, S = arena.shape[0], arena.shape[1], arena.shape[2]
+    rest = arena.shape[3:]
+    A, nsel = tables.shape
+    nbs = nsel if nbs is None else nbs
+    bt = S // nbs
+    pool_base = B * nbs
+    blk = arena.reshape(B, Hx, nbs, bt, *rest)
+    safe = jnp.clip(tables, 0, pool_base - 1)
+    # advanced indices at axes 0 and 2 (separated by a slice) land in front:
+    # [A, nbs, Hx, bt, *rest]
+    arena_take = blk[safe // nbs, :, safe % nbs]
+    pidx = jnp.clip(tables - pool_base, 0, max(pool.shape[0] - 1, 0))
+    pool_take = pool[pidx]  # [A, nbs, Hx, bt, *rest]
+    ina = (tables < pool_base).reshape(A, nsel, *([1] * (arena_take.ndim - 2)))
+    g = jnp.where(ina, arena_take, pool_take)
+    return jnp.swapaxes(g, 1, 2).reshape(A, Hx, nsel * bt, *rest)
+
+
 def fused_q8_heads(cache_k: dict) -> tuple[int, int]:
     """(Hkv, p) of a FUSED int8 GQA cache: the payload carries 2*Hkv K|V
     heads plus p ∈ {0, 1} packed-scale pseudo-heads; the plain "s" array
@@ -587,18 +793,32 @@ def fused_q8_heads(cache_k: dict) -> tuple[int, int]:
 
 
 def _decode_attend_q8_fallback(
-    q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids=None
+    q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids=None,
+    block_tables=None, pool=None,
 ):
     """Exact-f32 mirror of the q8 kernels' math (no q/prob requant) over the
     FUSED cache layout. Used on CPU builds without pallas-tpu and for cache
     lengths no int8-tileable block size divides. `cache_v` is the fused
-    layout's empty-dict placeholder (V lives in cache_k's head axis)."""
+    layout's empty-dict placeholder (V lives in cache_k's head axis). With
+    `block_tables`/`pool` the rows are block-indirect-gathered first
+    (`paged_gather`), so this is also the exact reference for the paged
+    kernels and the CPU serve path under physical paging."""
     del cache_v
     S = cache_k["q"].shape[3]
     Hkv, _ = fused_q8_heads(cache_k)
     pay = jax.lax.dynamic_index_in_dim(cache_k["q"], layer, 0, keepdims=False)
     ss = jax.lax.dynamic_index_in_dim(cache_k["s"], layer, 0, keepdims=False)
-    if slot_ids is not None:
+    if block_tables is not None:
+        tbl = (
+            block_tables
+            if slot_ids is None
+            else jnp.take(block_tables, slot_ids, 0)
+        )
+        pp = jax.lax.dynamic_index_in_dim(pool["q"], layer, 0, keepdims=False)
+        ps = jax.lax.dynamic_index_in_dim(pool["s"], layer, 0, keepdims=False)
+        pay = paged_gather(pay, pp, tbl)
+        ss = paged_gather(ss, ps, tbl)
+    elif slot_ids is not None:
         pay = jnp.take(pay, slot_ids, 0)
         ss = jnp.take(ss, slot_ids, 0)
     kf, vf = pay[:, :Hkv], pay[:, Hkv : 2 * Hkv]
@@ -631,6 +851,10 @@ def decode_attend_q8(
     lengths: jnp.ndarray,  # [Ba] int32 — this step's position per row
     *,
     slot_ids: jnp.ndarray | None = None,  # [Ba] int32 cache rows (None = 1:1)
+    block_tables: jnp.ndarray | None = None,  # [n_slots, nbs] int32 physical
+    #   block tables (executor/physical.py); None = contiguous layout
+    pool_k: dict | None = None,  # prefix pool mirroring cache_k's structure:
+    #   {"q": int8 [L,PXB,2*Hkv+p,bt,hd], "s": [L,PXB,2*Hkv,bt]}
     scale: float = 0.0,  # query scale (0 = head_dim**-0.5)
     interpret: bool | None = None,
 ) -> jnp.ndarray:
@@ -638,6 +862,14 @@ def decode_attend_q8(
     step (layout: models/llama.py:init_kv_cache — K heads, V heads, and an
     optional bit-packed scale pseudo-head share one payload array, PRE-
     append).
+
+    With `block_tables`/`pool_k` the cache is block-indirect: a runtime
+    identity check keeps the exact contiguous dispatch (including the
+    whole-S/blocked hybrid) whenever no row references a shared block —
+    raw decode without prefix sharing pays one `jnp.all` on a tiny int32
+    table, not a gather — and otherwise streams through
+    `_attend_q8_paged_kernel`. `LLM_MCP_TPU_Q8_DECODE=paged` forces the
+    paged arm (parity tests).
 
     The int8 payload streams from HBM straight into s8 x s8 -> s32 MXU dots
     (XLA's einsum path materializes a dequantized bf16 copy and runs ~2x
@@ -658,7 +890,8 @@ def decode_attend_q8(
 
     if not _HAS_PLTPU:  # pragma: no cover — CPU builds without pallas-tpu
         return _decode_attend_q8_fallback(
-            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids
+            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids,
+            block_tables, pool_k,
         )
 
     nk4 = new_k.reshape(B, Hkv, 1, hd)
@@ -671,7 +904,8 @@ def decode_attend_q8(
         # no whole-S fit and no int8-tileable block divides S: exact f32
         # math of the CPU fallback (slower, never wrong)
         return _decode_attend_q8_fallback(
-            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids
+            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids,
+            block_tables, pool_k,
         )
     # 1-DMA packed blocks need the scale pseudo-head present in the layout
     packed = p == 1 and os.environ.get("LLM_MCP_TPU_Q8_SCALE_PACK", "1") != "0"
@@ -762,34 +996,122 @@ def decode_attend_q8(
             kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interp
         )(*args)
 
+    def run_paged():
+        # block-indirect arm: BS is pinned to the ledger's block_tokens so
+        # table entry j covers exactly the kernel's block j
+        nbs = block_tables.shape[1]
+        bt = S // nbs
+        Hh = 2 * Hkv + 1 if packed else 2 * Hkv
+        tblf = jnp.take(block_tables, ids, 0).reshape(-1).astype(jnp.int32)
+        kernel = functools.partial(
+            _attend_q8_paged_kernel,
+            scale=sc,
+            block_s=bt,
+            seq_len=S,
+            packed=packed,
+            scale_dtype=cache_k["s"].dtype,
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,  # layer [1], lengths [Ba], tables [Ba*nbs]
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, Hkv, G, hd), lambda b, li, lens, tbl: (b, 0, 0, 0)),
+                pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, lens, tbl: (b, 0, 0, 0)),
+                pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, lens, tbl: (b, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),  # fused payload (arena)
+                pl.BlockSpec(memory_space=pl.ANY),  # plain scales (arena)
+                pl.BlockSpec(memory_space=pl.ANY),  # fused payload (pool)
+                pl.BlockSpec(memory_space=pl.ANY),  # plain scales (pool)
+            ],
+            out_specs=pl.BlockSpec(
+                (1, Hkv, G, hd), lambda b, li, lens, tbl: (b, 0, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((2, Hh, bt, hd), jnp.int8),
+                pltpu.VMEM((2, 2 * Hkv, bt), cache_k["s"].dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        )
+        return pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interp
+        )(
+            jnp.reshape(layer, (1,)).astype(jnp.int32),
+            lengths.astype(jnp.int32),
+            tblf,
+            q,
+            nk4,
+            nv4,
+            cache_k["q"],
+            cache_k["s"],
+            pool_k["q"],
+            pool_k["s"],
+        )
+
     mode = os.environ.get("LLM_MCP_TPU_Q8_DECODE", "auto")
-    if mode == "whole" and can_whole:
-        return run_whole()
-    if mode == "blocked" and BS:
-        return run_blocked()
-    if not can_whole:
-        return run_blocked()
-    if BS == 0 or interp:
-        # interpret mode keeps the static whole-S choice: a runtime cond
-        # would emulate BOTH kernels per call in tests. Parity tests force
-        # the blocked arm via LLM_MCP_TPU_Q8_DECODE=blocked instead.
-        return run_whole()
-    # Runtime hybrid (both executables compile once). The r05 4-DMA layout
-    # measured the crossover at ~40% traffic ratio (8B B=112 S=1024: 20.5
-    # vs 24.4 ms/step empty, 29.2 vs 24.4 at 88% fill); the fused layout
-    # cuts the blocked arm's per-cell fixed cost ~4x, so its win region
-    # extends to higher fills — default threshold 0.55 (projected from the
-    # r05 fixed-cost split, to be re-measured on hardware; the env knob is
-    # the re-tuning surface).
-    # Compare the kernels' ACTUAL traffic: whole-S DMAs all B rows in full
-    # (parked/pad rows included), blocked streams the attended prefix per
-    # active row and ONE block per parked row — so the ratio denominator is
-    # B·S, not active·S (normalizing by active rows would overestimate the
-    # whole-S path exactly in the low-occupancy regime blocked wins).
-    thr = float(os.environ.get("LLM_MCP_TPU_Q8_HYBRID", "0.55"))
-    w_eff = jnp.where(lengths < S, jnp.minimum(lengths + 1, S), BS)
-    ratio = jnp.sum(w_eff.astype(jnp.float32)) / (B * S)
-    return jax.lax.cond(ratio < thr, run_blocked, run_whole)
+
+    def run_contig():
+        if mode == "whole" and can_whole:
+            return run_whole()
+        if mode == "blocked" and BS:
+            return run_blocked()
+        if not can_whole:
+            return run_blocked()
+        if BS == 0 or interp:
+            # interpret mode keeps the static whole-S choice: a runtime cond
+            # would emulate BOTH kernels per call in tests. Parity tests force
+            # the blocked arm via LLM_MCP_TPU_Q8_DECODE=blocked instead.
+            return run_whole()
+        # Runtime hybrid (both executables compile once). The r05 4-DMA layout
+        # measured the crossover at ~40% traffic ratio (8B B=112 S=1024: 20.5
+        # vs 24.4 ms/step empty, 29.2 vs 24.4 at 88% fill); the fused layout
+        # cuts the blocked arm's per-cell fixed cost ~4x, so its win region
+        # extends to higher fills — default threshold 0.55 (projected from the
+        # r05 fixed-cost split, to be re-measured on hardware; the env knob is
+        # the re-tuning surface).
+        # Compare the kernels' ACTUAL traffic: whole-S DMAs all B rows in full
+        # (parked/pad rows included), blocked streams the attended prefix per
+        # active row and ONE block per parked row — so the ratio denominator is
+        # B·S, not active·S (normalizing by active rows would overestimate the
+        # whole-S path exactly in the low-occupancy regime blocked wins).
+        thr = float(os.environ.get("LLM_MCP_TPU_Q8_HYBRID", "0.55"))
+        w_eff = jnp.where(lengths < S, jnp.minimum(lengths + 1, S), BS)
+        ratio = jnp.sum(w_eff.astype(jnp.float32)) / (B * S)
+        return jax.lax.cond(ratio < thr, run_blocked, run_whole)
+
+    if block_tables is None:
+        return run_contig()
+    nbs = block_tables.shape[1]
+    paged_ok = (
+        pool_k is not None and nbs > 0 and S % nbs == 0
+        and (S // nbs) in (32, 64, 128, 256)
+    )
+    if not paged_ok:
+        # table present but the ledger block size has no int8-tileable arm
+        # (the engine gates physical mode on this; belt): exact gather math
+        return _decode_attend_q8_fallback(
+            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids,
+            block_tables, pool_k,
+        )
+    if mode == "paged":
+        return run_paged()
+    if interp:
+        # a runtime identity-cond would emulate both arms per call in tests;
+        # parity tests force the paged kernel via LLM_MCP_TPU_Q8_DECODE=paged,
+        # everything else takes the exact gather math
+        return _decode_attend_q8_fallback(
+            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids,
+            block_tables, pool_k,
+        )
+    # Identity tables (no row references a shared block — the raw-decode
+    # case, and every freed slot resets to identity) keep the contiguous
+    # dispatch bit-for-bit, hybrid included; only actual sharing pays the
+    # table-gather arm.
+    n_slots = cache_k["q"].shape[1]
+    ident = jnp.all(
+        block_tables
+        == jnp.arange(n_slots * nbs, dtype=block_tables.dtype).reshape(n_slots, nbs)
+    )
+    return jax.lax.cond(ident, run_contig, run_paged)
 
 
 def blocked_dma_count(layout: str, packed: bool = True) -> int:
@@ -803,12 +1125,16 @@ def blocked_dma_count(layout: str, packed: bool = True) -> int:
       q8_mla   — 1 (latent payload with inlined rope rows; per-position
                  scales fold via the absorbed-query trick, r05 layout)
 
+    The block-indirect (paged) arms issue the SAME counts — the table adds
+    a scalar lookup and a source branch, not copies (the `*_paged`
+    layouts are accepted so callers can assert that property).
+
     The r05 pre-fusion GQA layout issued 4 (kq/ks/vq/vs)."""
-    if layout == "q8_gqa":
+    if layout in ("q8_gqa", "q8_gqa_paged"):
         return 1 if packed else 2
-    if layout == "bf16_gqa":
+    if layout in ("bf16_gqa", "bf16_gqa_paged"):
         return 2
-    if layout == "q8_mla":
+    if layout in ("q8_mla", "q8_mla_paged"):
         return 1
     raise ValueError(f"unknown blocked layout: {layout!r}")
 
@@ -983,15 +1309,160 @@ def _attend_bf16_blocked_kernel(
     o_ref[0] = (acc / l).astype(o_ref.dtype)
 
 
+def _attend_bf16_paged_kernel(
+    li_ref,  # [1] int32 (scalar prefetch) — layer index
+    lengths_ref,  # [Ba] int32 (scalar prefetch) — this step's position per row
+    tbl_ref,  # [Ba * nbs] int32 (scalar prefetch) — flattened block tables
+    q_ref,  # [1, Hkv, G, hd] VMEM
+    nk_ref,  # [1, Hkv, 1, hd] VMEM
+    nv_ref,  # [1, Hkv, 1, hd] VMEM
+    k_hbm,  # [L, B, Hkv, S, hd] — slot arena (identity homes), HBM
+    v_hbm,  # [L, B, Hkv, S, hd]
+    pool_k_hbm,  # [L, PXB, Hkv, bt, hd] — prefix block pool
+    pool_v_hbm,  # [L, PXB, Hkv, bt, hd]
+    o_ref,  # [1, Hkv, G, hd] VMEM out
+    k_buf,  # VMEM scratch [2, Hkv, BS, hd] cache dtype (double buffer)
+    v_buf,
+    sems,  # DMA semaphores [2, 2]
+    *,
+    scale: float,
+    block_s: int,
+    seq_len: int,
+):
+    """Block-indirect sibling of `_attend_bf16_blocked_kernel`: same math
+    and double-buffered streaming, each block's two DMAs (split K/V)
+    resolved through the per-row block table — arena home vs. pool row,
+    same block shape either way (see `_attend_q8_paged_kernel`)."""
+    b = pl.program_id(0)
+    li = li_ref[0]
+    w = lengths_ref[b]
+    BS = block_s
+    Hkv = q_ref.shape[1]
+    nbs = seq_len // BS
+    pool_base = k_hbm.shape[1] * nbs
+    nblk = jnp.clip((w + BS) // BS, 1, nbs)
+    # parked/free rows (w >= S): one block; freed rows reset to identity
+    nblk = jnp.where(w >= seq_len, 1, nblk)
+
+    def arena_copies(phys, slot):
+        arow = phys // nbs
+        aoff = (phys % nbs) * BS
+        return (
+            pltpu.make_async_copy(
+                k_hbm.at[li, arow, :, pl.ds(aoff, BS), :],
+                k_buf.at[slot],
+                sems.at[slot, 0],
+            ),
+            pltpu.make_async_copy(
+                v_hbm.at[li, arow, :, pl.ds(aoff, BS), :],
+                v_buf.at[slot],
+                sems.at[slot, 1],
+            ),
+        )
+
+    def pool_copies(phys, slot):
+        prow = phys - pool_base
+        return (
+            pltpu.make_async_copy(
+                pool_k_hbm.at[li, prow], k_buf.at[slot], sems.at[slot, 0]
+            ),
+            pltpu.make_async_copy(
+                pool_v_hbm.at[li, prow], v_buf.at[slot], sems.at[slot, 1]
+            ),
+        )
+
+    def issue(j, slot, op):
+        phys = tbl_ref[b * nbs + j]
+        ina = phys < pool_base
+
+        @pl.when(ina)
+        def _arena():
+            for c in arena_copies(phys, slot):
+                getattr(c, op)()
+
+        @pl.when(jnp.logical_not(ina))
+        def _pool():
+            for c in pool_copies(phys, slot):
+                getattr(c, op)()
+
+    issue(0, 0, "start")
+
+    q = q_ref[0]  # [Hkv, G, hd]
+    nk = nk_ref[0, :, 0].astype(jnp.float32)  # [Hkv, hd]
+    nv = nv_ref[0, :, 0].astype(jnp.float32)
+    qc = q.astype(k_buf.dtype)
+    s_new = (
+        jnp.sum(q.astype(jnp.float32) * nk[:, None, :], axis=-1, keepdims=True) * scale
+    )  # [Hkv, G, 1]
+
+    G = q_ref.shape[2]
+    hd = q_ref.shape[3]
+    acc0 = jnp.zeros((Hkv, G, hd), jnp.float32)
+    m0 = jnp.full((Hkv, G, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hkv, G, 1), jnp.float32)
+
+    def body(j, carry):
+        acc, m, l = carry
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < nblk)
+        def _prefetch():
+            issue(j + 1, 1 - slot, "start")
+
+        issue(j, slot, "wait")
+        k = k_buf[slot]  # [Hkv, BS, hd]
+        v = v_buf[slot]
+        s = (
+            jax.lax.dot_general(
+                qc, k, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [Hkv, G, BS]
+        pos = j * BS + jax.lax.broadcasted_iota(jnp.int32, (1, 1, BS), 2)
+        s = jnp.where(pos == w, s_new, s)
+        s = jnp.where(pos <= w, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(pos <= w, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        p_w = jnp.sum(jnp.where(pos == w, p, 0.0), axis=-1, keepdims=True)
+        pv = jnp.where(pos == w, 0.0, p)
+        ctx = jax.lax.dot_general(
+            pv.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [Hkv, G, hd]
+        acc_new = acc * alpha + ctx + p_w * nv[:, None, :]
+        return acc_new, m_new, l_new
+
+    acc, m, l = jax.lax.fori_loop(0, nblk, body, (acc0, m0, l0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
 def _decode_attend_bf16_fallback(
-    q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids=None
+    q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids=None,
+    block_tables=None, pool_k=None, pool_v=None,
 ):
     """Exact-f32 einsum mirror of the bf16 kernels' math (whole-S reference
-    for the parity tests; the serving path on CPU / multi-chip meshes)."""
+    for the parity tests; the serving path on CPU / multi-chip meshes).
+    With `block_tables` the rows gather block-indirectly first."""
     S = cache_k.shape[3]
     k = jax.lax.dynamic_index_in_dim(cache_k, layer, 0, keepdims=False)
     v = jax.lax.dynamic_index_in_dim(cache_v, layer, 0, keepdims=False)
-    if slot_ids is not None:
+    if block_tables is not None:
+        tbl = (
+            block_tables
+            if slot_ids is None
+            else jnp.take(block_tables, slot_ids, 0)
+        )
+        k = paged_gather(
+            k, jax.lax.dynamic_index_in_dim(pool_k, layer, 0, keepdims=False), tbl
+        )
+        v = paged_gather(
+            v, jax.lax.dynamic_index_in_dim(pool_v, layer, 0, keepdims=False), tbl
+        )
+    elif slot_ids is not None:
         k = jnp.take(k, slot_ids, 0)
         v = jnp.take(v, slot_ids, 0)
     qf = q.astype(jnp.float32)
@@ -1020,6 +1491,10 @@ def decode_attend_bf16(
     lengths: jnp.ndarray,  # [Ba] int32 — this step's position per row
     *,
     slot_ids: jnp.ndarray | None = None,  # [Ba] int32 cache rows (None = 1:1)
+    block_tables: jnp.ndarray | None = None,  # [n_slots, nbs] int32 physical
+    #   block tables (executor/physical.py); None = contiguous layout
+    pool_k: jnp.ndarray | None = None,  # prefix pool [L, PXB, Hkv, bt, hd]
+    pool_v: jnp.ndarray | None = None,
     scale: float = 0.0,  # query scale (0 = head_dim**-0.5)
     interpret: bool | None = None,
 ) -> jnp.ndarray:
@@ -1028,7 +1503,10 @@ def decode_attend_bf16(
     PRE-append cache contract, compaction indirection, exact
     current-position override, and runtime whole-S/blocked hybrid
     (`LLM_MCP_TPU_BF16_DECODE` forces an arm, `LLM_MCP_TPU_BF16_HYBRID`
-    re-tunes the traffic-ratio threshold). Returns ctx [B, Hkv, G, hd]."""
+    re-tunes the traffic-ratio threshold). With `block_tables`/pools the
+    cache is block-indirect with the same identity-check fast path as
+    `decode_attend_q8` (`LLM_MCP_TPU_BF16_DECODE=paged` forces the paged
+    arm). Returns ctx [B, Hkv, G, hd]."""
     B, Hkv, G, hd = q.shape
     S = cache_k.shape[3]
     interp = _interpret() if interpret is None else interpret
@@ -1036,7 +1514,8 @@ def decode_attend_bf16(
 
     if not _HAS_PLTPU:  # pragma: no cover — CPU builds without pallas-tpu
         return _decode_attend_bf16_fallback(
-            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids
+            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids,
+            block_tables, pool_k, pool_v,
         )
 
     nk4 = new_k.reshape(B, Hkv, 1, hd)
@@ -1046,7 +1525,8 @@ def decode_attend_bf16(
     BS = next((c for c in (256, 128, 64, 32) if S % c == 0), 0)
     if not can_whole and BS == 0:
         return _decode_attend_bf16_fallback(
-            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids
+            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids,
+            block_tables, pool_k, pool_v,
         )
     ids = (
         jnp.arange(B, dtype=jnp.int32)
@@ -1118,26 +1598,96 @@ def decode_attend_bf16(
             kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interp
         )(*args)
 
+    def run_paged():
+        # block-indirect arm: BS pinned to the ledger's block_tokens
+        nbs = block_tables.shape[1]
+        bt = S // nbs
+        tblf = jnp.take(block_tables, ids, 0).reshape(-1).astype(jnp.int32)
+        kernel = functools.partial(
+            _attend_bf16_paged_kernel, scale=sc, block_s=bt, seq_len=S
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,  # layer [1], lengths [Ba], tables [Ba*nbs]
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, Hkv, G, hd), lambda b, li, lens, tbl: (b, 0, 0, 0)),
+                pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, lens, tbl: (b, 0, 0, 0)),
+                pl.BlockSpec((1, Hkv, 1, hd), lambda b, li, lens, tbl: (b, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),  # K arena
+                pl.BlockSpec(memory_space=pl.ANY),  # V arena
+                pl.BlockSpec(memory_space=pl.ANY),  # K pool
+                pl.BlockSpec(memory_space=pl.ANY),  # V pool
+            ],
+            out_specs=pl.BlockSpec(
+                (1, Hkv, G, hd), lambda b, li, lens, tbl: (b, 0, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((2, Hkv, bt, hd), cache_k.dtype),
+                pltpu.VMEM((2, Hkv, bt, hd), cache_v.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        )
+        return pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interp
+        )(
+            jnp.reshape(layer, (1,)).astype(jnp.int32),
+            lengths.astype(jnp.int32),
+            tblf,
+            q,
+            nk4,
+            nv4,
+            cache_k,
+            cache_v,
+            pool_k,
+            pool_v,
+        )
+
     mode = os.environ.get("LLM_MCP_TPU_BF16_DECODE", "auto")
-    if mode == "whole" and can_whole:
-        return run_whole()
-    if mode == "blocked" and BS:
-        return run_blocked()
-    if not can_whole:
-        return run_blocked()
-    if BS == 0 or interp:
-        # interpret mode keeps the static whole-S choice (same reasoning as
-        # decode_attend_q8); parity tests force the blocked arm via
-        # LLM_MCP_TPU_BF16_DECODE=blocked.
-        return run_whole()
-    # Runtime hybrid, same traffic-ratio rule as the q8 path. The bf16
-    # blocked arm pays 2 DMAs/cell (split K/V), so its fixed cost sits
-    # between the fused-q8 1-copy arm and the r05 4-copy layout — start at
-    # the same 0.55 default and re-tune on hardware via the env knob.
-    thr = float(os.environ.get("LLM_MCP_TPU_BF16_HYBRID", "0.55"))
-    w_eff = jnp.where(lengths < S, jnp.minimum(lengths + 1, S), BS)
-    ratio = jnp.sum(w_eff.astype(jnp.float32)) / (B * S)
-    return jax.lax.cond(ratio < thr, run_blocked, run_whole)
+
+    def run_contig():
+        if mode == "whole" and can_whole:
+            return run_whole()
+        if mode == "blocked" and BS:
+            return run_blocked()
+        if not can_whole:
+            return run_blocked()
+        if BS == 0 or interp:
+            # interpret mode keeps the static whole-S choice (same reasoning
+            # as decode_attend_q8); parity tests force the blocked arm via
+            # LLM_MCP_TPU_BF16_DECODE=blocked.
+            return run_whole()
+        # Runtime hybrid, same traffic-ratio rule as the q8 path. The bf16
+        # blocked arm pays 2 DMAs/cell (split K/V), so its fixed cost sits
+        # between the fused-q8 1-copy arm and the r05 4-copy layout — start at
+        # the same 0.55 default and re-tune on hardware via the env knob.
+        thr = float(os.environ.get("LLM_MCP_TPU_BF16_HYBRID", "0.55"))
+        w_eff = jnp.where(lengths < S, jnp.minimum(lengths + 1, S), BS)
+        ratio = jnp.sum(w_eff.astype(jnp.float32)) / (B * S)
+        return jax.lax.cond(ratio < thr, run_blocked, run_whole)
+
+    if block_tables is None:
+        return run_contig()
+    nbs = block_tables.shape[1]
+    paged_ok = (
+        pool_k is not None and nbs > 0 and S % nbs == 0
+        and (S // nbs) in (32, 64, 128, 256)
+    )
+    if not paged_ok or interp and mode != "paged":
+        # engine gates physical mode on a tileable block size (belt), and
+        # interpret runs keep a static arm choice — exact gather math
+        return _decode_attend_bf16_fallback(
+            q, new_k, new_v, cache_k, cache_v, layer, lengths, sc, slot_ids,
+            block_tables, pool_k, pool_v,
+        )
+    if mode == "paged":
+        return run_paged()
+    # identity tables keep the contiguous dispatch (see decode_attend_q8)
+    n_slots = cache_k.shape[1]
+    ident = jnp.all(
+        block_tables
+        == jnp.arange(n_slots * nbs, dtype=block_tables.dtype).reshape(n_slots, nbs)
+    )
+    return jax.lax.cond(ident, run_contig, run_paged)
 
 
 def _attend_q8_mla_kernel(
@@ -1371,6 +1921,129 @@ def _attend_q8_mla_blocked_kernel(
     o_ref[0] = (acc / l).astype(o_ref.dtype)
 
 
+def _attend_q8_mla_paged_kernel(
+    li_ref,  # [1] int32 (scalar prefetch) — layer index
+    lengths_ref,  # [Ba] int32 (scalar prefetch) — this step's position per row
+    tbl_ref,  # [Ba * nbs] int32 (scalar prefetch) — flattened block tables
+    qt_ref,  # [1, H, R] VMEM — absorbed queries (latent space)
+    qr_ref,  # [1, H, dr] VMEM — rope queries
+    nc_ref,  # [1, 1, R] VMEM — this step's exact latent
+    nr_ref,  # [1, 1, dr] VMEM — this step's exact rope key
+    lat_hbm,  # [L, B, 1, S, R] int8 — latent arena (identity homes), HBM
+    pool_lat_hbm,  # [L, PXB, 1, bt, R] int8 — latent prefix pool, HBM
+    lats_ref,  # [1, S] VMEM — latent scales, PRE-GATHERED through the table
+    rop_ref,  # [1, S, dr] VMEM — rope payload, PRE-GATHERED
+    rops_ref,  # [1, S] VMEM — rope scales, PRE-GATHERED
+    o_ref,  # [1, H, R] VMEM out — context in latent space
+    lat_buf,  # VMEM scratch [2, BS, R] int8 (double buffer)
+    sems,  # DMA semaphores [2]
+    *,
+    scale: float,
+    block_s: int,
+    seq_len: int,
+):
+    """Block-indirect sibling of `_attend_q8_mla_blocked_kernel`: the
+    latent payload — ~8/9 of the bytes — streams through the per-row block
+    table (arena home vs. pool row, one DMA per block either way); the
+    rope payload and both scale rows arrive PRE-GATHERED by the caller
+    (`paged_gather` in XLA) because their whole-row BlockSpec rides index
+    a single cache row and a [BS, dr]/[1, BS]-class manual DMA is exactly
+    the op Mosaic rejected when the blocked kernel was built (see its
+    docstring). Same static unroll + `pl.when`-gated DMAs + live-masked
+    stale-block no-ops as the blocked variant; BS equals the ledger's
+    block_tokens so table entry j covers kernel block j."""
+    b = pl.program_id(0)
+    li = li_ref[0]
+    w = lengths_ref[b]
+    BS = block_s
+    nbs = seq_len // BS
+    pool_base = lat_hbm.shape[1] * nbs
+    nblk = jnp.clip((w + BS) // BS, 1, nbs)
+    # parked/free rows (w >= S) stream one block; freed rows are identity
+    nblk = jnp.where(w >= seq_len, 1, nblk)
+
+    def issue(j: int, slot: int, op: str):
+        phys = tbl_ref[b * nbs + j]
+        ina = phys < pool_base
+
+        @pl.when((j < nblk) & ina)
+        def _arena():
+            c = pltpu.make_async_copy(
+                lat_hbm.at[li, phys // nbs, 0, pl.ds((phys % nbs) * BS, BS), :],
+                lat_buf.at[slot],
+                sems.at[slot],
+            )
+            getattr(c, op)()
+
+        @pl.when((j < nblk) & jnp.logical_not(ina))
+        def _pool():
+            c = pltpu.make_async_copy(
+                pool_lat_hbm.at[li, phys - pool_base, 0],
+                lat_buf.at[slot],
+                sems.at[slot],
+            )
+            getattr(c, op)()
+
+    issue(0, 0, "start")
+
+    qt = qt_ref[0].astype(jnp.float32)  # [H, R]
+    qr = qr_ref[0].astype(jnp.float32)  # [H, dr]
+    nc = nc_ref[0, 0].astype(jnp.float32)  # [R]
+    nr = nr_ref[0, 0].astype(jnp.float32)  # [dr]
+    qa = jnp.max(jnp.abs(qt), axis=-1)
+    qsc = jnp.maximum(qa / 127.0, 1e-30)
+    qt8 = jnp.round(qt / qsc[:, None]).astype(jnp.int8)
+    s_new = (
+        jnp.sum(qt * nc[None, :], axis=-1) + jnp.sum(qr * nr[None, :], axis=-1)
+    )[:, None] * scale  # [H, 1]
+
+    H, R = qt.shape
+    acc = jnp.zeros((H, R), jnp.float32)
+    m = jnp.full((H, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((H, 1), jnp.float32)
+
+    for j in range(nbs):  # static unroll; see blocked kernel's docstring
+        slot = j % 2
+        if j + 1 < nbs:
+            issue(j + 1, 1 - slot, "start")
+        issue(j, slot, "wait")
+        lat = lat_buf[slot]  # [BS, R] int8
+        lats = lats_ref[0, j * BS:(j + 1) * BS].astype(jnp.float32)
+        s_i = jax.lax.dot_general(
+            qt8, lat, (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32
+        )  # [H, BS]
+        s = s_i.astype(jnp.float32) * (scale * qsc)[:, None] * lats[None, :]
+        rops = rops_ref[0, j * BS:(j + 1) * BS].astype(jnp.float32)
+        rop = rop_ref[0, j * BS:(j + 1) * BS, :].astype(jnp.float32) * rops[:, None]
+        s = s + jax.lax.dot_general(
+            qr, rop, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        pos = j * BS + jax.lax.broadcasted_iota(jnp.int32, (1, BS), 1)
+        # skipped blocks (j >= nblk) hold STALE buffer bytes — gate every
+        # mask on liveness (same invariant as the blocked kernel)
+        live = pos <= jnp.where(j < nblk, w, -1)
+        cur = live & (pos == w)
+        s = jnp.where(cur, s_new, s)
+        s = jnp.where(live, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(live, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        p_w = jnp.sum(jnp.where(cur, p, 0.0), axis=-1, keepdims=True)
+        pv = jnp.where(live & ~cur, p * lats[None, :], 0.0)  # [H, BS]
+        pa = jnp.max(pv, axis=-1)
+        psc = jnp.maximum(pa / 127.0, 1e-30)
+        p8 = jnp.round(pv / psc[:, None]).astype(jnp.int8)
+        ctx_i = jax.lax.dot_general(
+            p8, lat, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+        )  # [H, R]
+        acc = acc * alpha + ctx_i.astype(jnp.float32) * psc[:, None] + p_w * nc[None, :]
+        m = m_new
+
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
 def mla_block_size(seq_len: int) -> int:
     """Block size for `_attend_q8_mla_blocked_kernel`, 0 = no blocked arm.
 
@@ -1387,24 +2060,35 @@ def mla_block_size(seq_len: int) -> int:
 
 
 def _decode_attend_q8_mla_fallback(
-    qt, qr, new_c, new_r, cache_c, cache_r, layer, lengths, scale, slot_ids
+    qt, qr, new_c, new_r, cache_c, cache_r, layer, lengths, scale, slot_ids,
+    block_tables=None, pool_c=None, pool_r=None,
 ):
     """Exact f32 math of the MLA kernel (CPU / unfit shapes): pre-append
-    semantics with the current position overridden by the exact vectors."""
+    semantics with the current position overridden by the exact vectors.
+    With `block_tables` every cache read gathers block-indirectly."""
     Ba = qt.shape[0]
 
     def rowsel(x):
         return x if slot_ids is None else jnp.take(x, slot_ids, axis=0)
 
-    def sel(entry):
-        return rowsel(
-            jax.lax.dynamic_index_in_dim(entry, layer, 0, keepdims=False)[:, 0]
+    if block_tables is not None:
+        tbl = (
+            block_tables
+            if slot_ids is None
+            else jnp.take(block_tables, slot_ids, 0)
         )
 
-    lat = sel(cache_c["q"]).astype(jnp.float32)  # [Ba, S, R]
-    rop = sel(cache_r["q"]).astype(jnp.float32)  # [Ba, S, dr]
-    ls = sel(cache_c["s"]).astype(jnp.float32)  # [Ba, S]
-    rs = sel(cache_r["s"]).astype(jnp.float32)
+    def sel(entry, pool_entry=None):
+        a = jax.lax.dynamic_index_in_dim(entry, layer, 0, keepdims=False)
+        if block_tables is None:
+            return rowsel(a[:, 0])
+        p = jax.lax.dynamic_index_in_dim(pool_entry, layer, 0, keepdims=False)
+        return paged_gather(a, p, tbl)[:, 0]
+
+    lat = sel(cache_c["q"], pool_c and pool_c["q"]).astype(jnp.float32)  # [Ba,S,R]
+    rop = sel(cache_r["q"], pool_r and pool_r["q"]).astype(jnp.float32)  # [Ba,S,dr]
+    ls = sel(cache_c["s"], pool_c and pool_c["s"]).astype(jnp.float32)  # [Ba, S]
+    rs = sel(cache_r["s"], pool_r and pool_r["s"]).astype(jnp.float32)
     S = lat.shape[1]
     qtf = qt.astype(jnp.float32)
     qrf = qr.astype(jnp.float32)
@@ -1440,6 +2124,10 @@ def decode_attend_q8_mla(
     lengths: jnp.ndarray,  # [Ba] int32 — this step's position per row
     *,
     slot_ids: jnp.ndarray | None = None,
+    block_tables: jnp.ndarray | None = None,  # [n_slots, nbs] int32 physical
+    #   block tables (executor/physical.py); None = contiguous layout
+    pool_c: dict | None = None,  # latent prefix pool mirroring cache_c
+    pool_r: dict | None = None,  # rope prefix pool mirroring cache_r
     scale: float,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
@@ -1452,7 +2140,10 @@ def decode_attend_q8_mla(
     multiple (tiny test configs). Past the whole-S kernel's VMEM budget,
     the BLOCKED variant streams the latent row from HBM with a dynamic
     trip count (`_attend_q8_mla_blocked_kernel`) — int8-latent long
-    context (S=32k) runs on the MXU too."""
+    context (S=32k) runs on the MXU too. With `block_tables`/pools the
+    latent payload streams block-indirectly
+    (`_attend_q8_mla_paged_kernel`, identity-table fast path as in
+    `decode_attend_q8`; `LLM_MCP_TPU_Q8_DECODE=paged` forces the arm)."""
     Ba, H, R = qt.shape
     dr = qr.shape[-1]
     S = cache_c["q"].shape[3]
@@ -1461,7 +2152,8 @@ def decode_attend_q8_mla(
     BS = mla_block_size(S)
     if not _HAS_PLTPU or (not fits and BS == 0) or (not interp and R % 128 != 0):
         return _decode_attend_q8_mla_fallback(
-            qt, qr, new_c, new_r, cache_c, cache_r, layer, lengths, scale, slot_ids
+            qt, qr, new_c, new_r, cache_c, cache_r, layer, lengths, scale, slot_ids,
+            block_tables, pool_c, pool_r,
         )
 
     ids = (
@@ -1548,6 +2240,62 @@ def decode_attend_q8_mla(
             kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interp
         )(*args)
 
+    def run_paged():
+        # latent payload streams through the table; rope + scales are
+        # PRE-GATHERED contiguous-equivalent rows (see the paged kernel's
+        # docstring for why they can't ride a per-block DMA)
+        nbs = block_tables.shape[1]
+        bt = S // nbs
+        tblc = jnp.take(block_tables, ids, 0).astype(jnp.int32)
+        lat_a = jax.lax.dynamic_index_in_dim(cache_c["s"], layer, 0, keepdims=False)
+        lat_p = jax.lax.dynamic_index_in_dim(pool_c["s"], layer, 0, keepdims=False)
+        lats_g = paged_gather(lat_a, lat_p, tblc)[:, 0]  # [Ba, S]
+        rop_a = jax.lax.dynamic_index_in_dim(cache_r["q"], layer, 0, keepdims=False)
+        rop_p = jax.lax.dynamic_index_in_dim(pool_r["q"], layer, 0, keepdims=False)
+        rop_g = paged_gather(rop_a, rop_p, tblc)[:, 0]  # [Ba, S, dr]
+        rops_a = jax.lax.dynamic_index_in_dim(cache_r["s"], layer, 0, keepdims=False)
+        rops_p = jax.lax.dynamic_index_in_dim(pool_r["s"], layer, 0, keepdims=False)
+        rops_g = paged_gather(rops_a, rops_p, tblc)[:, 0]  # [Ba, S]
+        kernel = functools.partial(
+            _attend_q8_mla_paged_kernel, scale=scale, block_s=bt, seq_len=S
+        )
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,  # layer [1], lengths [Ba], tables [Ba*nbs]
+            grid=(Ba,),
+            in_specs=[
+                pl.BlockSpec((1, H, R), lambda b, li, lens, tbl: (b, 0, 0)),
+                pl.BlockSpec((1, H, dr), lambda b, li, lens, tbl: (b, 0, 0)),
+                pl.BlockSpec((1, 1, R), lambda b, li, lens, tbl: (b, 0, 0)),
+                pl.BlockSpec((1, 1, dr), lambda b, li, lens, tbl: (b, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),  # latent arena (DMA'd)
+                pl.BlockSpec(memory_space=pl.ANY),  # latent pool (DMA'd)
+                pl.BlockSpec((1, S), lambda b, li, lens, tbl: (b, 0)),
+                pl.BlockSpec((1, S, dr), lambda b, li, lens, tbl: (b, 0, 0)),
+                pl.BlockSpec((1, S), lambda b, li, lens, tbl: (b, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, H, R), lambda b, li, lens, tbl: (b, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, bt, R), jnp.int8),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        )
+        return pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interp
+        )(
+            jnp.reshape(layer, (1,)).astype(jnp.int32),
+            lengths.astype(jnp.int32),
+            tblc.reshape(-1),
+            qt,
+            qr,
+            new_c.reshape(Ba, 1, R),
+            new_r.reshape(Ba, 1, dr),
+            cache_c["q"],
+            pool_c["q"],
+            lats_g,
+            rop_g,
+            rops_g,
+        )
+
     # STATIC selection (unlike decode_attend_q8's runtime hybrid): measured
     # at mla-8b kv8 B=32 S=2048, whole-S beats blocked even at low fill
     # (1845 vs 1653 tok/s — the absorbed form is MQA-shaped, so whole-S
@@ -1560,11 +2308,42 @@ def decode_attend_q8_mla(
     # above already returned exact f32 math. "Whole if it fits, else
     # blocked" below can therefore assume BS > 0.
     mode = os.environ.get("LLM_MCP_TPU_Q8_DECODE", "auto")
-    if mode == "whole" and fits:
-        return run_whole()
-    if mode == "blocked" and BS:
-        return run_blocked()
-    return run_whole() if fits else run_blocked()
+
+    def run_contig():
+        if mode == "whole" and fits:
+            return run_whole()
+        if mode == "blocked" and BS:
+            return run_blocked()
+        return run_whole() if fits else run_blocked()
+
+    if block_tables is None:
+        return run_contig()
+    nbs_t = block_tables.shape[1]
+    # paged arm shares the blocked kernel's static-unroll budget (≤ 64
+    # blocks) and needs an int8-tileable block size
+    paged_ok = (
+        pool_c is not None and nbs_t > 0 and S % nbs_t == 0
+        and (S // nbs_t) >= 32 and nbs_t <= 64
+    )
+    if mode == "paged" and paged_ok:
+        return run_paged()
+    if interp or not paged_ok:
+        # interpret runs keep a static arm choice (parity tests force the
+        # paged kernel via LLM_MCP_TPU_Q8_DECODE=paged); unfit block sizes
+        # take the exact gather math
+        return _decode_attend_q8_mla_fallback(
+            qt, qr, new_c, new_r, cache_c, cache_r, layer, lengths, scale, slot_ids,
+            block_tables, pool_c, pool_r,
+        )
+    # identity tables keep the contiguous dispatch (see decode_attend_q8)
+    n_slots = cache_c["q"].shape[1]
+    ident = jnp.all(
+        block_tables
+        == jnp.arange(n_slots * nbs_t, dtype=block_tables.dtype).reshape(
+            n_slots, nbs_t
+        )
+    )
+    return jax.lax.cond(ident, run_contig, run_paged)
 
 
 def _append_q8_kernel(

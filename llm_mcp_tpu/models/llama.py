@@ -38,6 +38,7 @@ from ..kernels.attention import (
     decode_attend_bf16,
     decode_attend_q8,
     flash_prefill_attention,
+    paged_gather,
 )
 from ..ops.norms import rms_norm as _rms_norm
 from ..ops.rope import rope_tables, apply_rope
@@ -520,6 +521,7 @@ def _decode_step_q8(
     tokens: jnp.ndarray,  # [Ba] int32 (compact batch when slot_ids is given)
     lengths: jnp.ndarray,  # [Ba] int32
     slot_ids: jnp.ndarray | None = None,  # [Ba] int32 cache rows (None = 1:1)
+    paged: dict | None = None,  # {"tbl","k","v"} physical paging operand
 ) -> tuple[jnp.ndarray, dict, dict]:
     """Decode step for the int8 cache on the pallas path.
 
@@ -562,6 +564,8 @@ def _decode_step_q8(
         ctx = decode_attend_q8(
             qg, k, v, cache_k, cache_v, li, lengths,
             slot_ids=slot_ids, scale=cfg.attn_scale,
+            block_tables=None if paged is None else paged["tbl"],
+            pool_k=None if paged is None else paged["k"],
         ).reshape(Ba, H * hd)
         h = _attn_residual(cfg, lp, ctx, h)
         h = _ffn_residual(cfg, lp, h, moe_capacity=Ba)
@@ -585,6 +589,7 @@ def _decode_step_bf16(
     tokens: jnp.ndarray,  # [Ba] int32 (compact batch when slot_ids is given)
     lengths: jnp.ndarray,  # [Ba] int32
     slot_ids: jnp.ndarray | None = None,  # [Ba] int32 cache rows (None = 1:1)
+    paged: dict | None = None,  # {"tbl","k","v"} physical paging operand
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Decode step for the bf16 cache on the pallas path — the structure
     that made the q8 path fast (`_decode_step_q8`), applied to the split
@@ -615,6 +620,9 @@ def _decode_step_bf16(
         ctx = decode_attend_bf16(
             qg, k, v, cache_k, cache_v, li, lengths,
             slot_ids=slot_ids, scale=cfg.attn_scale,
+            block_tables=None if paged is None else paged["tbl"],
+            pool_k=None if paged is None else paged["k"],
+            pool_v=None if paged is None else paged["v"],
         ).reshape(Ba, H * hd)
         h = _attn_residual(cfg, lp, ctx, h)
         h = _ffn_residual(cfg, lp, h, moe_capacity=Ba)
@@ -643,6 +651,7 @@ def llama_prefill_chunk_batch(
     nvalid: jnp.ndarray,  # [A] int32 — valid tokens per chunk
     skey: int = 0,  # STATIC bound on the PAST key range (0 = whole S); >= max(starts)
     all_logits: bool = False,  # STATIC: logits at every chunk position, not just the last
+    paged: dict | None = None,  # {"tbl","k","v"} physical paging operand
 ) -> tuple[jnp.ndarray, Any, Any]:
     """Batched chunked prefill: one bounded chunk for up to A slots' prompts
     in a single dispatch, written straight into the engine cache.
@@ -681,7 +690,7 @@ def llama_prefill_chunk_batch(
 
         return mla_prefill_chunk_batch(
             cfg, params, cache_k, cache_v, tokens, slots, starts, nvalid,
-            skey=skey, all_logits=all_logits,
+            skey=skey, all_logits=all_logits, paged=paged,
         )
     quantized = isinstance(cache_k, dict)
     # fused quantized cache: axis 2 of "q" is 2*Hkv + p — take Hkv from cfg
@@ -694,6 +703,17 @@ def llama_prefill_chunk_batch(
     neg = jnp.float32(-1e30)
     slots = jnp.asarray(slots, dtype=jnp.int32)
     starts = jnp.asarray(starts, dtype=jnp.int32)
+
+    # Block-indirect past reads: gather each slot's PAST rows through its
+    # block table (shared prefix blocks resolve to pool rows) instead of a
+    # contiguous slice. Only the first ceil(Sk/bt) table entries matter —
+    # the gather is bounded by the same static skey bucket as before.
+    ptbl = None
+    if paged is not None:
+        nbs_full = paged["tbl"].shape[1]
+        bt = S // nbs_full
+        nsel = max(1, -(-Sk // bt))
+        ptbl = jnp.take(paged["tbl"], slots, axis=0)[:, :nsel]
 
     h = _embed_in(cfg, params, tokens)  # [A, C, D]
     q_pos = starts[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]  # [A, C]
@@ -726,39 +746,64 @@ def llama_prefill_chunk_batch(
             # payload — one slice per slot covers both (the packed-scale
             # pseudo-head past 2*Hkv is never read here; the plain "s" rows
             # carry the arithmetic scales)
-            pays = jnp.stack(
-                [
-                    jax.lax.dynamic_slice(
-                        ck_all["q"], (li, slots[a], 0, 0, 0), (1, 1, 2 * Hkv, Sk, hd)
-                    )[0, 0]
-                    for a in range(A)
-                ]
-            )  # [A, 2*Hkv, Sk, hd] int8
-            kp, vp = list(pays[:, :Hkv]), list(pays[:, Hkv:])
-            srows = jnp.stack(
-                [
-                    jax.lax.dynamic_slice(
-                        ck_all["s"], (li, slots[a], 0, 0), (1, 1, 2 * Hkv, Sk)
-                    )[0, 0]
-                    for a in range(A)
-                ]
-            )  # [A, 2*Hkv, Sk]
+            if ptbl is not None:
+                pays = paged_gather(
+                    jax.lax.dynamic_index_in_dim(ck_all["q"], li, 0, keepdims=False),
+                    jax.lax.dynamic_index_in_dim(paged["k"]["q"], li, 0, keepdims=False),
+                    ptbl, nbs=nbs_full,
+                )[:, : 2 * Hkv, :Sk]  # [A, 2*Hkv, Sk, hd] int8
+                srows = paged_gather(
+                    jax.lax.dynamic_index_in_dim(ck_all["s"], li, 0, keepdims=False),
+                    jax.lax.dynamic_index_in_dim(paged["k"]["s"], li, 0, keepdims=False),
+                    ptbl, nbs=nbs_full,
+                )[:, : 2 * Hkv, :Sk]  # [A, 2*Hkv, Sk]
+            else:
+                pays = jnp.stack(
+                    [
+                        jax.lax.dynamic_slice(
+                            ck_all["q"], (li, slots[a], 0, 0, 0), (1, 1, 2 * Hkv, Sk, hd)
+                        )[0, 0]
+                        for a in range(A)
+                    ]
+                )  # [A, 2*Hkv, Sk, hd] int8
+                srows = jnp.stack(
+                    [
+                        jax.lax.dynamic_slice(
+                            ck_all["s"], (li, slots[a], 0, 0), (1, 1, 2 * Hkv, Sk)
+                        )[0, 0]
+                        for a in range(A)
+                    ]
+                )  # [A, 2*Hkv, Sk]
+            krows, vrows = pays[:, :Hkv], pays[:, Hkv:]
             ksr, vsr = srows[:, :Hkv], srows[:, Hkv:]
+        elif ptbl is not None:
+            krows = paged_gather(
+                jax.lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False),
+                jax.lax.dynamic_index_in_dim(paged["k"], li, 0, keepdims=False),
+                ptbl, nbs=nbs_full,
+            )[:, :, :Sk]
+            vrows = paged_gather(
+                jax.lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False),
+                jax.lax.dynamic_index_in_dim(paged["v"], li, 0, keepdims=False),
+                ptbl, nbs=nbs_full,
+            )[:, :, :Sk]
         else:
-            kp = [
-                jax.lax.dynamic_slice(
-                    ck_all, (li, slots[a], 0, 0, 0), (1, 1, Hkv, Sk, hd)
-                )[0, 0]
-                for a in range(A)
-            ]
-            vp = [
-                jax.lax.dynamic_slice(
-                    cv_all, (li, slots[a], 0, 0, 0), (1, 1, Hkv, Sk, hd)
-                )[0, 0]
-                for a in range(A)
-            ]
-        krows = jnp.stack(kp)  # [A, Hkv, Sk, hd] (int8 payload when quantized)
-        vrows = jnp.stack(vp)
+            krows = jnp.stack(
+                [
+                    jax.lax.dynamic_slice(
+                        ck_all, (li, slots[a], 0, 0, 0), (1, 1, Hkv, Sk, hd)
+                    )[0, 0]
+                    for a in range(A)
+                ]
+            )  # [A, Hkv, Sk, hd]
+            vrows = jnp.stack(
+                [
+                    jax.lax.dynamic_slice(
+                        cv_all, (li, slots[a], 0, 0, 0), (1, 1, Hkv, Sk, hd)
+                    )[0, 0]
+                    for a in range(A)
+                ]
+            )
 
         # past scores (dequant post-dot when the cache is int8)
         s_past = jnp.einsum(
@@ -844,6 +889,7 @@ def llama_prefill_chunk(
     start: jnp.ndarray,
     nvalid: jnp.ndarray,
     skey: int = 0,
+    paged: dict | None = None,
 ) -> tuple[jnp.ndarray, Any, Any]:
     """Single-slot wrapper over `llama_prefill_chunk_batch` (A=1)."""
     return llama_prefill_chunk_batch(
@@ -856,6 +902,7 @@ def llama_prefill_chunk(
         jnp.asarray(start, dtype=jnp.int32)[None],
         jnp.asarray(nvalid, dtype=jnp.int32)[None],
         skey=skey,
+        paged=paged,
     )
 
 
@@ -868,6 +915,10 @@ def llama_decode_step(
     lengths: jnp.ndarray,  # [Ba] int32 — position to write (tokens already in cache)
     attn_impl: str = "xla",
     slot_ids: jnp.ndarray | None = None,  # [Ba] int32 cache rows (None = 1:1)
+    paged: dict | None = None,  # {"tbl","k","v"} physical paging operand —
+    #   block-indirect reads through executor/physical.py tables (None =
+    #   contiguous). Writes are UNTOUCHED: decode always appends at private
+    #   positions, and private blocks live at their identity homes.
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One batched autoregressive step for all slots.
 
@@ -892,7 +943,7 @@ def llama_decode_step(
 
         return mla_decode_step(
             cfg, params, cache_k, cache_v, tokens, lengths,
-            slot_ids=slot_ids, attn_impl=attn_impl,
+            slot_ids=slot_ids, attn_impl=attn_impl, paged=paged,
         )
     quantized = isinstance(cache_k, dict)
     # fused quantized cache: axis 2 of "q" is 2*Hkv + p — take Hkv from cfg
@@ -920,13 +971,15 @@ def llama_decode_step(
         # append_kv_q8). decode_attend_q8 is built for pre-append caches: it
         # overrides position w with the exact new vectors.
         return _decode_step_q8(
-            cfg, params, cache_k, cache_v, tokens, lengths, slot_ids=slot_ids
+            cfg, params, cache_k, cache_v, tokens, lengths,
+            slot_ids=slot_ids, paged=paged,
         )
     if attn_impl == "pallas" and not quantized:
         # same structure for the bf16 cache (new: it used to take the
         # in-scan sliced kernel, which lost to XLA — the restructure wins)
         return _decode_step_bf16(
-            cfg, params, cache_k, cache_v, tokens, lengths, slot_ids=slot_ids
+            cfg, params, cache_k, cache_v, tokens, lengths,
+            slot_ids=slot_ids, paged=paged,
         )
 
     h = _embed_in(cfg, params, tokens)  # [Ba, D]
@@ -945,6 +998,18 @@ def llama_decode_step(
         # gather the compact batch's cache rows for the einsum attention
         # paths (identity when uncompacted — XLA elides the arange take)
         return x if slot_ids is None else jnp.take(x, slot_ids, axis=0)
+
+    ptbl = None if paged is None else jnp.take(paged["tbl"], rows, axis=0)
+
+    def csel(x_all, li, pool_all):
+        # layer-select + row-select; block-indirect through the compacted
+        # table when physical paging is live (subsumes rowsel: table row i
+        # resolves slot rows[i]'s blocks, private ones to identity homes)
+        x = jax.lax.dynamic_index_in_dim(x_all, li, 0, keepdims=False)
+        if ptbl is None:
+            return rowsel(x)
+        p = jax.lax.dynamic_index_in_dim(pool_all, li, 0, keepdims=False)
+        return paged_gather(x, p, ptbl)
 
     # The full cache rides the layer scan as CARRY, not xs/ys: as ys the
     # scan would materialize a fresh [L, B, Hkv, S, hd] stack every step — a
@@ -991,12 +1056,8 @@ def llama_decode_step(
             cv_all = cv_all.at[li, b_idx, h_idx, w_idx].set(v.astype(cv_all.dtype))
 
         if quantized:
-            payl = rowsel(
-                jax.lax.dynamic_index_in_dim(ck_all["q"], li, 0, keepdims=False)
-            )
-            ssl = rowsel(
-                jax.lax.dynamic_index_in_dim(ck_all["s"], li, 0, keepdims=False)
-            )
+            payl = csel(ck_all["q"], li, None if paged is None else paged["k"]["q"])
+            ssl = csel(ck_all["s"], li, None if paged is None else paged["k"]["s"])
             ck, cv = payl[:, :Hkv], payl[:, Hkv : 2 * Hkv]
             ks, vs = ssl[:, :Hkv], ssl[:, Hkv:]
             # int8 K dot in compute dtype; per-key-token dequant scales the
@@ -1016,8 +1077,8 @@ def llama_decode_step(
                 Ba, H * hd
             )
         else:
-            ck = rowsel(jax.lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False))
-            cv = rowsel(jax.lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False))
+            ck = csel(ck_all, li, None if paged is None else paged["k"])
+            cv = csel(cv_all, li, None if paged is None else paged["v"])
             scores = jnp.einsum("bhgd,bhsd->bhgs", qg, ck).astype(jnp.float32)
             scores = _softcap(scores * cfg.attn_scale, cfg.attn_softcap)
             m = attn_mask

@@ -42,6 +42,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from ..kernels.attention import paged_gather
 from ..ops.norms import rms_norm as _rms_norm
 from ..ops.rope import apply_rope, rope_tables
 from .configs import ModelConfig
@@ -300,6 +301,7 @@ def mla_prefill_chunk_batch(
     nvalid: jnp.ndarray,  # [A] int32 valid tokens per chunk
     skey: int = 0,  # STATIC bound on the PAST key range (0 = whole S)
     all_logits: bool = False,  # STATIC: logits at every chunk position
+    paged: dict | None = None,  # {"tbl","k","v"} physical paging operand
 ) -> tuple[jnp.ndarray, Any, Any]:
     """Batched chunked prefill for MLA — the absorbed-attention analog of
     `llama_prefill_chunk_batch` (same engine contract: one bounded chunk for
@@ -341,6 +343,17 @@ def mla_prefill_chunk_batch(
     c_idx = jnp.arange(C, dtype=jnp.int32)
     self_mask = jnp.broadcast_to((c_idx[None, :] <= c_idx[:, None])[None], (A, C, C))
 
+    # Block-indirect past reads through each slot's table (shared prefix
+    # latents resolve to pool rows); only the blocks covering the static
+    # skey bucket are gathered. Writes stay contiguous — chunk positions
+    # are private blocks, which live at their identity homes.
+    ptbl = None
+    if paged is not None:
+        nbs_full = paged["tbl"].shape[1]
+        bt = S // nbs_full
+        nsel = max(1, -(-Sk // bt))
+        ptbl = jnp.take(paged["tbl"], slots, axis=0)[:, :nsel]
+
     def layer(carry, lp):
         h, cc_all, cr_all, li = carry
         x = _norm(cfg, h, lp["attn_norm"])
@@ -352,7 +365,13 @@ def mla_prefill_chunk_batch(
         qt = jnp.einsum("achd,rhd->achr", qn, w_uk)  # [A, C, H, R]
 
         # ---- reads first: past latents/rope keys from the PRE-write cache
-        def past_rows(cache, d):
+        def past_rows(cache, d, pool=None):
+            if ptbl is not None:
+                return paged_gather(
+                    jax.lax.dynamic_index_in_dim(cache, li, 0, keepdims=False),
+                    jax.lax.dynamic_index_in_dim(pool, li, 0, keepdims=False),
+                    ptbl, nbs=nbs_full,
+                )[:, 0, :Sk]  # [A, Sk, d] (d absent for scale planes)
             return jnp.stack(
                 [
                     jax.lax.dynamic_slice(
@@ -362,25 +381,25 @@ def mla_prefill_chunk_batch(
                 ]
             )  # [A, Sk, d]
 
-        if quantized:
-            lat = past_rows(cc_all["q"], R)
-            rop = past_rows(cr_all["q"], dr)
-            ls = jnp.stack(
+        def past_scales(cache_s, pool_s=None):
+            if ptbl is not None:
+                return past_rows(cache_s, 0, pool_s).astype(jnp.float32)
+            return jnp.stack(
                 [
                     jax.lax.dynamic_slice(
-                        cc_all["s"], (li, slots[a], 0, 0), (1, 1, 1, Sk)
+                        cache_s, (li, slots[a], 0, 0), (1, 1, 1, Sk)
                     )[0, 0, 0]
                     for a in range(A)
                 ]
             ).astype(jnp.float32)  # [A, Sk]
-            rs = jnp.stack(
-                [
-                    jax.lax.dynamic_slice(
-                        cr_all["s"], (li, slots[a], 0, 0), (1, 1, 1, Sk)
-                    )[0, 0, 0]
-                    for a in range(A)
-                ]
-            ).astype(jnp.float32)
+
+        pk = None if paged is None else paged["k"]
+        pv = None if paged is None else paged["v"]
+        if quantized:
+            lat = past_rows(cc_all["q"], R, pk and pk["q"])
+            rop = past_rows(cr_all["q"], dr, pv and pv["q"])
+            ls = past_scales(cc_all["s"], pk and pk["s"])
+            rs = past_scales(cr_all["s"], pv and pv["s"])
             # per-token dequant scales fold POST-DOT (decode path's trick)
             s_past = (
                 jnp.einsum("achr,asr->ahcs", qt, lat.astype(qt.dtype)).astype(
@@ -393,8 +412,8 @@ def mla_prefill_chunk_batch(
                 * rs[:, None, None, :]
             ) * scale
         else:
-            lat = past_rows(cc_all, R)
-            rop = past_rows(cr_all, dr)
+            lat = past_rows(cc_all, R, pk)
+            rop = past_rows(cr_all, dr, pv)
             s_past = (
                 jnp.einsum("achr,asr->ahcs", qt, lat.astype(qt.dtype))
                 + jnp.einsum("achd,asd->ahcs", qr, rop.astype(qr.dtype))
@@ -489,6 +508,7 @@ def mla_decode_step(
     lengths: jnp.ndarray,  # [Ba] int32 — write position per row
     slot_ids: jnp.ndarray | None = None,  # [Ba] compaction indirection
     attn_impl: str = "xla",
+    paged: dict | None = None,  # {"tbl","k","v"} physical paging operand
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One absorbed-attention decode step for all slots.
 
@@ -521,6 +541,8 @@ def mla_decode_step(
 
     def rowsel(x):
         return x if slot_ids is None else jnp.take(x, slot_ids, axis=0)
+
+    ptbl = None if paged is None else jnp.take(paged["tbl"], rows, axis=0)
 
     def layer(carry, lp):
         h, cc_all, cr_all, li = carry
@@ -559,16 +581,20 @@ def mla_decode_step(
         w_uk, w_uv = _absorbed_w(lp, h.dtype, R, H, dn, dv)
         qt = jnp.einsum("bhd,rhd->bhr", qn, w_uk)
 
-        def sel(x):
-            return rowsel(
-                jax.lax.dynamic_index_in_dim(x, li, 0, keepdims=False)[:, 0]
-            )
+        def sel(x, pool=None):
+            xl = jax.lax.dynamic_index_in_dim(x, li, 0, keepdims=False)
+            if ptbl is None:
+                return rowsel(xl[:, 0])
+            pp = jax.lax.dynamic_index_in_dim(pool, li, 0, keepdims=False)
+            return paged_gather(xl, pp, ptbl)[:, 0]
 
+        pk = None if paged is None else paged["k"]
+        pv = None if paged is None else paged["v"]
         if quantized:
-            lat = sel(cc_all["q"])  # [Ba, S, R] int8 payload
-            rop = sel(cr_all["q"])  # [Ba, S, dr] int8
-            ls = sel(cc_all["s"]).astype(jnp.float32)  # [Ba, S]
-            rs = sel(cr_all["s"]).astype(jnp.float32)
+            lat = sel(cc_all["q"], pk and pk["q"])  # [Ba, S, R] int8 payload
+            rop = sel(cr_all["q"], pv and pv["q"])  # [Ba, S, dr] int8
+            ls = sel(cc_all["s"], pk and pk["s"]).astype(jnp.float32)  # [Ba, S]
+            rs = sel(cr_all["s"], pv and pv["s"]).astype(jnp.float32)
             # per-token dequant scales fold POST-DOT (the GQA int8 cache's
             # trick): each dot's scores multiply by its own scale row, and
             # the value-side scale folds into the probs before the PV dot
@@ -584,8 +610,8 @@ def mla_decode_step(
             pl = (probs * ls[:, None, :]).astype(h.dtype)
             ctx_lat = jnp.einsum("bhs,bsr->bhr", pl, lat.astype(h.dtype))
         else:
-            lat = sel(cc_all)  # [Ba, S, R]
-            rop = sel(cr_all)  # [Ba, S, dr]
+            lat = sel(cc_all, pk)  # [Ba, S, R]
+            rop = sel(cr_all, pv)  # [Ba, S, dr]
             scores = (
                 jnp.einsum("bhr,bsr->bhs", qt, lat.astype(qt.dtype))
                 + jnp.einsum("bhd,bsd->bhs", qr, rop.astype(qr.dtype))
@@ -613,6 +639,9 @@ def mla_decode_step(
             ctx_lat = decode_attend_q8_mla(
                 qt, qr, c, kr, cache_c, cache_r, li, lengths,
                 slot_ids=slot_ids, scale=scale,
+                block_tables=None if paged is None else paged["tbl"],
+                pool_c=None if paged is None else paged["k"],
+                pool_r=None if paged is None else paged["v"],
             )
             ctx = jnp.einsum("bhr,rhd->bhd", ctx_lat.astype(h.dtype), w_uv)
             h = h + qdot(ctx.reshape(Ba, H * dv), lp["wo_mla"])

@@ -213,6 +213,24 @@ def kv_cache_specs(quantized: bool = False, latent: bool = False) -> dict[str, A
     return {"k": row, "v": row}
 
 
+def kv_pool_specs(quantized: bool = False, latent: bool = False) -> dict[str, Any]:
+    """Specs for the physical prefix pool (executor/physical.py pool_like):
+    pool leaves are the arena leaves with batch→pool-row and S→block_tokens
+    `[L, PXB, Hx, bt, ...]`. Axis-for-axis the cache specs apply, EXCEPT the
+    pool-row axis replicates instead of sharding on dp — pool rows hold
+    shared prefix blocks any slot on any dp shard may gather through its
+    block table, so they are a global resource, not slot-partitioned."""
+    def drop_dp(spec: Any) -> Any:
+        if not isinstance(spec, P):
+            return spec
+        return P(*(None if ax == "dp" else ax for ax in spec))
+
+    return jax.tree.map(
+        drop_dp, kv_cache_specs(quantized=quantized, latent=latent),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
 def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
     """Place a pytree on the mesh according to matching PartitionSpecs."""
     return jax.tree.map(

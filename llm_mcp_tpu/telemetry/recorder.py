@@ -24,8 +24,10 @@ An *event* is a tuple ``(seq, ts, etype, trace_id, fields)``:
   ts        wall-clock seconds
   etype     short event kind: admit / budget / chunk / verify / decode /
             fused / preempt / offload / restore / cow / pin / unpin /
-            migrate_out / migrate_in / shed / watchdog / compile /
-            anomaly / profile
+            pg_tbl (device block-table reset/rebuild, with the shared-row
+            count) / pg_cow (physical boundary-block copy: pool row ->
+            identity home) / migrate_out / migrate_in / shed / watchdog /
+            compile / anomaly / profile
   trace_id  the request's 32-hex trace id ("" for engine-global events) —
             a dump stitches directly into /v1/traces
   fields    flat dict of scalars (or None)

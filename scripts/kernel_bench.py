@@ -100,8 +100,13 @@ def bench_attn(
 ) -> dict[str, float]:
     """Time one jitted attend call for `layout` at (B, S, fill).
 
-    arm: "whole" | "blocked" | "auto" (the runtime hybrid). Forced via the
-    kernels' own env knobs so the measured dispatch is the production one.
+    arm: "whole" | "blocked" | "paged" | "auto" (the runtime hybrid).
+    Forced via the kernels' own env knobs so the measured dispatch is the
+    production one. The "paged" arm is the block-indirect gather
+    (executor/physical.py block tables): half of every row's blocks
+    redirect to a shared prefix pool — the worst-case table-miss pattern —
+    so attn_us_per_cell prices the indirection against the contiguous
+    blocked arm at the same (fill, batch) point.
     """
     import os
 
@@ -117,7 +122,24 @@ def bench_attn(
     BS = next((c for c in (256, 128, 64, 32) if S % c == 0), 0)
     layer = jnp.int32(0)
 
-    env = {"q8_gqa": "LLM_MCP_TPU_Q8_DECODE", "bf16_gqa": "LLM_MCP_TPU_BF16_DECODE"}
+    paged = arm == "paged"
+    bt = next((c for c in (64, 128, 32, 256) if S % c == 0), 0)
+    nbs = S // bt if bt else 0
+    tbl = None
+    pxb = 0
+    if paged:
+        if not nbs:
+            raise SystemExit(f"S={S} has no paged-tileable block size")
+        pxb = max(nbs // 2, 1)
+        t = np.arange(B * nbs, dtype=np.int32).reshape(B, nbs)
+        t[:, : nbs // 2] = B * nbs + np.arange(nbs // 2, dtype=np.int32)
+        tbl = jnp.asarray(t)
+
+    env = {
+        "q8_gqa": "LLM_MCP_TPU_Q8_DECODE",
+        "bf16_gqa": "LLM_MCP_TPU_BF16_DECODE",
+        "q8_mla": "LLM_MCP_TPU_Q8_DECODE",
+    }
     old = None
     if layout in env:
         old = os.environ.get(env[layout])
@@ -130,7 +152,14 @@ def bench_attn(
             nk = jnp.asarray(rng.standard_normal((B, Hkv, hd)), dtype)
             nv = jnp.asarray(rng.standard_normal((B, Hkv, hd)), dtype)
             A.decode_attend_q8.clear_cache()  # env knob is read at trace time
-            fn = lambda: A.decode_attend_q8(q, nk, nv, ck, cv, layer, lengths)
+            pool_k = None
+            if paged:
+                # pool leaves mirror the cache with B→PXB rows, S→bt tokens
+                pool_k, _ = _rand_fused_q8_cache(rng, 1, pxb, Hkv, bt, hd, dtype)
+            fn = lambda: A.decode_attend_q8(
+                q, nk, nv, ck, cv, layer, lengths,
+                block_tables=tbl, pool_k=pool_k,
+            )
             # bytes one call streams: int8 payload rows + scale rows over the
             # attended prefix (blocked) or the full S extent (whole-S)
             row_bytes = 2 * Hkv * hd + 2 * Hkv * jnp.dtype(dtype).itemsize
@@ -139,7 +168,13 @@ def bench_attn(
             q = jnp.asarray(rng.standard_normal((B, Hkv, G, hd)), dtype)
             nk = jnp.asarray(rng.standard_normal((B, Hkv, hd)), dtype)
             nv = jnp.asarray(rng.standard_normal((B, Hkv, hd)), dtype)
-            fn = lambda: A.decode_attend_bf16(q, nk, nv, ck, cv, layer, lengths)
+            pool_k = pool_v = None
+            if paged:
+                pool_k, pool_v = _rand_bf16_cache(rng, 1, pxb, Hkv, bt, hd, dtype)
+            fn = lambda: A.decode_attend_bf16(
+                q, nk, nv, ck, cv, layer, lengths,
+                block_tables=tbl, pool_k=pool_k, pool_v=pool_v,
+            )
             row_bytes = 2 * Hkv * hd * jnp.dtype(dtype).itemsize
         elif layout == "q8_mla":
             H = Hkv * G
@@ -161,10 +196,25 @@ def bench_attn(
             nc = jnp.asarray(rng.standard_normal((B, R)), dtype)
             nr = jnp.asarray(rng.standard_normal((B, dr)), dtype)
             sc = (R + dr) ** -0.5
+            pool_c = pool_r = None
+            if paged:
+                pool_c = {
+                    "q": jnp.asarray(
+                        rng.integers(-127, 128, (1, pxb, 1, bt, R), dtype="int8")
+                    ),
+                    "s": jnp.asarray(rng.random((1, pxb, 1, bt), dtype="float32") * 0.02),
+                }
+                pool_r = {
+                    "q": jnp.asarray(
+                        rng.integers(-127, 128, (1, pxb, 1, bt, dr), dtype="int8")
+                    ),
+                    "s": jnp.asarray(rng.random((1, pxb, 1, bt), dtype="float32") * 0.02),
+                }
             # the MLA dispatch is jitted by its callers, not at def site
             mla_call = jax.jit(
                 lambda qt, qr, nc, nr, cc, cr, lens: A.decode_attend_q8_mla(
-                    qt, qr, nc, nr, cc, cr, layer, lens, scale=sc
+                    qt, qr, nc, nr, cc, cr, layer, lens,
+                    block_tables=tbl, pool_c=pool_c, pool_r=pool_r, scale=sc,
                 )
             )
             fn = lambda: mla_call(qt, qr, nc, nr, cc, cr, lengths)
@@ -187,13 +237,14 @@ def bench_attn(
                 os.environ[env[layout]] = old
 
     whole = arm == "whole"
-    cells = _cells(lengths, S, BS or S, whole)
+    eff_bs = (bt if paged else BS) or S
+    cells = _cells(lengths, S, eff_bs, whole)
     lens = np.asarray(lengths)
     if whole:
         streamed = B * S * row_bytes
     else:
-        w = np.where(lens < S, np.minimum(lens + 1, S), BS or S)
-        streamed = float(np.sum(np.ceil(w / (BS or S)) * (BS or S))) * row_bytes
+        w = np.where(lens < S, np.minimum(lens + 1, S), eff_bs)
+        streamed = float(np.sum(np.ceil(w / eff_bs) * eff_bs)) * row_bytes
     packed = (
         layout == "q8_gqa"
         and isinstance(ck, dict)
@@ -203,6 +254,7 @@ def bench_attn(
     # spec: fused payload + plain scales (q8), split K + V (bf16), latent +
     # rope payloads with their scale rows (mla)
     whole_dma = {"q8_gqa": 2, "bf16_gqa": 2, "q8_mla": 4}
+    dma_layout = layout + "_paged" if paged else layout
     return {
         "layout": layout,
         "arm": arm,
@@ -213,7 +265,9 @@ def bench_attn(
         "attn_us_per_cell": round(dt * 1e6 / max(cells, 1), 3),
         "gbps": round(streamed / dt / 1e9, 2),
         "dma_per_cell": (
-            whole_dma[layout] if whole else A.blocked_dma_count(layout, packed=packed)
+            whole_dma[layout]
+            if whole
+            else A.blocked_dma_count(dma_layout, packed=packed)
         ),
     }
 
@@ -325,11 +379,14 @@ def main() -> int:
         )
         for layout in layouts:
             if layout == "q8_mla":
-                # the MLA dispatch picks its own arm (whole-S under the VMEM
-                # budget, blocked past it) with no forcing knob: time it once
-                arms = ["auto"]
+                # the MLA dispatch picks its own contiguous arm (whole-S
+                # under the VMEM budget, blocked past it) with no forcing
+                # knob: time it once, plus the block-indirect arm
+                arms = ["auto", "paged"]
             else:
-                arms = ["whole", "blocked"] + (["auto"] if on_tpu else [])
+                arms = ["whole", "blocked", "paged"] + (
+                    ["auto"] if on_tpu else []
+                )
             for B in batches:
                 for fill in fills:
                     for arm in arms:

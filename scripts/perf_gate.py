@@ -38,9 +38,14 @@ Checks, in order of authority:
      ISSUE 6 acceptance bar: >= 3x the slots at equal HBM budget when 90%
      of prompts share a prefix) and cow_copies_per_req <= 2.0 (more means
      boundary blocks are churning — check TPU_KV_BLOCK_TOKENS against the
-     stored prefix lengths). paged_block_leaks is an exact check like
-     window_errors: any nonzero end-of-run leak/double-free count from the
-     ledger audit fails the gate outright.
+     stored prefix lengths). With the physical block pool (ISSUE 10),
+     paged_hbm_bytes_ratio >= 2.5: peak contiguous-equivalent HBM bytes
+     (logical blocks + resident prefix-cache rows, what the slot-contiguous
+     arena would have spent) over peak physical pool bytes actually
+     allocated — under 2.5 on the 90%-shared sweep means admission is
+     copying rows instead of pinning them. paged_block_leaks is an exact
+     check like window_errors: any nonzero end-of-run leak/double-free
+     count from the ledger audit fails the gate outright.
   5. KV-migration floors, when the record carries them: the 2-engine
      oversubscribed sweep must have moved at least one snapshot or
      queued request (migration_count >= 1) and its admitted p95 TTFT
@@ -84,6 +89,7 @@ HIGHER_BETTER = (
     "embed_per_s_nomic-embed-text_b1_tpu",
     "embed_per_s_qwen3-embedding-8b-int8_b64_d1024_tpu",
     "paged_admit_ratio",
+    "paged_hbm_bytes_ratio",
     "migration_count",
     "migrate_ttft_gain",
     "raw_decode_tok_per_s_llama-3.1-8b-int8_kv8_b112_tpu",
@@ -91,7 +97,7 @@ HIGHER_BETTER = (
     "layers_gbps",
 )
 LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms", "cow_copies_per_req",
-                "attn_us_per_cell")
+                "attn_us_per_cell", "attn_us_per_cell_paged")
 
 # absolute floors/ceilings applied regardless of baseline coverage (only
 # ever read with .get(): a floor for a metric the record lacks must skip,
@@ -114,6 +120,12 @@ ABS_MIN = {
     # paged KV: the oversubscribed 90%-shared sweep must multiply admitted
     # slots at least 3x at equal HBM budget (peak logical/physical blocks)
     "paged_admit_ratio": 3.0,
+    # physical block pool: peak contiguous-equivalent HBM bytes over peak
+    # physical bytes. 2.5 (not 3.0) because the numerator charges the real
+    # prefix-cache rows the contiguous arena keeps resident, while the
+    # denominator includes the pool's one shared copy — honest accounting
+    # sits a little under the slot-count admit ratio
+    "paged_hbm_bytes_ratio": 2.5,
     # KV migration: the 2-engine oversubscribed sweep must actually move
     # work (at least one snapshot or queued-steal) and the drained leg's
     # admitted p95 TTFT must be no worse than shedding-only — a gain under

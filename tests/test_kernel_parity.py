@@ -223,6 +223,169 @@ def test_mla_block_cap_boundary(monkeypatch):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+# -- block-indirect (paged) arms ---------------------------------------------
+#
+# Construction: start from a CONTIGUOUS reference cache, force blocks
+# [0, nshared) of every slot to identical bytes (the shared prefix), move
+# ONE copy of those blocks into the pool, point every slot's table at the
+# pool rows, and scramble the arena's donor region with garbage. The paged
+# arm on (scrambled arena + table + pool) must match the plain contiguous
+# fallback on the reference — proving every shared read really goes
+# through the table. nshared tracks the fill level, so 0% runs the
+# identity-table case and 90% redirects every block including the one
+# holding the write position (the kernels' exact current-row override).
+
+
+def _paged_split(tree, bt, nshared, pxb, rng):
+    """(ref, arena, pool, tables) for a cache pytree of [L,B,H,S,...]
+    leaves. Physical ids < B*nbs are arena homes (slot p//nbs, block
+    p%nbs); ids >= B*nbs index pool rows — the same mapping
+    executor/physical.py maintains."""
+    if isinstance(tree, dict):
+        parts = {k: _paged_split(v, bt, nshared, pxb, rng) for k, v in tree.items()}
+        return tuple({k: v[i] for k, v in parts.items()} for i in range(3))
+    x = np.array(tree)
+    L, B, H, S = x.shape[:4]
+    for j in range(nshared):  # shared prefix: one content for every slot
+        x[:, :, :, j * bt:(j + 1) * bt] = x[:, :1, :, j * bt:(j + 1) * bt]
+    ref = x.copy()
+    pool = np.zeros((L, pxb, H, bt) + x.shape[4:], x.dtype)
+    for j in range(nshared):
+        pool[:, j] = x[:, 0, :, j * bt:(j + 1) * bt]
+        blk = x[:, :, :, j * bt:(j + 1) * bt]
+        junk = (
+            rng.integers(-127, 128, blk.shape)
+            if np.issubdtype(x.dtype, np.integer)
+            else rng.standard_normal(blk.shape)
+        )
+        x[:, :, :, j * bt:(j + 1) * bt] = junk.astype(x.dtype)
+    return jnp.asarray(ref), jnp.asarray(x), jnp.asarray(pool)
+
+
+def _paged_tables(B, nbs, nshared):
+    tbl = np.arange(B * nbs, dtype=np.int32).reshape(B, nbs)
+    tbl[:, :nshared] = B * nbs + np.arange(nshared, dtype=np.int32)
+    return jnp.asarray(tbl)
+
+
+# The paged arms follow the leak-soak precedent: the production
+# configuration (packed scales; and for bf16/MLA the mid-fill case that
+# exercises both shared and private blocks) runs in tier-1, the rest of
+# the fill x pack grid is slow-marked and covered by `-m slow` runs.
+@pytest.mark.parametrize(
+    "pack", [pytest.param("0", marks=pytest.mark.slow), "1"])
+@pytest.mark.parametrize("fill", FILLS)
+def test_q8_gqa_paged_parity(monkeypatch, fill, pack):
+    """Block-indirect fused-q8 kernel (packed and unpacked) vs the plain
+    contiguous fallback on the pre-split reference cache."""
+    monkeypatch.setenv("LLM_MCP_TPU_Q8_DECODE", "paged")
+    monkeypatch.setenv("LLM_MCP_TPU_Q8_SCALE_PACK", pack)
+    A.decode_attend_q8.clear_cache()
+    rng = np.random.default_rng(21)
+    L, B, Hkv, S, hd, G, bt = 2, 3, 2, 256, 64, 2, 64
+    nbs = S // bt
+    nshared = min(nbs, round(fill * nbs))
+    ck, cv = _fused_q8_cache(rng, L, B, Hkv, S, hd)
+    ref, arena, pool = _paged_split(ck, bt, nshared, nbs, rng)
+    tbl = _paged_tables(B, nbs, nshared)
+    q = jnp.asarray(rng.standard_normal((B, Hkv, G, hd)), jnp.float32)
+    nk = jnp.asarray(rng.standard_normal((B, Hkv, hd)), jnp.float32)
+    nv = jnp.asarray(rng.standard_normal((B, Hkv, hd)), jnp.float32)
+    lens = _lens_for(fill, B, S, rng)
+    ids = jnp.asarray(rng.permutation(B), jnp.int32)
+    out = A.decode_attend_q8(
+        q, nk, nv, arena, cv, jnp.int32(1), lens, slot_ids=ids,
+        block_tables=tbl, pool_k=pool, interpret=True,
+    )
+    want = A._decode_attend_q8_fallback(
+        q, nk, nv, ref, cv, jnp.int32(1), lens, hd**-0.5, ids
+    )
+    assert float(jnp.max(jnp.abs(out - want))) < 0.05
+    assert not bool(jnp.isnan(out).any())
+
+
+@pytest.mark.parametrize(
+    "fill", [pytest.param(0.0, marks=pytest.mark.slow), 0.4,
+             pytest.param(0.9, marks=pytest.mark.slow)])
+def test_bf16_gqa_paged_parity(monkeypatch, fill):
+    """Block-indirect bf16 kernel vs the contiguous fallback on the
+    reference: f32 caches on CPU, so both sides run exact math."""
+    monkeypatch.setenv("LLM_MCP_TPU_BF16_DECODE", "paged")
+    A.decode_attend_bf16.clear_cache()
+    rng = np.random.default_rng(22)
+    L, B, Hkv, S, hd, G, bt = 2, 3, 2, 256, 64, 2, 64
+    nbs = S // bt
+    nshared = min(nbs, round(fill * nbs))
+    ck = jnp.asarray(rng.standard_normal((L, B, Hkv, S, hd)), jnp.float32)
+    cv = jnp.asarray(rng.standard_normal((L, B, Hkv, S, hd)), jnp.float32)
+    ref_k, arena_k, pool_k = _paged_split(ck, bt, nshared, nbs, rng)
+    ref_v, arena_v, pool_v = _paged_split(cv, bt, nshared, nbs, rng)
+    tbl = _paged_tables(B, nbs, nshared)
+    q = jnp.asarray(rng.standard_normal((B, Hkv, G, hd)), jnp.float32)
+    nk = jnp.asarray(rng.standard_normal((B, Hkv, hd)), jnp.float32)
+    nv = jnp.asarray(rng.standard_normal((B, Hkv, hd)), jnp.float32)
+    lens = _lens_for(fill, B, S, rng)
+    ids = jnp.asarray(rng.permutation(B), jnp.int32)
+    out = A.decode_attend_bf16(
+        q, nk, nv, arena_k, arena_v, jnp.int32(1), lens, slot_ids=ids,
+        block_tables=tbl, pool_k=pool_k, pool_v=pool_v, interpret=True,
+    )
+    want = A._decode_attend_bf16_fallback(
+        q, nk, nv, ref_k, ref_v, jnp.int32(1), lens, hd**-0.5, ids
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "fill", [pytest.param(0.0, marks=pytest.mark.slow), 0.4,
+             pytest.param(0.9, marks=pytest.mark.slow)])
+def test_mla_paged_parity(monkeypatch, fill):
+    """Block-indirect MLA latent kernel vs the contiguous fallback: one
+    table drives BOTH the latent and rope pools."""
+    monkeypatch.setenv("LLM_MCP_TPU_Q8_DECODE", "paged")
+    rng = np.random.default_rng(23)
+    L, B, S, R, dr, H, bt = 2, 3, 256, 64, 32, 4, 64
+    nbs = S // bt
+    nshared = min(nbs, round(fill * nbs))
+    cc, cr, qt, qr, nc, nr = _mla_args(rng, L, B, S, R, dr, H)
+    ref_c, arena_c, pool_c = _paged_split(cc, bt, nshared, nbs, rng)
+    ref_r, arena_r, pool_r = _paged_split(cr, bt, nshared, nbs, rng)
+    tbl = _paged_tables(B, nbs, nshared)
+    lens = _lens_for(fill, B, S, rng)
+    ids = jnp.asarray(rng.permutation(B), jnp.int32)
+    sc = (R + dr) ** -0.5
+    out = A.decode_attend_q8_mla(
+        qt, qr, nc, nr, arena_c, arena_r, jnp.int32(1), lens, slot_ids=ids,
+        block_tables=tbl, pool_c=pool_c, pool_r=pool_r, scale=sc,
+        interpret=True,
+    )
+    want = A._decode_attend_q8_mla_fallback(
+        qt, qr, nc, nr, ref_c, ref_r, jnp.int32(1), lens, sc, ids
+    )
+    assert float(jnp.max(jnp.abs(out - want))) < 0.05
+
+
+def test_paged_fallback_gather_matches_contiguous():
+    """`paged_gather` (the exact XLA gather every serve path uses on CPU)
+    reassembles the reference bit-for-bit from (arena, pool, table) — the
+    foundation the engine's greedy-identity guarantees rest on."""
+    rng = np.random.default_rng(24)
+    L, B, H, S, hd, bt = 2, 3, 2, 256, 16, 64
+    nbs = S // bt
+    x = jnp.asarray(rng.standard_normal((L, B, H, S, hd)), jnp.float32)
+    ref, arena, pool = _paged_split(x, bt, 2, nbs, rng)
+    tbl = _paged_tables(B, nbs, 2)
+    for layer in range(L):
+        got = A.paged_gather(arena[layer], pool[layer], tbl)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref[layer]))
+    # narrow-table prefix (the chunked-prefill read): first 2 blocks only,
+    # with nbs naming the FULL blocks-per-slot so physical ids decode right
+    got = A.paged_gather(arena[0], pool[0], tbl[:, :2], nbs=nbs)
+    np.testing.assert_array_equal(
+        np.asarray(got), np.asarray(ref[0][:, :, : 2 * bt])
+    )
+
+
 # -- append kernels ----------------------------------------------------------
 
 
@@ -281,6 +444,9 @@ KERNEL_PARITY = {
     "_attend_q8_mla_blocked_kernel": ("tests/test_kernel_parity.py", "test_mla_blocked_parity"),
     "_append_q8_kernel": ("tests/test_kernel_parity.py", "test_append_q8_kernel_parity"),
     "_append_bf16_kernel": ("tests/test_kernel_parity.py", "test_append_bf16_kernel_parity"),
+    "_attend_q8_paged_kernel": ("tests/test_kernel_parity.py", "test_q8_gqa_paged_parity"),
+    "_attend_bf16_paged_kernel": ("tests/test_kernel_parity.py", "test_bf16_gqa_paged_parity"),
+    "_attend_q8_mla_paged_kernel": ("tests/test_kernel_parity.py", "test_mla_paged_parity"),
 }
 
 

@@ -12,7 +12,8 @@ Four layers of coverage:
   3. Engine integration on the CPU backend — the ledger is always on, a
      prefix-cache hit pins blocks instead of allocating, COW fires exactly
      when the stored prefix isn't block-aligned, a preempted shared slot
-     snapshots ONLY its private rows, and a threaded
+     snapshots ONLY its private rows, TPU_PAGED_PHYSICAL=0 is a
+     token-identical true no-op vs the physical block pool, and a threaded
      admit/diverge/finish/preempt soak quiesces with zero leaked and zero
      double-freed blocks for all four cache layouts.
   4. SliceEngine mirrored variant — the leader's flushed ("blk", ops)
@@ -504,6 +505,65 @@ def test_shared_preempt_snapshots_private_rows_only(monkeypatch):
         assert eng.total_errors == 0
     finally:
         eng.shutdown()
+
+
+def test_physical_escape_hatch_identity(monkeypatch):
+    """TPU_PAGED_PHYSICAL=0 is a TRUE no-op: greedy output across prefix
+    hits and a preempt -> restore cycle is token-identical to the physical
+    block-pool engine. Runs at block_tokens=64 (inside the pool-eligible
+    set — the default bt=16 of the other engine tests stays contiguous),
+    so the physical leg pins pool rows and gathers through slot tables
+    while the off leg re-materializes rows exactly as before ISSUE 10."""
+    monkeypatch.setenv("TPU_KV_HOST_OFFLOAD", "1")
+    texts: dict[str, dict[str, str]] = {}
+    prompts = [SHARED + f"hatch probe {i}?" for i in range(3)]
+    streams = (SHARED + "hatch stream one", SHARED + "hatch stream two")
+    for phys in ("1", "0"):
+        monkeypatch.setenv("TPU_PAGED_PHYSICAL", phys)
+        eng = _paged_engine(monkeypatch, block_tokens=64, max_slots=2)
+        got: dict[str, str] = {}
+        lock = threading.Lock()
+        try:
+            assert eng.paging_stats()["physical"] == (1.0 if phys == "1" else 0.0)
+            for p in prompts:
+                got[p] = eng.generate(p, max_tokens=8, temperature=0.0)["text"]
+            assert eng.prefix_cache_hits >= 1
+
+            def low(p):
+                r = eng.generate(p, max_tokens=32, temperature=0.0, priority=0)
+                with lock:
+                    got[p] = r["text"]
+
+            threads = [threading.Thread(target=low, args=(p,), daemon=True)
+                       for p in streams]
+            for t in threads:
+                t.start()
+            deadline = time.time() + 60
+            while eng.slots_in_use() < 2 and time.time() < deadline:
+                time.sleep(0.005)
+            assert eng.slots_in_use() == 2
+            hi = eng.generate("urgent", max_tokens=4, temperature=0.0,
+                              priority=5)
+            assert hi["usage"]["completion_tokens"] >= 1
+            for t in threads:
+                t.join(timeout=120)
+            assert not any(t.is_alive() for t in threads)
+            assert eng.memory_stats()["preempted_total"] >= 1
+            ps = eng.paging_stats()
+            assert ps["admit_shared_total"] >= 1.0
+            if phys == "1":
+                # the pool actually carried the sharing: the peak byte
+                # ratio is only emitted (and only moves) on pin-only
+                # physical admissions
+                assert ps.get("hbm_bytes_ratio_peak", 0.0) >= 1.0
+            else:
+                assert "hbm_bytes_ratio_peak" not in ps
+            _assert_engine_clean(eng)
+            assert eng.total_errors == 0
+            texts[phys] = got
+        finally:
+            eng.shutdown()
+    assert texts["1"] == texts["0"]
 
 
 # One layout runs in tier-1 to keep the fast suite inside its wall-clock
