@@ -295,6 +295,7 @@ def serve_path_metrics(
     sp0 = eng.speculation_stats()
     ms0 = eng.memory_stats()
     pg0 = eng.paging_stats()
+    sc0 = eng.scheduler_stats()
     ev0, dr0 = rec.events_total(), rec.dropped_events
     m0 = time.time()
     time.sleep(measure_s)
@@ -305,6 +306,7 @@ def serve_path_metrics(
     sp1 = eng.speculation_stats()
     ms1 = eng.memory_stats()
     pg1 = eng.paging_stats()
+    sc1 = eng.scheduler_stats()
     ev1, dr1 = rec.events_total(), rec.dropped_events
     m1 = time.time()
     # engine-loop budget over the window: where each wall-clock second of
@@ -429,6 +431,32 @@ def serve_path_metrics(
     # Degenerate-window evidence (a run where decode is broken still serves
     # prefill first-tokens at a plausible-looking rate — VERDICT r2 recorded
     # 26 tok/s of pure first-tokens as the metric of record):
+    # prefill economy over the window (scheduler true-vs-padded token
+    # counters + the compile ledger): true prompt tok/s, the pad-waste the
+    # staging shape cost on top of them, and how many distinct prefill
+    # executables the run minted — the ragged path's whole thesis is the
+    # last two numbers going down while the first goes up
+    pf_true = sc1.get("prefill_true_tokens", 0.0) - sc0.get(
+        "prefill_true_tokens", 0.0
+    )
+    pf_padded = sc1.get("prefill_padded_tokens", 0.0) - sc0.get(
+        "prefill_padded_tokens", 0.0
+    )
+    if pf_padded > 0:
+        out["prefill_tok_per_s"] = round(pf_true / wall, 1)
+        out["prefill_pad_waste_pct"] = round(
+            100.0 * (1.0 - pf_true / pf_padded), 1
+        )
+    from llm_mcp_tpu.telemetry.recorder import get_compile_ledger
+
+    _PREFILL_PHASES = ("chunk", "pf_rag", "fused", "fused_rag")
+    out["prefill_executables"] = float(
+        sum(
+            1
+            for row in get_compile_ledger().table()
+            if row.get("phase") in _PREFILL_PHASES
+        )
+    )
     out["window_errors"] = float(err1 - err0)
     finished = fin1 - fin0
     if finished > 0:
@@ -1308,6 +1336,17 @@ def main() -> None:
                 # repetitive sweep in secondary is its best case)
                 line["spec_accept_rate"] = round(serve["spec_accept_rate"], 3)
                 line["spec_tok_per_call"] = round(serve["spec_tok_per_call"], 2)
+            if "prefill_tok_per_s" in serve:
+                # prefill economy over the headline window, promoted where
+                # scripts/perf_gate.py reads it: true prompt tok/s (floor),
+                # pad-waste of the staging shape (ceiling), and the distinct
+                # prefill executable count from the compile ledger — the
+                # ragged packed path's whole case is these moving together
+                line["prefill_tok_per_s"] = serve["prefill_tok_per_s"]
+                line["prefill_pad_waste_pct"] = serve["prefill_pad_waste_pct"]
+                line["prefill_executables"] = serve.get(
+                    "prefill_executables", 0.0
+                )
             if "oversub_kv_preempted" in secondary:
                 # the oversubscription sweep's pool counters, promoted into
                 # the line of record: preempt/restore churn, sheds, and the

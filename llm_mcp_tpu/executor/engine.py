@@ -47,7 +47,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..kernels.attention import pallas_supported, resolve_attn_impl, resolve_decode_impl
+from ..kernels.attention import (
+    pallas_supported,
+    ragged_prefill_max_tokens,
+    resolve_attn_impl,
+    resolve_decode_impl,
+    resolve_ragged_impl,
+)
 from ..utils.faults import maybe_fail
 from ..models.configs import ModelConfig, resolve_config
 from ..models.weights import load_llama_checkpoint
@@ -56,12 +62,14 @@ from ..models.llama import (
     init_kv_cache,
     llama_prefill,
     llama_prefill_chunk_batch,
+    llama_prefill_chunk_ragged,
     llama_decode_step,
     quantize_kv,
 )
 from ..ops.sampling import sample_tokens, spec_verify
 from ..parallel.sharding import (
     llama_param_specs, kv_cache_specs, kv_pool_specs, shard_pytree,
+    supports_ragged_prefill,
 )
 from ..telemetry import recorder as flight
 from ..telemetry import tracing
@@ -233,6 +241,7 @@ class _DispatchedRound:
     t0: float
     rid: int = 0  # monotonic round id (slot-reuse cooling fence)
     prefill_tokens: int = 0  # fused chunk-group tokens (scheduler cost attribution)
+    prefill_padded: int = 0  # dispatched token shape incl. pads (pad-waste EMA)
 
 
 @dataclass
@@ -270,14 +279,21 @@ class _PrefillGroup:
     are active (pure-prefill window, back-to-back)."""
 
     metas: list  # [(slot, _PrefillState, n)] — n = valid tokens this chunk
-    tokens: Any  # np [Ab, bucket]
-    slots_arr: Any  # np [Ab]
-    starts_arr: Any  # np [Ab]
-    nv_arr: Any  # np [Ab]
-    bucket: int
+    tokens: Any  # np [Ab, bucket] (ragged: np [T] packed token buffer)
+    slots_arr: Any  # np [Ab] (ragged: np [R])
+    starts_arr: Any  # np [Ab] (ragged: np [R])
+    nv_arr: Any  # np [Ab] (ragged: np [R])
+    bucket: int  # ragged: the packed buffer length T
     skey: int
     n_tokens: int  # total valid tokens staged (≤ the round's budget)
-    logits: Any = None  # device [Ab, V] once dispatched
+    logits: Any = None  # device [Ab, V] once dispatched (ragged: [R, V])
+    # Ragged packed descriptors (tentpole path — _stage_ragged_group). metas
+    # row i ↔ descriptor row i, so finish/fail indexing is shared with the
+    # bucketed path.
+    ragged: bool = False
+    rowids_arr: Any = None  # np [T] — row id per packed token (pads = R)
+    positions_arr: Any = None  # np [T] — cache position (pads = max_seq_len)
+    last_idx_arr: Any = None  # np [R] — packed index of each row's last token
 
 
 class GenerationEngine:
@@ -497,7 +513,9 @@ class GenerationEngine:
                 allowed[bad] = False
         self._allowed_mask = jnp.asarray(allowed) if not allowed.all() else None
 
-        self._decode_fn, self._fused_fn = self._build_decode()
+        self._decode_fn, self._fused_fn, self._fused_ragged_fn = (
+            self._build_decode()
+        )
         mask = self._allowed_mask
         cfg_ = self.cfg
 
@@ -536,6 +554,48 @@ class GenerationEngine:
                 and cfg_.vocab_size % axes.get("tp", 1) == 0
             ):
                 self.sp = axes["sp"]
+
+        # Ragged packed prefill (kernels/attention.py ragged_* family): the
+        # chunked-prefill path of record when available. Fixed-shape packed
+        # token buffer + per-row (slot, start, len) descriptors → zero pad
+        # compute and ONE executable per (T, layout) instead of the bucketed
+        # (Ab, bucket, skey) zoo. TPU_RAGGED_PREFILL=0 restores the bucketed
+        # path bit-identically (the gate only selects the staging branch).
+        # Gated to the same single-program regime as the prefix cache: no sp
+        # ring, no mesh, and the model families the ragged kernels cover
+        # (windows/softcaps stay bucketed).
+        self.ragged_prefill = (
+            os.environ.get("TPU_RAGGED_PREFILL", "1")
+            not in ("", "0", "false", "no", "off")
+            and self.prefill_chunk > 0
+            and self.sp == 1
+            and supports_ragged_prefill(mesh)
+            and not cfg_.sliding_window
+            and not cfg_.attn_softcap
+        )
+        self._ragged_impl = resolve_ragged_impl() if self.ragged_prefill else ""
+        if self.ragged_prefill:
+            hd = cfg_.resolved_head_dim
+            cap = min(
+                max(self.admit_batch * self.prefill_chunk, 1),
+                ragged_prefill_max_tokens(
+                    hd,
+                    cfg_.n_kv_heads,
+                    latent=cfg_.kv_lora_rank,
+                    rope_dim=cfg_.qk_rope_head_dim if cfg_.kv_lora_rank else 0,
+                ),
+            )
+            # pow2 floor: packed buffer lengths ride the pow2 ladder (the
+            # kernel tiles T by block_q and asserts divisibility), so the cap
+            # itself must sit on the ladder or a full group would bucket past
+            # the VMEM budget ragged_prefill_max_tokens derived.
+            self._ragged_cap = 1 << (cap.bit_length() - 1)
+            log.info(
+                "ragged prefill enabled: impl=%s cap=%d tokens",
+                self._ragged_impl, self._ragged_cap,
+            )
+        else:
+            self._ragged_cap = 0
 
         kv_q = self.kv_quant == "int8"
         # quantized GQA caches use the FUSED single-payload layout
@@ -778,10 +838,21 @@ class GenerationEngine:
                 paged=paged,
             )
 
+        @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("skey",))
+        def ragged_chunk_fn(params, ck, cv, tokens, rowids, positions, slots,
+                            starts, last_idx, skey, paged=None):
+            # standalone ragged dispatch (pure-prefill window); same trailing-
+            # `paged` / donation contract as prefill_chunk_fn
+            return llama_prefill_chunk_ragged(
+                cfg_, params, ck, cv, tokens, rowids, positions, slots,
+                starts, last_idx, skey=skey, paged=paged,
+            )
+
         self._admit_fn = admit_fn
         self._insert_cached_fn = insert_cached_fn
         self._insert_at_fn = insert_at_fn
         self._prefill_chunk_fn = prefill_chunk_fn
+        self._ragged_chunk_fn = ragged_chunk_fn
         # Prompt-prefix KV cache (vLLM-style prefix reuse, exact-prefix
         # match): production chat traffic repeats long shared prefixes
         # (system prompts, few-shot preambles) across requests; their KV is
@@ -1196,7 +1267,29 @@ class GenerationEngine:
             )
             return out, p_logits, ck, cv, d_last
 
-        return decode_chunk_fn, fused_step_fn
+        @partial(
+            jax.jit, donate_argnums=(1, 2, 7),
+            static_argnames=("compact", "skey"),
+        )
+        def fused_ragged_fn(params, ck, cv, packed, d_temp, d_topk, d_topp,
+                            d_last, p_tokens, p_rowids, p_positions, p_slots,
+                            p_starts, p_last_idx, compact, skey, paged=None):
+            """fused_step_fn's ragged twin: the chunk group rides the packed
+            token buffer + per-row descriptors instead of [Ab, bucket] pads,
+            so ONE executable per (T, compact) covers every fill mix (the
+            bucketed zoo minted one per (Ab, bucket, skey)). Same disjoint-
+            slot argument as fused_step_fn."""
+            out, ck, cv, d_last = decode_body(
+                params, ck, cv, packed, d_temp, d_topk, d_topp, d_last,
+                compact, paged=paged,
+            )
+            p_logits, ck, cv = llama_prefill_chunk_ragged(
+                cfg, params, ck, cv, p_tokens, p_rowids, p_positions,
+                p_slots, p_starts, p_last_idx, skey=skey, paged=paged,
+            )
+            return out, p_logits, ck, cv, d_last
+
+        return decode_chunk_fn, fused_step_fn, fused_ragged_fn
 
     def _build_verify(self):
         """Jitted speculative verify: ONE model call over [token, draft_1..
@@ -3427,6 +3520,8 @@ class GenerationEngine:
         )
         if budget <= 0:
             return None
+        if self.ragged_prefill:
+            return self._stage_ragged_group(budget)
         group: list[int] = []
         metas: list[tuple[int, _PrefillState, int]] = []
         try:  # staging bugs must also fail over to waiters
@@ -3490,6 +3585,90 @@ class GenerationEngine:
             )
             return None
 
+    def _stage_ragged_group(self, budget: int) -> _PrefillGroup | None:
+        """Ragged staging (the tentpole path): pack up to admit_batch slots'
+        next chunks back-to-back into ONE [T] token buffer with per-token
+        (rowid, position) and per-row (slot, start) descriptors — no
+        (bucket, skey) join constraint, no pad rows, and each row is charged
+        its TRUE token count against the budget (the bucketed path charges
+        true tokens too but dispatches bucket-padded compute; here the pad
+        tail is only T - total ≤ the pow2 rounding). T rides the pow2 ladder
+        capped at _ragged_cap, so every fill mix reuses one executable per
+        packed length."""
+        R = max(1, self.admit_batch)
+        S = self.max_seq_len
+        picked: list[tuple[int, _PrefillState, int, int]] = []
+        metas: list[tuple[int, _PrefillState, int]] = []
+        try:
+            used = 0
+            max_start = 0
+            cap = min(budget, self._ragged_cap)
+            for slot in list(self._prefill_q):
+                if len(picked) >= R or used >= cap:
+                    break
+                st = self._prefills[slot]
+                start = st.done
+                n = min(self.prefill_chunk, len(st.ids) - start, cap - used)
+                if n <= 0:
+                    continue
+                picked.append((slot, st, start, n))
+                used += n
+                max_start = max(max_start, start)
+            if not picked:
+                return None
+            T = pow2_bucket(used, self._ragged_cap, floor=min(32, self._ragged_cap))
+            tokens = np.zeros((T,), dtype=np.int32)
+            rowids = np.full((T,), R, dtype=np.int32)  # pads → dropped writes
+            positions = np.full((T,), S, dtype=np.int32)
+            slots_arr = np.zeros((R,), dtype=np.int32)
+            starts_arr = np.zeros((R,), dtype=np.int32)
+            nv_arr = np.zeros((R,), dtype=np.int32)
+            last_idx = np.zeros((R,), dtype=np.int32)
+            off = 0
+            for i, (slot, st, start, n) in enumerate(picked):
+                tokens[off : off + n] = st.ids[start : start + n]
+                rowids[off : off + n] = i
+                positions[off : off + n] = np.arange(start, start + n)
+                slots_arr[i] = slot
+                starts_arr[i] = start
+                nv_arr[i] = n
+                last_idx[i] = off + n - 1
+                metas.append((slot, st, n))
+                off += n
+            # the kernel arm ignores skey entirely (data-dependent block
+            # trips) — pass 0 so TPU mints ONE executable per T; the XLA arm
+            # (CPU) keeps the bucketed-style static past bound for compile
+            # cache reuse without whole-S gathers on short prefixes.
+            if self._ragged_impl == "kernel":
+                skey = 0
+            else:
+                skey = (
+                    min(pow2_bucket(max_start, S), S)
+                    if max_start
+                    else min(128, S)
+                )
+            return _PrefillGroup(
+                metas=metas, tokens=tokens, slots_arr=slots_arr,
+                starts_arr=starts_arr, nv_arr=nv_arr,
+                bucket=T, skey=skey, n_tokens=used, ragged=True,
+                rowids_arr=rowids, positions_arr=positions,
+                last_idx_arr=last_idx,
+            )
+        except Exception as e:
+            self._fail_prefill_group(
+                _PrefillGroup(
+                    metas=metas or [
+                        (s, self._prefills[s], 0)
+                        for s in self._prefill_q
+                        if s in self._prefills
+                    ],
+                    tokens=None, slots_arr=None, starts_arr=None,
+                    nv_arr=None, bucket=0, skey=0, n_tokens=0,
+                ),
+                e,
+            )
+            return None
+
     def _dispatch_prefill_group(self, group: _PrefillGroup) -> None:
         """Standalone chunk dispatch for a pure-prefill window (no decode
         rows active — nothing to fuse with). Synchronous: the measured wall
@@ -3498,6 +3677,36 @@ class GenerationEngine:
             maybe_fail(
                 "engine.prefill", f"slots={[s for s, _, _ in group.metas]}"
             )
+            if group.ragged:
+                # packed ragged dispatch: compiled shape is (T, skey, phys)
+                # only — fill mix rides the descriptors, not the executable
+                first = self._note_exec_shape("pf_rag", group.bucket,
+                                              group.skey,
+                                              self._phys is not None)
+                t0 = time.perf_counter()
+                group.logits, self._ck, self._cv = self._ragged_chunk_fn(
+                    self.params, self._ck, self._cv, group.tokens,
+                    group.rowids_arr, group.positions_arr, group.slots_arr,
+                    group.starts_arr, group.last_idx_arr, group.skey,
+                    paged=self._paged_arg(),
+                )
+                jax.block_until_ready(self._ck)
+                wall = time.perf_counter() - t0
+                if first:
+                    self._compile_obs(
+                        "pf_rag",
+                        (group.bucket, group.skey, self._phys is not None),
+                        wall,
+                    )
+                self._sched.observe_prefill(
+                    group.n_tokens, wall, padded_tokens=group.bucket
+                )
+                self._flight.event(
+                    "pf_rag", rows=len(group.metas), tokens=group.n_tokens,
+                    packed=group.bucket, wall_ms=round(wall * 1e3, 2),
+                )
+                self._finish_prefill_group(group)
+                return
             first = self._note_exec_shape("chunk", group.tokens.shape[0],
                                           group.bucket, group.skey,
                                           self._phys is not None)
@@ -3515,7 +3724,10 @@ class GenerationEngine:
                     (group.tokens.shape[0], group.bucket, group.skey,
                      self._phys is not None), wall,
                 )
-            self._sched.observe_prefill(group.n_tokens, wall)
+            self._sched.observe_prefill(
+                group.n_tokens, wall,
+                padded_tokens=group.tokens.shape[0] * group.bucket,
+            )
             self._flight.event(
                 "chunk", rows=len(group.metas), tokens=group.n_tokens,
                 bucket=group.bucket, wall_ms=round(wall * 1e3, 2),
@@ -3820,38 +4032,73 @@ class GenerationEngine:
             maybe_fail(
                 "engine.prefill", f"slots={[s for s, _, _ in group.metas]}"
             )
-            first = self._note_exec_shape(
-                "fused", Ba, compact, group.tokens.shape[0],
-                group.bucket, group.skey, self._phys is not None,
-            )
-            t0c = time.perf_counter()
-            (out, group.logits, self._ck, self._cv,
-             self._d_last_tok) = self._fused_fn(
-                self.params,
-                self._ck,
-                self._cv,
-                jnp.asarray(packed),
-                self._d_temp,
-                self._d_topk,
-                self._d_topp,
-                self._d_last_tok,
-                group.tokens,
-                group.slots_arr,
-                group.starts_arr,
-                group.nv_arr,
-                compact=compact,
-                skey=group.skey,
-                paged=self._paged_arg(),
-            )
-            if first:
-                # dispatch is async but jit trace+compile is synchronous —
-                # the first call's wall time is dominated by the compile
-                self._compile_obs(
-                    "fused",
-                    (Ba, compact, group.tokens.shape[0], group.bucket,
-                     group.skey, self._phys is not None),
-                    time.perf_counter() - t0c,
+            if group.ragged:
+                first = self._note_exec_shape(
+                    "fused_rag", Ba, compact, group.bucket, group.skey,
+                    self._phys is not None,
                 )
+                t0c = time.perf_counter()
+                (out, group.logits, self._ck, self._cv,
+                 self._d_last_tok) = self._fused_ragged_fn(
+                    self.params,
+                    self._ck,
+                    self._cv,
+                    jnp.asarray(packed),
+                    self._d_temp,
+                    self._d_topk,
+                    self._d_topp,
+                    self._d_last_tok,
+                    group.tokens,
+                    group.rowids_arr,
+                    group.positions_arr,
+                    group.slots_arr,
+                    group.starts_arr,
+                    group.last_idx_arr,
+                    compact=compact,
+                    skey=group.skey,
+                    paged=self._paged_arg(),
+                )
+                if first:
+                    self._compile_obs(
+                        "fused_rag",
+                        (Ba, compact, group.bucket, group.skey,
+                         self._phys is not None),
+                        time.perf_counter() - t0c,
+                    )
+            else:
+                first = self._note_exec_shape(
+                    "fused", Ba, compact, group.tokens.shape[0],
+                    group.bucket, group.skey, self._phys is not None,
+                )
+                t0c = time.perf_counter()
+                (out, group.logits, self._ck, self._cv,
+                 self._d_last_tok) = self._fused_fn(
+                    self.params,
+                    self._ck,
+                    self._cv,
+                    jnp.asarray(packed),
+                    self._d_temp,
+                    self._d_topk,
+                    self._d_topp,
+                    self._d_last_tok,
+                    group.tokens,
+                    group.slots_arr,
+                    group.starts_arr,
+                    group.nv_arr,
+                    compact=compact,
+                    skey=group.skey,
+                    paged=self._paged_arg(),
+                )
+                if first:
+                    # dispatch is async but jit trace+compile is synchronous
+                    # — the first call's wall time is dominated by the
+                    # compile
+                    self._compile_obs(
+                        "fused",
+                        (Ba, compact, group.tokens.shape[0], group.bucket,
+                         group.skey, self._phys is not None),
+                        time.perf_counter() - t0c,
+                    )
         else:
             first = self._note_exec_shape("decode", Ba, compact,
                                           self._phys is not None)
@@ -3886,8 +4133,16 @@ class GenerationEngine:
         # one lock acquisition per round; a no-op inside a block)
         self._paging.extend_many({b: int(self._lengths[b]) for b in active})
         self._rid_dispatched += 1
+        if group is not None:
+            padded = (
+                group.bucket if group.ragged
+                else group.tokens.shape[0] * group.bucket
+            )
+        else:
+            padded = 0
         self._flight.event(
-            "fused" if group is not None else "decode",
+            ("fused_rag" if group.ragged else "fused")
+            if group is not None else "decode",
             rid=self._rid_dispatched, rows=len(active),
             prefill_tokens=group.n_tokens if group is not None else 0,
         )
@@ -3895,6 +4150,7 @@ class GenerationEngine:
             out=out, entries=entries, base=base, t0=round_t0,
             rid=self._rid_dispatched,
             prefill_tokens=group.n_tokens if group is not None else 0,
+            prefill_padded=padded,
         )
 
     def _complete_round(self, disp: _DispatchedRound) -> _PendingRound:
@@ -3915,7 +4171,9 @@ class GenerationEngine:
         # that EMA to the chunk group's prompt tokens
         dt = time.perf_counter() - disp.t0
         if disp.prefill_tokens:
-            self._sched.observe_fused(dt, disp.prefill_tokens)
+            self._sched.observe_fused(
+                dt, disp.prefill_tokens, padded_tokens=disp.prefill_padded
+            )
         else:
             self._sched.observe_decode(dt)
         K = out.shape[0]

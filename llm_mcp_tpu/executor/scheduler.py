@@ -67,6 +67,17 @@ class TokenBudgetScheduler:
         self.starved_rounds = 0
         self.verify_rounds = 0
         self.verify_tokens = 0
+        # Pad-waste accounting (ragged-prefill line of record): dispatches
+        # report both their TRUE token count and the DISPATCHED shape
+        # (rows × bucket for the padded path, packed T for ragged). The
+        # per-token cost EMA divides by the dispatched count — compute
+        # scales with pads, and attributing pad time to true tokens
+        # inflated the EMA and shrank fair_cap under mixed fill (the
+        # pre-ragged bug this fixes). The cumulative totals feed the
+        # prefill_pad_waste_pct stat bench promotes to the line of record.
+        self.prefill_true_tokens = 0
+        self.prefill_padded_tokens = 0
+        self.pad_waste = 0.0  # EMA of per-dispatch waste fraction
 
     # -- cost observation --------------------------------------------------
 
@@ -75,20 +86,35 @@ class TokenBudgetScheduler:
         if round_s > 0:
             self.decode_round_s = _EMA * self.decode_round_s + (1 - _EMA) * round_s
 
-    def observe_prefill(self, tokens: int, seconds: float) -> None:
-        """A standalone chunk dispatch: `tokens` prompt tokens in `seconds`."""
+    def observe_prefill(
+        self, tokens: int, seconds: float, padded_tokens: int = 0
+    ) -> None:
+        """A standalone chunk dispatch: `tokens` TRUE prompt tokens in
+        `seconds`. `padded_tokens` is the dispatched token shape (≥ tokens;
+        0 ⇒ unknown, treated as un-padded): the cost EMA divides by it —
+        the device computed every pad — while the waste ratio records how
+        much of the dispatch was pads."""
         if tokens <= 0 or seconds <= 0:
             return
-        per = min(1.0, max(1e-8, seconds / tokens))
+        comp = max(int(tokens), int(padded_tokens))
+        per = min(1.0, max(1e-8, seconds / comp))
         self.prefill_tok_s = _EMA * self.prefill_tok_s + (1 - _EMA) * per
+        self.prefill_true_tokens += int(tokens)
+        self.prefill_padded_tokens += comp
+        waste = 1.0 - tokens / comp
+        self.pad_waste = _EMA * self.pad_waste + (1 - _EMA) * waste
 
-    def observe_fused(self, round_s: float, prefill_tokens: int) -> None:
+    def observe_fused(
+        self, round_s: float, prefill_tokens: int, padded_tokens: int = 0
+    ) -> None:
         """A fused round: attribute the time over the decode EMA to its
         prefill tokens. Rounds faster than the EMA teach nothing (the
         residual would be negative)."""
         extra = round_s - self.decode_round_s
         if prefill_tokens > 0 and extra > 0:
-            self.observe_prefill(prefill_tokens, extra)
+            self.observe_prefill(
+                prefill_tokens, extra, padded_tokens=padded_tokens
+            )
 
     def observe_verify(self, tokens: int, seconds: float) -> None:
         """A speculative verify dispatch: `tokens` chunk positions (the base
@@ -102,8 +128,14 @@ class TokenBudgetScheduler:
     # -- policy ------------------------------------------------------------
 
     def fair_cap(self) -> int:
-        """Prefill tokens whose estimated device time ≈ one decode round."""
-        return max(self.min_budget, int(self.decode_round_s / self.prefill_tok_s))
+        """Prefill tokens whose estimated device time ≈ one decode round.
+        The budget is granted in TRUE tokens but a padded dispatch computes
+        its pads too — discount by the observed waste EMA so `cap` true
+        tokens of staging still land ≈ one decode round of device time
+        (under ragged prefill the waste EMA ≈ 0 and the discount vanishes)."""
+        cap = self.decode_round_s / self.prefill_tok_s
+        cap *= max(0.0, 1.0 - self.pad_waste)
+        return max(self.min_budget, int(cap))
 
     def decide(
         self,
@@ -183,4 +215,12 @@ class TokenBudgetScheduler:
             "fair_cap_tokens": float(self.fair_cap()),
             "verify_rounds": float(self.verify_rounds),
             "verify_tokens": float(self.verify_tokens),
+            "prefill_true_tokens": float(self.prefill_true_tokens),
+            "prefill_padded_tokens": float(self.prefill_padded_tokens),
+            "prefill_pad_waste_pct": (
+                100.0
+                * (1.0 - self.prefill_true_tokens / self.prefill_padded_tokens)
+                if self.prefill_padded_tokens
+                else 0.0
+            ),
         }

@@ -275,6 +275,13 @@ class SliceEngine:
         self.max_seq_len = max_seq_len
         self.decode_chunk = decode_chunk
         self.prefill_chunk = max(0, prefill_chunk)
+        # Ragged packed prefill (GenerationEngine.ragged_prefill) stays OFF
+        # on the sliced path regardless of TPU_RAGGED_PREFILL: every follower
+        # replays broadcast dispatch commands by shape, and the ragged
+        # descriptors assume the single-program engine's slot/ledger
+        # ownership. Guarded passthrough — the bucketed chunk machinery below
+        # is the multi-host path of record.
+        self.ragged_prefill = False
         self.target_ttft_ms = max(1.0, float(target_ttft_ms))
         self.quant = quant
         self.tokenizer = tokenizer or load_tokenizer(weights_dir)
@@ -1491,7 +1498,10 @@ class SliceEngine:
                 tokens=sum(n for _, _, n in metas), bucket=f_bucket,
                 wall_ms=round(wall * 1e3, 1),
             )
-            self._sched.observe_prefill(sum(n for _, _, n in metas), wall)
+            self._sched.observe_prefill(
+                sum(n for _, _, n in metas), wall,
+                padded_tokens=Ab * f_bucket,
+            )
         except Exception as e:
             # fail the group's waiters HERE (the loop's crash handler drains
             # the rest): the donated cache died with the dispatch

@@ -2655,3 +2655,1038 @@ def decode_attention(
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, hd), q.dtype),
         interpret=interp,
     )(lengths.astype(jnp.int32), q, cache_k, cache_v)
+
+
+# ---------------------------------------------------------------------------
+# Ragged paged-native flash prefill (the chunked-prefill path of record)
+# ---------------------------------------------------------------------------
+#
+# One fixed-shape packed dispatch replaces the bucketed path's per-(bucket,
+# skey) executable zoo. A [T]-token buffer carries up to R rows' chunks
+# back-to-back: row r occupies packed positions [offsets[r], offsets[r+1]);
+# pads sit past offsets[R] with rowid == R and write position == S, so their
+# cache scatters DROP (the engine's parked-slot OOB convention). Each kernel
+# tiles q-blocks against
+#
+#   (a) the row's already-cached prefix, streamed block-indirect through the
+#       PR 10 per-slot tables (arena identity homes < pool_base, shared
+#       prefix pool rows >= pool_base — the same two-way `pl.when` descriptor
+#       resolution as `_attend_q8_paged_kernel`), masked `k_pos < starts[r]`;
+#   (b) the packed SELF segment from in-register K/V (exact bf16, even over
+#       an int8 cache — the chunk path's current-token override generalized),
+#       masked by segment equality + packed-index causal order.
+#
+# T and R are static; every descriptor (offsets, starts, tables) is data —
+# one executable per (T, layout) serves every fill mix. Masks come from the
+# row's segment BOUNDARIES (scalar-prefetch `offsets`, rows packed in
+# ascending order), not per-token rowid vectors: boundary compares are plain
+# 2-D iota-vs-scalar ops, which Mosaic vectorizes with no gather/relayout.
+#
+# Numerics mirror `llama_prefill_chunk_batch` / `mla_prefill_chunk_batch`:
+# raw dots accumulate in f32 (int8 values are exact in every wider dtype),
+# per-position dequant scales fold post-dot on the score AND value sides, and
+# the attn scale applies to scores after dequant. The kernels use online
+# softmax where the bucketed path takes one joint softmax — reductions
+# associate differently, so outputs agree to bf16 rounding, not bitwise; the
+# acceptance bar is greedy token identity (tests/test_kernel_parity.py).
+#
+# Sliding-window and softcap families are NOT covered — the engine gates
+# those to the bucketed path (`GenerationEngine._ragged` eligibility).
+
+
+def resolve_ragged_impl() -> str:
+    """Implementation for the ragged chunked-prefill attention.
+
+    env LLM_MCP_TPU_RAGGED_IMPL: auto (default) | kernel | xla.
+    auto → the Pallas kernels on a TPU chip, the exact packed XLA fallback
+    elsewhere (CPU serve; parity tests force `kernel` to exercise the
+    kernels in interpret mode). This only picks HOW a ragged dispatch
+    computes attention — whether ragged dispatch happens at all is the
+    engine's TPU_RAGGED_PREFILL gate."""
+    mode = os.environ.get("LLM_MCP_TPU_RAGGED_IMPL", "auto")
+    if mode in ("kernel", "xla"):
+        return mode
+    return "kernel" if _on_tpu() else "xla"
+
+
+def ragged_block_size(seq_len: int, block_tokens: int | None = None) -> int:
+    """KV block size for the ragged kernels' past streams. Under physical
+    paging it MUST equal the ledger's block_tokens (logical block j covers
+    exactly table entry j); unpaged identity tables pick the largest
+    MXU-friendly divisor of S."""
+    if block_tokens:
+        return block_tokens
+    for bs in (256, 128, 64, 32):
+        if seq_len % bs == 0 and bs <= seq_len:
+            return bs
+    return seq_len
+
+
+def ragged_prefill_max_tokens(
+    head_dim: int, n_kv_heads: int, *, latent: int = 0, rope_dim: int = 0
+) -> int:
+    """Largest packed-token capacity T the ragged kernels can hold in VMEM.
+
+    The self segment keeps the whole chunk's K/V (GQA: 2·Hkv·hd bf16 per
+    token; MLA: latent+rope bf16 per token) resident across q-tiles; the
+    past stream is double-buffered blocks (T-independent). 10 MB of the
+    ~16 MB budget bounds T, leaving headroom for q/out tiles, f32 score
+    tiles, and the MLA pre-gathered rope/scale rows."""
+    budget = 10 * 1024 * 1024
+    if latent:
+        per_tok = 2 * (latent + rope_dim)
+    else:
+        per_tok = 2 * 2 * n_kv_heads * head_dim
+    return max(256, budget // per_tok)
+
+
+def _seg_of(offs_ref, idx, n_rows: int):
+    """Descriptor row of packed index `idx` by counting crossed boundaries
+    (rows are packed contiguously ascending; pads land in segment n_rows)."""
+    seg = jnp.zeros(idx.shape, jnp.int32)
+    for r in range(1, n_rows + 1):
+        seg = seg + (idx >= offs_ref[r]).astype(jnp.int32)
+    return seg
+
+
+def _ragged_prefill_bf16_kernel(
+    li_ref,  # [1] int32 (scalar prefetch) — layer index
+    offs_ref,  # [R+1] int32 (scalar prefetch) — packed row boundaries
+    starts_ref,  # [R] int32 (scalar prefetch) — cached-prefix length per row
+    tbl_ref,  # [R * nbs] int32 (scalar prefetch) — flattened block tables
+    q_ref,  # [Hkv, BQ, G, hd] VMEM — this tile's post-rope queries
+    ks_ref,  # [Hkv, T, hd] VMEM — the chunk's own post-rope keys (packed)
+    vs_ref,  # [Hkv, T, hd] VMEM
+    ck_hbm,  # [L, B, Hkv, S, hd] ANY — arena K (identity homes)
+    cv_hbm,  # ANY — arena V
+    pk_hbm,  # [L, PXB, Hkv, bt, hd] ANY — prefix pool K
+    pv_hbm,  # ANY — prefix pool V
+    o_ref,  # [Hkv, BQ, G, hd] VMEM out
+    kbuf,  # VMEM scratch [2, Hkv, BS, hd] (double buffer)
+    vbuf,
+    sems,  # DMA semaphores [2, 2]
+    *,
+    scale: float,
+    block_s: int,
+    seq_len: int,
+    n_rows: int,
+):
+    """Ragged flash prefill over the split bf16 GQA cache: per packed q-tile,
+    one double-buffered block-indirect K/V stream per descriptor row (past),
+    then causal packed self tiles, all folded into one online softmax."""
+    qi = pl.program_id(0)
+    li = li_ref[0]
+    BS = block_s
+    Hkv, BQ, G, hd = q_ref.shape
+    nbs = seq_len // BS
+    pool_base = ck_hbm.shape[1] * nbs
+    t0 = qi * BQ
+
+    q = q_ref[...].astype(jnp.float32)  # [Hkv, BQ, G, hd]
+    t_idx = t0 + jax.lax.broadcasted_iota(jnp.int32, (BQ, 1), 0)  # packed idx
+
+    acc = jnp.zeros((Hkv, BQ, G, hd), jnp.float32)
+    m = jnp.full((Hkv, BQ, G, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((Hkv, BQ, G, 1), jnp.float32)
+
+    # ---- past segment: block-indirect stream per row with cached prefix
+    for r in range(n_rows):
+        w = starts_ref[r]
+        lo = offs_ref[r]
+        hi = offs_ref[r + 1]
+        # skip rows with no tokens in this tile or no cached prefix
+        use = (hi > lo) & (lo < t0 + BQ) & (hi > t0) & (w > 0)
+        nblk = jnp.where(use, jnp.minimum((w + BS - 1) // BS, nbs), 0)
+
+        def issue(j, slot, op, r=r):
+            phys = tbl_ref[r * nbs + j]
+            ina = phys < pool_base
+
+            @pl.when(ina)
+            def _arena():
+                arow = phys // nbs
+                aoff = (phys % nbs) * BS
+                for c in (
+                    pltpu.make_async_copy(
+                        ck_hbm.at[li, arow, :, pl.ds(aoff, BS), :],
+                        kbuf.at[slot],
+                        sems.at[slot, 0],
+                    ),
+                    pltpu.make_async_copy(
+                        cv_hbm.at[li, arow, :, pl.ds(aoff, BS), :],
+                        vbuf.at[slot],
+                        sems.at[slot, 1],
+                    ),
+                ):
+                    getattr(c, op)()
+
+            @pl.when(jnp.logical_not(ina))
+            def _pool():
+                prow = phys - pool_base
+                for c in (
+                    pltpu.make_async_copy(
+                        pk_hbm.at[li, prow], kbuf.at[slot], sems.at[slot, 0]
+                    ),
+                    pltpu.make_async_copy(
+                        pv_hbm.at[li, prow], vbuf.at[slot], sems.at[slot, 1]
+                    ),
+                ):
+                    getattr(c, op)()
+
+        @pl.when(nblk > 0)
+        def _warm(issue=issue):
+            issue(0, 0, "start")
+
+        sel_q = (t_idx >= lo) & (t_idx < hi)  # [BQ, 1]
+
+        def body(j, carry, issue=issue, sel_q=sel_q, w=w, nblk=nblk):
+            acc, m, l = carry
+            slot = jax.lax.rem(j, 2)
+
+            @pl.when(j + 1 < nblk)
+            def _pf():
+                issue(j + 1, 1 - slot, "start")
+
+            issue(j, slot, "wait")
+            k = kbuf[slot].astype(jnp.float32)  # [Hkv, BS, hd]
+            v = vbuf[slot].astype(jnp.float32)
+            s = (
+                jax.lax.dot_general(
+                    q, k, (((3,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )  # [Hkv, BQ, G, BS]
+            k_pos = j * BS + jax.lax.broadcasted_iota(jnp.int32, (1, BS), 1)
+            mask = (sel_q & (k_pos < w))[None, :, None, :]
+            s = jnp.where(mask, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc = acc * alpha + jax.lax.dot_general(
+                p, v, (((3,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            return acc, m_new, l
+
+        acc, m, l = jax.lax.fori_loop(0, nblk, body, (acc, m, l))
+
+    # ---- self segment: causal packed tiles, segment-equality masked
+    seg_q = _seg_of(offs_ref, t_idx, n_rows)  # [BQ, 1]
+
+    def sbody(tb, carry):
+        acc, m, l = carry
+        k = ks_ref[:, pl.ds(tb * BQ, BQ), :].astype(jnp.float32)
+        v = vs_ref[:, pl.ds(tb * BQ, BQ), :].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q, k, (((3,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [Hkv, BQ, G, BQk]
+        u_idx = tb * BQ + jax.lax.broadcasted_iota(jnp.int32, (1, BQ), 1)
+        seg_k = _seg_of(offs_ref, u_idx, n_rows)  # [1, BQk]
+        mask = ((seg_q == seg_k) & (u_idx <= t_idx))[None, :, None, :]
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((3,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_new, l
+
+    acc, m, l = jax.lax.fori_loop(0, qi + 1, sbody, (acc, m, l))
+    out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
+    o_ref[...] = out.astype(o_ref.dtype)
+
+
+def _ragged_prefill_q8_kernel(
+    li_ref,  # [1] int32 (scalar prefetch)
+    offs_ref,  # [R+1] int32 (scalar prefetch)
+    starts_ref,  # [R] int32 (scalar prefetch)
+    tbl_ref,  # [R * nbs] int32 (scalar prefetch)
+    q_ref,  # [Hkv, BQ, G, hd] VMEM — post-rope queries (bf16)
+    ks_ref,  # [Hkv, T, hd] VMEM — self keys, exact bf16
+    vs_ref,  # [Hkv, T, hd] VMEM
+    srow_ref,  # [R, 2*Hkv, S] VMEM — pre-gathered plain dequant scales
+    pay_hbm,  # [L, B, 2*Hkv + p, S, hd] int8 ANY — fused arena payload
+    pool_pay_hbm,  # [L, PXB, 2*Hkv + p, bt, hd] int8 ANY — prefix pool
+    o_ref,  # [Hkv, BQ, G, hd] VMEM out
+    pay_buf,  # VMEM scratch [2, 2*Hkv, BS, hd] int8 (double buffer)
+    sems,  # DMA semaphores [2, 1]
+    *,
+    scale: float,
+    block_s: int,
+    seq_len: int,
+    n_rows: int,
+):
+    """Ragged flash prefill over the FUSED int8 GQA cache. One payload DMA
+    per past block (K and V heads ride the same copy — the PR 7 one-DMA
+    property); the packed-scale pseudo-head is never streamed — per-row
+    plain scales arrive PRE-GATHERED whole-S in VMEM (`paged_gather` on the
+    "s" plane), dodging the narrow scale-row DMAs Mosaic rejects (see
+    `_attend_q8_mla_blocked_kernel`). Dequant folds post-dot on score and
+    value sides; the self segment stays exact bf16 from registers."""
+    qi = pl.program_id(0)
+    li = li_ref[0]
+    BS = block_s
+    Hkv, BQ, G, hd = q_ref.shape
+    nbs = seq_len // BS
+    pool_base = pay_hbm.shape[1] * nbs
+    t0 = qi * BQ
+
+    q = q_ref[...].astype(jnp.float32)
+    t_idx = t0 + jax.lax.broadcasted_iota(jnp.int32, (BQ, 1), 0)
+
+    acc = jnp.zeros((Hkv, BQ, G, hd), jnp.float32)
+    m = jnp.full((Hkv, BQ, G, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((Hkv, BQ, G, 1), jnp.float32)
+
+    for r in range(n_rows):
+        w = starts_ref[r]
+        lo = offs_ref[r]
+        hi = offs_ref[r + 1]
+        use = (hi > lo) & (lo < t0 + BQ) & (hi > t0) & (w > 0)
+        nblk = jnp.where(use, jnp.minimum((w + BS - 1) // BS, nbs), 0)
+
+        def issue(j, slot, op, r=r):
+            phys = tbl_ref[r * nbs + j]
+            ina = phys < pool_base
+
+            @pl.when(ina)
+            def _arena():
+                arow = phys // nbs
+                aoff = (phys % nbs) * BS
+                getattr(
+                    pltpu.make_async_copy(
+                        pay_hbm.at[li, arow, pl.ds(0, 2 * Hkv), pl.ds(aoff, BS), :],
+                        pay_buf.at[slot],
+                        sems.at[slot, 0],
+                    ),
+                    op,
+                )()
+
+            @pl.when(jnp.logical_not(ina))
+            def _pool():
+                prow = phys - pool_base
+                getattr(
+                    pltpu.make_async_copy(
+                        pool_pay_hbm.at[li, prow, pl.ds(0, 2 * Hkv)],
+                        pay_buf.at[slot],
+                        sems.at[slot, 0],
+                    ),
+                    op,
+                )()
+
+        @pl.when(nblk > 0)
+        def _warm(issue=issue):
+            issue(0, 0, "start")
+
+        sel_q = (t_idx >= lo) & (t_idx < hi)
+
+        def body(j, carry, issue=issue, sel_q=sel_q, w=w, nblk=nblk, r=r):
+            acc, m, l = carry
+            slot = jax.lax.rem(j, 2)
+
+            @pl.when(j + 1 < nblk)
+            def _pf():
+                issue(j + 1, 1 - slot, "start")
+
+            issue(j, slot, "wait")
+            buf = pay_buf[slot]  # [2*Hkv, BS, hd] int8
+            k = buf[:Hkv].astype(jnp.float32)
+            v = buf[Hkv:].astype(jnp.float32)
+            ss = srow_ref[r, :, pl.ds(j * BS, BS)].astype(jnp.float32)  # [2Hkv,BS]
+            kss, vss = ss[:Hkv], ss[Hkv:]
+            s = (
+                jax.lax.dot_general(
+                    q, k, (((3,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                )
+                * kss[:, None, None, :]
+                * scale
+            )
+            k_pos = j * BS + jax.lax.broadcasted_iota(jnp.int32, (1, BS), 1)
+            mask = (sel_q & (k_pos < w))[None, :, None, :]
+            s = jnp.where(mask, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc = acc * alpha + jax.lax.dot_general(
+                p * vss[:, None, None, :], v, (((3,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            return acc, m_new, l
+
+        acc, m, l = jax.lax.fori_loop(0, nblk, body, (acc, m, l))
+
+    seg_q = _seg_of(offs_ref, t_idx, n_rows)
+
+    def sbody(tb, carry):
+        acc, m, l = carry
+        k = ks_ref[:, pl.ds(tb * BQ, BQ), :].astype(jnp.float32)
+        v = vs_ref[:, pl.ds(tb * BQ, BQ), :].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q, k, (((3,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        u_idx = tb * BQ + jax.lax.broadcasted_iota(jnp.int32, (1, BQ), 1)
+        seg_k = _seg_of(offs_ref, u_idx, n_rows)
+        mask = ((seg_q == seg_k) & (u_idx <= t_idx))[None, :, None, :]
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((3,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, m_new, l
+
+    acc, m, l = jax.lax.fori_loop(0, qi + 1, sbody, (acc, m, l))
+    out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
+    o_ref[...] = out.astype(o_ref.dtype)
+
+
+def _ragged_prefill_mla_kernel(
+    li_ref,  # [1] int32 (scalar prefetch)
+    offs_ref,  # [R+1] int32 (scalar prefetch)
+    starts_ref,  # [R] int32 (scalar prefetch)
+    tbl_ref,  # [R * nbs] int32 (scalar prefetch)
+    qt_ref,  # [BQ, H, Rl] VMEM — absorbed latent queries (q_nope @ W_uk)
+    qr_ref,  # [BQ, H, dr] VMEM — post-rope rope queries
+    cs_ref,  # [T, Rl] VMEM — the chunk's own latents, exact bf16
+    krs_ref,  # [T, dr] VMEM — the chunk's own post-rope rope keys
+    rop_ref,  # [R, S, dr] VMEM — pre-gathered cached rope rows (native dtype)
+    ls_ref,  # [R, 1, S] VMEM — latent dequant scales (ones when bf16)
+    rs_ref,  # [R, 1, S] VMEM — rope dequant scales (ones when bf16)
+    lat_hbm,  # [L, B, 1, S, Rl] ANY — latent arena (int8 or bf16)
+    pool_lat,  # [L, PXB, 1, bt, Rl] ANY — latent prefix pool
+    o_ref,  # [BQ, H, Rl] VMEM out — attended latent context
+    lbuf,  # VMEM scratch [2, BS, Rl] (double buffer)
+    sems,  # DMA semaphores [2, 1]
+    *,
+    scale: float,
+    block_s: int,
+    seq_len: int,
+    n_rows: int,
+):
+    """Ragged flash prefill over the MLA latent cache, absorbed form: scores
+    land directly on cached latents (q_nope pre-folded through W_uk), the
+    value side re-expands outside the kernel. One static `quantized`-free
+    body covers bf16 AND int8 latents: blocks stream in the cache's native
+    dtype and dequant scales (ones for bf16 — exact multiply) fold post-dot.
+    Rope rows + scales arrive PRE-GATHERED whole-S (`paged_gather`): the
+    per-block [BS, dr] rope slices are exactly the narrow DMAs Mosaic
+    rejects in the MLA decode kernels, so only the [BS, Rl] latent payload
+    streams block-indirect."""
+    qi = pl.program_id(0)
+    li = li_ref[0]
+    BS = block_s
+    BQ, H, Rl = qt_ref.shape
+    nbs = seq_len // BS
+    pool_base = lat_hbm.shape[1] * nbs
+    t0 = qi * BQ
+
+    qt = qt_ref[...].astype(jnp.float32)  # [BQ, H, Rl]
+    qr = qr_ref[...].astype(jnp.float32)  # [BQ, H, dr]
+    t_idx = t0 + jax.lax.broadcasted_iota(jnp.int32, (BQ, 1), 0)
+
+    acc = jnp.zeros((BQ, H, Rl), jnp.float32)
+    m = jnp.full((BQ, H, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((BQ, H, 1), jnp.float32)
+
+    for r in range(n_rows):
+        w = starts_ref[r]
+        lo = offs_ref[r]
+        hi = offs_ref[r + 1]
+        use = (hi > lo) & (lo < t0 + BQ) & (hi > t0) & (w > 0)
+        nblk = jnp.where(use, jnp.minimum((w + BS - 1) // BS, nbs), 0)
+
+        def issue(j, slot, op, r=r):
+            phys = tbl_ref[r * nbs + j]
+            ina = phys < pool_base
+
+            @pl.when(ina)
+            def _arena():
+                arow = phys // nbs
+                aoff = (phys % nbs) * BS
+                getattr(
+                    pltpu.make_async_copy(
+                        lat_hbm.at[li, arow, 0, pl.ds(aoff, BS), :],
+                        lbuf.at[slot],
+                        sems.at[slot, 0],
+                    ),
+                    op,
+                )()
+
+            @pl.when(jnp.logical_not(ina))
+            def _pool():
+                prow = phys - pool_base
+                getattr(
+                    pltpu.make_async_copy(
+                        pool_lat.at[li, prow, 0], lbuf.at[slot], sems.at[slot, 0]
+                    ),
+                    op,
+                )()
+
+        @pl.when(nblk > 0)
+        def _warm(issue=issue):
+            issue(0, 0, "start")
+
+        sel_q = (t_idx >= lo) & (t_idx < hi)  # [BQ, 1]
+
+        def body(j, carry, issue=issue, sel_q=sel_q, w=w, nblk=nblk, r=r):
+            acc, m, l = carry
+            slot = jax.lax.rem(j, 2)
+
+            @pl.when(j + 1 < nblk)
+            def _pf():
+                issue(j + 1, 1 - slot, "start")
+
+            issue(j, slot, "wait")
+            lat = lbuf[slot].astype(jnp.float32)  # [BS, Rl]
+            rop = rop_ref[r, pl.ds(j * BS, BS), :].astype(jnp.float32)  # [BS,dr]
+            lsb = ls_ref[r, :, pl.ds(j * BS, BS)].astype(jnp.float32)  # [1, BS]
+            rsb = rs_ref[r, :, pl.ds(j * BS, BS)].astype(jnp.float32)
+            s = (
+                jax.lax.dot_general(
+                    qt, lat, (((2,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * lsb[:, None, :]
+                + jax.lax.dot_general(
+                    qr, rop, (((2,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * rsb[:, None, :]
+            ) * scale  # [BQ, H, BS]
+            k_pos = j * BS + jax.lax.broadcasted_iota(jnp.int32, (1, BS), 1)
+            mask = (sel_q & (k_pos < w))[:, None, :]
+            s = jnp.where(mask, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc = acc * alpha + jax.lax.dot_general(
+                p * lsb[:, None, :], lat, (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return acc, m_new, l
+
+        acc, m, l = jax.lax.fori_loop(0, nblk, body, (acc, m, l))
+
+    seg_q = _seg_of(offs_ref, t_idx, n_rows)
+
+    def sbody(tb, carry):
+        acc, m, l = carry
+        c = cs_ref[pl.ds(tb * BQ, BQ), :].astype(jnp.float32)  # [BQk, Rl]
+        kr = krs_ref[pl.ds(tb * BQ, BQ), :].astype(jnp.float32)  # [BQk, dr]
+        s = (
+            jax.lax.dot_general(
+                qt, c, (((2,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            + jax.lax.dot_general(
+                qr, kr, (((2,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        ) * scale  # [BQ, H, BQk]
+        u_idx = tb * BQ + jax.lax.broadcasted_iota(jnp.int32, (1, BQ), 1)
+        seg_k = _seg_of(offs_ref, u_idx, n_rows)
+        mask = ((seg_q == seg_k) & (u_idx <= t_idx))[:, None, :]
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, c, (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc, m_new, l
+
+    acc, m, l = jax.lax.fori_loop(0, qi + 1, sbody, (acc, m, l))
+    out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
+    o_ref[...] = out.astype(o_ref.dtype)
+
+
+def _ragged_attend_gqa_fallback(
+    q, k_self, v_self, krows, vrows, ksr, vsr, rowids, starts, scale
+):
+    """Exact packed mirror of `llama_prefill_chunk_batch`'s attention math
+    (joint softmax over [past | self], bf16 dots, post-dot dequant) — the
+    CPU/XLA arm of the ragged dispatchers and the reference the kernels are
+    parity-tested against. Past rows arrive pre-gathered per descriptor row
+    ([R, Hkv, Sk, hd]); a static loop over the R rows selects each token's
+    row without a [T, Sk, hd] gather (memory mirrors the bucketed form).
+
+    q [T, Hkv, G, hd] · k_self/v_self [T, Hkv, hd] · ksr/vsr [R, Hkv, Sk]
+    (None for bf16) · rowids [T] (pads = R) · starts [R] → [T, Hkv, G, hd].
+    """
+    T, Hkv, G, hd = q.shape
+    R, _, Sk, _ = krows.shape
+    neg = jnp.float32(NEG_INF)
+    rid = rowids.astype(jnp.int32)
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+    key_pos = jnp.arange(Sk, dtype=jnp.int32)
+
+    s_past = jnp.full((Hkv, G, T, Sk), neg, jnp.float32)
+    for r in range(R):
+        sr = jnp.einsum(
+            "thgd,hsd->hgts", q, krows[r].astype(q.dtype)
+        ).astype(jnp.float32)
+        if ksr is not None:
+            sr = sr * ksr[r].astype(jnp.float32)[:, None, None, :]
+        s_past = jnp.where((rid == r)[None, None, :, None], sr, s_past)
+    s_past = s_past * scale
+    start_t = starts[jnp.clip(rid, 0, R - 1)]  # [T]
+    pm = (key_pos[None, :] < start_t[:, None]) & (rid < R)[:, None]
+    s_past = jnp.where(pm[None, None], s_past, neg)
+
+    s_self = jnp.einsum("thgd,uhd->hgtu", q, k_self).astype(jnp.float32) * scale
+    sm = (rid[None, :] == rid[:, None]) & (t_idx[None, :] <= t_idx[:, None])
+    s_self = jnp.where(sm[None, None], s_self, neg)
+
+    s = jnp.concatenate([s_past, s_self], axis=-1)  # [Hkv, G, T, Sk+T]
+    probs = jax.nn.softmax(s, axis=-1)
+    p_past, p_self = probs[..., :Sk], probs[..., Sk:]
+    ctx = jnp.einsum("hgtu,uhd->thgd", p_self.astype(q.dtype), v_self)
+    for r in range(R):
+        pr = p_past
+        if vsr is not None:
+            pr = pr * vsr[r].astype(jnp.float32)[:, None, None, :]
+        cr = jnp.einsum("hgts,hsd->thgd", pr.astype(q.dtype), vrows[r].astype(q.dtype))
+        ctx = ctx + jnp.where((rid == r)[:, None, None, None], cr, jnp.zeros_like(cr))
+    return ctx.astype(q.dtype)
+
+
+def _ragged_attend_mla_fallback(
+    qt, qr, c_self, kr_self, lat, rop, ls, rs, rowids, starts, scale
+):
+    """Exact packed mirror of `mla_prefill_chunk_batch`'s attention math —
+    the XLA arm of `ragged_prefill_attend_mla` and the kernels' parity
+    reference. lat/rop [R, Sk, ·] pre-gathered; ls/rs [R, Sk] f32 dequant
+    scales or None (bf16). Returns attended latent context [T, H, Rl]."""
+    T, H, Rl = qt.shape
+    R, Sk, _ = lat.shape
+    neg = jnp.float32(NEG_INF)
+    rid = rowids.astype(jnp.int32)
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+    key_pos = jnp.arange(Sk, dtype=jnp.int32)
+
+    s_past = jnp.full((H, T, Sk), neg, jnp.float32)
+    for r in range(R):
+        sr = jnp.einsum("thr,sr->hts", qt, lat[r].astype(qt.dtype)).astype(
+            jnp.float32
+        )
+        rr = jnp.einsum("thd,sd->hts", qr, rop[r].astype(qr.dtype)).astype(
+            jnp.float32
+        )
+        if ls is not None:
+            sr = sr * ls[r][None, None, :]
+            rr = rr * rs[r][None, None, :]
+        s_past = jnp.where((rid == r)[None, :, None], sr + rr, s_past)
+    s_past = s_past * scale
+    start_t = starts[jnp.clip(rid, 0, R - 1)]
+    pm = (key_pos[None, :] < start_t[:, None]) & (rid < R)[:, None]
+    s_past = jnp.where(pm[None], s_past, neg)
+
+    s_self = (
+        jnp.einsum("thr,ur->htu", qt, c_self)
+        + jnp.einsum("thd,ud->htu", qr, kr_self)
+    ).astype(jnp.float32) * scale
+    sm = (rid[None, :] == rid[:, None]) & (t_idx[None, :] <= t_idx[:, None])
+    s_self = jnp.where(sm[None], s_self, neg)
+
+    s = jnp.concatenate([s_past, s_self], axis=-1)  # [H, T, Sk+T]
+    probs = jax.nn.softmax(s, axis=-1)
+    p_past, p_self = probs[..., :Sk], probs[..., Sk:]
+    ctx = jnp.einsum("htu,ur->thr", p_self.astype(qt.dtype), c_self)
+    for r in range(R):
+        pr = p_past * ls[r][None, None, :] if ls is not None else p_past
+        cr = jnp.einsum("hts,sr->thr", pr.astype(qt.dtype), lat[r].astype(qt.dtype))
+        ctx = ctx + jnp.where((rid == r)[:, None, None], cr, jnp.zeros_like(cr))
+    return ctx.astype(qt.dtype)
+
+
+def _ragged_tables(slots, S, BS, block_tables):
+    """(tbl [R, nbs], nbs, paged?) — the per-row block tables the kernels
+    stream through: the PR 10 ledger tables gathered to the descriptor rows,
+    or identity tables (phys = slot·nbs + j, always arena) when unpaged."""
+    slots = jnp.asarray(slots, jnp.int32)
+    if block_tables is not None:
+        return jnp.take(block_tables, slots, axis=0), block_tables.shape[1], True
+    nbs = S // BS
+    tbl = slots[:, None] * nbs + jnp.arange(nbs, dtype=jnp.int32)[None, :]
+    return tbl, nbs, False
+
+
+def ragged_prefill_attend_bf16(
+    q: jnp.ndarray,  # [T, Hkv, G, hd] post-rope queries (packed)
+    k_self: jnp.ndarray,  # [T, Hkv, hd] the chunk's own post-rope keys
+    v_self: jnp.ndarray,  # [T, Hkv, hd]
+    cache_k: jnp.ndarray,  # [L, B, Hkv, S, hd]
+    cache_v: jnp.ndarray,
+    layer,  # traced int32 scalar
+    rowids: jnp.ndarray,  # [T] int32 — descriptor row per token (pads = R)
+    offsets: jnp.ndarray,  # [R+1] int32 — packed row boundaries
+    slots: jnp.ndarray,  # [R] int32
+    starts: jnp.ndarray,  # [R] int32 — cached-prefix length per row
+    *,
+    scale: float = 0.0,
+    skey: int = 0,  # STATIC past bound for the XLA arm (0 = whole S)
+    block_tables=None,  # [max_slots, nbs] ledger tables (None = unpaged)
+    pool_k=None,  # [L, PXB, Hkv, bt, hd] prefix pool
+    pool_v=None,
+    impl: str | None = None,
+    interpret: bool | None = None,
+    block_q: int = 128,
+) -> jnp.ndarray:
+    """Ragged chunked-prefill attention over the split bf16 GQA cache.
+    Returns [T, Hkv, G, hd] attended context for the packed chunk."""
+    T, Hkv, G, hd = q.shape
+    L, B, _, S, _ = cache_k.shape
+    R = slots.shape[0]
+    sc = scale or hd**-0.5
+    starts = jnp.asarray(starts, jnp.int32)
+    use_kernel = (impl or resolve_ragged_impl()) == "kernel" and _HAS_PLTPU
+
+    if not use_kernel:
+        Sk = min(skey, S) if skey else S
+        ck_l = jax.lax.dynamic_index_in_dim(cache_k, layer, 0, keepdims=False)
+        cv_l = jax.lax.dynamic_index_in_dim(cache_v, layer, 0, keepdims=False)
+        slots_i = jnp.asarray(slots, jnp.int32)
+        if block_tables is not None:
+            nbs_full = block_tables.shape[1]
+            bt = S // nbs_full
+            nsel = max(1, -(-Sk // bt))
+            tbl = jnp.take(block_tables, slots_i, axis=0)[:, :nsel]
+            pk_l = jax.lax.dynamic_index_in_dim(pool_k, layer, 0, keepdims=False)
+            pv_l = jax.lax.dynamic_index_in_dim(pool_v, layer, 0, keepdims=False)
+            krows = paged_gather(ck_l, pk_l, tbl, nbs=nbs_full)[:, :, :Sk]
+            vrows = paged_gather(cv_l, pv_l, tbl, nbs=nbs_full)[:, :, :Sk]
+        else:
+            krows = jnp.take(ck_l, slots_i, axis=0)[:, :, :Sk]
+            vrows = jnp.take(cv_l, slots_i, axis=0)[:, :, :Sk]
+        return _ragged_attend_gqa_fallback(
+            q, k_self, v_self, krows, vrows, None, None, rowids, starts, sc
+        )
+
+    interp = _interpret() if interpret is None else interpret
+    bt = None if block_tables is None else S // block_tables.shape[1]
+    BS = ragged_block_size(S, bt)
+    tbl, nbs, paged = _ragged_tables(slots, S, BS, block_tables)
+    if paged:
+        pk, pv = pool_k, pool_v
+    else:
+        pk = jnp.zeros((L, 1, Hkv, BS, hd), cache_k.dtype)
+        pv = jnp.zeros((L, 1, Hkv, BS, hd), cache_v.dtype)
+    BQ = min(block_q, T)
+    assert T % BQ == 0, (T, BQ)
+    kernel = functools.partial(
+        _ragged_prefill_bf16_kernel, scale=sc, block_s=BS, seq_len=S, n_rows=R
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,  # li [1], offsets [R+1], starts [R], tbl [R*nbs]
+        grid=(T // BQ,),
+        in_specs=[
+            pl.BlockSpec((Hkv, BQ, G, hd), lambda qi, li, of, st, tb: (0, qi, 0, 0)),
+            pl.BlockSpec((Hkv, T, hd), lambda qi, li, of, st, tb: (0, 0, 0)),
+            pl.BlockSpec((Hkv, T, hd), lambda qi, li, of, st, tb: (0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),  # arena K
+            pl.BlockSpec(memory_space=pl.ANY),  # arena V
+            pl.BlockSpec(memory_space=pl.ANY),  # pool K
+            pl.BlockSpec(memory_space=pl.ANY),  # pool V
+        ],
+        out_specs=pl.BlockSpec(
+            (Hkv, BQ, G, hd), lambda qi, li, of, st, tb: (0, qi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, Hkv, BS, hd), cache_k.dtype),
+            pltpu.VMEM((2, Hkv, BS, hd), cache_v.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((Hkv, T, G, hd), q.dtype),
+        interpret=interp,
+    )(
+        jnp.reshape(jnp.asarray(layer, jnp.int32), (1,)),
+        jnp.asarray(offsets, jnp.int32),
+        starts,
+        tbl.reshape(-1).astype(jnp.int32),
+        q.transpose(1, 0, 2, 3),
+        k_self.transpose(1, 0, 2),
+        v_self.transpose(1, 0, 2),
+        cache_k,
+        cache_v,
+        pk,
+        pv,
+    )
+    return out.transpose(1, 0, 2, 3)
+
+
+def ragged_prefill_attend_q8(
+    q: jnp.ndarray,  # [T, Hkv, G, hd] post-rope queries (packed)
+    k_self: jnp.ndarray,  # [T, Hkv, hd] exact bf16 self keys
+    v_self: jnp.ndarray,
+    cache_k: dict,  # FUSED int8 cache {"q": [L,B,2Hkv+p,S,hd], "s": [L,B,2Hkv,S]}
+    layer,
+    rowids: jnp.ndarray,
+    offsets: jnp.ndarray,
+    slots: jnp.ndarray,
+    starts: jnp.ndarray,
+    *,
+    scale: float = 0.0,
+    skey: int = 0,
+    block_tables=None,
+    pool=None,  # {"q", "s"} prefix pool (paged["k"])
+    impl: str | None = None,
+    interpret: bool | None = None,
+    block_q: int = 128,
+) -> jnp.ndarray:
+    """Ragged chunked-prefill attention over the FUSED int8 GQA cache.
+    Returns [T, Hkv, G, hd]."""
+    T, Hkv, G, hd = q.shape
+    L, B, _, S, _ = cache_k["q"].shape
+    R = slots.shape[0]
+    sc = scale or hd**-0.5
+    starts = jnp.asarray(starts, jnp.int32)
+    slots_i = jnp.asarray(slots, jnp.int32)
+    use_kernel = (impl or resolve_ragged_impl()) == "kernel" and _HAS_PLTPU
+
+    if not use_kernel:
+        Sk = min(skey, S) if skey else S
+        pay_l = jax.lax.dynamic_index_in_dim(cache_k["q"], layer, 0, keepdims=False)
+        ss_l = jax.lax.dynamic_index_in_dim(cache_k["s"], layer, 0, keepdims=False)
+        if block_tables is not None:
+            nbs_full = block_tables.shape[1]
+            bt = S // nbs_full
+            nsel = max(1, -(-Sk // bt))
+            tbl = jnp.take(block_tables, slots_i, axis=0)[:, :nsel]
+            pp_l = jax.lax.dynamic_index_in_dim(pool["q"], layer, 0, keepdims=False)
+            ps_l = jax.lax.dynamic_index_in_dim(pool["s"], layer, 0, keepdims=False)
+            pays = paged_gather(pay_l, pp_l, tbl, nbs=nbs_full)[:, : 2 * Hkv, :Sk]
+            srows = paged_gather(ss_l, ps_l, tbl, nbs=nbs_full)[:, : 2 * Hkv, :Sk]
+        else:
+            pays = jnp.take(pay_l, slots_i, axis=0)[:, : 2 * Hkv, :Sk]
+            srows = jnp.take(ss_l, slots_i, axis=0)[:, : 2 * Hkv, :Sk]
+        return _ragged_attend_gqa_fallback(
+            q,
+            k_self,
+            v_self,
+            pays[:, :Hkv],
+            pays[:, Hkv:],
+            srows[:, :Hkv],
+            srows[:, Hkv:],
+            rowids,
+            starts,
+            sc,
+        )
+
+    interp = _interpret() if interpret is None else interpret
+    bt = None if block_tables is None else S // block_tables.shape[1]
+    BS = ragged_block_size(S, bt)
+    tbl, nbs, paged_ = _ragged_tables(slots, S, BS, block_tables)
+    # plain scales pre-gathered whole-S through the same tables the payload
+    # streams through — the scale rows must come from the SAME physical
+    # blocks (pool rows for a pinned prefix), not the arena slot rows
+    ss_l = jax.lax.dynamic_index_in_dim(cache_k["s"], layer, 0, keepdims=False)
+    if paged_:
+        ps_l = jax.lax.dynamic_index_in_dim(pool["s"], layer, 0, keepdims=False)
+        srows = paged_gather(ss_l, ps_l, jnp.take(block_tables, slots_i, 0))
+        pp = pool["q"]
+    else:
+        srows = jnp.take(ss_l, slots_i, axis=0)
+        pp = jnp.zeros((L, 1, cache_k["q"].shape[2], BS, hd), jnp.int8)
+    BQ = min(block_q, T)
+    assert T % BQ == 0, (T, BQ)
+    kernel = functools.partial(
+        _ragged_prefill_q8_kernel, scale=sc, block_s=BS, seq_len=S, n_rows=R
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(T // BQ,),
+        in_specs=[
+            pl.BlockSpec((Hkv, BQ, G, hd), lambda qi, li, of, st, tb: (0, qi, 0, 0)),
+            pl.BlockSpec((Hkv, T, hd), lambda qi, li, of, st, tb: (0, 0, 0)),
+            pl.BlockSpec((Hkv, T, hd), lambda qi, li, of, st, tb: (0, 0, 0)),
+            pl.BlockSpec(
+                (R, 2 * Hkv, S), lambda qi, li, of, st, tb: (0, 0, 0)
+            ),  # scales
+            pl.BlockSpec(memory_space=pl.ANY),  # fused arena payload
+            pl.BlockSpec(memory_space=pl.ANY),  # fused pool payload
+        ],
+        out_specs=pl.BlockSpec(
+            (Hkv, BQ, G, hd), lambda qi, li, of, st, tb: (0, qi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, 2 * Hkv, BS, hd), jnp.int8),
+            pltpu.SemaphoreType.DMA((2, 1)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((Hkv, T, G, hd), q.dtype),
+        interpret=interp,
+    )(
+        jnp.reshape(jnp.asarray(layer, jnp.int32), (1,)),
+        jnp.asarray(offsets, jnp.int32),
+        starts,
+        tbl.reshape(-1).astype(jnp.int32),
+        q.transpose(1, 0, 2, 3),
+        k_self.transpose(1, 0, 2),
+        v_self.transpose(1, 0, 2),
+        srows,
+        cache_k["q"],
+        pp,
+    )
+    return out.transpose(1, 0, 2, 3)
+
+
+def ragged_prefill_attend_mla(
+    qt: jnp.ndarray,  # [T, H, Rl] absorbed latent queries
+    qr: jnp.ndarray,  # [T, H, dr] post-rope rope queries
+    c_self: jnp.ndarray,  # [T, Rl] the chunk's own latents (exact bf16)
+    kr_self: jnp.ndarray,  # [T, dr] the chunk's own post-rope rope keys
+    cache_c,  # [L, B, 1, S, Rl] latents or int8 {"q","s"}
+    cache_r,  # [L, B, 1, S, dr] rope keys or int8 {"q","s"}
+    layer,
+    rowids: jnp.ndarray,
+    offsets: jnp.ndarray,
+    slots: jnp.ndarray,
+    starts: jnp.ndarray,
+    *,
+    scale: float,
+    skey: int = 0,
+    block_tables=None,
+    pool_c=None,  # paged["k"] — latent prefix pool (array or {"q","s"})
+    pool_r=None,  # paged["v"] — rope prefix pool
+    impl: str | None = None,
+    interpret: bool | None = None,
+    block_q: int = 128,
+) -> jnp.ndarray:
+    """Ragged chunked-prefill attention over the MLA latent cache (absorbed
+    form, bf16 or int8). Returns attended latent context [T, H, Rl] — the
+    caller re-expands through W_uv."""
+    quantized = isinstance(cache_c, dict)
+    lat_all = cache_c["q"] if quantized else cache_c
+    rop_all = cache_r["q"] if quantized else cache_r
+    L, B, _, S, Rl = lat_all.shape
+    dr = rop_all.shape[-1]
+    T = qt.shape[0]
+    R = slots.shape[0]
+    starts = jnp.asarray(starts, jnp.int32)
+    slots_i = jnp.asarray(slots, jnp.int32)
+    use_kernel = (impl or resolve_ragged_impl()) == "kernel" and _HAS_PLTPU
+
+    def rows_of(cache_full, pool_full, bound):
+        """Layer-select + per-row gather of a cache plane, bounded to the
+        first `bound` positions (block-rounded under paging)."""
+        plane = jax.lax.dynamic_index_in_dim(cache_full, layer, 0, keepdims=False)
+        if block_tables is not None:
+            nbs_full = block_tables.shape[1]
+            bt = S // nbs_full
+            nsel = max(1, -(-bound // bt))
+            pool_plane = jax.lax.dynamic_index_in_dim(
+                pool_full, layer, 0, keepdims=False
+            )
+            tbl = jnp.take(block_tables, slots_i, axis=0)[:, :nsel]
+            g = paged_gather(plane, pool_plane, tbl, nbs=nbs_full)
+        else:
+            g = jnp.take(plane, slots_i, axis=0)
+        return g[:, 0, :bound]  # drop the fake head axis
+
+    if not use_kernel:
+        Sk = min(skey, S) if skey else S
+        if quantized:
+            lat = rows_of(cache_c["q"], pool_c and pool_c["q"], Sk)
+            rop = rows_of(cache_r["q"], pool_r and pool_r["q"], Sk)
+            ls = rows_of(cache_c["s"], pool_c and pool_c["s"], Sk).astype(jnp.float32)
+            rs = rows_of(cache_r["s"], pool_r and pool_r["s"], Sk).astype(jnp.float32)
+        else:
+            lat = rows_of(cache_c, pool_c, Sk)
+            rop = rows_of(cache_r, pool_r, Sk)
+            ls = rs = None
+        return _ragged_attend_mla_fallback(
+            qt, qr, c_self, kr_self, lat, rop, ls, rs, rowids, starts, scale
+        )
+
+    interp = _interpret() if interpret is None else interpret
+    bt = None if block_tables is None else S // block_tables.shape[1]
+    BS = ragged_block_size(S, bt)
+    tbl, nbs, paged_ = _ragged_tables(slots, S, BS, block_tables)
+    # rope rows + dequant scales pre-gathered whole-S (per-block rope/scale
+    # slices are the narrow DMAs Mosaic rejects); latent payload streams
+    rop_g = rows_of(rop_all, pool_r["q"] if (paged_ and quantized) else pool_r, S)
+    if quantized:
+        ls_g = rows_of(cache_c["s"], pool_c and pool_c["s"], S)[:, None, :]
+        rs_g = rows_of(cache_r["s"], pool_r and pool_r["s"], S)[:, None, :]
+    else:
+        ls_g = jnp.ones((R, 1, S), jnp.float32)
+        rs_g = jnp.ones((R, 1, S), jnp.float32)
+    pl_pool = (
+        (pool_c["q"] if quantized else pool_c)
+        if paged_
+        else jnp.zeros((L, 1, 1, BS, Rl), lat_all.dtype)
+    )
+    BQ = min(block_q, T)
+    assert T % BQ == 0, (T, BQ)
+    kernel = functools.partial(
+        _ragged_prefill_mla_kernel, scale=scale, block_s=BS, seq_len=S, n_rows=R
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(T // BQ,),
+        in_specs=[
+            pl.BlockSpec((BQ, qt.shape[1], Rl), lambda qi, li, of, st, tb: (qi, 0, 0)),
+            pl.BlockSpec((BQ, qt.shape[1], dr), lambda qi, li, of, st, tb: (qi, 0, 0)),
+            pl.BlockSpec((T, Rl), lambda qi, li, of, st, tb: (0, 0)),
+            pl.BlockSpec((T, dr), lambda qi, li, of, st, tb: (0, 0)),
+            pl.BlockSpec((R, S, dr), lambda qi, li, of, st, tb: (0, 0, 0)),
+            pl.BlockSpec((R, 1, S), lambda qi, li, of, st, tb: (0, 0, 0)),
+            pl.BlockSpec((R, 1, S), lambda qi, li, of, st, tb: (0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),  # latent arena
+            pl.BlockSpec(memory_space=pl.ANY),  # latent pool
+        ],
+        out_specs=pl.BlockSpec(
+            (BQ, qt.shape[1], Rl), lambda qi, li, of, st, tb: (qi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, BS, Rl), lat_all.dtype),
+            pltpu.SemaphoreType.DMA((2, 1)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, qt.shape[1], Rl), qt.dtype),
+        interpret=interp,
+    )(
+        jnp.reshape(jnp.asarray(layer, jnp.int32), (1,)),
+        jnp.asarray(offsets, jnp.int32),
+        starts,
+        tbl.reshape(-1).astype(jnp.int32),
+        qt,
+        qr,
+        c_self,
+        kr_self,
+        rop_g,
+        ls_g,
+        rs_g,
+        lat_all,
+        pl_pool,
+    )

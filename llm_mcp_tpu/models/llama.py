@@ -39,6 +39,8 @@ from ..kernels.attention import (
     decode_attend_q8,
     flash_prefill_attention,
     paged_gather,
+    ragged_prefill_attend_bf16,
+    ragged_prefill_attend_q8,
 )
 from ..ops.norms import rms_norm as _rms_norm
 from ..ops.rope import rope_tables, apply_rope
@@ -904,6 +906,144 @@ def llama_prefill_chunk(
         skey=skey,
         paged=paged,
     )
+
+
+def llama_prefill_chunk_ragged(
+    cfg: ModelConfig,
+    params: Params,
+    cache_k: Any,  # [L, B, Hkv, S, hd] engine cache (or fused int8 {"q","s"})
+    cache_v: Any,
+    tokens: jnp.ndarray,  # [T] int32 — PACKED chunks, rows back-to-back
+    rowids: jnp.ndarray,  # [T] int32 — descriptor row per token, SORTED
+    #   ascending; pad tokens carry rowid == R
+    positions: jnp.ndarray,  # [T] int32 — absolute rope/write position per
+    #   token; pad tokens carry S (their cache scatters DROP)
+    slots: jnp.ndarray,  # [R] int32 — engine slot per descriptor row
+    starts: jnp.ndarray,  # [R] int32 — cached-prefix length per row
+    last_idx: jnp.ndarray,  # [R] int32 — packed index of each row's LAST
+    #   token this chunk (0 for unused rows — never sampled by the engine)
+    skey: int = 0,  # STATIC past bound for the XLA arm (kernel arm ignores
+    #   it — past trips are data-dependent, so 0 keeps ONE executable)
+    paged: dict | None = None,  # {"tbl","k","v"} physical paging operand
+) -> tuple[jnp.ndarray, Any, Any]:
+    """Ragged chunked prefill: the packed-descriptor twin of
+    `llama_prefill_chunk_batch`. Instead of [A, C] bucket-padded rows, up to
+    R rows' chunks pack back-to-back into one [T] token buffer — compute is
+    spent on real tokens only, and because T and R are static while every
+    descriptor (rowids, positions, offsets, starts, tables) is data, ONE
+    executable per (T, layout) serves every fill mix where the bucketed path
+    mints one per (A, bucket, skey). Attention runs through the ragged
+    paged-native kernels (`kernels/attention.py:ragged_prefill_attend_*`):
+    the cached prefix streams block-indirect through the PR 10 tables, the
+    chunk's own K/V stays exact bf16 from registers, and masks derive from
+    the packed row boundaries. Same read-past-then-write discipline as the
+    bucketed path; writes are positional scatters (`mode="drop"` — pad
+    tokens carry position S and vanish, the parked-slot OOB convention).
+
+    Sliding-window and softcap families are NOT supported — the engine's
+    ragged eligibility gate routes them to the bucketed path.
+
+    Returns (logits [R, V] f32 at each row's `last_idx` token, new_k, new_v).
+    """
+    if cfg.kv_lora_rank:  # MLA family: absorbed ragged prefill over latents
+        from .mla import mla_prefill_chunk_ragged
+
+        return mla_prefill_chunk_ragged(
+            cfg, params, cache_k, cache_v, tokens, rowids, positions,
+            slots, starts, last_idx, skey=skey, paged=paged,
+        )
+    if cfg.sliding_window or cfg.attn_softcap:
+        raise NotImplementedError(
+            "ragged prefill covers global-attention, no-softcap families; "
+            "the engine gates others to the bucketed path"
+        )
+    quantized = isinstance(cache_k, dict)
+    L, B, _, S, hd = _cache_shape(cache_k)
+    Hkv = cfg.n_kv_heads
+    H = cfg.n_heads
+    G = H // Hkv
+    T = tokens.shape[0]
+    R = slots.shape[0]
+    slots = jnp.asarray(slots, dtype=jnp.int32)
+    starts = jnp.asarray(starts, dtype=jnp.int32)
+    rowids = jnp.asarray(rowids, dtype=jnp.int32)
+    positions = jnp.asarray(positions, dtype=jnp.int32)
+    # packed row boundaries from the sorted rowids: offsets[r] = first packed
+    # index of row r; offsets[R] = total real tokens
+    offsets = jnp.concatenate(
+        [
+            jnp.zeros((1,), jnp.int32),
+            jnp.sum(
+                (rowids[None, :] < jnp.arange(1, R + 1, dtype=jnp.int32)[:, None]),
+                axis=1,
+                dtype=jnp.int32,
+            ),
+        ]
+    )  # [R+1]
+    wslot = slots[jnp.clip(rowids, 0, R - 1)]  # [T] write slot per token
+    moe_valid = rowids < R  # [T]
+    btbl = paged["tbl"] if paged is not None else None
+
+    h = _embed_in(cfg, params, tokens)  # [T, D]
+    cos, sin = rope_tables(cfg, hd, positions)  # [T, hd/2]
+
+    def layer(carry, lp):
+        h, ck_all, cv_all, li = carry
+        x = _norm(cfg, h, lp["attn_norm"])
+        q, k, v = _qkv(cfg, lp, x)
+        q = apply_rope(q.reshape(T, H, hd), cos, sin)
+        k = apply_rope(k.reshape(T, Hkv, hd), cos, sin)
+        v = v.reshape(T, Hkv, hd)
+        qg = q.reshape(T, Hkv, G, hd)
+
+        # ---- reads first: ragged attention over [cached past | packed self]
+        if quantized:
+            ctx = ragged_prefill_attend_q8(
+                qg, k, v, ck_all, li, rowids, offsets, slots, starts,
+                scale=cfg.attn_scale, skey=skey, block_tables=btbl,
+                pool=paged["k"] if paged is not None else None,
+            )
+        else:
+            ctx = ragged_prefill_attend_bf16(
+                qg, k, v, ck_all, cv_all, li, rowids, offsets, slots, starts,
+                scale=cfg.attn_scale, skey=skey, block_tables=btbl,
+                pool_k=paged["k"] if paged is not None else None,
+                pool_v=paged["v"] if paged is not None else None,
+            )
+        ctx = ctx.reshape(T, H * hd)
+        h = _attn_residual(cfg, lp, ctx, h)
+        h = _ffn_residual(cfg, lp, h, moe_valid=moe_valid)
+
+        # ---- writes last: positional scatter, pads (position S) DROP ----
+        # (paging keeps writes at identity arena homes — COW re-homing is
+        # host-side ledger machinery, so the scatter needs no tables)
+        if quantized:
+            fused = fuse_prompt_kv(
+                k.transpose(1, 0, 2), v.transpose(1, 0, 2),
+                scale_dtype=ck_all["s"].dtype,
+            )  # {"q": [2*Hkv+p, T, hd], "s": [2*Hkv, T]}
+            ck_all = {
+                "q": ck_all["q"].at[li, wslot, :, positions].set(
+                    fused["q"].transpose(1, 0, 2), mode="drop"
+                ),
+                "s": ck_all["s"].at[li, wslot, :, positions].set(
+                    fused["s"].T, mode="drop"
+                ),
+            }
+        else:
+            ck_all = ck_all.at[li, wslot, :, positions].set(
+                k.astype(ck_all.dtype), mode="drop"
+            )
+            cv_all = cv_all.at[li, wslot, :, positions].set(
+                v.astype(cv_all.dtype), mode="drop"
+            )
+        return (h, ck_all, cv_all, li + 1), None
+
+    (h, new_k, new_v, _), _ = jax.lax.scan(
+        layer, (h, cache_k, cache_v, jnp.int32(0)), params["layers"]
+    )
+    last = jnp.take(h, jnp.clip(last_idx, 0, T - 1), axis=0)  # [R, D]
+    return _logits(cfg, params, last), new_k, new_v
 
 
 def llama_decode_step(

@@ -42,7 +42,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from ..kernels.attention import paged_gather
+from ..kernels.attention import paged_gather, ragged_prefill_attend_mla
 from ..ops.norms import rms_norm as _rms_norm
 from ..ops.rope import apply_rope, rope_tables
 from .configs import ModelConfig
@@ -486,6 +486,117 @@ def mla_prefill_chunk_batch(
     last = jnp.take_along_axis(
         h, jnp.clip(nvalid - 1, 0, C - 1)[:, None, None], axis=1
     )[:, 0]  # [A, D]
+    return _logits(cfg, params, last), new_c, new_r
+
+
+def mla_prefill_chunk_ragged(
+    cfg: ModelConfig,
+    params: Params,
+    cache_c: Any,  # [L, B, 1, S, R] latents (or int8 {"q","s"} pytree)
+    cache_r: Any,  # [L, B, 1, S, dr] rope keys
+    tokens: jnp.ndarray,  # [T] int32 — PACKED chunks, rows back-to-back
+    rowids: jnp.ndarray,  # [T] int32 — descriptor row per token, sorted
+    #   ascending; pads carry rowid == Rn
+    positions: jnp.ndarray,  # [T] int32 — absolute positions; pads carry S
+    slots: jnp.ndarray,  # [Rn] int32
+    starts: jnp.ndarray,  # [Rn] int32 cached-prefix length per row
+    last_idx: jnp.ndarray,  # [Rn] int32 packed index of each row's last token
+    skey: int = 0,  # STATIC past bound for the XLA arm (kernel arm ignores)
+    paged: dict | None = None,  # {"tbl","k","v"} physical paging operand
+) -> tuple[jnp.ndarray, Any, Any]:
+    """Ragged chunked prefill for MLA — the packed-descriptor twin of
+    `mla_prefill_chunk_batch` (see `llama_prefill_chunk_ragged` for the
+    descriptor contract). Queries fold through W_uk so the cached prefix
+    scores straight against latent rows, streamed block-indirect by
+    `kernels/attention.py:ragged_prefill_attend_mla`; the chunk's own
+    latents/rope keys stay exact bf16 from registers; the value side
+    re-expands only the attended [H, R] context through W_uv.
+
+    Returns (logits [Rn, V] f32 at each row's `last_idx` token, new_c, new_r).
+    """
+    H, dn, dr, dv = _dims(cfg)
+    quantized = isinstance(cache_c, dict)
+    L, B, _, S, R = (cache_c["q"] if quantized else cache_c).shape
+    T = tokens.shape[0]
+    Rn = slots.shape[0]
+    scale = mla_scale(cfg)
+    slots = jnp.asarray(slots, dtype=jnp.int32)
+    starts = jnp.asarray(starts, dtype=jnp.int32)
+    rowids = jnp.asarray(rowids, dtype=jnp.int32)
+    positions = jnp.asarray(positions, dtype=jnp.int32)
+    offsets = jnp.concatenate(
+        [
+            jnp.zeros((1,), jnp.int32),
+            jnp.sum(
+                (rowids[None, :] < jnp.arange(1, Rn + 1, dtype=jnp.int32)[:, None]),
+                axis=1,
+                dtype=jnp.int32,
+            ),
+        ]
+    )  # [Rn+1]
+    wslot = slots[jnp.clip(rowids, 0, Rn - 1)]  # [T]
+    moe_valid = rowids < Rn
+    btbl = paged["tbl"] if paged is not None else None
+    pool_c = paged["k"] if paged is not None else None
+    pool_r = paged["v"] if paged is not None else None
+
+    h = _embed_in(cfg, params, tokens)  # [T, D]
+    cos, sin = rope_tables(cfg, dr, positions)  # [T, dr/2]
+
+    def layer(carry, lp):
+        h, cc_all, cr_all, li = carry
+        x = _norm(cfg, h, lp["attn_norm"])
+        qn, qr = _queries(cfg, lp, x)  # [T, H, dn/dr]
+        qr = apply_rope(qr, cos, sin)
+        c, kr = _latents(cfg, lp, x)  # [T, R], [T, dr]
+        kr = apply_rope(kr[..., None, :], cos, sin)[..., 0, :]
+        w_uk, w_uv = _absorbed_w(lp, h.dtype, R, H, dn, dv)
+        qt = jnp.einsum("thd,rhd->thr", qn, w_uk)  # [T, H, R]
+
+        # ---- reads first: ragged attention over [cached past | packed self]
+        ctx_lat = ragged_prefill_attend_mla(
+            qt, qr, c, kr, cc_all, cr_all, li, rowids, offsets, slots, starts,
+            scale=scale, skey=skey, block_tables=btbl,
+            pool_c=pool_c, pool_r=pool_r,
+        )  # [T, H, R]
+        ctx = jnp.einsum("thr,rhd->thd", ctx_lat, w_uv).reshape(T, H * dv)
+        h = h + qdot(ctx, lp["wo_mla"])
+        h = _ffn_residual(cfg, lp, h, moe_valid=moe_valid)
+
+        # ---- writes last: positional scatter, pads (position S) DROP ----
+        if quantized:
+            cq = quantize_kv(c, scale_dtype=cc_all["s"].dtype)
+            rq = quantize_kv(kr, scale_dtype=cr_all["s"].dtype)
+            cc_all = {
+                "q": cc_all["q"].at[li, wslot, 0, positions].set(
+                    cq["q"], mode="drop"
+                ),
+                "s": cc_all["s"].at[li, wslot, 0, positions].set(
+                    cq["s"], mode="drop"
+                ),
+            }
+            cr_all = {
+                "q": cr_all["q"].at[li, wslot, 0, positions].set(
+                    rq["q"], mode="drop"
+                ),
+                "s": cr_all["s"].at[li, wslot, 0, positions].set(
+                    rq["s"], mode="drop"
+                ),
+            }
+        else:
+            cc_all = cc_all.at[li, wslot, 0, positions].set(
+                c.astype(cc_all.dtype), mode="drop"
+            )
+            cr_all = cr_all.at[li, wslot, 0, positions].set(
+                kr.astype(cr_all.dtype), mode="drop"
+            )
+        return (h, cc_all, cr_all, li + 1), None
+
+    carry = (h, cache_c, cache_r, jnp.int32(0))
+    if "dense_layers" in params:
+        carry, _ = jax.lax.scan(layer, carry, params["dense_layers"])
+    (h, new_c, new_r, _), _ = jax.lax.scan(layer, carry, params["layers"])
+    last = jnp.take(h, jnp.clip(last_idx, 0, T - 1), axis=0)  # [Rn, D]
     return _logits(cfg, params, last), new_c, new_r
 
 

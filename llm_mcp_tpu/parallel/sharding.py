@@ -231,6 +231,20 @@ def kv_pool_specs(quantized: bool = False, latent: bool = False) -> dict[str, An
     )
 
 
+def supports_ragged_prefill(mesh: Mesh | None) -> bool:
+    """Whether the ragged packed-prefill path (kernels/attention.py
+    ragged_* family) may run under `mesh`.
+
+    The ragged kernels take the packed [T] token buffer and the per-row
+    (slot, start, len) descriptors as whole-array operands and stream cache
+    blocks by absolute physical index — there is no clean axis left to
+    shard: rows bound for different dp shards interleave inside one packed
+    buffer, and tp would split the per-row DMA descriptors mid-stream.
+    Single-program regime only; any real mesh keeps the bucketed chunk path,
+    which shards per kv_cache_specs."""
+    return mesh is None or mesh.size == 1
+
+
 def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
     """Place a pytree on the mesh according to matching PartitionSpecs."""
     return jax.tree.map(

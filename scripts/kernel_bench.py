@@ -272,6 +272,149 @@ def bench_attn(
     }
 
 
+def bench_ragged_prefill(
+    model: str = "tiny-llm",
+    dist: str = "uniform",
+    rows: int = 4,
+    chunk: int = 32,
+    S: int = 256,
+    iters: int = 3,
+    n_mix: int = 4,
+    seed: int = 0,
+) -> dict[str, float]:
+    """Chunked-prefill dispatch comparison at one FILL DISTRIBUTION: the
+    bucketed [Ab, bucket] group vs the ragged packed [T] buffer over the
+    same pending chunk mixes (models/llama.py llama_prefill_chunk_batch vs
+    llama_prefill_chunk_ragged).
+
+    dist: "uniform"  — chunk lens U[1, chunk], mixed cached depths
+          "bimodal"  — half the rows near-empty chunks, half full chunks
+                       (the tail-latency mix that maximizes bucket pad)
+          "shared90" — every row resumes past a deep (~75% S) shared
+                       prefix with a short suffix chunk (the prefix-cache
+                       hit mix)
+
+    Reports true-token throughput, the pad-waste ratio of each staging
+    shape, and how many DISTINCT executables the n_mix draws minted — the
+    (bucket, skey) zoo vs the pow2-T ladder. Compiles are warmed per shape
+    before timing so tok/s prices the dispatch, not the jit cache."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_mcp_tpu.executor.common import pow2_bucket
+    from llm_mcp_tpu.models import get_config, init_kv_cache
+    from llm_mcp_tpu.models.llama import (
+        llama_prefill_chunk_batch,
+        llama_prefill_chunk_ragged,
+    )
+
+    cfg = get_config(model)
+    rng = np.random.default_rng(seed)
+    dtype = jnp.bfloat16 if jax.devices()[0].platform == "tpu" else jnp.float32
+    params_key = jax.random.PRNGKey(seed)
+    from llm_mcp_tpu.models.llama import init_llama_params
+
+    params = init_llama_params(cfg, params_key, dtype=dtype)
+    cache = init_kv_cache(cfg, rows, S, dtype=dtype)
+    ck0, cv0 = cache["k"], cache["v"]
+    V = cfg.vocab_size
+
+    def draw_mix():
+        if dist == "bimodal":
+            lens = np.where(
+                rng.random(rows) < 0.5,
+                rng.integers(1, max(2, chunk // 8), rows),
+                chunk,
+            )
+            starts = rng.integers(0, max(1, S // 4), rows)
+        elif dist == "shared90":
+            lens = rng.integers(1, max(2, chunk // 4), rows)
+            starts = np.full(rows, int(S * 0.75) - chunk)
+        else:  # uniform
+            lens = rng.integers(1, chunk + 1, rows)
+            starts = rng.integers(0, max(1, S // 4), rows)
+        starts = np.minimum(starts, S - chunk - 1).astype(np.int32)
+        return lens.astype(np.int32), starts
+
+    bucketed = partial(jax.jit, static_argnames=("skey",))(
+        lambda p, ck, cv, t, sl, st, nv, skey: llama_prefill_chunk_batch(
+            cfg, p, ck, cv, t, sl, st, nv, skey=skey
+        )
+    )
+    ragged = partial(jax.jit, static_argnames=("skey",))(
+        lambda p, ck, cv, t, rid, pos, sl, st, li, skey:
+        llama_prefill_chunk_ragged(
+            cfg, p, ck, cv, t, rid, pos, sl, st, li, skey=skey
+        )
+    )
+
+    mixes = [draw_mix() for _ in range(n_mix)]
+    stats = {"bucketed": [0, 0, 0.0, set()], "ragged": [0, 0, 0.0, set()]}
+    for lens, starts in mixes:
+        total = int(lens.sum())
+        skey = min(pow2_bucket(int(starts.max()), S), S)
+        # -- bucketed staging: Ab pow2 rows x pow2 max-len bucket
+        bucket = pow2_bucket(int(lens.max()), chunk)
+        Ab = 1 << (rows - 1).bit_length()
+        toks = np.zeros((Ab, bucket), np.int32)
+        for i, n in enumerate(lens):
+            toks[i, :n] = rng.integers(3, V, n)
+        sl = np.arange(Ab, dtype=np.int32) % rows
+        b_args = (jnp.asarray(toks), jnp.asarray(sl),
+                  jnp.asarray(np.resize(starts, Ab)),
+                  jnp.asarray(np.resize(lens, Ab)))
+        # -- ragged staging: one packed pow2-T buffer
+        T = pow2_bucket(total, max(chunk * rows, 32))
+        pt = np.zeros(T, np.int32)
+        rid = np.full(T, rows, np.int32)
+        pos = np.full(T, S, np.int32)
+        li = np.zeros(rows, np.int32)
+        off = 0
+        for i, (n, st) in enumerate(zip(lens, starts)):
+            pt[off : off + n] = rng.integers(3, V, n)
+            rid[off : off + n] = i
+            pos[off : off + n] = np.arange(st, st + n)
+            li[i] = off + n - 1
+            off += n
+        r_args = (jnp.asarray(pt), jnp.asarray(rid), jnp.asarray(pos),
+                  jnp.asarray(np.arange(rows, dtype=np.int32)),
+                  jnp.asarray(starts), jnp.asarray(li))
+        for name, fn, args, padded, shape in (
+            ("bucketed", bucketed, b_args, Ab * bucket, (Ab, bucket, skey)),
+            ("ragged", ragged, r_args, T, (T, skey)),
+        ):
+            out = fn(params, ck0, cv0, *args, skey=skey)  # warm the shape
+            jax.block_until_ready(out[0])
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(params, ck0, cv0, *args, skey=skey)
+            jax.block_until_ready(out[0])
+            st_ = stats[name]
+            st_[0] += total * iters
+            st_[1] += padded * iters
+            st_[2] += time.perf_counter() - t0
+            st_[3].add(shape)
+    b, r = stats["bucketed"], stats["ragged"]
+    return {
+        "bench": "ragged_prefill",
+        "model": model,
+        "dist": dist,
+        "rows": rows,
+        "chunk": chunk,
+        "S": S,
+        "bucketed_tok_per_s": round(b[0] / b[2], 1),
+        "ragged_tok_per_s": round(r[0] / r[2], 1),
+        "speedup": round((b[2] / b[0]) / (r[2] / r[0]), 3),
+        "bucketed_pad_waste_pct": round(100.0 * (1 - b[0] / b[1]), 1),
+        "ragged_pad_waste_pct": round(100.0 * (1 - r[0] / r[1]), 1),
+        "bucketed_executables": len(b[3]),
+        "ragged_executables": len(r[3]),
+    }
+
+
 def bench_layer_pass(
     model: str = "tiny-llm", B: int = 8, S: int = 256, K: int = 16, rounds: int = 2
 ) -> dict[str, float]:
@@ -357,6 +500,11 @@ def main() -> int:
     ap.add_argument("--fills", default="0.0,0.4,0.9", help="comma list of fill fractions")
     ap.add_argument("--iters", type=int, default=0, help="timed calls per point")
     ap.add_argument("--layer-pass", action="store_true", help="layer pass only")
+    ap.add_argument("--ragged-only", action="store_true", help="ragged prefill sweep only")
+    ap.add_argument(
+        "--dists", default="uniform,bimodal,shared90",
+        help="comma list of ragged-prefill fill distributions",
+    )
     ap.add_argument("--model", default="", help="layer-pass model (default by platform)")
     args = ap.parse_args()
 
@@ -370,6 +518,32 @@ def main() -> int:
     fills = [float(f) for f in args.fills.split(",") if f]
     iters = args.iters or (20 if on_tpu else 3)
     model = args.model or ("llama-3.1-8b" if on_tpu else "tiny-llm")
+
+    if args.ragged_only or not args.layer_pass:
+        rp_model = args.model or ("llama-3.1-8b" if on_tpu else "tiny-llm")
+        rp_rows = 8 if on_tpu else 4
+        rp_chunk = 256 if on_tpu else 32
+        rp_S = S
+        for dist in [d for d in args.dists.split(",") if d]:
+            try:
+                print(
+                    json.dumps(
+                        bench_ragged_prefill(
+                            rp_model, dist, rows=rp_rows, chunk=rp_chunk,
+                            S=rp_S, iters=iters,
+                        )
+                    ),
+                    flush=True,
+                )
+            except Exception as e:
+                print(
+                    json.dumps(
+                        {"bench": "ragged_prefill", "dist": dist, "error": repr(e)}
+                    ),
+                    flush=True,
+                )
+    if args.ragged_only:
+        return 0
 
     if not args.layer_pass:
         layouts = (

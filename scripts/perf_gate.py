@@ -60,6 +60,12 @@ Checks, in order of authority:
      bandwidth of the w8a8 layer pass; r05 measured ~570 of 819 GB/s).
      attn_us_per_cell gates relatively (latency-class) when a baseline
      carries it.
+  7. Prefill-economy checks, when the record carries them (ISSUE 11
+     ragged packed prefill): prefill_tok_per_s >= 500 (collapse floor),
+     prefill_pad_waste_pct <= 50 (the bucketed pow2 staging wastes
+     30-60% on mixed fills; the ragged packed buffer must not regress
+     back to it), and prefill_executables gates relatively against the
+     baseline (the executable-zoo count must never grow back).
 
 Missing metrics are reported as [SKIP] with a stderr warning but never
 fail the gate (older records predate newer fields — a KeyError here
@@ -95,9 +101,11 @@ HIGHER_BETTER = (
     "raw_decode_tok_per_s_llama-3.1-8b-int8_kv8_b112_tpu",
     "raw_decode_tok_per_s_mla-8b-int8_kv8_b4_s32768_tpu",
     "layers_gbps",
+    "prefill_tok_per_s",
 )
 LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms", "cow_copies_per_req",
-                "attn_us_per_cell", "attn_us_per_cell_paged")
+                "attn_us_per_cell", "attn_us_per_cell_paged",
+                "prefill_pad_waste_pct", "prefill_executables")
 
 # absolute floors/ceilings applied regardless of baseline coverage (only
 # ever read with .get(): a floor for a metric the record lacks must skip,
@@ -146,10 +154,22 @@ ABS_MIN = {
     # ~570 GB/s of the v5e's 819; 500 is the collapse floor (a drop below
     # means the fused pass re-materializes weights or lost the s8 MXU path)
     "layers_gbps": 500.0,
+    # prefill economy (ISSUE 11 ragged packed prefill): true prompt tok/s
+    # over the headline window. 500 is the collapse floor for the 8B
+    # headline — prefill riding a broken path (per-prompt serial admission,
+    # silent CPU fallback) lands far below it, while any healthy chunked
+    # window clears it with margin
+    "prefill_tok_per_s": 500.0,
 }
 ABS_MAX = {
     "p95_ttft_ms": 5000.0,
     "window_errors": 0.0,
+    # staging pad waste: 1 - true/dispatched prefill tokens. The bucketed
+    # pow2 path measures 30-60% on mixed fills; the ragged packed path's
+    # bound is one partial pow2-T buffer per window. 50% catches a ragged
+    # regression to worst-case bucketing without flaking the bucketed
+    # escape hatch (TPU_RAGGED_PREFILL=0 runs gate relatively instead)
+    "prefill_pad_waste_pct": 50.0,
     # more than ~2 copy-on-write blocks per completed request means the
     # block size fights the stored prefix lengths instead of sharing them
     "cow_copies_per_req": 2.0,

@@ -22,6 +22,16 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: engine tests rebuild the same tiny-model
+# executables dozens of times across files; dedupe the compiles within (and
+# across) suite runs. env-first so subprocess tests (distributed, slice,
+# worker) inherit the same cache.
+_cache_dir = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", "/tmp/llm_mcp_tpu_test_xla_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+
 import pytest  # noqa: E402
 
 

@@ -402,6 +402,136 @@ def test_decode_compact_matches_full_batch(kv_quant):
         off.shutdown()
 
 
+_RAGGED_PROMPTS = [
+    "ragged prefill equivalence " * 6,
+    "short",
+    "another mixed-length prompt for the packer " * 3,
+]
+_RAGGED_SHARED = "you are a helpful assistant. answer briefly. " * 3
+
+
+# tier-1 runs one GQA and one MLA layout; the other two ride the same code
+# paths (layout dispatch happens inside the model fn) and run under -m slow
+@pytest.mark.parametrize(
+    "model,kv_quant",
+    [
+        ("tiny-llm", ""),
+        pytest.param("tiny-llm", "int8", marks=pytest.mark.slow),
+        pytest.param("tiny-mla", "", marks=pytest.mark.slow),
+        pytest.param("tiny-mla", "int8", marks=pytest.mark.slow),
+    ],
+)
+def test_ragged_prefill_toggle_token_identical(monkeypatch, model, kv_quant):
+    """The escape hatch is bit-exact: TPU_RAGGED_PREFILL=0 (bucketed chunk
+    groups) and =1 (packed ragged staging) produce identical greedy tokens
+    per cache layout, across concurrent mixed-length admissions AND a
+    prefix-cache-hit admission whose suffix chunks read pinned blocks."""
+    outs = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("TPU_RAGGED_PREFILL", flag)
+        eng = GenerationEngine(
+            model, max_slots=4, max_seq_len=256, dtype=jnp.float32,
+            decode_chunk=2, prefill_chunk=8, kv_quant=kv_quant, seed=3,
+            prompt_cache_mb=8,
+        )
+        staged: list[int] = []
+        if flag == "1":
+            assert eng.ragged_prefill, "ragged gate should be on"
+            orig = eng._stage_ragged_group
+
+            def spy(budget, _o=orig):
+                g = _o(budget)
+                if g is not None:
+                    staged.append(g.n_tokens)
+                return g
+
+            eng._stage_ragged_group = spy
+        else:
+            assert not eng.ragged_prefill
+        eng.start()
+        try:
+            with cf.ThreadPoolExecutor(max_workers=3) as ex:
+                res = list(ex.map(
+                    lambda p: eng.generate(p, max_tokens=10, temperature=0.0),
+                    _RAGGED_PROMPTS,
+                ))
+            # 1st records the shared prompt, 2nd stores the entry, 3rd hits
+            # it — the hit's suffix chunks ride the staging path under test
+            hs = [
+                eng.generate(_RAGGED_SHARED + f"question {i}", max_tokens=8,
+                             temperature=0.0)
+                for i in range(3)
+            ]
+            assert eng.prefix_cache_hits >= 1, "prefix cache never hit"
+            if flag == "1":
+                assert staged, "ragged staging never ran"
+            outs[flag] = (
+                [r["text"] for r in res + hs],
+                [r["usage"] for r in res + hs],
+            )
+        finally:
+            eng.shutdown()
+    assert outs["0"][0] == outs["1"][0]
+    assert outs["0"][1] == outs["1"][1]
+
+
+def test_ragged_prefill_preempt_restore_token_identical(monkeypatch):
+    """A slot preempted while its prompt is still chunking under ragged
+    staging must restore to a token-identical stream: the packed-buffer
+    descriptors are rebuilt from the committed length, not from any state
+    the offload could have lost."""
+    import threading
+    import time
+
+    monkeypatch.setenv("TPU_KV_HOST_OFFLOAD", "1")
+    monkeypatch.setenv("TPU_RAGGED_PREFILL", "1")
+    eng = GenerationEngine(
+        "tiny-llm", max_slots=2, max_seq_len=256, dtype=jnp.float32,
+        decode_chunk=4, prefill_chunk=8, seed=3,
+    )
+    assert eng.ragged_prefill
+    eng.start()
+    # long prompts × chunk 8 keep both slots mid-prefill for many rounds,
+    # so the high-priority admission preempts a still-chunking victim
+    victim = "preempt during chunked admission " * 6
+    other = "second low priority stream holding its slot " * 4
+    results: dict[str, dict] = {}
+    lock = threading.Lock()
+
+    def low(p):
+        r = eng.generate(p, max_tokens=24, temperature=0.0, priority=0)
+        with lock:
+            results[p] = r
+
+    try:
+        threads = [
+            threading.Thread(target=low, args=(p,), daemon=True)
+            for p in (victim, other)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 60
+        while eng.slots_in_use() < 2 and time.time() < deadline:
+            time.sleep(0.002)
+        assert eng.slots_in_use() == 2, "low-priority streams never admitted"
+        hi = eng.generate("urgent request", max_tokens=6, temperature=0.0,
+                          priority=5)
+        assert hi["usage"]["completion_tokens"] >= 1
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "preempted stream hung"
+        st = eng.memory_stats()
+        assert st["preempted_total"] >= 1, "no preemption happened"
+        assert st["restored_total"] >= 1, "offloaded slot never restored"
+        # uncontended references on the same engine, same executables
+        for p in (victim, other):
+            ref = eng.generate(p, max_tokens=24, temperature=0.0)
+            assert results[p]["text"] == ref["text"]
+        assert eng.total_errors == 0
+    finally:
+        eng.shutdown()
+
+
 def test_engine_int8_kv_cache():
     """int8 KV cache serves coherently through both prefill paths."""
     eng = GenerationEngine(

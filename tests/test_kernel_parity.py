@@ -22,6 +22,8 @@ import llm_mcp_tpu.kernels.attention as A
 from llm_mcp_tpu.models.quant import pack_scales, scale_pack_width
 
 FILLS = (0.0, 0.4, 0.9)
+# ragged tier-1 keeps the boundary fills; the interior fill rides -m slow
+RAGGED_FILLS = (0.0, pytest.param(0.4, marks=pytest.mark.slow), 0.9)
 
 
 def _fused_q8_cache(rng, L, B, Hkv, S, hd, dtype=jnp.float32):
@@ -428,6 +430,173 @@ def test_append_bf16_kernel_parity(monkeypatch):
     np.testing.assert_array_equal(np.asarray(out_v), np.asarray(ref_v))
 
 
+# -- ragged packed prefill ---------------------------------------------------
+#
+# The chunked-prefill tentpole (kernels/attention.py ragged_* family): a
+# packed [T] token buffer with per-row (slot, start, len) descriptors, the
+# cached prefix streamed block-indirect through per-slot tables. Parity is
+# kernel-in-interpret vs the module's own exact XLA arm (`impl="xla"`) —
+# the arm that mirrors the bucketed chunk math the engine's greedy-identity
+# acceptance pins end-to-end (tests/test_engine.py ragged toggle tests).
+# Construction per the paged-decode precedent: identity tables scrambled so
+# prefix blocks resolve through donor pool rows and foreign arena homes in
+# shuffled order; the packed buffer carries a batch remainder (pads past the
+# last row) and an EMPTY row (a budget-starved descriptor). The fill level
+# drives the cached-prefix depth (`starts`), covering no-past, mid-block,
+# and deep multi-block streaming.
+
+
+def _ragged_case(fill, S, bt, B=6, pxb=4):
+    R, T = 3, 32
+    lens = [10, 0, 14]  # row 1 empty; total 24 < T = 32: remainder pads
+    total = sum(lens)
+    offsets = np.zeros(R + 1, np.int32)
+    offsets[1:] = np.cumsum(lens)
+    rowids = np.concatenate(
+        [np.full(n, r, np.int32) for r, n in enumerate(lens)]
+        + [np.full(T - total, R, np.int32)]
+    )
+    base = int(fill * (S - 16))
+    starts = np.asarray(
+        [base + 5 if base else 0, 0, max(1, base) if base else 0], np.int32
+    )
+    slots = np.asarray([4, 2, 0], np.int32)
+    nbs = S // bt
+    tbl = np.arange(B * nbs, dtype=np.int32).reshape(B, nbs)
+    # scrambled donors: slot 4's prefix resolves through pool rows 1, 3 and
+    # slot 2's arena home; slot 0's through pool 0 and slot 5's home
+    tbl[4, 0] = B * nbs + 1
+    if nbs > 1:
+        tbl[4, 1] = 2 * nbs + 1
+    if nbs > 2:
+        tbl[4, 2] = B * nbs + 3
+    tbl[0, 0] = B * nbs + 0
+    if nbs > 1:
+        tbl[0, 1] = 5 * nbs + 1
+    return R, T, total, rowids, offsets, slots, starts, tbl, nbs, pxb
+
+
+@pytest.mark.parametrize(
+    "paged", [pytest.param(False, marks=pytest.mark.slow), True])
+@pytest.mark.parametrize("fill", RAGGED_FILLS)
+def test_ragged_prefill_bf16_parity(fill, paged):
+    rng = np.random.default_rng(31)
+    L, Hkv, G, hd, S, bt, B = 2, 2, 2, 64, 128, 32, 6
+    R, T, total, rowids, offsets, slots, starts, tbl, nbs, pxb = _ragged_case(
+        fill, S, bt, B
+    )
+    ck = jnp.asarray(rng.standard_normal((L, B, Hkv, S, hd)), jnp.float32)
+    cv = jnp.asarray(rng.standard_normal((L, B, Hkv, S, hd)), jnp.float32)
+    pk = jnp.asarray(rng.standard_normal((L, pxb, Hkv, bt, hd)), jnp.float32)
+    pv = jnp.asarray(rng.standard_normal((L, pxb, Hkv, bt, hd)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((T, Hkv, G, hd)), jnp.float32)
+    ks = jnp.asarray(rng.standard_normal((T, Hkv, hd)), jnp.float32)
+    vs = jnp.asarray(rng.standard_normal((T, Hkv, hd)), jnp.float32)
+    kw = dict(
+        scale=hd**-0.5, skey=0, block_q=16,
+        block_tables=jnp.asarray(tbl) if paged else None,
+        pool_k=pk if paged else None, pool_v=pv if paged else None,
+    )
+    args = (q, ks, vs, ck, cv, 1, rowids, offsets, slots, starts)
+    ref = A.ragged_prefill_attend_bf16(*args, impl="xla", **kw)
+    out = A.ragged_prefill_attend_bf16(
+        *args, impl="kernel", interpret=True, **kw
+    )
+    np.testing.assert_allclose(
+        np.asarray(out[:total]), np.asarray(ref[:total]), atol=2e-5
+    )
+    assert not bool(jnp.isnan(out).any())
+
+
+@pytest.mark.parametrize(
+    "paged", [pytest.param(False, marks=pytest.mark.slow), True])
+@pytest.mark.parametrize("fill", RAGGED_FILLS)
+def test_ragged_prefill_q8_parity(fill, paged):
+    """Fused int8 layout incl. the bit-packed scale pseudo-head riding the
+    payload DMA; plain scales pre-gathered through the SAME scrambled
+    tables as the payload blocks."""
+    rng = np.random.default_rng(32)
+    L, Hkv, G, hd, S, bt, B = 2, 2, 2, 64, 128, 32, 6
+    R, T, total, rowids, offsets, slots, starts, tbl, nbs, pxb = _ragged_case(
+        fill, S, bt, B
+    )
+    ck, _ = _fused_q8_cache(rng, L, B, Hkv, S, hd)
+    p = ck["q"].shape[2] - 2 * Hkv
+    pool = {
+        "q": jnp.asarray(
+            rng.integers(-127, 128, (L, pxb, 2 * Hkv + p, bt, hd), dtype="int8")
+        ),
+        "s": jnp.asarray(rng.random((L, pxb, 2 * Hkv, bt), dtype="float32") * 0.02),
+    }
+    q = jnp.asarray(rng.standard_normal((T, Hkv, G, hd)), jnp.float32)
+    ks = jnp.asarray(rng.standard_normal((T, Hkv, hd)), jnp.float32)
+    vs = jnp.asarray(rng.standard_normal((T, Hkv, hd)), jnp.float32)
+    kw = dict(
+        scale=hd**-0.5, skey=0, block_q=16,
+        block_tables=jnp.asarray(tbl) if paged else None,
+        pool=pool if paged else None,
+    )
+    args = (q, ks, vs, ck, 1, rowids, offsets, slots, starts)
+    ref = A.ragged_prefill_attend_q8(*args, impl="xla", **kw)
+    out = A.ragged_prefill_attend_q8(*args, impl="kernel", interpret=True, **kw)
+    assert float(jnp.max(jnp.abs(out[:total] - ref[:total]))) < 1e-4
+    assert not bool(jnp.isnan(out).any())
+
+
+@pytest.mark.parametrize(
+    "quant", [pytest.param(False, marks=pytest.mark.slow), True])
+@pytest.mark.parametrize(
+    "paged", [pytest.param(False, marks=pytest.mark.slow), True])
+@pytest.mark.parametrize("fill", RAGGED_FILLS)
+def test_ragged_prefill_mla_parity(fill, paged, quant):
+    """One ragged MLA body covers bf16 and int8 latents (ones-scales when
+    bf16); rope and per-token scales ride pre-gathered VMEM operands while
+    the latent payload streams block-indirect."""
+    rng = np.random.default_rng(33)
+    L, S, bt, B, Rl, dr, H = 2, 128, 32, 6, 32, 16, 4
+    R, T, total, rowids, offsets, slots, starts, tbl, nbs, pxb = _ragged_case(
+        fill, S, bt, B
+    )
+    if quant:
+        cc = {
+            "q": jnp.asarray(rng.integers(-127, 128, (L, B, 1, S, Rl), dtype="int8")),
+            "s": jnp.asarray(rng.random((L, B, 1, S), dtype="float32") * 0.02),
+        }
+        cr = {
+            "q": jnp.asarray(rng.integers(-127, 128, (L, B, 1, S, dr), dtype="int8")),
+            "s": jnp.asarray(rng.random((L, B, 1, S), dtype="float32") * 0.02),
+        }
+        pc = {
+            "q": jnp.asarray(rng.integers(-127, 128, (L, pxb, 1, bt, Rl), dtype="int8")),
+            "s": jnp.asarray(rng.random((L, pxb, 1, bt), dtype="float32") * 0.02),
+        }
+        pr = {
+            "q": jnp.asarray(rng.integers(-127, 128, (L, pxb, 1, bt, dr), dtype="int8")),
+            "s": jnp.asarray(rng.random((L, pxb, 1, bt), dtype="float32") * 0.02),
+        }
+    else:
+        cc = jnp.asarray(rng.standard_normal((L, B, 1, S, Rl)), jnp.float32)
+        cr = jnp.asarray(rng.standard_normal((L, B, 1, S, dr)), jnp.float32)
+        pc = jnp.asarray(rng.standard_normal((L, pxb, 1, bt, Rl)), jnp.float32)
+        pr = jnp.asarray(rng.standard_normal((L, pxb, 1, bt, dr)), jnp.float32)
+    qt = jnp.asarray(rng.standard_normal((T, H, Rl)), jnp.float32)
+    qr = jnp.asarray(rng.standard_normal((T, H, dr)), jnp.float32)
+    cs = jnp.asarray(rng.standard_normal((T, Rl)), jnp.float32)
+    krs = jnp.asarray(rng.standard_normal((T, dr)), jnp.float32)
+    kw = dict(
+        scale=(Rl + dr) ** -0.5, skey=0, block_q=16,
+        block_tables=jnp.asarray(tbl) if paged else None,
+        pool_c=pc if paged else None, pool_r=pr if paged else None,
+    )
+    args = (qt, qr, cs, krs, cc, cr, 1, rowids, offsets, slots, starts)
+    ref = A.ragged_prefill_attend_mla(*args, impl="xla", **kw)
+    out = A.ragged_prefill_attend_mla(
+        *args, impl="kernel", interpret=True, **kw
+    )
+    assert float(jnp.max(jnp.abs(out[:total] - ref[:total]))) < 1e-4
+    assert not bool(jnp.isnan(out).any())
+
+
 # -- the guard ---------------------------------------------------------------
 
 # Every Pallas kernel body in kernels/attention.py and the test that pins
@@ -447,6 +616,9 @@ KERNEL_PARITY = {
     "_attend_q8_paged_kernel": ("tests/test_kernel_parity.py", "test_q8_gqa_paged_parity"),
     "_attend_bf16_paged_kernel": ("tests/test_kernel_parity.py", "test_bf16_gqa_paged_parity"),
     "_attend_q8_mla_paged_kernel": ("tests/test_kernel_parity.py", "test_mla_paged_parity"),
+    "_ragged_prefill_bf16_kernel": ("tests/test_kernel_parity.py", "test_ragged_prefill_bf16_parity"),
+    "_ragged_prefill_q8_kernel": ("tests/test_kernel_parity.py", "test_ragged_prefill_q8_parity"),
+    "_ragged_prefill_mla_kernel": ("tests/test_kernel_parity.py", "test_ragged_prefill_mla_parity"),
 }
 
 

@@ -424,6 +424,58 @@ def test_prefix_hit_pins_blocks(monkeypatch):
         eng.shutdown()
 
 
+def test_ragged_prefill_pin_only_over_shared_prefix(monkeypatch):
+    """Ragged packed prefill over a pinned shared prefix is a PURE READ of
+    the entry's blocks: across admit → suffix chunks → finish the ledger's
+    per-block refcounts return exactly to the stored-entry state, no COW
+    copy fires (the pow2 stored length is block-aligned), and the audit
+    stays clean — the block-indirect kernel stream must never look like a
+    writer to the ledger."""
+    monkeypatch.setenv("TPU_RAGGED_PREFILL", "1")
+    # prefill_chunk 8 forces several ragged chunk rounds per admission
+    eng = _paged_engine(monkeypatch, prefill_chunk=8)
+    try:
+        assert eng.ragged_prefill, "ragged gate should be on for this engine"
+        staged: list[int] = []
+        orig = eng._stage_ragged_group
+
+        def spy(budget, _o=orig):
+            g = _o(budget)
+            if g is not None:
+                staged.append(g.n_tokens)
+            return g
+
+        eng._stage_ragged_group = spy
+        # 1st records the prompt, 2nd stores the entry, 3rd hits it
+        for i in range(2):
+            eng.generate(SHARED + f"warm {i}?", max_tokens=4, temperature=0.0)
+        mgr = eng._paging
+        assert mgr._prefix, "prefix entry never stored"
+        before = {
+            bid: mgr._rc[bid]
+            for ids, _ in mgr._prefix.values()
+            for bid in ids
+        }
+        cow0 = mgr.stats()["cow_copies_total"]
+        hits0 = eng.prefix_cache_hits
+        out = eng.generate(SHARED + "the pinned one?", max_tokens=6,
+                           temperature=0.0)
+        assert out["usage"]["completion_tokens"] >= 1
+        assert eng.prefix_cache_hits > hits0, "admission never hit the entry"
+        assert staged, "ragged staging never ran"
+        after = {
+            bid: mgr._rc[bid]
+            for ids, _ in mgr._prefix.values()
+            for bid in ids
+        }
+        assert after == before, "shared-prefix refcounts drifted"
+        assert mgr.stats()["cow_copies_total"] == cow0, "pin-only read COWed"
+        assert mgr.leak_count() == 0
+        _assert_engine_clean(eng)
+    finally:
+        eng.shutdown()
+
+
 def test_cow_on_unaligned_stored_prefix(monkeypatch):
     """Stored prefix lengths are pow2 (>= 32); with a block size that
     doesn't divide them the boundary block is partially shared and every

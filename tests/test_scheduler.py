@@ -111,6 +111,8 @@ def test_stats_contract():
         "prefill_token_budget", "starved_rounds", "decode_round_ema_ms",
         "prefill_tok_cost_us", "fair_cap_tokens",
         "verify_rounds", "verify_tokens",
+        "prefill_true_tokens", "prefill_padded_tokens",
+        "prefill_pad_waste_pct",
     }
     assert all(isinstance(v, float) for v in st.values())
 
