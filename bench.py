@@ -296,6 +296,7 @@ def serve_path_metrics(
     ms0 = eng.memory_stats()
     pg0 = eng.paging_stats()
     sc0 = eng.scheduler_stats()
+    pf0 = eng.perf_stats()
     ev0, dr0 = rec.events_total(), rec.dropped_events
     m0 = time.time()
     time.sleep(measure_s)
@@ -307,6 +308,7 @@ def serve_path_metrics(
     ms1 = eng.memory_stats()
     pg1 = eng.paging_stats()
     sc1 = eng.scheduler_stats()
+    pf1 = eng.perf_stats()
     ev1, dr1 = rec.events_total(), rec.dropped_events
     m1 = time.time()
     # engine-loop budget over the window: where each wall-clock second of
@@ -494,6 +496,31 @@ def serve_path_metrics(
     per_ev = recorder_append_cost_s()
     out["recorder_events_per_s"] = round(1.0 / per_ev, 0) if per_ev > 0 else 0.0
     out["recorder_overhead_pct"] = round(100.0 * (ev1 - ev0) * per_ev / wall, 4)
+    # perf observatory over the window (telemetry/perf.py): per-token ITL
+    # percentiles (the rolling window at the m1 edge — freshly the window's
+    # tokens), SLO-conforming goodput tok/s by delta of the lifetime
+    # good-token ledger, and the live roofline MBU/MFU for the engine's
+    # active cache layout from the sampled decode device walls
+    itl1 = pf1.get("itl") or {}
+    itl_n = itl1.get("samples", 0.0) - (pf0.get("itl") or {}).get("samples", 0.0)
+    if itl_n > 0:
+        out["itl_p50_ms"] = round(itl1.get("p50_ms", 0.0), 3)
+        out["itl_p95_ms"] = round(itl1.get("p95_ms", 0.0), 3)
+        out["itl_p99_ms"] = round(itl1.get("p99_ms", 0.0), 3)
+        out["itl_samples"] = float(itl_n)
+    gp0, gp1 = pf0.get("goodput") or {}, pf1.get("goodput") or {}
+    if gp1.get("finished_tokens", 0.0) > gp0.get("finished_tokens", 0.0):
+        out["goodput_tok_per_s"] = round(
+            (gp1.get("good_tokens", 0.0) - gp0.get("good_tokens", 0.0)) / wall, 1
+        )
+        fin_tok = gp1.get("finished_tokens", 0.0) - gp0.get("finished_tokens", 0.0)
+        good_tok = gp1.get("good_tokens", 0.0) - gp0.get("good_tokens", 0.0)
+        out["goodput_ratio"] = round(good_tok / fin_tok, 4) if fin_tok else 1.0
+    rl1 = pf1.get("roofline") or {}
+    if rl1.get("device_tok_per_s", 0.0) > 0:
+        out["decode_mfu"] = rl1.get("decode_mfu", 0.0)
+        out["decode_mbu"] = rl1.get("decode_mbu", 0.0)
+        out["perf_device_tok_per_s"] = rl1.get("device_tok_per_s", 0.0)
     if ttfts:
         out["p50_ttft_ms"] = statistics.median(ttfts)
         out["p95_ttft_ms"] = sorted(ttfts)[max(0, int(len(ttfts) * 0.95) - 1)]
@@ -1422,6 +1449,23 @@ def main() -> None:
                 line["recorder_overhead_pct"] = serve.get(
                     "recorder_overhead_pct", 0.0
                 )
+            if "itl_p95_ms" in serve:
+                # token pacing over the headline window (perf observatory),
+                # promoted where scripts/perf_gate.py reads it: per-token
+                # ITL p95 is the streaming-smoothness ceiling
+                line["itl_p50_ms"] = serve["itl_p50_ms"]
+                line["itl_p95_ms"] = serve["itl_p95_ms"]
+            if "goodput_tok_per_s" in serve:
+                # SLO-conforming tokens/s (DistServe's metric) beside the
+                # raw headline — the gap between them is the SLO-violating
+                # share of the raw number
+                line["goodput_tok_per_s"] = serve["goodput_tok_per_s"]
+                line["goodput_ratio"] = serve.get("goodput_ratio", 1.0)
+            if "decode_mbu" in serve:
+                # live roofline from sampled decode rounds: the continuous
+                # descendant of the one-off layers_gbps microbench
+                line["decode_mbu"] = serve["decode_mbu"]
+                line["decode_mfu"] = serve["decode_mfu"]
             if "phase_pct" in serve:
                 # where the engine loop's wall-clock went during the window
                 line["serve_phase_pct"] = serve["phase_pct"]
@@ -1462,6 +1506,10 @@ def main() -> None:
             smoke_line["recorder_overhead_pct"] = serve.get(
                 "recorder_overhead_pct", 0.0
             )
+            if "itl_p95_ms" in serve:
+                smoke_line["itl_p95_ms"] = serve["itl_p95_ms"]
+            if "goodput_tok_per_s" in serve:
+                smoke_line["goodput_tok_per_s"] = serve["goodput_tok_per_s"]
             print(json.dumps(smoke_line))
             if smoke_line["recorder_dropped_events"] > 0:
                 # the smoke IS the recorder's no-drop proof: a drop here

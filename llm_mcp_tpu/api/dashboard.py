@@ -118,6 +118,49 @@ class DashboardAPI:
             for name, i in engines.items()
             if isinstance(i.get("migration"), dict)
         }
+        # prefill economy (scheduler stats, engines[name]["scheduler"]):
+        # true vs padded prefill tokens through the dispatchers and the pad
+        # waste the ragged path exists to erase — >20% waste on the
+        # non-ragged path is the "turn on TPU_PREFILL_RAGGED" signal
+        prefill = {
+            name: {
+                "true_tokens": int(i["scheduler"].get("prefill_true_tokens", 0.0)),
+                "padded_tokens": int(
+                    i["scheduler"].get("prefill_padded_tokens", 0.0)
+                ),
+                "pad_waste_pct": round(
+                    i["scheduler"].get("prefill_pad_waste_pct", 0.0), 1
+                ),
+            }
+            for name, i in engines.items()
+            if isinstance(i.get("scheduler"), dict)
+        }
+        # condensed perf-observatory view (full document under
+        # engines[name]["perf"] and /v1/debug/perf): token pacing (ITL),
+        # the goodput split, and the live roofline for the active layout
+        perf = {
+            name: {
+                "itl_p50_ms": round(
+                    (i["perf"].get("itl") or {}).get("p50_ms", 0.0), 2
+                ),
+                "itl_p95_ms": round(
+                    (i["perf"].get("itl") or {}).get("p95_ms", 0.0), 2
+                ),
+                "goodput_tok_per_s": round(
+                    (i["perf"].get("goodput") or {}).get("goodput_tok_per_s", 0.0), 1
+                ),
+                "goodput_ratio": round(
+                    (i["perf"].get("goodput") or {}).get("goodput_ratio", 1.0), 3
+                ),
+                "decode_mfu": (i["perf"].get("roofline") or {}).get("decode_mfu", 0.0),
+                "decode_mbu": (i["perf"].get("roofline") or {}).get("decode_mbu", 0.0),
+                "active_layout": (i["perf"].get("roofline") or {}).get(
+                    "active_layout", ""
+                ),
+            }
+            for name, i in engines.items()
+            if isinstance(i.get("perf"), dict)
+        }
         # condensed flight-recorder view (full stats under
         # engines[name]["flight"], raw ring via /v1/debug/flight): anomaly
         # dump history per engine plus watchdog transition counts — the
@@ -160,6 +203,8 @@ class DashboardAPI:
                 "speculation": speculation,
                 "memory": memory,
                 "paging": paging,
+                "prefill": prefill,
+                "perf": perf,
                 "migration": migration,
                 "anomalies": anomalies,
                 "compiles": compiles,

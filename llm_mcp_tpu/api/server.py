@@ -87,6 +87,9 @@ class CoreServer:
         self._flight_events = 0.0
         self._anomaly_counts: dict[str, dict[str, float]] = {}
         self._watchdog_counts: dict[str, dict[str, float]] = {}
+        # perf observatory: sampled phase walls are cumulative per
+        # engine+phase+bucket, bridged by delta like the rest
+        self._perf_phase_s: dict[str, dict[str, float]] = {}
         self.limits = LimitsEngine(self.db, strict=self.cfg.strict_model_limits)
         self.circuit = CircuitBreaker()
         self.router = Router(
@@ -408,6 +411,44 @@ class CoreServer:
                             "migrate_out_bytes_total",
                         )
                     }
+            pfs = getattr(e, "perf_stats", None)
+            if pfs is not None:
+                pf = pfs()
+                info[name]["perf"] = pf
+                gp = pf.get("goodput") or {}
+                rl = pf.get("roofline") or {}
+                self.metrics.goodput_tok_per_s.labels(engine=name).set(
+                    gp.get("goodput_tok_per_s", 0.0)
+                )
+                self.metrics.goodput_ratio.labels(engine=name).set(
+                    gp.get("goodput_ratio", 1.0)
+                )
+                self.metrics.decode_mfu.labels(engine=name).set(
+                    rl.get("decode_mfu", 0.0)
+                )
+                self.metrics.decode_mbu.labels(engine=name).set(
+                    rl.get("decode_mbu", 0.0)
+                )
+                # sampled phase walls advance by delta, per (phase, bucket)
+                prev_ph = self._perf_phase_s.get(name, {})
+                cur_ph: dict[str, float] = {}
+                for ph, rec_ in (pf.get("phases") or {}).items():
+                    for bucket in ("host_s", "device_s", "wait_s"):
+                        k = f"{ph}/{bucket}"
+                        cur = float(rec_.get(bucket, 0.0))
+                        cur_ph[k] = cur
+                        if cur > prev_ph.get(k, 0.0):
+                            self.metrics.perf_phase_seconds.labels(
+                                engine=name, phase=ph,
+                                bucket=bucket[:-2],
+                            ).inc(cur - prev_ph.get(k, 0.0))
+                self._perf_phase_s[name] = cur_ph
+                # each ITL sample lands in the histogram exactly once
+                drain = getattr(e, "drain_itl_samples", None)
+                if drain is not None:
+                    h = self.metrics.itl_seconds.labels(engine=name)
+                    for v in drain():
+                        h.observe(v)
             fst = getattr(e, "flight_stats", None)
             if fst is not None:
                 fs = fst()
@@ -513,6 +554,7 @@ class CoreServer:
         r("POST", "/v1/debug/test", self.dashboard.handle_smoke_test)
         r("GET", "/v1/debug/flight", self.handle_debug_flight)
         r("GET", "/v1/debug/compiles", self.handle_debug_compiles)
+        r("GET", "/v1/debug/perf", self.handle_debug_perf)
         r("GET", "/v1/debug/profile", self.handle_debug_profile)
         r("POST", "/v1/debug/profile", self.handle_debug_profile_start)
 
@@ -631,6 +673,19 @@ class CoreServer:
                 "stats": led.stats(),
                 "table": led.table(),
                 "entries": led.entries(limit=limit),
+            }
+        )
+
+    def handle_debug_perf(self, req: Request, resp: Response) -> None:
+        """Perf observatory (telemetry/perf.py) per engine: ITL/TPOT
+        percentiles, the goodput split against the TTFT+ITL SLO, sampled
+        per-phase {host, device, wait} attribution (TPU_PERF_SAMPLE), and
+        the four-layout roofline (MFU/MBU vs TPU_PEAK_* chip peaks)."""
+        resp.write_json(
+            {
+                name: e.perf_stats()
+                for name, e in self.gen_engines.items()
+                if getattr(e, "perf_stats", None) is not None
             }
         )
 

@@ -71,6 +71,7 @@ from ..parallel.sharding import (
     llama_param_specs, kv_cache_specs, kv_pool_specs, shard_pytree,
     supports_ragged_prefill,
 )
+from ..telemetry import perf
 from ..telemetry import recorder as flight
 from ..telemetry import tracing
 from .common import fine_bucket, pow2_bucket
@@ -226,6 +227,13 @@ class _Slot:
     # and restore re-inserts them from the entry's device arrays).
     shared_entry: Any = None
     shared_len: int = 0
+    # perf observatory (telemetry/perf.py): wall of this slot's previous
+    # emission (anchor for the next round's inter-token gap) + lifetime
+    # ITL accumulation, folded into the decode span and goodput ledger
+    # at finish
+    perf_last_emit: float = 0.0
+    itl_s_total: float = 0.0
+    itl_samples: int = 0
 
 
 @dataclass
@@ -1077,6 +1085,26 @@ class GenerationEngine:
         self._anomaly = flight.AnomalyMonitor(
             self._flight, target_ttft_ms=self.target_ttft_ms
         )
+        # Perf observatory (telemetry/perf.py): ITL/TPOT timelines, goodput
+        # accounting, and sampled steady-state phase attribution with
+        # roofline MFU/MBU — the CompileLedger's steady-state complement.
+        # Per-engine (its roofline is this engine's model shape); stdlib
+        # module, so the engine hands it plain scalars only.
+        self._perf = perf.PerfObservatory(
+            shape=perf.ModelShape.from_config(self.cfg),
+            active_layout=perf.layout_name(
+                bool(self.cfg.kv_lora_rank), self.kv_quant == "int8"
+            ),
+            paged=self._phys is not None,
+            block_tokens=self._paging.block_tokens,
+            weight_bytes_per_param=(
+                1.0 if self.quant == "int8" else jnp.dtype(dtype).itemsize
+            ),
+            target_ttft_ms=self.target_ttft_ms,
+        )
+        # wall of the previous round completion: the sampled "wait" bucket
+        # (scheduler/host gap between consecutive device rounds)
+        self._perf_mark = time.perf_counter()
         # watchdog/compile-grace state transition counts (satellite of the
         # shed-while-compiling post-mortem gap): bridged to
         # llmtpu_watchdog_transitions_total{state=...} by engines_info
@@ -2030,6 +2058,18 @@ class GenerationEngine:
 
     def anomaly_history(self, limit: int = 20) -> list[dict[str, Any]]:
         return self._anomaly.history(limit)
+
+    def perf_stats(self) -> dict[str, Any]:
+        """Perf-observatory block (/v1/debug/perf + engines_info + bench):
+        ITL percentiles, goodput split, sampled per-phase host/device/wait
+        attribution, and the four-layout roofline. Read-only over the
+        observatory's own lock, so safe from any thread."""
+        return self._perf.stats()
+
+    def drain_itl_samples(self) -> list[float]:
+        """ITL samples (seconds) since the last drain — engines_info feeds
+        them to the llmtpu_itl_seconds histogram exactly once."""
+        return self._perf.drain_itl()
 
     # -- on-demand profiler capture (/v1/debug/profile) --------------------
 
@@ -3368,9 +3408,15 @@ class GenerationEngine:
             self._d_temp, self._d_topk, self._d_topp, self._d_last_tok,
             jnp.asarray(tokens), jnp.asarray(ipack), jnp.asarray(fpack),
         )
+        t_call = time.perf_counter()  # jit returned; device running
         toks0 = np.asarray(toks0)  # host sync: first-call wall ≈ compile time
         if first:
             self._compile_obs("admit", (Ab, bucket), time.perf_counter() - t0c)
+        else:
+            self._sample_prefill_phase(
+                "admit", t0c, t_call,
+                sum(len(ids) for _, _, ids in batch), A,
+            )
         for i, (slot, req, ids) in enumerate(batch):
             self._activate_state(slot, req, ids, int(toks0[i]))
 
@@ -3690,6 +3736,7 @@ class GenerationEngine:
                     group.starts_arr, group.last_idx_arr, group.skey,
                     paged=self._paged_arg(),
                 )
+                t_call = time.perf_counter()  # jit returned; device running
                 jax.block_until_ready(self._ck)
                 wall = time.perf_counter() - t0
                 if first:
@@ -3697,6 +3744,11 @@ class GenerationEngine:
                         "pf_rag",
                         (group.bucket, group.skey, self._phys is not None),
                         wall,
+                    )
+                else:
+                    self._sample_prefill_phase(
+                        "pf_rag", t0, t_call, group.n_tokens,
+                        len(group.metas),
                     )
                 self._sched.observe_prefill(
                     group.n_tokens, wall, padded_tokens=group.bucket
@@ -3716,6 +3768,7 @@ class GenerationEngine:
                 group.slots_arr, group.starts_arr, group.nv_arr, group.skey,
                 paged=self._paged_arg(),
             )
+            t_call = time.perf_counter()  # jit returned; device running
             jax.block_until_ready(self._ck)
             wall = time.perf_counter() - t0
             if first:
@@ -3723,6 +3776,10 @@ class GenerationEngine:
                     "chunk",
                     (group.tokens.shape[0], group.bucket, group.skey,
                      self._phys is not None), wall,
+                )
+            else:
+                self._sample_prefill_phase(
+                    "chunk", t0, t_call, group.n_tokens, len(group.metas),
                 )
             self._sched.observe_prefill(
                 group.n_tokens, wall,
@@ -3891,11 +3948,28 @@ class GenerationEngine:
             np.int32(self._next_counter()), skey=skey,
             paged=self._paged_arg(),
         )
+        t_call = time.perf_counter()  # jit returned (dispatch is async)
         n_acc = np.asarray(n_acc)  # the round's host sync point
         final = np.asarray(final)
         if first:
             self._compile_obs("verify", (A, C, skey, self._phys is not None),
                               time.perf_counter() - t0)
+        elif self._perf.should_sample("verify"):
+            # verify is synchronous, so the asarray fetch IS the device wall
+            t_done = time.perf_counter()
+            wait_s = max(0.0, t0 - self._perf_mark)
+            self._perf.observe_phase(
+                "verify", t_call - t0, t_done - t_call, wait_s,
+                tokens=total, rows=n,
+                ctx_mean=float(starts_arr[:n].mean()) if n else 0.0,
+            )
+            self._flight.event(
+                "perf", phase="verify",
+                host_ms=round((t_call - t0) * 1e3, 3),
+                device_ms=round((t_done - t_call) * 1e3, 3),
+                wait_ms=round(wait_s * 1e3, 3),
+                rows=n,
+            )
         self._sched.observe_verify(total, time.perf_counter() - t0)
         before = self.total_tokens
         drafted_round = 0
@@ -3919,6 +3993,7 @@ class GenerationEngine:
             parts: list[str] = []
             finish = None
             emitted = 0
+            gen_before = s.generated
             for j, tok in enumerate(toks):
                 emit, finish = self._process_token(s, int(tok), base_b + j)
                 if int(tok) != self.tokenizer.eos_id:
@@ -3928,6 +4003,7 @@ class GenerationEngine:
                 if finish is not None:
                     break
             self.spec_emitted += emitted
+            self._observe_itl(s, s.generated - gen_before)
             if parts:
                 s.req.out.put({"type": "token", "text": "".join(parts)})
             if finish is not None:
@@ -4140,12 +4216,40 @@ class GenerationEngine:
             )
         else:
             padded = 0
-        self._flight.event(
+        phase_name = (
             ("fused_rag" if group.ragged else "fused")
-            if group is not None else "decode",
+            if group is not None else "decode"
+        )
+        self._flight.event(
+            phase_name,
             rid=self._rid_dispatched, rows=len(active),
             prefill_tokens=group.n_tokens if group is not None else 0,
+            prefill_padded=padded,
         )
+        # Sampled steady-state attribution (every Nth dispatch of this
+        # phase; first dispatches belong to the CompileLedger): host = the
+        # staging+dispatch wall up to the async jit return, device = one
+        # block_until_ready on the round (the sample's cost — it serializes
+        # the pipeline for this round only), wait = the host-side gap since
+        # the previous round's fetch landed.
+        if not first and self._perf.should_sample(phase_name):
+            t1 = time.perf_counter()
+            jax.block_until_ready(out)
+            t2 = time.perf_counter()
+            wait_s = max(0.0, round_t0 - self._perf_mark)
+            ctx_mean = float(base[active].mean()) if nact else 0.0
+            self._perf.observe_phase(
+                phase_name, t1 - round_t0, t2 - t1, wait_s,
+                tokens=nact * self.decode_chunk, rows=nact,
+                ctx_mean=ctx_mean,
+            )
+            self._flight.event(
+                "perf", phase=phase_name,
+                host_ms=round((t1 - round_t0) * 1e3, 3),
+                device_ms=round((t2 - t1) * 1e3, 3),
+                wait_ms=round(wait_s * 1e3, 3),
+                rows=nact,
+            )
         return _DispatchedRound(
             out=out, entries=entries, base=base, t0=round_t0,
             rid=self._rid_dispatched,
@@ -4166,6 +4270,7 @@ class GenerationEngine:
         authoritative for events, usage, and text."""
         out = np.asarray(disp.out)  # [K, Ba] — the only host sync per round
         self._last_round_ts = time.time()  # decode-cadence stall signal
+        self._perf_mark = time.perf_counter()  # sampled wait-gap anchor
         # feed the token-budget scheduler's cost model: prefill-free rounds
         # teach the decode-round EMA; fused rounds attribute their time over
         # that EMA to the chunk group's prompt tokens
@@ -4248,12 +4353,14 @@ class GenerationEngine:
             parts: list[str] = []
             finish = None
             base_b = int(p.base[b])
+            gen_before = s.generated
             for k in range(K):
                 emit, finish = self._process_token(s, int(p.out[k, col]), base_b + k)
                 if emit:
                     parts.append(emit)
                 if finish is not None:
                     break
+            self._observe_itl(s, s.generated - gen_before)
             if parts:
                 # ONE coalesced text event per slot per round: the K tokens
                 # were all learned at the same fetch, so splitting them into
@@ -4268,6 +4375,49 @@ class GenerationEngine:
                 self._finish_slot(b, s, finish)
         with self.stats_lock:
             self._window.append((time.time(), self.total_tokens - before))
+
+    def _sample_prefill_phase(
+        self, phase: str, t0: float, t_call: float, tokens: int, rows: int
+    ) -> None:
+        """Sampled attribution for the synchronous prefill-family
+        dispatches, called right after their device sync: t0→t_call is host
+        staging (the jit call returns as soon as the dispatch is queued),
+        t_call→now is device compute. Every Nth dispatch per phase
+        (TPU_PERF_SAMPLE); first dispatches never reach here (they are the
+        CompileLedger's)."""
+        if not self._perf.should_sample(phase):
+            return
+        t_done = time.perf_counter()
+        wait_s = max(0.0, t0 - self._perf_mark)
+        self._perf.observe_phase(
+            phase, t_call - t0, t_done - t_call, wait_s,
+            tokens=tokens, rows=rows,
+        )
+        self._flight.event(
+            "perf", phase=phase,
+            host_ms=round((t_call - t0) * 1e3, 3),
+            device_ms=round((t_done - t_call) * 1e3, 3),
+            wait_ms=round(wait_s * 1e3, 3),
+            rows=rows,
+        )
+
+    def _observe_itl(self, s: _Slot, n_new: int) -> None:
+        """Fold one emission round's tokens into the slot's token timeline:
+        the wall gap since the slot's previous emission (first round: since
+        its TTFT stamp) spread evenly over the round's tokens — the engine
+        learns a round's tokens at ONE fetch, so a finer per-token split
+        would be fiction. Feeds the observatory's ITL window/goodput and
+        the itl_degradation anomaly detector."""
+        if n_new <= 0:
+            return
+        now = time.time()
+        anchor = s.perf_last_emit or s.first_token_at or now
+        gap = max(0.0, now - anchor)
+        itl = self._perf.observe_itl(gap, n_new)
+        s.perf_last_emit = now
+        s.itl_s_total += gap
+        s.itl_samples += n_new
+        self._anomaly.signal("itl_degradation", itl_ms=itl * 1e3)
 
     def _emit_token(self, slot_idx: int, s: _Slot, tok: int, pos: int) -> bool:
         """Append one token to a slot; returns False when the slot finished.
@@ -4351,6 +4501,13 @@ class GenerationEngine:
         with self.stats_lock:
             self.finished_requests += 1
             self.finished_tokens += s.generated
+        ttft_ms = (s.first_token_at - req.created_at) * 1000.0
+        itl_mean_ms = (
+            s.itl_s_total / s.itl_samples * 1e3 if s.itl_samples else 0.0
+        )
+        # goodput ledger: classify against the joint TTFT+ITL SLO
+        if s.first_token_at:
+            self._perf.finish_request(ttft_ms, itl_mean_ms, s.generated)
         # record BEFORE the done/_DONE events publish: a caller unblocked by
         # the queue must be able to see the completed trace immediately
         if req.trace_ctx and s.first_token_at:
@@ -4359,7 +4516,9 @@ class GenerationEngine:
             attrs = {
                 "request_id": req.request_id,
                 "completion_tokens": s.generated,
+                "output_tokens": s.generated,
                 "tok_per_s": round(s.generated / dur, 1),
+                "itl_mean_ms": round(itl_mean_ms, 2),
                 "finish_reason": finish,
             }
             if s.spec is not None:
@@ -4380,7 +4539,7 @@ class GenerationEngine:
                     "completion_tokens": s.generated,
                     "total_tokens": s.prompt_len + s.generated,
                 },
-                "ttft_ms": (s.first_token_at - req.created_at) * 1000.0,
+                "ttft_ms": ttft_ms,
             }
         )
         req.out.put(_DONE)

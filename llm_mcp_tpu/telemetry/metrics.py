@@ -288,5 +288,50 @@ class Metrics:
             registry=r,
         )
 
+        # -- Perf observatory (telemetry/perf.py, TPU_PERF_SAMPLE /
+        # TPU_TARGET_ITL_MS; doc/observability.md). ITL samples are drained
+        # from each engine's observatory at engines_info refresh (exactly
+        # once, like compile_seconds); goodput/roofline gauges read straight
+        # from perf_stats(); the sampled phase-walls counters advance by
+        # delta like the pool/paging bridges above.
+        self.itl_seconds = Histogram(
+            "llmtpu_itl_seconds",
+            "Inter-token latency (TPOT): per-token share of each emission round's wall gap",
+            ["engine"],
+            buckets=(0.002, 0.005, 0.01, 0.02, 0.04, 0.08, 0.15, 0.3, 0.6, 1.2, 2.5, 5),
+            registry=r,
+        )
+        self.goodput_tok_per_s = Gauge(
+            "llmtpu_goodput_tok_per_s",
+            "Tokens/s from requests meeting the joint TTFT+ITL SLO (60s window)",
+            ["engine"],
+            registry=r,
+        )
+        self.goodput_ratio = Gauge(
+            "llmtpu_goodput_ratio",
+            "SLO-conforming / total finished tokens (cumulative)",
+            ["engine"],
+            registry=r,
+        )
+        self.decode_mfu = Gauge(
+            "llmtpu_decode_mfu",
+            "Model FLOPs utilization of sampled decode rounds vs TPU_PEAK_TFLOPS",
+            ["engine"],
+            registry=r,
+        )
+        self.decode_mbu = Gauge(
+            "llmtpu_decode_mbu",
+            "HBM bandwidth utilization of sampled decode rounds vs TPU_PEAK_HBM_GBPS",
+            ["engine"],
+            registry=r,
+        )
+        self.perf_phase_seconds = Counter(
+            "llmtpu_perf_phase_seconds_total",
+            "Sampled engine-loop wall seconds by dispatch phase and bucket "
+            "(host staging / device compute / scheduler wait)",
+            ["engine", "phase", "bucket"],
+            registry=r,
+        )
+
     def render(self) -> tuple[bytes, str]:
         return generate_latest(self.registry), CONTENT_TYPE_LATEST

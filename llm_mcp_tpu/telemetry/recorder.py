@@ -22,12 +22,16 @@ An *event* is a tuple ``(seq, ts, etype, trace_id, fields)``:
   seq       monotonic step sequence (process-wide, from itertools.count —
             a single CPython bytecode op, so the hot path needs no lock)
   ts        wall-clock seconds
-  etype     short event kind: admit / budget / chunk / verify / decode /
-            fused / preempt / offload / restore / cow / pin / unpin /
-            pg_tbl (device block-table reset/rebuild, with the shared-row
-            count) / pg_cow (physical boundary-block copy: pool row ->
-            identity home) / migrate_out / migrate_in / shed / watchdog /
-            compile / anomaly / profile
+  etype     short event kind: admit / budget / chunk / pf_rag (packed
+            ragged prefill, with true/padded token fields) / verify /
+            decode / fused / fused_rag (ragged fused step) / preempt /
+            offload / restore / cow / pin / unpin / snap (paged ledger
+            snapshot for preempt/offload) / pg_tbl (device
+            block-table reset/rebuild, with the shared-row count) /
+            pg_cow (physical boundary-block copy: pool row -> identity
+            home) / migrate_out / migrate_in / shed / watchdog /
+            compile / perf (sampled host/device/wait phase timing from
+            the perf observatory) / anomaly / profile
   trace_id  the request's 32-hex trace id ("" for engine-global events) —
             a dump stitches directly into /v1/traces
   fields    flat dict of scalars (or None)
@@ -68,6 +72,7 @@ __all__ = [
     "CompileLedger",
     "DecodeStallDetector",
     "FlightRecorder",
+    "ITLDegradationDetector",
     "PagedLeakDetector",
     "PingPongDetector",
     "ShedDuringGraceDetector",
@@ -408,6 +413,42 @@ class PingPongDetector:
                 f"{len(dq)} times in {self.window_s:.0f}s")
 
 
+class ITLDegradationDetector:
+    """Windowed mean inter-token latency breached M× the TPU_TARGET_ITL_MS
+    SLO. TTFTBurnDetector's decode-side sibling: the burn case it catches
+    is tokens still flowing but *slowly* — a decode stall never trips
+    (cadence stops entirely), yet users see exactly this as sluggish
+    streaming. Fed per-round (itl_ms = round gap / tokens learned), it
+    needs min_samples before judging so one coalesced round can't fire it."""
+
+    name = "itl_degradation"
+
+    def __init__(self, target_ms: float, mult: float = 3.0,
+                 window: int = 64, min_samples: int = 16):
+        self.target_ms = target_ms
+        self.mult = mult
+        self.window = deque(maxlen=max(4, window))
+        self.min_samples = max(1, min_samples)
+        self._latched = False
+
+    def observe(self, itl_ms: float) -> str | None:
+        if self.target_ms <= 0:
+            return None
+        self.window.append(itl_ms)
+        if len(self.window) < self.min_samples:
+            return None
+        mean = sum(self.window) / len(self.window)
+        if mean <= self.mult * self.target_ms:
+            self._latched = False
+            return None
+        if self._latched:
+            return None
+        self._latched = True
+        return (f"ITL degradation: mean {mean:.1f}ms over last "
+                f"{len(self.window)} rounds vs {self.mult:g}x target "
+                f"({self.target_ms:.0f}ms)")
+
+
 class ShedDuringGraceDetector:
     """Load was shed while the watchdog's compile-grace window was active —
     the engine dropped work because of a *compile*, not a wedge. One fire
@@ -440,14 +481,18 @@ class AnomalyMonitor:
         detectors: list | None = None,
         history: int = 64,
         target_ttft_ms: float | None = None,
+        target_itl_ms: float | None = None,
     ):
         self.recorder = recorder
         if detectors is None:
             if target_ttft_ms is None:
                 target_ttft_ms = _env_float("TPU_TARGET_TTFT_MS", 0.0)
+            if target_itl_ms is None:
+                target_itl_ms = _env_float("TPU_TARGET_ITL_MS", 0.0)
             detectors = [
                 DecodeStallDetector(),
                 TTFTBurnDetector(target_ms=target_ttft_ms),
+                ITLDegradationDetector(target_ms=target_itl_ms),
                 SpecCollapseDetector(),
                 PagedLeakDetector(),
                 PingPongDetector(),

@@ -66,6 +66,12 @@ Checks, in order of authority:
      30-60% on mixed fills; the ragged packed buffer must not regress
      back to it), and prefill_executables gates relatively against the
      baseline (the executable-zoo count must never grow back).
+  8. Perf-observatory checks, when the record carries them (ISSUE 12):
+     goodput_ratio >= 0.5 (under half the finished tokens meeting the
+     TTFT+ITL SLO means the headline is mostly violation traffic),
+     decode_mbu >= 0.3 (sampled decode HBM bandwidth collapse floor),
+     itl_p95_ms <= 500 absolute plus relative latency-class gating, and
+     goodput_tok_per_s gates relatively like other throughput metrics.
 
 Missing metrics are reported as [SKIP] with a stderr warning but never
 fail the gate (older records predate newer fields — a KeyError here
@@ -102,10 +108,14 @@ HIGHER_BETTER = (
     "raw_decode_tok_per_s_mla-8b-int8_kv8_b4_s32768_tpu",
     "layers_gbps",
     "prefill_tok_per_s",
+    "goodput_tok_per_s",
+    "goodput_ratio",
+    "decode_mbu",
 )
 LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms", "cow_copies_per_req",
                 "attn_us_per_cell", "attn_us_per_cell_paged",
-                "prefill_pad_waste_pct", "prefill_executables")
+                "prefill_pad_waste_pct", "prefill_executables",
+                "itl_p95_ms")
 
 # absolute floors/ceilings applied regardless of baseline coverage (only
 # ever read with .get(): a floor for a metric the record lacks must skip,
@@ -160,6 +170,15 @@ ABS_MIN = {
     # silent CPU fallback) lands far below it, while any healthy chunked
     # window clears it with margin
     "prefill_tok_per_s": 500.0,
+    # perf observatory (telemetry/perf.py). goodput_ratio: under half the
+    # finished tokens meeting the TTFT+ITL SLO means the headline tok/s is
+    # mostly SLO-violating traffic — DistServe's "raw throughput lied"
+    # case. decode_mbu: sampled decode rounds moving under 30% of
+    # TPU_PEAK_HBM_GBPS on the 8B int8 headline is a bandwidth collapse
+    # (lost fused layout / silent fallback); healthy rounds measured well
+    # above it (layers_gbps ~570/819 ≈ 0.70 on the weight stream alone)
+    "goodput_ratio": 0.5,
+    "decode_mbu": 0.3,
 }
 ABS_MAX = {
     "p95_ttft_ms": 5000.0,
@@ -174,6 +193,11 @@ ABS_MAX = {
     # block size fights the stored prefix lengths instead of sharing them
     "cow_copies_per_req": 2.0,
     "paged_block_leaks": 0.0,
+    # per-token ITL p95 (perf observatory): the streaming-smoothness
+    # collapse ceiling. A healthy decode round spreads its wall over K
+    # tokens per slot (tens of ms each at the 8B headline); half a second
+    # per token means rounds are stalling or emission is starved
+    "itl_p95_ms": 500.0,
 }
 
 
