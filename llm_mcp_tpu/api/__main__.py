@@ -126,7 +126,18 @@ def main() -> None:
             circuit=server.router.circuit,
             device_max_concurrency=cfg.device_max_concurrency,
             default_lease_s=float(cfg.worker_lease_seconds),
-        ).start(f"{ghost or '0.0.0.0'}:{gport or 9090}")
+        )
+        if gen_engines:
+            # KV transfer endpoint on the same server: remote migration in,
+            # and the fleet prefix tier's PrefixFetch out (handlers must be
+            # registered before start). Advertise the dialable address so
+            # peers' routers can pull prefixes from this process.
+            eng = next(iter(gen_engines.values()))
+            grpc_server.enable_kv_transfer(
+                eng.migrate_import_stream, prefix_export=server.prefix_export
+            )
+            server.transfer_addr = server.transfer_addr or cfg.grpc_addr
+        grpc_server.start(f"{ghost or '0.0.0.0'}:{gport or 9090}")
         log.info("grpc worker protocol on %s", cfg.grpc_addr)
 
     stop = []

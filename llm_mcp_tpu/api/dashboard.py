@@ -35,6 +35,7 @@ class DashboardAPI:
         router: Router,
         cfg: Config,
         engines_info=None,  # callable -> dict with local engine stats
+        route_stats=None,  # callable -> prefix-route outcome counters
     ):
         self.db = db
         self.queue = queue
@@ -42,6 +43,7 @@ class DashboardAPI:
         self.router = router
         self.cfg = cfg
         self.engines_info = engines_info or (lambda: {})
+        self.route_stats = route_stats or (lambda: {})
         self.started_at = time.time()
 
     # -- dashboard ---------------------------------------------------------
@@ -181,6 +183,43 @@ class DashboardAPI:
             for name, i in engines.items()
             if isinstance(i.get("flight"), dict)
         }
+        # condensed prefix-locality routing view (full tier stats under
+        # engines[name]["prefix_tier"], knobs + digest via
+        # /v1/debug/prefix): route outcomes plus each engine's chain
+        # residency and wire traffic — is the fleet prefix tier hitting?
+        rs = self.route_stats() or {}
+        decided = rs.get("local", 0.0) + rs.get("fetch", 0.0) + rs.get("miss", 0.0)
+        routing = {
+            "outcomes": {
+                k: int(rs.get(k, 0.0)) for k in ("local", "fetch", "miss", "fetch_fail")
+            },
+            "hit_rate": round(
+                (rs.get("local", 0.0) + rs.get("fetch", 0.0)) / decided, 3
+            )
+            if decided
+            else 0.0,
+            "matched_tokens": int(rs.get("matched_tokens", 0.0)),
+            "fetch_ms": round(rs.get("fetch_ms", 0.0), 1),
+            "engines": {
+                name: {
+                    "chains": int(i["prefix_tier"].get("chains", 0.0)),
+                    "longest_tokens": int(i["prefix_tier"].get("longest_tokens", 0.0)),
+                    "exports": int(i["prefix_tier"].get("exports_total", 0.0)),
+                    "imports": int(i["prefix_tier"].get("imports_total", 0.0)),
+                    "import_rejects": int(
+                        i["prefix_tier"].get("import_rejects_total", 0.0)
+                    ),
+                    "out_mb": round(
+                        i["prefix_tier"].get("export_bytes_total", 0.0) / 2**20, 2
+                    ),
+                    "in_mb": round(
+                        i["prefix_tier"].get("import_bytes_total", 0.0) / 2**20, 2
+                    ),
+                }
+                for name, i in engines.items()
+                if isinstance(i.get("prefix_tier"), dict)
+            },
+        }
         # condensed compile-ledger view (full table via /v1/debug/compiles):
         # the ledger is process-wide — one block, costliest shapes first,
         # so cold-boot compile spend is visible without grepping logs
@@ -206,6 +245,7 @@ class DashboardAPI:
                 "prefill": prefill,
                 "perf": perf,
                 "migration": migration,
+                "routing": routing,
                 "anomalies": anomalies,
                 "compiles": compiles,
                 "issues": issues,

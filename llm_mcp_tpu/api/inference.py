@@ -58,6 +58,7 @@ class InferenceAPI:
         gen_engines: dict[str, GenerationEngine] | None = None,
         embed_engines: dict[str, EmbeddingEngine] | None = None,
         cloud: Any = None,  # providers.CloudClient | None
+        prefix_fetch: Any = None,  # CoreServer.maybe_prefix_fetch | None
     ):
         self.catalog = catalog
         self.queue = queue
@@ -67,6 +68,7 @@ class InferenceAPI:
         self.gen_engines = gen_engines or {}
         self.embed_engines = embed_engines or {}
         self.cloud = cloud
+        self.prefix_fetch = prefix_fetch
 
     # -- helpers -----------------------------------------------------------
 
@@ -257,6 +259,16 @@ class InferenceAPI:
                 rspan.set_attrs(
                     {"provider": "tpu", "device": self.device_id, "reason": "local-engine"}
                 )
+                # Fleet prefix tier: before dispatch, see whether this engine
+                # (or a peer, via PrefixFetch) already holds the prompt's KV
+                # prefix. Tokenizing here duplicates work the engine will do
+                # at submit, but encode is microseconds against a prefill —
+                # and it is what lets the route span carry the matched length.
+                if self.prefix_fetch is not None:
+                    outcome, matched = self.prefix_fetch(model, engine, prompt)
+                    if outcome:
+                        rspan.set_attr("prefix_matched_tokens", matched)
+                        rspan.set_attr("prefix_outcome", outcome)
             else:
                 rspan.set_attrs(
                     {

@@ -90,6 +90,21 @@ class CoreServer:
         # perf observatory: sampled phase walls are cumulative per
         # engine+phase+bucket, bridged by delta like the rest
         self._perf_phase_s: dict[str, dict[str, float]] = {}
+        # fleet prefix tier (routing/prefix.py): engine export/import
+        # counters bridge by delta; route outcomes accumulate here for the
+        # dashboard/debug surfaces. prefix_sources lets in-process peers
+        # (bench, tests) register a duck-typed `prefix_fetch(ids)` source
+        # directly; remote peers resolve lazily from their advertised
+        # transfer_addr tag through a cached gRPC transfer client.
+        self._prefix_tier_counts: dict[str, dict[str, float]] = {}
+        self.prefix_sources: dict[str, Any] = {}
+        self._prefix_clients: dict[str, Any] = {}
+        self.transfer_addr = os.environ.get("TPU_TRANSFER_ADDR", "").strip()
+        self._route_prefix = {
+            "local": 0.0, "fetch": 0.0, "miss": 0.0,
+            "fetch_fail": 0.0, "matched_tokens": 0.0, "fetch_ms": 0.0,
+        }
+        self._route_prefix_lock = threading.Lock()
         self.limits = LimitsEngine(self.db, strict=self.cfg.strict_model_limits)
         self.circuit = CircuitBreaker()
         self.router = Router(
@@ -118,6 +133,7 @@ class CoreServer:
             gen_engines=self.gen_engines,
             embed_engines=self.embed_engines,
             cloud=self.cloud,
+            prefix_fetch=self.maybe_prefix_fetch,
         )
         self.jobs = JobsAPI(
             queue=self.queue,
@@ -134,6 +150,7 @@ class CoreServer:
             router=self.router,
             cfg=self.cfg,
             engines_info=self.engines_info,
+            route_stats=self.route_prefix_stats,
         )
 
         # Process-default tracer: the HTTP layer, router, engines, and
@@ -231,6 +248,136 @@ class CoreServer:
                 vals.append(float(st.get("headroom", 1.0)))
         return min(vals) if vals else None
 
+    def _prefill_cost_tag(self) -> float | None:
+        """Measured prefill cost in µs/token across local engines — the
+        perf observatory's prefill-family phase walls (admit / chunk /
+        pf_rag) divided by the tokens they prefilled. None until enough
+        sampled traffic exists; the router then uses its conservative
+        default. This is the price side of the prefix-locality score:
+        matched tokens × this cost = expected TTFT savings of a hit."""
+        wall = tok = 0.0
+        for e in self.gen_engines.values():
+            pf = getattr(e, "perf_stats", None)
+            if pf is None:
+                continue
+            phases = pf().get("phases", {})
+            for p in ("admit", "chunk", "pf_rag"):
+                r = phases.get(p) or {}
+                wall += float(r.get("host_s", 0.0)) + float(r.get("device_s", 0.0))
+                tok += float(r.get("tokens", 0.0))
+        if tok <= 0 or wall <= 0:
+            return None
+        return wall / tok * 1e6
+
+    def _prefix_digest_tag(self) -> dict | None:
+        """Union digest of every local engine's resident prefix chains
+        (routing/prefix.py merge_digests), or None when no engine caches
+        prefixes — tag omitted, peers never score against this device."""
+        from ..routing.prefix import merge_digests
+
+        digests = []
+        for e in self.gen_engines.values():
+            pd = getattr(e, "prefix_digest", None)
+            if pd is None:
+                continue
+            d = pd()
+            if d:
+                digests.append(d)
+        return merge_digests(digests)
+
+    # -- fleet prefix tier (routing/prefix.py; doc/performance.md) ---------
+
+    def maybe_prefix_fetch(self, model: str, engine: Any, prompt: str) -> tuple[str, int]:
+        """Serve-path hook (api/inference.py, before dispatch): does this
+        engine — or a peer, via the PrefixFetch RPC — already hold the
+        prompt's KV prefix? Returns (outcome, matched_tokens); outcome is
+        "" when the tier is off or the engine has no prefix cache, else
+        local | fetch | miss. A peer is only dialed when its advertised
+        digest claims strictly more than the local cache AND at least
+        TPU_PREFIX_FETCH_MIN_TOKENS — below that, recompute beats the wire
+        (measured crossover; doc/performance.md). Fetch failures degrade
+        to the local outcome: the prompt prefills from scratch exactly as
+        it would have without the tier."""
+        from ..routing import prefix as prefix_fp
+
+        if not prefix_fp.prefix_route_enabled():
+            return "", 0
+        match_len = getattr(engine, "prefix_match_len", None)
+        if match_len is None:
+            return "", 0
+        try:
+            ids = [int(t) for t in engine.tokenizer.encode(prompt)]
+        except Exception:
+            return "", 0
+        local = int(match_len(ids))
+        outcome, matched = ("local", local) if local > 0 else ("miss", 0)
+        best = self.router.best_prefix_peer(
+            model,
+            ids,
+            exclude_device=self.device_id,
+            min_tokens=max(prefix_fp.fetch_min_tokens(), local + 1),
+        )
+        if best is not None:
+            dev, _claimed = best
+            src = self._prefix_source_for(dev)
+            if src is not None:
+                t0 = time.time()
+                payload = None
+                try:
+                    payload = src.prefix_fetch(ids)
+                except ConnectionError as e:
+                    log.warning("prefix fetch from %s failed: %s", dev.get("id"), e)
+                    with self._route_prefix_lock:
+                        self._route_prefix["fetch_fail"] += 1
+                if payload and engine.prefix_import(payload):
+                    matched = int(match_len(ids))
+                    outcome = "fetch"
+                    with self._route_prefix_lock:
+                        self._route_prefix["fetch_ms"] += (time.time() - t0) * 1e3
+        self.metrics.route_prefix_hit.labels(outcome=outcome).inc()
+        self.metrics.route_prefix_matched_tokens.observe(matched)
+        with self._route_prefix_lock:
+            self._route_prefix[outcome] += 1
+            self._route_prefix["matched_tokens"] += matched
+        return outcome, matched
+
+    def _prefix_source_for(self, dev: dict[str, Any]) -> Any:
+        """Resolve a peer device row (router.best_prefix_peer, tags parsed)
+        to something with `prefix_fetch(ids) -> bytes | None`."""
+        src = self.prefix_sources.get(str(dev.get("id") or ""))
+        if src is not None:
+            return src
+        addr = str((dev.get("tags") or {}).get("transfer_addr") or "").strip()
+        if not addr:
+            return None
+        cli = self._prefix_clients.get(addr)
+        if cli is None:
+            try:
+                from ..rpc.client import GrpcTransferClient
+
+                cli = GrpcTransferClient(addr, timeout_s=30.0)
+            except Exception:  # grpc not installed on this host
+                return None
+            self._prefix_clients[addr] = cli
+        return cli
+
+    def prefix_export(self, ids: list[int]) -> bytes | None:
+        """PrefixFetch service callback (rpc/server.py KVTransferService):
+        first local engine holding a resident chain for these prompt ids
+        wins — single-model deployments have exactly one candidate."""
+        for e in self.gen_engines.values():
+            fn = getattr(e, "prefix_export", None)
+            if fn is None:
+                continue
+            payload = fn(ids)
+            if payload is not None:
+                return payload
+        return None
+
+    def route_prefix_stats(self) -> dict[str, float]:
+        with self._route_prefix_lock:
+            return dict(self._route_prefix)
+
     # -- local engine device registration ----------------------------------
 
     def register_local_device(self) -> None:
@@ -266,6 +413,26 @@ class CoreServer:
             # candidates (routing/router.py banding): a saturated device
             # that can drain itself recovers faster than one that sheds
             tags["migration"] = True
+        # Prefix-locality routing inputs (routing/prefix.py + router.py):
+        # the resident-chain digest, the live admission-queue depth, and
+        # the measured prefill cost — refreshed on every discovery tick.
+        # tags_at stamps the refresh so routing/limits.py can de-rank a
+        # wedged device whose tags went stale (ROUTE_TAG_TTL_S).
+        digest = self._prefix_digest_tag()
+        if digest is not None:
+            tags["prefix_digest"] = digest
+        qd = sum(
+            float(getattr(e, "queue_depth", lambda: 0)() or 0)
+            for e in self.gen_engines.values()
+        )
+        tags["queue_depth"] = qd
+        pc = self._prefill_cost_tag()
+        if pc is not None:
+            tags["prefill_us_per_tok"] = round(pc, 2)
+        if self.transfer_addr:
+            # peers dial this for PrefixFetch (and remote migration)
+            tags["transfer_addr"] = self.transfer_addr
+        tags["tags_at"] = time.time()
         self.catalog.upsert_device(
             self.device_id,
             name=self.device_id,
@@ -409,6 +576,32 @@ class CoreServer:
                             "migrated_out_total",
                             "migrated_in_total",
                             "migrate_out_bytes_total",
+                        )
+                    }
+            pts = getattr(e, "prefix_tier_stats", None)
+            if pts is not None:
+                pt = pts()
+                if pt.get("enabled"):
+                    info[name]["prefix_tier"] = pt
+                    prev_t = self._prefix_tier_counts.get(name, {})
+                    for key, counter in (
+                        ("exports_total", self.metrics.prefix_tier_exports.labels(engine=name)),
+                        ("imports_total", self.metrics.prefix_tier_imports.labels(engine=name)),
+                        ("import_rejects_total", self.metrics.prefix_tier_rejects.labels(engine=name)),
+                        ("export_bytes_total", self.metrics.prefix_tier_bytes.labels(engine=name, direction="out")),
+                        ("import_bytes_total", self.metrics.prefix_tier_bytes.labels(engine=name, direction="in")),
+                    ):
+                        cur_t = float(pt.get(key, 0.0))
+                        if cur_t > prev_t.get(key, 0.0):
+                            counter.inc(cur_t - prev_t.get(key, 0.0))
+                    self._prefix_tier_counts[name] = {
+                        k: float(pt.get(k, 0.0))
+                        for k in (
+                            "exports_total",
+                            "imports_total",
+                            "import_rejects_total",
+                            "export_bytes_total",
+                            "import_bytes_total",
                         )
                     }
             pfs = getattr(e, "perf_stats", None)
@@ -555,6 +748,7 @@ class CoreServer:
         r("GET", "/v1/debug/flight", self.handle_debug_flight)
         r("GET", "/v1/debug/compiles", self.handle_debug_compiles)
         r("GET", "/v1/debug/perf", self.handle_debug_perf)
+        r("GET", "/v1/debug/prefix", self.handle_debug_prefix)
         r("GET", "/v1/debug/profile", self.handle_debug_profile)
         r("POST", "/v1/debug/profile", self.handle_debug_profile_start)
 
@@ -686,6 +880,28 @@ class CoreServer:
                 name: e.perf_stats()
                 for name, e in self.gen_engines.items()
                 if getattr(e, "perf_stats", None) is not None
+            }
+        )
+
+    def handle_debug_prefix(self, req: Request, resp: Response) -> None:
+        """Fleet prefix tier: the knobs, this device's advertised digest,
+        route-outcome counters (local / fetch / miss and wire time), and
+        each engine's export/import tallies — the one-stop answer to "is
+        prefix-locality routing actually hitting?"."""
+        from ..routing import prefix as prefix_fp
+
+        resp.write_json(
+            {
+                "enabled": prefix_fp.prefix_route_enabled(),
+                "fetch_min_tokens": prefix_fp.fetch_min_tokens(),
+                "transfer_addr": self.transfer_addr,
+                "route": self.route_prefix_stats(),
+                "digest": self._prefix_digest_tag(),
+                "engines": {
+                    name: e.prefix_tier_stats()
+                    for name, e in self.gen_engines.items()
+                    if getattr(e, "prefix_tier_stats", None) is not None
+                },
             }
         )
 

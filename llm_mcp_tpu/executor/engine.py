@@ -71,6 +71,7 @@ from ..parallel.sharding import (
     llama_param_specs, kv_cache_specs, kv_pool_specs, shard_pytree,
     supports_ragged_prefill,
 )
+from ..routing import prefix as prefix_fp
 from ..telemetry import perf
 from ..telemetry import recorder as flight
 from ..telemetry import tracing
@@ -160,6 +161,39 @@ def _pool_put_pool_fn(pk, pv, src_row, dst_row):
         return jax.lax.dynamic_update_slice(pool, seg, (0, dst_row, 0, 0) + z)
 
     return _tree2(one, pk, pk), _tree2(one, pv, pv)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _pool_put_host_fn(pk, pv, hk, hv, prow):
+    """Remote prefix import: upload ONE wire-decoded host block (shaped
+    [L, 1, heads, block_tokens, *rest], zero-padded past the chain's
+    tail) into pool row `prow`. Block-shaped on purpose: one executable
+    regardless of the imported chain's length."""
+
+    def one(pool, blk):
+        z = (0,) * (pool.ndim - 4)
+        return jax.lax.dynamic_update_slice(
+            pool, blk.astype(pool.dtype), (0, prow, 0, 0) + z
+        )
+
+    return _tree2(one, pk, hk), _tree2(one, pv, hv)
+
+
+def _host_block(x, off: int, bt: int):
+    """Slice one block [off, off+bt) of a wire-decoded host KV tree on the
+    token axis, zero-padding a short tail to block shape (the pad is dead:
+    admission COWs the boundary block and the suffix prefill overwrites
+    past the stored length). Dict-aware ({} = fused-int8 live sentinel)."""
+    if isinstance(x, dict):
+        if not x:
+            return {}
+        return {k: _host_block(v, off, bt) for k, v in x.items()}
+    seg = x[:, :, :, off : off + bt]
+    if seg.shape[3] < bt:
+        pad = [(0, 0)] * seg.ndim
+        pad[3] = (0, bt - seg.shape[3])
+        seg = np.pad(seg, pad)
+    return np.ascontiguousarray(seg)
 
 
 def _has_safetensors(weights_dir: str) -> bool:
@@ -888,6 +922,21 @@ class GenerationEngine:
         self._recent_prompts: deque[tuple] = deque(maxlen=16)
         self.prefix_cache_hits = 0
         self.prefix_cache_misses = 0
+        # Fleet prefix tier (routing/prefix.py): _prefix_pub mirrors the
+        # resident chain set {key: stored_tokens} behind its own lock so
+        # digest building (discovery refresh thread) and match probes
+        # (serve threads) never touch the engine-thread-owned OrderedDict.
+        # prefix_export/prefix_import park work on _prefix_rpc_in; the
+        # engine thread services it in _admit_pending, where touching
+        # _prefix_cache and dispatching pool uploads is safe.
+        self._prefix_pub: dict[tuple, int] = {}
+        self._prefix_pub_lock = threading.Lock()
+        self._prefix_rpc_in: "queue.Queue[tuple]" = queue.Queue()
+        self.prefix_exports_total = 0
+        self.prefix_export_bytes_total = 0
+        self.prefix_imports_total = 0
+        self.prefix_import_bytes_total = 0
+        self.prefix_import_rejects_total = 0
         # device-resident sampling params (see admit_fn docstring); host
         # mirrors (self._temp/_topk/_topp) stay the source of truth for
         # rebuild after a poisoned dispatch consumed the donated buffers
@@ -3084,6 +3133,11 @@ class GenerationEngine:
             # migrated-in snapshots re-enter first: their prefill was spent
             # on another engine and their consumers have been waiting since
             admitted = self._migrate_restore_pending() or admitted
+        if not self._prefix_rpc_in.empty():
+            # parked prefix_fetch work (export gathers / import uploads):
+            # serviced here because only the engine thread may touch the
+            # prefix cache and dispatch against the device pool
+            self._drain_prefix_rpc()
         if self._pool is not None and self._pool.has_preempted():
             # offloaded snapshots re-enter ahead of the queue (subject to
             # the fairness/aging rule inside) — they already spent their
@@ -3322,6 +3376,8 @@ class GenerationEngine:
             self._prefix_cache[key] = ent
             self._prefix_by_len.setdefault(p0, {})[key] = ent
             self._prefix_cache_bytes += nbytes
+            with self._prefix_pub_lock:
+                self._prefix_pub[key] = p0
             while self._prefix_cache_bytes > self._prefix_budget and self._prefix_cache:
                 self._evict_lru_prefix()
             log.info(
@@ -3352,6 +3408,8 @@ class GenerationEngine:
         self._prefix_cache[key] = ent
         self._prefix_by_len.setdefault(p0, {})[key] = ent
         self._prefix_cache_bytes += nbytes
+        with self._prefix_pub_lock:
+            self._prefix_pub[key] = p0
         while self._prefix_cache_bytes > self._prefix_budget and self._prefix_cache:
             self._evict_lru_prefix()
         log.info(
@@ -3365,6 +3423,8 @@ class GenerationEngine:
         and the by-length index."""
         old_key, old = self._prefix_cache.popitem(last=False)
         self._prefix_cache_bytes -= old["bytes"]
+        with self._prefix_pub_lock:
+            self._prefix_pub.pop(old_key, None)
         self._paging.prefix_release(old.get("key", old_key))
         if self._phys is not None:
             # pool rows free only once the last sharer pin lets the ledger
@@ -3375,6 +3435,324 @@ class GenerationEngine:
             bucket_d.pop(old_key, None)
             if not bucket_d:
                 del self._prefix_by_len[old["P"]]
+
+    # -- fleet prefix tier (prefix-locality routing, remote fetch) ---------
+
+    def prefix_chains(self) -> list[tuple[tuple, int]]:
+        """Resident prefix chains as ``(token_key, stored_tokens)`` pairs
+        — the digest source. Reads the published mirror, safe from any
+        thread."""
+        with self._prefix_pub_lock:
+            return list(self._prefix_pub.items())
+
+    def prefix_digest(self, top_k: int = prefix_fp.DEFAULT_TOP_K) -> dict | None:
+        """Compact digest of resident chains for the discovery tag channel
+        (routing/prefix.py build_digest), or None when the prefix cache is
+        off or empty — absent tag means "nothing to match", exactly like
+        kv_headroom's opt-in semantics."""
+        if not self._prefix_budget:
+            return None
+        chains = self.prefix_chains()
+        if not chains:
+            return None
+        return prefix_fp.build_digest(
+            chains, self._paging.block_tokens, top_k=top_k
+        )
+
+    def prefix_match_len(self, ids: list[int]) -> int:
+        """Longest resident chain that is a STRICT prefix of `ids`
+        (thread-safe; the fetch path compares this against a peer's claim
+        before paying for the wire)."""
+        t = tuple(ids)
+        best = 0
+        with self._prefix_pub_lock:
+            for key, n in self._prefix_pub.items():
+                if n > best and n < len(t) and key == t[:n]:
+                    best = n
+        return best
+
+    def prefix_export(self, ids: list[int], timeout_s: float = 30.0) -> bytes | None:
+        """Snapshot the longest resident chain prefixing `ids` as a wire
+        payload (the `prefix_fetch` RPC's source side); a chain that only
+        partially overlaps ships pow2-truncated to the shared prefix.
+        Parks the request on the engine thread — only it may touch the
+        prefix cache and the device pool — and blocks the caller until
+        served. None on miss, disabled cache, or timeout."""
+        if not self._prefix_budget:
+            return None
+        box: dict[str, Any] = {}
+        ev = threading.Event()
+        self._prefix_rpc_in.put(("export", (list(ids),), box, ev))
+        self._wake.set()
+        if not ev.wait(timeout_s):
+            return None
+        return box.get("payload")
+
+    def prefix_import(self, payload: bytes, timeout_s: float = 30.0) -> bool:
+        """Adopt a peer's exported prefix chain into the local cache (the
+        fetch destination side). Decodes on the caller thread (pure host
+        work), then parks the insert on the engine thread. After a
+        successful import the next admission sees an ordinary prefix-cache
+        hit and re-pins via admit_shared — pin-only, zero row copies on
+        the physical path."""
+        if not self._prefix_budget:
+            return False
+        try:
+            header, trees = migration.decode_payload(payload)
+        except Exception:
+            with self.stats_lock:
+                self.prefix_import_rejects_total += 1
+            return False
+        if header.get("kind") != "prefix":
+            with self.stats_lock:
+                self.prefix_import_rejects_total += 1
+            return False
+        box: dict[str, Any] = {}
+        ev = threading.Event()
+        self._prefix_rpc_in.put(("import", (header, trees, len(payload)), box, ev))
+        self._wake.set()
+        if not ev.wait(timeout_s):
+            return False
+        return bool(box.get("ok"))
+
+    def _drain_prefix_rpc(self) -> None:
+        """Engine thread: service parked prefix export/import requests
+        (_admit_pending). Failures report through the box — the waiting
+        RPC thread owns error semantics."""
+        while True:
+            try:
+                kind, args, box, ev = self._prefix_rpc_in.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                if kind == "export":
+                    box["payload"] = self._prefix_export_now(*args)
+                else:
+                    box["ok"] = self._prefix_import_now(*args)
+            except Exception as e:  # noqa: BLE001 — must release the waiter
+                log.warning("prefix %s failed: %s", kind, e)
+                box["error"] = str(e)
+            finally:
+                ev.set()
+
+    def _prefix_export_now(self, ids: list[int]) -> bytes | None:
+        """Gather the longest resident chain prefixing `ids` into a wire
+        payload (engine thread). Non-strict match: exporting the whole
+        prompt is fine — the REQUESTER enforces its own strict-prefix rule
+        against its (longer) prompt. When no whole chain prefixes the
+        request, the best chain ships TRUNCATED to the largest pow2
+        prefix both sides share: the advertised digest claims matches at
+        block granularity (routing/prefix.py chain hashes), so a peer may
+        dial on a partial overlap — refusing it here would waste the RPC
+        the router already paid for. Pow2 because import only admits pow2
+        lengths (one compiled insert per entry length)."""
+        if not self._prefix_cache:
+            return None
+        t = tuple(ids)
+        key, ent, P0 = None, None, 0
+        for P in sorted(self._prefix_by_len, reverse=True):
+            if P > len(t):
+                continue
+            e = self._prefix_by_len[P].get(t[:P])
+            if e is not None:
+                key, ent, P0 = t[:P], e, P
+                break
+        if ent is None:
+            for P, bucket in self._prefix_by_len.items():
+                for k2, e in bucket.items():
+                    c = self._common_len(k2, t)
+                    trunc = 1 << (c.bit_length() - 1) if c else 0
+                    if trunc >= self.PREFIX_MIN and trunc < P and trunc > P0:
+                        key, ent, P0 = k2, e, trunc
+        if ent is None:
+            return None
+        t0 = time.perf_counter()
+        if "k" in ent:
+            hk, hv = self._host_tree(ent["k"]), self._host_tree(ent["v"])
+        else:
+            lids = self._paging.prefix_ids(key)
+            if lids is None or self._phys is None:
+                return None
+            rows = []
+            for lid in lids[: max(1, P0 // self._paging.block_tokens)]:
+                prow = self._phys.phys_of(lid)
+                if prow is None:
+                    self._phys.missing_pins += 1
+                    return None
+                rows.append(prow - self._phys.pool_base)
+            hk, hv = self._pool_entry_rows(rows, P0)
+        if P0 < int(ent["P"]) and "k" in ent:
+            # contiguous entry: token axis is 3 ([L, 1, H, P, *rest]),
+            # dict leaves are the fused-int8 live sentinel
+            def _cut(x):
+                if isinstance(x, dict):
+                    return {k: _cut(v) for k, v in x.items()}
+                return x[:, :, :, :P0]
+
+            hk, hv = _cut(hk), _cut(hv)
+        header = {
+            "kind": "prefix",
+            "P": P0,
+            "ids": [int(x) for x in key[:P0]],
+            "block_tokens": self._paging.block_tokens,
+        }
+        payload = migration.encode_payload(header, {"k": hk, "v": hv})
+        self._prefix_cache.move_to_end(key)  # a fetched chain is hot fleet-wide
+        with self.stats_lock:
+            self.prefix_exports_total += 1
+            self.prefix_export_bytes_total += len(payload)
+        self._flight.event(
+            "prefix_out", tokens=P0, wire_bytes=len(payload),
+            wall_ms=round((time.perf_counter() - t0) * 1e3, 1),
+        )
+        log.info(
+            "prefix export: %d tokens, %.1f KB in %.1f ms",
+            P0, len(payload) / 1024, (time.perf_counter() - t0) * 1e3,
+        )
+        return payload
+
+    def _prefix_import_now(self, header: dict, trees: dict, nbytes_wire: int) -> bool:
+        """Insert a wire-decoded chain into the local prefix cache (engine
+        thread): ledger registration first (evicting LRU entries to fit,
+        exactly like a local store), then pool-row uploads on the physical
+        path or a device-array entry on the contiguous path."""
+        P0 = int(header.get("P") or 0)
+        ids = [int(x) for x in header.get("ids") or []]
+        hk = trees.get("k")
+        hv = trees.get("v")
+        hv = {} if hv is None else hv
+        # Only pow2 lengths insert: _match_prefix probes pow2 buckets and
+        # insert_cached compiles per entry length — a peer's entries are
+        # pow2 by construction (_maybe_store_prefix), so a violation means
+        # a corrupt or foreign payload. Geometry must match the local
+        # cache leaf-for-leaf (layers, heads, head dims): a peer running a
+        # different model or cache layout never imports.
+        if (
+            P0 < self.PREFIX_MIN or P0 & (P0 - 1) or len(ids) != P0
+            or hk is None
+            or not self._prefix_wire_compat(hk, hv)
+        ):
+            with self.stats_lock:
+                self.prefix_import_rejects_total += 1
+            return False
+        key = tuple(ids)
+        if key in self._prefix_cache:
+            self._prefix_cache.move_to_end(key)
+            return True
+        while not self._paging.prefix_can_fit(P0) and self._prefix_cache:
+            self._evict_lru_prefix()
+        if self._paging.prefix_register(key, P0) is None:
+            with self.stats_lock:
+                self.prefix_import_rejects_total += 1
+            return False
+        if self._phys is not None:
+            if not self._import_prefix_physical(key, hk, hv):
+                self._paging.prefix_release(key)
+                self._phys.sweep(self._paging.alive)
+                with self.stats_lock:
+                    self.prefix_import_rejects_total += 1
+                return False
+            nbytes = sum(
+                (x.size // (x.shape[1] * x.shape[3])) * P0 * x.dtype.itemsize
+                for x in jax.tree.leaves((self._ck, self._cv))
+            )
+            ent = {"P": P0, "bytes": nbytes, "key": key}
+        else:
+            pk = jax.tree.map(jnp.asarray, hk)
+            pv = jax.tree.map(jnp.asarray, hv) if hv is not None else {}
+            nbytes = sum(
+                x.size * x.dtype.itemsize for x in jax.tree.leaves((pk, pv))
+            )
+            ent = {"P": P0, "k": pk, "v": pv, "bytes": nbytes, "key": key}
+        self._prefix_cache[key] = ent
+        self._prefix_by_len.setdefault(P0, {})[key] = ent
+        self._prefix_cache_bytes += nbytes
+        with self._prefix_pub_lock:
+            self._prefix_pub[key] = P0
+        while self._prefix_cache_bytes > self._prefix_budget and self._prefix_cache:
+            self._evict_lru_prefix()
+        ok = key in self._prefix_cache  # budget smaller than the entry evicts it
+        with self.stats_lock:
+            if ok:
+                self.prefix_imports_total += 1
+                self.prefix_import_bytes_total += nbytes_wire
+            else:
+                self.prefix_import_rejects_total += 1
+        if ok:
+            self._flight.event("prefix_in", tokens=P0, wire_bytes=nbytes_wire)
+            log.info(
+                "prefix import: %d tokens, %.1f KB wire (%d entries)",
+                P0, nbytes_wire / 1024, len(self._prefix_cache),
+            )
+        return ok
+
+    def _prefix_wire_compat(self, hk, hv) -> bool:
+        """Whether wire-decoded host KV trees match the local cache's
+        geometry (same leaf set; same layer, head, and trailing dims) —
+        everything except the slot and token axes, which import rewrites."""
+        ref = (
+            (self._pool_k, self._pool_v) if self._phys is not None
+            else (self._ck, self._cv)
+        )
+        try:
+            ref_leaves = jax.tree.leaves(ref)
+            host_leaves = jax.tree.leaves((hk, hv))
+        except Exception:
+            return False
+        if len(ref_leaves) != len(host_leaves):
+            return False
+        for p, h in zip(ref_leaves, host_leaves):
+            if (
+                h.ndim != p.ndim
+                or h.shape[0] != p.shape[0]
+                or h.shape[1] != 1
+                or h.shape[2] != p.shape[2]
+                or h.shape[4:] != p.shape[4:]
+            ):
+                return False
+        return True
+
+    def _import_prefix_physical(self, key: tuple, hk, hv) -> bool:
+        """Upload a wire-decoded chain's blocks into fresh prefix-pool
+        rows (one block-shaped dispatch per block — same executable for
+        every chain length)."""
+        lids = self._paging.prefix_ids(key)
+        if lids is None:
+            return False
+        rows = self._phys.register_prefix(lids)
+        if rows is None:
+            return False
+        bt = self._paging.block_tokens
+        for j, prow in enumerate(rows):
+            first = self._note_exec_shape("pool_put_host")
+            t0 = time.perf_counter()
+            self._pool_k, self._pool_v = _pool_put_host_fn(
+                self._pool_k, self._pool_v,
+                _host_block(hk, j * bt, bt), _host_block(hv, j * bt, bt),
+                np.int32(prow),
+            )
+            if first:
+                self._compile_obs("pool_put_host", (bt,),
+                                  time.perf_counter() - t0)
+        return True
+
+    def prefix_tier_stats(self) -> dict[str, float]:
+        """Fleet-prefix-tier observability block (engines_info, dashboard,
+        /v1/debug/prefix)."""
+        with self._prefix_pub_lock:
+            chains = len(self._prefix_pub)
+            longest = max(self._prefix_pub.values(), default=0)
+        with self.stats_lock:
+            return {
+                "enabled": 1.0 if self._prefix_budget else 0.0,
+                "chains": float(chains),
+                "longest_tokens": float(longest),
+                "exports_total": float(self.prefix_exports_total),
+                "export_bytes_total": float(self.prefix_export_bytes_total),
+                "imports_total": float(self.prefix_imports_total),
+                "import_bytes_total": float(self.prefix_import_bytes_total),
+                "import_rejects_total": float(self.prefix_import_rejects_total),
+            }
 
     def _start_batch(self, batch: list[tuple[int, GenRequest, list[int]]]) -> None:
         """Admit up to admit_batch short prompts with ONE batched prefill

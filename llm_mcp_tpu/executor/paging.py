@@ -363,6 +363,14 @@ class PagedKVManager:
             ent = self._prefix.get(key)
             return list(ent[0]) if ent else None
 
+    def prefix_chains(self) -> list[tuple[Any, int]]:
+        """Snapshot of resident prefix entries as ``(key, tokens)`` pairs
+        — the routing tier's digest source (routing/prefix.py). Safe from
+        any thread; a digest built from a snapshot that races an eviction
+        only misprices one routing score until the next tag refresh."""
+        with self._lock:
+            return [(key, tokens) for key, (_, tokens) in self._prefix.items()]
+
     # -- preempt / restore --------------------------------------------------
 
     def preempt_slot(self, slot: int, snap_id: int) -> list[tuple]:

@@ -52,11 +52,46 @@ class DeviceLimitSpec:
         }
 
 
-def device_headroom(tags: dict | None) -> float:
+def tags_fresh(tags: dict | None, now: float | None = None) -> bool:
+    """Whether a device's advertised tags are recent enough to trust.
+
+    Devices stamp ``tags_at`` (epoch seconds) on every discovery refresh
+    (server.register_local_device); a wedged engine stops refreshing but
+    its *last* advertised headroom/digest would keep attracting traffic
+    forever — the stale-tag routing hazard. Tags older than
+    ``ROUTE_TAG_TTL_S`` (default 180 s = three missed discovery refreshes
+    at the default DISCOVERY_INTERVAL of 60 s) read as stale; devices
+    that never stamp (older executors, test fixtures) read as fresh so
+    the TTL only bites on opted-in devices. `now` is injectable for
+    frozen-clock tests."""
+    ts = (tags or {}).get("tags_at")
+    if ts is None:
+        return True
+    try:
+        ttl = float(os.environ.get("ROUTE_TAG_TTL_S", "180") or 0.0)
+    except ValueError:
+        ttl = 180.0
+    if ttl <= 0:
+        return True
+    import time as _time
+
+    now = _time.time() if now is None else now
+    try:
+        return (now - float(ts)) <= ttl
+    except (TypeError, ValueError):
+        return True
+
+
+def device_headroom(tags: dict | None, now: float | None = None) -> float:
     """Shed-free KV-pool headroom a device advertises in its `kv_headroom`
     tag (server.register_local_device), in [0, 1]. Devices without the tag
     (no pool, older executors) read as 1.0 — fully admittable — so the
-    router's saturation de-ranking only ever acts on devices that opted in."""
+    router's saturation de-ranking only ever acts on devices that opted in.
+    Stale tags (tags_fresh False) read as 0.0: a device that stopped
+    refreshing is de-ranked to the saturated band rather than trusted at
+    its last-known headroom."""
+    if not tags_fresh(tags, now):
+        return 0.0
     try:
         return float((tags or {}).get("kv_headroom", 1.0))
     except (TypeError, ValueError):
@@ -69,6 +104,37 @@ def device_migration(tags: dict | None) -> bool:
     that can drain its pool to a peer recovers faster than one that can
     only shed, so the router prefers it within the saturated band."""
     return bool((tags or {}).get("migration", False))
+
+
+def device_prefix_digest(tags: dict | None, now: float | None = None) -> dict | None:
+    """The device's advertised prefix-chain digest (routing/prefix.py
+    build_digest shape), or None when absent or stale — a stale digest
+    describes chains the engine may long since have evicted, so the
+    router must not score on it."""
+    if not tags_fresh(tags, now):
+        return None
+    d = (tags or {}).get("prefix_digest")
+    return d if isinstance(d, dict) else None
+
+
+def device_queue_depth(tags: dict | None) -> float:
+    """Admission-queue depth the device last advertised (`queue_depth`
+    tag) — the congestion side of the prefix-locality score."""
+    try:
+        return max(0.0, float((tags or {}).get("queue_depth", 0.0)))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def device_prefill_cost(tags: dict | None) -> float:
+    """Measured prefill cost in seconds/token (`prefill_us_per_tok` tag,
+    from the perf observatory's prefill-family phase walls). 0.0 when the
+    device hasn't measured yet — the router then falls back to a
+    conservative default so digests still rank."""
+    try:
+        return max(0.0, float((tags or {}).get("prefill_us_per_tok", 0.0))) / 1e6
+    except (TypeError, ValueError):
+        return 0.0
 
 
 def derive_device_limits(hbm_gb: float, chips: int = 1) -> DeviceLimitSpec:

@@ -26,9 +26,24 @@ from ..state.db import Database
 from ..telemetry import tracing
 from ..utils.config import getenv
 from .circuit import CircuitBreaker
-from .limits import LimitsEngine, device_headroom, device_migration
+from .limits import (
+    LimitsEngine,
+    device_headroom,
+    device_migration,
+    device_prefill_cost,
+    device_prefix_digest,
+    device_queue_depth,
+)
+from .prefix import match_digest, prefix_route_enabled, request_hashes_for
 
 log = logging.getLogger("router")
+
+# Fallbacks for the prefix-locality score when a device hasn't measured
+# yet: ~50 us/token is the order of magnitude of 8B-class TPU prefill,
+# and one queued request costs roughly one admission round. Both only
+# shape *relative* ranking inside a headroom band, so rough is fine.
+DEFAULT_PREFILL_S_PER_TOK = 50e-6
+QUEUE_PENALTY_S = 0.05
 
 PROVIDER_TPU = "tpu"
 PROVIDER_OPENROUTER = "openrouter"
@@ -143,12 +158,37 @@ class Router:
 
     # -- device selection --------------------------------------------------
 
+    @staticmethod
+    def _prefix_score(
+        tags: dict, px_ids: list[int] | None, hash_memo: dict
+    ) -> tuple[float, int, bool]:
+        """Expected-savings score of routing this request to a device
+        holding part of its prefix: matched tokens × that device's
+        measured prefill cost (PR 12 phase walls, `prefill_us_per_tok`
+        tag), minus a queue-depth congestion penalty. Returns
+        ``(score_s, matched_tokens, exact)``; all-zero when the device
+        advertises no (fresh) digest. Request boundary hashes are memoized
+        per block geometry so a fleet scan hashes the prompt once."""
+        digest = device_prefix_digest(tags)
+        if digest is None or not px_ids:
+            return 0.0, 0, False
+        bt = int(digest.get("bt", 0) or 0)
+        if bt <= 0:
+            return 0.0, 0, False
+        if bt not in hash_memo:
+            hash_memo[bt] = request_hashes_for(digest, px_ids)
+        matched, exact = match_digest(digest, hash_memo[bt])
+        cost = device_prefill_cost(tags) or DEFAULT_PREFILL_S_PER_TOK
+        score = matched * cost - device_queue_depth(tags) * QUEUE_PENALTY_S
+        return score, matched, exact
+
     def select_device(
         self,
         model: str,
         task_type: str = "generate",
         *,
         max_latency_ms: float = 0.0,
+        prefix_ids: list[int] | None = None,
     ) -> dict[str, Any] | None:
         """Best online device that has the model, passes limits and circuit,
         ranked by latest benchmark tps DESC, latency ASC, then freshness.
@@ -208,10 +248,22 @@ class Router:
         # sort keeps the SQL tps/latency/freshness order within each band,
         # so a saturated device is still reachable when it's the only one
         # with the model.
-        def _band(r) -> tuple[bool, bool]:
+        # Prefix locality re-ranks WITHIN a band only: the engine holding
+        # the longest resident chain of this prompt wins among its healthy
+        # (or equally saturated) peers, but a long cached prefix never
+        # outranks headroom — a saturated hit would just shed. With
+        # TPU_PREFIX_ROUTE=0 (or no prompt ids) every score is 0.0 and the
+        # stable sort reproduces the pre-locality ordering byte-for-byte.
+        px_ids = prefix_ids if (prefix_ids and prefix_route_enabled()) else None
+        hash_memo: dict[int, list] = {}
+        scores: dict[str, tuple[float, int, bool]] = {}
+
+        def _band(r) -> tuple[bool, bool, float]:
             tags = Database.from_json(r["tags"], {})
             saturated = device_headroom(tags) <= 0.0
-            return (saturated and not device_migration(tags), saturated)
+            sc = self._prefix_score(tags, px_ids, hash_memo) if px_ids else (0.0, 0, False)
+            scores[r["id"]] = sc
+            return (saturated and not device_migration(tags), saturated, -sc[0])
 
         rows = sorted(rows, key=_band)
         for r in rows:
@@ -229,8 +281,58 @@ class Router:
                     log.debug("device %s rejected for %s: %s", dev_id, model, why)
                     continue
             r["tags"] = Database.from_json(r["tags"], {})
+            sc = scores.get(dev_id, (0.0, 0, False))
+            r["prefix_score_s"] = sc[0]
+            r["prefix_matched_tokens"] = sc[1]
+            r["prefix_match_exact"] = sc[2]
             return r
         return None
+
+    def best_prefix_peer(
+        self,
+        model: str,
+        prefix_ids: list[int],
+        *,
+        exclude_device: str = "",
+        min_tokens: int = 0,
+    ) -> tuple[dict[str, Any], int] | None:
+        """Peer advertising the longest fresh prefix-chain match for this
+        prompt — the remote-fetch probe. Unlike select_device this never
+        routes: it only answers "who could we pull KV blocks from", so it
+        skips the benchmark ranking and bands and keeps the circuit/online
+        gates. Returns ``(device_row, matched_tokens)`` or None when no
+        peer beats `min_tokens`."""
+        if self.db is None or not prefix_ids or not prefix_route_enabled():
+            return None
+        rows = self.db.query(
+            """
+            SELECT d.id, d.name, d.addr, d.tags FROM devices d
+            JOIN device_models dm ON dm.device_id = d.id AND dm.available = 1
+            WHERE d.online = 1 AND dm.model_id = ?
+            """,
+            (model,),
+        )
+        hash_memo: dict[int, list] = {}
+        best: tuple[dict[str, Any], int] | None = None
+        for r in rows:
+            if r["id"] == exclude_device or not r["addr"]:
+                continue
+            if not self.circuit.allow(r["id"]):
+                continue
+            tags = Database.from_json(r["tags"], {})
+            digest = device_prefix_digest(tags)
+            if digest is None:
+                continue
+            bt = int(digest.get("bt", 0) or 0)
+            if bt <= 0:
+                continue
+            if bt not in hash_memo:
+                hash_memo[bt] = request_hashes_for(digest, prefix_ids)
+            matched, _ = match_digest(digest, hash_memo[bt])
+            if matched >= max(1, min_tokens) and (best is None or matched > best[1]):
+                r["tags"] = tags
+                best = (r, matched)
+        return best
 
     # -- main entry --------------------------------------------------------
 
@@ -246,12 +348,15 @@ class Router:
         max_latency_ms: float = 0.0,
         force_cloud: bool = False,
         prefer_local: bool = True,
+        prefix_ids: list[int] | None = None,
     ) -> RouteDecision:
         """Route one LLM request. The cascade mirrors RouteLLM
         (router.go:126-274); a `quality` value engages smart routing
         (router.go:407-528). The decision is recorded as a `route` span:
         chosen provider/device/tier, the human reason, the fallback chain
-        actually walked, and the chosen device's circuit-breaker state."""
+        actually walked, and the chosen device's circuit-breaker state.
+        `prefix_ids` (prompt token ids, when the caller tokenized already)
+        engages prefix-locality ranking in select_device."""
         chain: list[str] = []
         with tracing.get_tracer().span(
             "route", attrs={"kind": kind, "model": model, "quality": quality}
@@ -267,6 +372,7 @@ class Router:
                 max_latency_ms=max_latency_ms,
                 force_cloud=force_cloud,
                 prefer_local=prefer_local,
+                prefix_ids=prefix_ids,
             )
             sp.set_attrs(
                 {
@@ -278,6 +384,10 @@ class Router:
                     "fallback_chain": ">".join(chain),
                 }
             )
+            if "prefix_matched_tokens" in d.extras:
+                sp.set_attr(
+                    "prefix_matched_tokens", d.extras["prefix_matched_tokens"]
+                )
             if d.device_id:
                 sp.set_attr("circuit", self.circuit.status(d.device_id))
             return d
@@ -295,6 +405,7 @@ class Router:
         max_latency_ms: float,
         force_cloud: bool,
         prefer_local: bool,
+        prefix_ids: list[int] | None = None,
     ) -> RouteDecision:
         if quality:
             chain.append(f"smart:{quality}")
@@ -311,7 +422,7 @@ class Router:
             chain.append(f"explicit:{provider}")
             return self._cloud_decision(provider, model, kind, reason="explicit provider")
         if provider == PROVIDER_TPU:
-            local = self._local_decision(model, kind, max_latency_ms)
+            local = self._local_decision(model, kind, max_latency_ms, prefix_ids)
             chain.append("explicit:tpu" if local else "explicit:tpu:miss")
             if local:
                 return local
@@ -322,7 +433,7 @@ class Router:
 
         # auto cascade
         if kind == "embed" and not force_cloud:
-            local = self._local_decision(model, kind, max_latency_ms)
+            local = self._local_decision(model, kind, max_latency_ms, prefix_ids)
             if local:
                 chain.append("local-embed")
                 return local
@@ -334,7 +445,7 @@ class Router:
                 return cloud
             chain.append("cloud:forced:miss")
         if prefer_local and not force_cloud:
-            local = self._local_decision(model, kind, max_latency_ms)
+            local = self._local_decision(model, kind, max_latency_ms, prefix_ids)
             if local:
                 chain.append("local")
                 return local
@@ -344,7 +455,7 @@ class Router:
             chain.append("cloud")
             return cloud
         chain.append("cloud:miss")
-        local = self._local_decision(model, kind, max_latency_ms)
+        local = self._local_decision(model, kind, max_latency_ms, prefix_ids)
         if local:
             chain.append("local-last-resort")
             return local
@@ -354,15 +465,21 @@ class Router:
         )
 
     def _local_decision(
-        self, model: str, kind: str, max_latency_ms: float
+        self,
+        model: str,
+        kind: str,
+        max_latency_ms: float,
+        prefix_ids: list[int] | None = None,
     ) -> RouteDecision | None:
         if not model:
             return None
         task = "embed" if kind == "embed" else "generate"
-        dev = self.select_device(model, task, max_latency_ms=max_latency_ms)
+        dev = self.select_device(
+            model, task, max_latency_ms=max_latency_ms, prefix_ids=prefix_ids
+        )
         if dev is None:
             return None
-        return RouteDecision(
+        d = RouteDecision(
             provider=PROVIDER_TPU,
             kind=kind,
             model=model,
@@ -370,6 +487,9 @@ class Router:
             device_addr=dev["addr"],
             reason=f"local device {dev['id']} (tps={dev['bench_tps'] or 0})",
         )
+        if dev.get("prefix_matched_tokens"):
+            d.extras["prefix_matched_tokens"] = int(dev["prefix_matched_tokens"])
+        return d
 
     def _first_cloud(self, model: str, kind: str, reason: str) -> RouteDecision | None:
         if self.has_openrouter:

@@ -259,6 +259,11 @@ class GrpcTransferClient:
             request_serializer=lambda b: b,
             response_deserializer=lambda b: b,
         )
+        self._prefix_fetch = self.channel.unary_unary(
+            f"/{TRANSFER_SERVICE_NAME}/PrefixFetch",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
 
     def close(self) -> None:
         self.channel.close()
@@ -272,6 +277,24 @@ class GrpcTransferClient:
             ):
                 yield json.loads(frame)
         except grpc.RpcError as e:
+            raise ConnectionError(f"grpc {e.code().name}: {e.details()}") from e
+
+    def prefix_fetch(
+        self, ids: list[int], *, timeout_s: float | None = None
+    ) -> bytes | None:
+        """Pull the peer's longest resident prefix chain for these prompt
+        token ids as a raw wire payload. None on a clean miss (NOT_FOUND /
+        prefix tier disabled); other failures raise ConnectionError so the
+        caller can fall back to recompute AND note the peer as flaky."""
+        try:
+            return self._prefix_fetch(
+                json.dumps({"ids": [int(x) for x in ids]}).encode(),
+                timeout=timeout_s if timeout_s is not None else self.timeout_s,
+                metadata=GrpcCoreClient._trace_metadata(),
+            )
+        except grpc.RpcError as e:
+            if e.code() in (grpc.StatusCode.NOT_FOUND, grpc.StatusCode.UNIMPLEMENTED):
+                return None
             raise ConnectionError(f"grpc {e.code().name}: {e.details()}") from e
 
 

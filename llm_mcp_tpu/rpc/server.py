@@ -86,11 +86,17 @@ class GrpcCoreServer:
         # (16 parked streams would otherwise starve heartbeats → lease loss).
         self._stream_slots = threading.BoundedSemaphore(max(1, max_workers // 2))
 
-    def enable_kv_transfer(self, import_stream: Callable[[bytes], Any]) -> None:
+    def enable_kv_transfer(
+        self,
+        import_stream: Callable[[bytes], Any],
+        prefix_export: Callable[[list[int]], bytes | None] | None = None,
+    ) -> None:
         """Register the KV transfer service on this server — must run
-        before start() (gRPC handlers are fixed at server start)."""
+        before start() (gRPC handlers are fixed at server start).
+        `prefix_export` additionally serves the PrefixFetch RPC (the
+        fleet prefix tier's source side)."""
         self._server.add_generic_rpc_handlers(
-            (KVTransferService(import_stream).handler(),)
+            (KVTransferService(import_stream, prefix_export=prefix_export).handler(),)
         )
 
     # -- service wiring (hand-rolled: no grpc_tools plugin in the env) -----
@@ -369,10 +375,17 @@ class KVTransferService:
     is raised to fit whole-bucket snapshots.
     """
 
-    def __init__(self, import_stream: Callable[[bytes], Any]):
+    def __init__(
+        self,
+        import_stream: Callable[[bytes], Any],
+        prefix_export: Callable[[list[int]], bytes | None] | None = None,
+    ):
         # import_stream: engine.migrate_import_stream — payload in, iterator
         # of event dicts out (raises on a payload this engine cannot run)
+        # prefix_export: engine.prefix_export — prompt token ids in, wire
+        # payload of the longest resident chain out (None on miss)
         self._import_stream = import_stream
+        self._prefix_export = prefix_export
         self._server: grpc.Server | None = None
         self.port = 0
 
@@ -396,12 +409,42 @@ class KVTransferService:
                 for evt in events:
                     yield json.dumps(evt).encode()
 
+        def prefix_fetch(request: bytes, ctx) -> bytes:
+            # request: JSON {"ids": [prompt token ids]} — response: the raw
+            # migration-codec payload of this engine's longest resident
+            # chain prefixing those ids. NOT_FOUND on miss keeps the
+            # requester's recompute path cheap (no payload decode).
+            if self._prefix_export is None:
+                ctx.abort(grpc.StatusCode.UNIMPLEMENTED, "prefix tier disabled")
+            try:
+                ids = [int(x) for x in json.loads(request.decode())["ids"]]
+            except (ValueError, KeyError, UnicodeDecodeError) as e:
+                ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad prefix request: {e}")
+            tp = GrpcCoreServer._traceparent(ctx)
+            span = (
+                tracing.get_tracer().span(
+                    "rpc.PrefixFetch", parent=tp, attrs={"tokens": len(ids)}
+                )
+                if tp
+                else nullcontext()
+            )
+            with span:
+                payload = self._prefix_export(ids)
+            if payload is None:
+                ctx.abort(grpc.StatusCode.NOT_FOUND, "no resident prefix")
+            return payload
+
         handlers = {
             "Transfer": grpc.unary_stream_rpc_method_handler(
                 transfer,
                 request_deserializer=lambda b: b,
                 response_serializer=lambda b: b,
-            )
+            ),
+            "PrefixFetch": grpc.unary_unary_rpc_method_handler(
+                prefix_fetch,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            ),
         }
         return grpc.method_handlers_generic_handler(TRANSFER_SERVICE_NAME, handlers)
 

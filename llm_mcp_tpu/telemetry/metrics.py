@@ -249,6 +249,50 @@ class Metrics:
             registry=r,
         )
 
+        # -- Prefix-locality routing / fleet prefix tier (routing/prefix.py,
+        # TPU_PREFIX_ROUTE / TPU_PREFIX_FETCH_MIN_TOKENS; doc/performance.md).
+        # outcome: local = the serving engine already held the longest known
+        # prefix; fetch = a peer's chain was pulled over PrefixFetch and
+        # admitted pin-only; miss = nobody held a usable prefix.
+        self.route_prefix_hit = Counter(
+            "llmtpu_route_prefix_hit_total",
+            "Prefix-locality routing decisions by outcome",
+            ["outcome"],
+            registry=r,
+        )
+        self.route_prefix_matched_tokens = Histogram(
+            "llmtpu_route_prefix_matched_tokens",
+            "Prompt tokens covered by a resident (or fetched) prefix chain at route time",
+            buckets=(32, 64, 128, 256, 512, 1024, 2048, 4096, 8192),
+            registry=r,
+        )
+        # Engine-side tier counters, advanced by delta from prefix_tier_stats()
+        # in api/server.py engines_info like the pool/paging bridges above.
+        self.prefix_tier_exports = Counter(
+            "llmtpu_prefix_tier_exports_total",
+            "Prefix chains exported to peers over the PrefixFetch RPC",
+            ["engine"],
+            registry=r,
+        )
+        self.prefix_tier_imports = Counter(
+            "llmtpu_prefix_tier_imports_total",
+            "Peer prefix chains imported and pinned into the local cache",
+            ["engine"],
+            registry=r,
+        )
+        self.prefix_tier_bytes = Counter(
+            "llmtpu_prefix_tier_bytes_total",
+            "Wire bytes of prefix-tier payloads by direction",
+            ["engine", "direction"],
+            registry=r,
+        )
+        self.prefix_tier_rejects = Counter(
+            "llmtpu_prefix_tier_import_rejects_total",
+            "Peer prefix payloads rejected (geometry mismatch, no budget, bad header)",
+            ["engine"],
+            registry=r,
+        )
+
         # -- Flight recorder / anomaly dumps / compile ledger --
         # (telemetry/recorder.py, TPU_FLIGHT knobs; doc/observability.md).
         # The recorder itself is stdlib-only, so all Prometheus bridging

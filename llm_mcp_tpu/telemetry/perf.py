@@ -75,9 +75,10 @@ DISPATCH_PHASES = (
     "admit", "chunk", "decode", "fused", "fused_rag", "pf_rag", "verify",
 )
 # Compile-ledger-only phases: rare, data-dependent dispatches (COW block
-# copies, pool offload staging, preemption restore) with no steady-state
+# copies, pool offload staging, host-payload pool puts on the fleet
+# prefix-tier import path, preemption restore) with no steady-state
 # cadence worth sampling — the ledger's first-dispatch wall is the story.
-AUX_COMPILE_PHASES = ("cow", "pool_put", "restore")
+AUX_COMPILE_PHASES = ("cow", "pool_put", "pool_put_host", "restore")
 
 CACHE_LAYOUTS = ("gqa_bf16", "gqa_int8", "mla_bf16", "mla_int8")
 

@@ -29,7 +29,10 @@ An *event* is a tuple ``(seq, ts, etype, trace_id, fields)``:
             snapshot for preempt/offload) / pg_tbl (device
             block-table reset/rebuild, with the shared-row count) /
             pg_cow (physical boundary-block copy: pool row -> identity
-            home) / migrate_out / migrate_in / shed / watchdog /
+            home) / prefix_out (fleet prefix-tier chain export, with
+            token + byte counts) / prefix_in (pin-only prefix-tier
+            import from a peer) / migrate_out / migrate_in / shed /
+            watchdog /
             compile / perf (sampled host/device/wait phase timing from
             the perf observatory) / anomaly / profile
   trace_id  the request's 32-hex trace id ("" for engine-global events) —

@@ -53,6 +53,12 @@ Checks, in order of authority:
      1.0). Records from hosts that cannot give each engine its own
      silicon (one device, or a single-core CPU) carry neither key
      and [SKIP].
+  5b. Prefix-locality routing floor, when the record carries it: the
+     2-engine 90%-shared-prefix sweep must show prefix_route_hit_rate
+     >= 0.5 — the share of routed requests landing on an engine that
+     already holds the prefix (or pulls it over the fetch path). Same
+     single-device escape hatch as the migration sweep: a marker key
+     instead, and the metric [SKIP]s with a warning.
   6. Raw-decode kernel floors, when the record carries them: the B=112
      headline-shape sweep >= 5600 tok/s (the pre-fusion starting line —
      the fused-layout work climbs FROM here), the MLA S=32k int8-latent
@@ -104,6 +110,7 @@ HIGHER_BETTER = (
     "paged_hbm_bytes_ratio",
     "migration_count",
     "migrate_ttft_gain",
+    "prefix_route_hit_rate",
     "raw_decode_tok_per_s_llama-3.1-8b-int8_kv8_b112_tpu",
     "raw_decode_tok_per_s_mla-8b-int8_kv8_b4_s32768_tpu",
     "layers_gbps",
@@ -151,6 +158,13 @@ ABS_MIN = {
     # and TPU_MIGRATE=0 beats shipping it
     "migration_count": 1.0,
     "migrate_ttft_gain": 1.0,
+    # prefix-locality routing: the 2-engine 90%-shared-prefix sweep must
+    # land at least half its routed requests where the prefix is already
+    # resident (or arrives via fetch) — under 0.5 the digest channel is
+    # stale/ignored and TPU_PREFIX_ROUTE=0 beats shipping it. Hosts that
+    # cannot give each engine its own silicon emit a marker instead and
+    # the key [SKIP]s with a warning.
+    "prefix_route_hit_rate": 0.5,
     # raw-decode kernel floors (promoted top-level by bench.py). The b112
     # headline-shape sweep measured 5609 tok/s pre-fusion (r5): the fused
     # cache layout + wqkv/w13 layer pass must never regress BELOW that

@@ -1,0 +1,407 @@
+"""Prefix-locality routing: fingerprints, digests, TTL, device banding.
+
+Pure-logic + catalog-backed tests (no engines, no network): the chain-hash
+and digest algebra of routing/prefix.py, the stale-tag TTL in
+routing/limits.py under a frozen clock, and table-driven select_device
+ordering — healthy > saturated-with-migration > saturated, with the
+prefix score re-ranking only WITHIN a band and TPU_PREFIX_ROUTE=0
+reproducing the pre-locality decisions exactly."""
+
+import pytest
+
+from llm_mcp_tpu.routing import Router
+from llm_mcp_tpu.routing.limits import (
+    device_headroom,
+    device_prefix_digest,
+    device_prefill_cost,
+    device_queue_depth,
+    tags_fresh,
+)
+from llm_mcp_tpu.routing.prefix import (
+    build_digest,
+    chain_hashes,
+    match_digest,
+    merge_digests,
+    request_hashes_for,
+)
+
+BT = 64
+PROMPT = list(range(300))  # 4 full blocks + a 44-token head
+
+
+# -- chain hashing -----------------------------------------------------------
+
+
+def test_chain_hashes_boundaries_and_head():
+    bounds = chain_hashes(PROMPT, BT)
+    assert [n for n, _ in bounds] == [64, 128, 192, 256, 300]
+    # deterministic, and each boundary commits to exactly ids[:n]
+    again = chain_hashes(PROMPT, BT)
+    assert bounds == again
+    assert chain_hashes(PROMPT[:128], BT) == bounds[:2]
+
+
+def test_chain_hashes_diverge_after_shared_prefix():
+    other = PROMPT[:128] + [9999] + PROMPT[129:]
+    a, b = chain_hashes(PROMPT, BT), chain_hashes(other, BT)
+    assert a[:2] == b[:2]  # shared leading blocks hash identically
+    assert a[2][1] != b[2][1]  # first divergent block breaks the chain
+    assert a[3][1] != b[3][1]  # and stays broken (rolling hash)
+
+
+def test_chain_hashes_empty_and_sub_block():
+    assert chain_hashes([], BT) == []
+    (n, h), = chain_hashes([1, 2, 3], BT)
+    assert n == 3 and len(h) == 16
+
+
+# -- digest build / match / merge --------------------------------------------
+
+
+def test_digest_head_hit_is_exact():
+    digest = build_digest([(PROMPT[:128], 128)], BT)
+    req = request_hashes_for(digest, PROMPT)
+    matched, exact = match_digest(digest, req)
+    assert (matched, exact) == (128, True)
+
+
+def test_digest_bloom_catches_non_head_boundary():
+    # peer stores a LONGER chain (256) than our whole prompt shares; the
+    # 128-boundary is not a head, so only the bloom can claim it
+    digest = build_digest([(PROMPT[:256], 256)], BT)
+    short = PROMPT[:130]  # shares 2 full blocks, then ends
+    req = request_hashes_for(digest, short)
+    matched, exact = match_digest(digest, req)
+    assert matched == 128 and exact is False
+
+
+def test_digest_no_match_for_unrelated_prompt():
+    digest = build_digest([(PROMPT[:256], 256)], BT)
+    req = request_hashes_for(digest, [7] * 300)
+    assert match_digest(digest, req) == (0, False)
+
+
+def test_request_hashes_drop_full_prompt_boundary():
+    # a hit must leave >= 1 suffix token: the head boundary covering the
+    # entire prompt is excluded (strict-prefix rule)
+    digest = build_digest([(PROMPT, len(PROMPT))], BT)
+    req = request_hashes_for(digest, PROMPT[:128])
+    assert [n for n, _ in req] == [64]
+
+
+def test_merge_digests_union_and_geometry():
+    d1 = build_digest([(PROMPT[:128], 128)], BT)
+    d2 = build_digest([(PROMPT[:256], 256)], BT)
+    merged = merge_digests([d1, d2])
+    req = request_hashes_for(merged, PROMPT + [1])
+    assert match_digest(merged, req) == (256, True)
+    # mismatched block geometry never merges; first engine wins
+    d3 = build_digest([(PROMPT[:128], 128)], 32)
+    merged = merge_digests([d1, d3])
+    assert merged["bt"] == BT
+    assert merge_digests([]) is None
+
+
+# -- stale-tag TTL (frozen clock) --------------------------------------------
+
+
+def test_tags_fresh_frozen_clock(monkeypatch):
+    monkeypatch.setenv("ROUTE_TAG_TTL_S", "180")
+    tags = {"tags_at": 1000.0}
+    assert tags_fresh(tags, now=1000.0 + 179)
+    assert not tags_fresh(tags, now=1000.0 + 181)
+    # unstamped tags (older executors, fixtures) always read fresh
+    assert tags_fresh({}, now=1e12)
+    assert tags_fresh(None, now=1e12)
+    # TTL <= 0 disables the check
+    monkeypatch.setenv("ROUTE_TAG_TTL_S", "0")
+    assert tags_fresh(tags, now=1e12)
+
+
+def test_stale_tags_zero_headroom_and_drop_digest(monkeypatch):
+    monkeypatch.setenv("ROUTE_TAG_TTL_S", "180")
+    digest = build_digest([(PROMPT[:128], 128)], BT)
+    tags = {"tags_at": 1000.0, "kv_headroom": 0.9, "prefix_digest": digest}
+    assert device_headroom(tags, now=1100.0) == 0.9
+    assert device_prefix_digest(tags, now=1100.0) == digest
+    # past the TTL the last advertised headroom/digest must not attract
+    # traffic: headroom reads saturated, the digest disappears
+    assert device_headroom(tags, now=2000.0) == 0.0
+    assert device_prefix_digest(tags, now=2000.0) is None
+
+
+def test_tag_readers_defaults():
+    assert device_queue_depth({"queue_depth": 3}) == 3.0
+    assert device_queue_depth({"queue_depth": -2}) == 0.0
+    assert device_queue_depth({}) == 0.0
+    assert device_prefill_cost({"prefill_us_per_tok": 50.0}) == pytest.approx(50e-6)
+    assert device_prefill_cost({}) == 0.0
+    assert device_prefix_digest({"prefix_digest": "junk"}) is None
+
+
+# -- ledger chain snapshot ---------------------------------------------------
+
+
+def test_paging_prefix_chains_snapshot():
+    from llm_mcp_tpu.executor.paging import PagedKVManager
+
+    mgr = PagedKVManager(
+        max_slots=4, max_seq_len=128, block_tokens=16, bytes_per_token=4,
+        prefix_budget_bytes=8 * 16 * 4,
+    )
+    key = tuple(PROMPT[:32])
+    assert mgr.prefix_register(key, 32) is not None
+    assert mgr.prefix_chains() == [(key, 32)]
+    mgr.prefix_release(key)
+    assert mgr.prefix_chains() == []
+
+
+# -- catalog-backed device banding -------------------------------------------
+
+
+MODEL = "llama-3.1-8b"
+
+
+def _fleet(catalog, devices):
+    """devices: [(id, tps, tags)] — all online, all serving MODEL."""
+    catalog.upsert_model(MODEL, params_b=8.0, kind="llm")
+    for dev_id, tps, tags in devices:
+        catalog.upsert_device(dev_id, addr=f"10.0.0.{len(dev_id)}:8080", tags=tags)
+        catalog.sync_device_models(dev_id, [MODEL])
+        catalog.record_benchmark(dev_id, MODEL, "generate", tps=tps, latency_ms=40)
+
+
+@pytest.mark.parametrize(
+    "present,expect",
+    [
+        # full fleet: healthy wins despite the worst benchmark
+        (("healthy", "sat-mig", "sat"), "healthy"),
+        # no healthy device: saturated-with-migration beats plain saturated
+        (("sat-mig", "sat"), "sat-mig"),
+        # last resort: a saturated device is still reachable
+        (("sat",), "sat"),
+    ],
+)
+def test_select_device_band_order(db, catalog, present, expect):
+    bands = {
+        "healthy": (900, {"kv_headroom": 0.8}),
+        "sat-mig": (2400, {"kv_headroom": 0.0, "migration": True}),
+        "sat": (9000, {"kv_headroom": 0.0}),
+    }
+    _fleet(catalog, [(d, *bands[d]) for d in present])
+    r = Router(db, has_openrouter=False, has_openai=False)
+    dev = r.select_device(MODEL, "generate")
+    assert dev["id"] == expect
+
+
+def test_prefix_score_reranks_within_healthy_band(db, catalog, monkeypatch):
+    monkeypatch.setenv("TPU_PREFIX_ROUTE", "1")
+    digest = build_digest([(PROMPT[:256], 256)], BT)
+    _fleet(
+        catalog,
+        [
+            ("fast", 2400, {"kv_headroom": 0.8}),
+            ("holder", 900, {"kv_headroom": 0.8, "prefix_digest": digest}),
+        ],
+    )
+    r = Router(db, has_openrouter=False, has_openai=False)
+    # without prompt ids the benchmark leader wins
+    assert r.select_device(MODEL, "generate")["id"] == "fast"
+    # with them, the peer holding 256 resident prefix tokens out-scores it
+    dev = r.select_device(MODEL, "generate", prefix_ids=PROMPT + [1])
+    assert dev["id"] == "holder"
+    assert dev["prefix_matched_tokens"] == 256
+    assert dev["prefix_match_exact"] is True
+
+
+def test_prefix_score_never_overrides_saturation(db, catalog, monkeypatch):
+    monkeypatch.setenv("TPU_PREFIX_ROUTE", "1")
+    digest = build_digest([(PROMPT[:256], 256)], BT)
+    _fleet(
+        catalog,
+        [
+            ("fresh", 900, {"kv_headroom": 0.8}),
+            ("sat-holder", 2400, {"kv_headroom": 0.0, "prefix_digest": digest}),
+        ],
+    )
+    r = Router(db, has_openrouter=False, has_openai=False)
+    # the saturated device's long resident prefix would just shed: a
+    # cached chain re-ranks within a band, never across bands
+    dev = r.select_device(MODEL, "generate", prefix_ids=PROMPT + [1])
+    assert dev["id"] == "fresh"
+
+
+def test_queue_depth_penalty_erodes_prefix_score(db, catalog, monkeypatch):
+    monkeypatch.setenv("TPU_PREFIX_ROUTE", "1")
+    digest = build_digest([(PROMPT[:256], 256)], BT)
+    # 256 tokens * 50us default = 12.8ms of savings; 10 queued requests
+    # * 50ms penalty swamps it — the congested holder loses
+    _fleet(
+        catalog,
+        [
+            ("idle", 900, {"kv_headroom": 0.8}),
+            (
+                "congested-holder",
+                2400,
+                {"kv_headroom": 0.8, "prefix_digest": digest, "queue_depth": 10},
+            ),
+        ],
+    )
+    r = Router(db, has_openrouter=False, has_openai=False)
+    dev = r.select_device(MODEL, "generate", prefix_ids=PROMPT + [1])
+    assert dev["id"] == "idle"
+
+
+def test_prefix_route_disabled_is_noop(db, catalog, monkeypatch):
+    digest = build_digest([(PROMPT[:256], 256)], BT)
+    _fleet(
+        catalog,
+        [
+            ("fast", 2400, {"kv_headroom": 0.8}),
+            ("holder", 900, {"kv_headroom": 0.8, "prefix_digest": digest}),
+        ],
+    )
+    r = Router(db, has_openrouter=False, has_openai=False)
+    baseline = r.select_device(MODEL, "generate")
+    monkeypatch.setenv("TPU_PREFIX_ROUTE", "0")
+    dev = r.select_device(MODEL, "generate", prefix_ids=PROMPT + [1])
+    # same device, and no score fields leak into the decision
+    assert dev["id"] == baseline["id"] == "fast"
+    assert dev["prefix_matched_tokens"] == 0
+    assert r.best_prefix_peer(MODEL, PROMPT + [1]) is None
+
+
+# -- best_prefix_peer (remote-fetch probe) -----------------------------------
+
+
+def test_best_prefix_peer_longest_fresh_match(db, catalog, monkeypatch):
+    monkeypatch.setenv("TPU_PREFIX_ROUTE", "1")
+    monkeypatch.setenv("ROUTE_TAG_TTL_S", "180")
+    import time
+
+    stale = time.time() - 10_000
+    d128 = build_digest([(PROMPT[:128], 128)], BT)
+    d256 = build_digest([(PROMPT[:256], 256)], BT)
+    _fleet(
+        catalog,
+        [
+            ("self", 900, {"prefix_digest": d256}),
+            ("short", 900, {"prefix_digest": d128}),
+            ("long", 900, {"prefix_digest": d256}),
+            ("stale", 900, {"prefix_digest": d256, "tags_at": stale}),
+            ("mute", 900, {}),
+        ],
+    )
+    r = Router(db, has_openrouter=False, has_openai=False)
+    got = r.best_prefix_peer(MODEL, PROMPT + [1], exclude_device="self")
+    assert got is not None
+    dev, matched = got
+    assert dev["id"] == "long" and matched == 256
+    # min_tokens above the best claim → no peer
+    assert (
+        r.best_prefix_peer(MODEL, PROMPT + [1], exclude_device="self", min_tokens=512)
+        is None
+    )
+    # circuit-denied peers are skipped
+    for _ in range(3):
+        r.circuit.record("long", ok=False)
+    dev, matched = r.best_prefix_peer(MODEL, PROMPT + [1], exclude_device="self")
+    assert dev["id"] == "short" and matched == 128
+
+
+# -- engine export / import roundtrip (the remote-fetch data path) -----------
+
+
+def _prefix_engine(**kw):
+    import jax.numpy as jnp
+
+    from llm_mcp_tpu.executor import GenerationEngine
+
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_seq_len", 256)
+    kw.setdefault("dtype", jnp.float32)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("prefill_chunk", 64)
+    kw.setdefault("prompt_cache_mb", 64)
+    return GenerationEngine("tiny-llm", **kw).start()
+
+
+def test_partial_chain_export_truncates_to_pow2():
+    """A resident chain that extends PAST the requester's shared prefix
+    still exports — truncated to the largest pow2 both sides share. The
+    digest claims matches at block granularity, so the router dials on
+    partial overlaps; a whole-chain-only exporter would waste that RPC.
+    (This is the serve-path shape: chat templating makes primes share
+    more with each other than the probe shares with the chain.)"""
+    shared = "you are a helpful assistant. answer briefly and precisely. " * 2
+    a = _prefix_engine()
+    b = _prefix_engine()
+    try:
+        for i in range(3):  # primes share `shared + "prime alpha "` → 128-chain
+            a.generate(shared + f"prime alpha {i}", max_tokens=2, temperature=0.0)
+        assert any(n == 128 for _, n in a.prefix_chains())
+        probe = shared + "what color is the sky?"
+        ids = [int(t) for t in a.tokenizer.encode(probe)]
+        # probe diverges at token 121: no whole chain prefixes it...
+        assert a.prefix_match_len(ids) == 0
+        payload = a.prefix_export(ids)
+        assert payload is not None  # ...but the 64-token truncation ships
+        assert a.prefix_tier_stats()["exports_total"] == 1.0
+        assert b.prefix_import(payload) is True
+        assert b.prefix_match_len(ids) == 64
+        assert b.paging_stats()["leaks"] == 0.0
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_engine_prefix_fetch_roundtrip_over_rpc():
+    """Full remote-fetch data path: engine A stores a shared prefix, serves
+    it over the PrefixFetch RPC, engine B imports it pin-only — and B's
+    next request on that prefix is an ordinary cache hit with greedy-token
+    identity against the engine that computed the KV."""
+    pytest.importorskip("grpc")
+    from llm_mcp_tpu.rpc.client import GrpcTransferClient
+    from llm_mcp_tpu.rpc.server import KVTransferService
+
+    shared = "you are a helpful assistant. answer briefly and precisely. " * 2
+    a = _prefix_engine()
+    b = _prefix_engine()
+    svc = cli = None
+    try:
+        for i in range(3):  # chains store on their second sighting
+            a.generate(shared + f"prime {i}", max_tokens=2, temperature=0.0)
+        assert a.prefix_chains(), "exporter never stored a chain"
+        probe = shared + "what color is the sky?"
+        ids = [int(t) for t in a.tokenizer.encode(probe)]
+        assert b.prefix_match_len(ids) == 0
+
+        svc = KVTransferService(
+            a.migrate_import_stream, prefix_export=a.prefix_export
+        ).start("127.0.0.1:0")
+        cli = GrpcTransferClient(f"127.0.0.1:{svc.port}")
+        assert cli.prefix_fetch([999_999] * 64) is None  # clean NOT_FOUND miss
+        payload = cli.prefix_fetch(ids)
+        assert payload
+        assert a.prefix_tier_stats()["exports_total"] == 1.0
+
+        assert b.prefix_import(b"garbage") is False  # rejected, not raised
+        assert b.prefix_import(payload) is True
+        assert b.prefix_match_len(ids) >= 32
+        st = b.prefix_tier_stats()
+        assert st["imports_total"] == 1.0 and st["import_bytes_total"] > 0
+        assert st["import_rejects_total"] == 1.0
+
+        ref = a.generate(probe, max_tokens=10, temperature=0.0)
+        hits_before = b.prefix_cache_hits
+        out = b.generate(probe, max_tokens=10, temperature=0.0)
+        assert out["text"] == ref["text"]
+        assert b.prefix_cache_hits > hits_before
+        assert b.paging_stats()["leaks"] == 0.0
+    finally:
+        if cli is not None:
+            cli.close()
+        if svc is not None:
+            svc.stop()
+        a.shutdown()
+        b.shutdown()
