@@ -1,0 +1,271 @@
+"""Registry-census pass: the cross-module string registries, reconciled.
+
+Three registries keep fast-moving string namespaces honest, and each had
+its own hand-rolled guard in a different test file:
+
+1. **kernel parity** — every `_*_kernel` Pallas function in
+   kernels/attention.py must appear in the `KERNEL_PARITY` dict
+   (tests/test_kernel_parity.py) pointing at a test that exists. The
+   blocked q8 kernel once shipped with zero coverage; this census is
+   what keeps that from recurring. The dict itself stays in the test
+   file — next to the tests it names — and is read here via AST.
+2. **dispatch phases** — every `_compile_obs("phase")` ledger call in
+   the engine must name a phase registered in DISPATCH_PHASES or
+   AUX_COMPILE_PHASES (telemetry/perf.py); every steady-state dispatch
+   phase must actually reach the ledger, have a PHASE_COSTS cost model,
+   and be observed by `_note_exec_shape`. An unregistered phase compiles
+   and runs but is invisible to the perf observatory.
+3. **flight etypes** — every `.event("etype")` string the engine emits
+   must appear in the recorder module docstring's identifier census
+   (the docstring doubles as the etype catalog that flight_dump.py
+   renders from), and the ragged-prefill + perf etypes must stay listed.
+
+All three read source via AST only — no test-module or engine import —
+so the census runs in milliseconds without jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import (
+    Finding,
+    RepoIndex,
+    call_string_args,
+    dict_string_keys,
+    literal_assignment,
+    string_tuple,
+)
+
+PASS_ID = "registry-census"
+
+_IDENT_RE = re.compile(r"[a-z_][a-z0-9_]*")
+
+
+def _parity_registry(tree: ast.Module) -> dict[str, tuple[str, str]] | None:
+    """The KERNEL_PARITY literal: kernel name -> (test file, test name)."""
+    node = literal_assignment(tree, "KERNEL_PARITY")
+    if not isinstance(node, ast.Dict):
+        return None
+    out: dict[str, tuple[str, str]] = {}
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            continue
+        if isinstance(v, (ast.Tuple, ast.List)) and len(v.elts) == 2 and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in v.elts
+        ):
+            out[k.value] = (v.elts[0].value, v.elts[1].value)
+    return out
+
+
+def _function_names(tree: ast.Module) -> set[str]:
+    return {
+        n.name
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+class RegistryCensusPass:
+    pass_id = PASS_ID
+
+    def run(self, index: RepoIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        findings.extend(self._kernel_parity(index))
+        findings.extend(self._dispatch_phases(index))
+        findings.extend(self._flight_etypes(index))
+        return findings
+
+    # -- 1. kernel parity ----------------------------------------------------
+
+    def _kernel_parity(self, index: RepoIndex) -> list[Finding]:
+        kmod_rel = index.config["kernel_module"]
+        reg_rel = index.config["parity_registry"]
+        ktree = index.ast(kmod_rel)
+        rtree = index.ast(reg_rel)
+        if ktree is None or rtree is None:
+            missing = kmod_rel if ktree is None else reg_rel
+            return [
+                Finding(
+                    PASS_ID, missing, 0, "parity-file-missing",
+                    f"{missing} not found — kernel-parity census cannot run",
+                )
+            ]
+        registry = _parity_registry(rtree)
+        if registry is None:
+            return [
+                Finding(
+                    PASS_ID, reg_rel, 0, "parity-registry-missing",
+                    f"no KERNEL_PARITY dict literal in {reg_rel}",
+                )
+            ]
+        kernels = {
+            n for n in _function_names(ktree)
+            if n.startswith("_") and n.endswith("_kernel")
+        }
+        findings: list[Finding] = []
+        if not kernels:
+            findings.append(
+                Finding(
+                    PASS_ID, kmod_rel, 0, "no-kernels-found",
+                    f"found no `_*_kernel` functions in {kmod_rel} — did "
+                    "the naming convention change?",
+                )
+            )
+        for name in sorted(kernels - set(registry)):
+            findings.append(
+                Finding(
+                    PASS_ID, kmod_rel, 0, f"kernel-unregistered:{name}",
+                    f"Pallas kernel {name} has no KERNEL_PARITY entry — add "
+                    "an interpret-mode parity test and register it in "
+                    f"{reg_rel}",
+                )
+            )
+        for name in sorted(set(registry) - kernels):
+            findings.append(
+                Finding(
+                    PASS_ID, reg_rel, 0, f"kernel-stale:{name}",
+                    f"KERNEL_PARITY entry {name} names a kernel that no "
+                    f"longer exists in {kmod_rel}",
+                )
+            )
+        test_trees: dict[str, ast.Module | None] = {}
+        for name, (mod_path, test_name) in sorted(registry.items()):
+            if mod_path not in test_trees:
+                test_trees[mod_path] = index.ast(mod_path)
+            ttree = test_trees[mod_path]
+            if ttree is None:
+                findings.append(
+                    Finding(
+                        PASS_ID, reg_rel, 0,
+                        f"parity-test-file-missing:{name}",
+                        f"{name}: registered parity file {mod_path} does "
+                        "not exist",
+                    )
+                )
+            elif test_name not in _function_names(ttree):
+                findings.append(
+                    Finding(
+                        PASS_ID, reg_rel, 0,
+                        f"parity-test-missing:{name}",
+                        f"{name}: registered test {mod_path}::{test_name} "
+                        "does not exist",
+                    )
+                )
+        return findings
+
+    # -- 2. dispatch phases --------------------------------------------------
+
+    def _dispatch_phases(self, index: RepoIndex) -> list[Finding]:
+        perf_rel = index.config["perf_module"]
+        eng_rel = index.config["engine_module"]
+        ptree = index.ast(perf_rel)
+        etree = index.ast(eng_rel)
+        if ptree is None or etree is None:
+            missing = perf_rel if ptree is None else eng_rel
+            return [
+                Finding(
+                    PASS_ID, missing, 0, "phase-file-missing",
+                    f"{missing} not found — dispatch-phase census cannot "
+                    "run",
+                )
+            ]
+        dispatch = string_tuple(ptree, "DISPATCH_PHASES")
+        aux = string_tuple(ptree, "AUX_COMPILE_PHASES")
+        costs = dict_string_keys(ptree, "PHASE_COSTS")
+        if dispatch is None or aux is None or costs is None:
+            gone = [
+                n for n, v in (
+                    ("DISPATCH_PHASES", dispatch),
+                    ("AUX_COMPILE_PHASES", aux),
+                    ("PHASE_COSTS", costs),
+                )
+                if v is None
+            ]
+            return [
+                Finding(
+                    PASS_ID, perf_rel, 0, "phase-registry-missing",
+                    f"{perf_rel} no longer defines {', '.join(gone)} as "
+                    "literals — the phase registry must stay statically "
+                    "extractable",
+                )
+            ]
+        got = call_string_args(etree, ("_compile_obs", "_note_exec_shape"))
+        registered = set(dispatch) | set(aux)
+        findings: list[Finding] = []
+        for phase in sorted(got["_compile_obs"] - registered):
+            findings.append(
+                Finding(
+                    PASS_ID, eng_rel, 0, f"phase-unregistered:{phase}",
+                    f"engine ledgers compile phase {phase!r} that is in "
+                    "neither DISPATCH_PHASES nor AUX_COMPILE_PHASES — the "
+                    "observatory will never report it",
+                )
+            )
+        for phase in sorted(set(dispatch) - got["_compile_obs"]):
+            findings.append(
+                Finding(
+                    PASS_ID, perf_rel, 0, f"phase-unledgered:{phase}",
+                    f"DISPATCH_PHASES entry {phase!r} never reaches "
+                    "_compile_obs in the engine — dead registry row",
+                )
+            )
+        for phase in sorted(set(dispatch) - set(costs)):
+            findings.append(
+                Finding(
+                    PASS_ID, perf_rel, 0, f"phase-uncosted:{phase}",
+                    f"dispatch phase {phase!r} has no PHASE_COSTS entry — "
+                    "rooflines will misattribute its time",
+                )
+            )
+        for phase in sorted(set(dispatch) - got["_note_exec_shape"]):
+            findings.append(
+                Finding(
+                    PASS_ID, eng_rel, 0, f"phase-unsampled:{phase}",
+                    f"dispatch phase {phase!r} is never passed to "
+                    "_note_exec_shape — per-phase exec sampling misses it",
+                )
+            )
+        return findings
+
+    # -- 3. flight etypes ----------------------------------------------------
+
+    def _flight_etypes(self, index: RepoIndex) -> list[Finding]:
+        rec_rel = index.config["recorder_module"]
+        eng_rel = index.config["engine_module"]
+        rtree = index.ast(rec_rel)
+        etree = index.ast(eng_rel)
+        if rtree is None or etree is None:
+            missing = rec_rel if rtree is None else eng_rel
+            return [
+                Finding(
+                    PASS_ID, missing, 0, "etype-file-missing",
+                    f"{missing} not found — etype census cannot run",
+                )
+            ]
+        doc = ast.get_docstring(rtree) or ""
+        census = set(_IDENT_RE.findall(doc))
+        emitted = call_string_args(etree, ("event",))["event"]
+        findings: list[Finding] = []
+        for etype in sorted(emitted - census):
+            findings.append(
+                Finding(
+                    PASS_ID, eng_rel, 0, f"etype-uncensused:{etype}",
+                    f"engine emits flight etype {etype!r} absent from the "
+                    f"{rec_rel} docstring census — flight_dump.py renders "
+                    "from that catalog; add the etype there",
+                )
+            )
+        for etype in sorted(
+            set(index.config["required_etypes"]) - census
+        ):
+            findings.append(
+                Finding(
+                    PASS_ID, rec_rel, 0, f"etype-required-missing:{etype}",
+                    f"required flight etype {etype!r} dropped from the "
+                    f"{rec_rel} docstring census",
+                )
+            )
+        return findings

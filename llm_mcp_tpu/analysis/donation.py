@@ -1,0 +1,305 @@
+"""Donation-safety pass: donated buffers must die at the call site.
+
+`jax.jit(..., donate_argnums=...)` hands the input buffer's HBM to the
+output — reading the python binding afterwards returns a deleted array
+and raises (on TPU) or silently aliases garbage (in some interpret
+paths). Every donating dispatch in executor/ and kernels/ follows one
+shape today: `self.cache_k, self.cache_v = _fn(self.cache_k, ...)` — the
+donated binding is rebound in the same statement. This pass flags the
+shape that is NOT that:
+
+1. **read-after-donate** — a call to a known donating function where an
+   expression passed in a donated position (a plain Name or Attribute,
+   the only things that alias a live binding) is loaded again later in
+   the enclosing function before being rebound.
+2. **import-time jnp** — module-level `jnp.*` / `jax.numpy.*` calls.
+   They are not donation bugs but the same class of dispatch-discipline
+   bug: they initialize the backend at import time, which breaks the
+   subprocess import lints, slows every CLI entry point, and on TPU can
+   grab the chip before the mesh is configured.
+
+Scope and honesty: donating functions are recognized by their decorator
+(`@partial(jax.jit, donate_argnums=...)`) or a `name = jax.jit(fn,
+donate_argnums=...)` binding, and call sites are matched by bare name
+within the same module — dispatch through dicts or stored attributes is
+invisible here and stays the runtime's problem. The ordering check is
+lineno-based within the enclosing function: exact for straight-line code,
+approximate around loops (a donated read on a *later* line of an earlier
+iteration is caught; a backwards jump to an earlier line is not).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .core import Finding, RepoIndex, walk_skipping_functions
+
+PASS_ID = "donation"
+
+# where donating dispatches live (and the only place they should)
+DEFAULT_SUBDIRS = ("executor", "kernels")
+
+
+@dataclass
+class DonatedFn:
+    name: str
+    donate_argnums: tuple[int, ...]
+    line: int
+
+
+def _donate_argnums_of(call: ast.Call) -> tuple[int, ...] | None:
+    """The donate_argnums tuple of a jax.jit(...) / partial(jax.jit, ...)
+    call expression, or None if it doesn't donate."""
+    is_jit = False
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "jit":
+        is_jit = True
+    if isinstance(f, ast.Name) and f.id in ("jit", "partial"):
+        is_jit = True
+    if isinstance(f, ast.Attribute) and f.attr == "partial":
+        is_jit = True
+    if not is_jit:
+        return None
+    if isinstance(f, ast.Name) and f.id == "partial" or (
+        isinstance(f, ast.Attribute) and f.attr == "partial"
+    ):
+        # partial(jax.jit, ...): first positional arg must be *.jit
+        if not (
+            call.args
+            and isinstance(call.args[0], (ast.Attribute, ast.Name))
+            and (
+                (isinstance(call.args[0], ast.Attribute)
+                 and call.args[0].attr == "jit")
+                or (isinstance(call.args[0], ast.Name)
+                    and call.args[0].id == "jit")
+            )
+        ):
+            return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                nums = []
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, int
+                    ):
+                        nums.append(elt.value)
+                return tuple(nums)
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            return ()
+    return None
+
+
+def _collect_donated(tree: ast.Module) -> dict[str, DonatedFn]:
+    """Donating functions declared anywhere in the module (including
+    closures defined inside methods — the engine's dispatch lambdas)."""
+    out: dict[str, DonatedFn] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    nums = _donate_argnums_of(dec)
+                    if nums:
+                        out[node.name] = DonatedFn(
+                            node.name, nums, node.lineno
+                        )
+        elif isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            nums = _donate_argnums_of(node.value)
+            if nums and len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name
+            ):
+                out[node.targets[0].id] = DonatedFn(
+                    node.targets[0].id, nums, node.lineno
+                )
+    return out
+
+
+def _store_exprs(node: ast.AST) -> set[str]:
+    """Unparsed expressions rebound by an assignment-like statement."""
+    out: set[str] = set()
+
+    def add_target(t: ast.expr):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for elt in t.elts:
+                add_target(elt)
+        else:
+            out.add(ast.unparse(t))
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            add_target(t)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        add_target(node.target)
+    elif isinstance(node, (ast.For, ast.AsyncFor)):
+        add_target(node.target)
+    elif isinstance(node, ast.withitem) and node.optional_vars is not None:
+        add_target(node.optional_vars)
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            out.add(ast.unparse(t))
+    return out
+
+
+class DonationSafetyPass:
+    pass_id = PASS_ID
+
+    def run(self, index: RepoIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        pkg = index.config["package"]
+        donate_files = [
+            p for p in index.package_files()
+            if any(p.startswith(f"{pkg}/{d}/") for d in DEFAULT_SUBDIRS)
+        ]
+        for relpath in donate_files:
+            tree = index.ast(relpath)
+            if tree is None:
+                continue
+            findings.extend(self._read_after_donate(relpath, tree))
+        for relpath in index.package_files():
+            tree = index.ast(relpath)
+            if tree is None:
+                continue
+            findings.extend(self._import_time_jnp(relpath, tree))
+        return findings
+
+    # -- read-after-donate ---------------------------------------------------
+
+    def _read_after_donate(
+        self, relpath: str, tree: ast.Module
+    ) -> list[Finding]:
+        donated = _collect_donated(tree)
+        if not donated:
+            return []
+        findings: list[Finding] = []
+        for func in ast.walk(tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            findings.extend(
+                self._audit_function(relpath, func, donated)
+            )
+        return findings
+
+    def _audit_function(
+        self,
+        relpath: str,
+        func: ast.FunctionDef | ast.AsyncFunctionDef,
+        donated: dict[str, DonatedFn],
+    ) -> list[Finding]:
+        # call sites of donated functions directly under this function
+        # (nested defs audit themselves)
+        own_nodes = [
+            n for n in ast.walk(func)
+            if self._owner(n, func) is func
+        ]
+        calls: list[tuple[ast.Call, DonatedFn]] = []
+        for n in own_nodes:
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Name)
+                and n.func.id in donated
+                # a *definition* shadowing the name would be caught by
+                # _collect_donated anyway; calls are what we audit
+            ):
+                calls.append((n, donated[n.func.id]))
+        if not calls:
+            return []
+
+        findings: list[Finding] = []
+        for call, dfn in calls:
+            donated_exprs: list[str] = []
+            for idx in dfn.donate_argnums:
+                if idx < len(call.args):
+                    arg = call.args[idx]
+                    if isinstance(arg, (ast.Name, ast.Attribute)):
+                        donated_exprs.append(ast.unparse(arg))
+            if not donated_exprs:
+                continue
+            call_stmt = self._enclosing_stmt(call, func)
+            if call_stmt is None:
+                continue
+            end = getattr(call_stmt, "end_lineno", call_stmt.lineno)
+            # the statement holding the call rebinds its own targets
+            rebound = _store_exprs(call_stmt)
+            loads: dict[str, int] = {}
+            stores: dict[str, int] = {}
+            for n in own_nodes:
+                line = getattr(n, "lineno", None)
+                if line is None or line <= end:
+                    continue
+                if isinstance(n, (ast.Name, ast.Attribute)) and isinstance(
+                    getattr(n, "ctx", None), ast.Load
+                ):
+                    s = ast.unparse(n)
+                    if s in donated_exprs and s not in rebound:
+                        loads[s] = min(loads.get(s, line), line)
+                for s in _store_exprs(n):
+                    if s in donated_exprs:
+                        stores[s] = min(stores.get(s, line), line)
+            for s, load_line in sorted(loads.items()):
+                if s in rebound:
+                    continue
+                store_line = stores.get(s)
+                if store_line is not None and store_line <= load_line:
+                    continue
+                findings.append(
+                    Finding(
+                        PASS_ID, relpath, load_line,
+                        f"read-after-donate:{s}@{func.name}<-{dfn.name}",
+                        f"{s!r} is donated to {dfn.name}() at line "
+                        f"{call.lineno} and read again here without being "
+                        "rebound — the buffer is dead after the call",
+                    )
+                )
+        return findings
+
+    @staticmethod
+    def _owner(node: ast.AST, func: ast.AST):
+        """The nearest enclosing function of `node` (parents attached by
+        the lock pass's walk or patched here on demand)."""
+        cur = getattr(node, "_lint_parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = getattr(cur, "_lint_parent", None)
+        return func if node is not func else None
+
+    @staticmethod
+    def _enclosing_stmt(node: ast.AST, func: ast.AST) -> ast.stmt | None:
+        cur = node
+        while cur is not None and cur is not func:
+            parent = getattr(cur, "_lint_parent", None)
+            if isinstance(cur, ast.stmt) and isinstance(
+                parent, (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.If, ast.For, ast.While, ast.With, ast.Try)
+            ):
+                return cur
+            cur = parent
+        return None
+
+    # -- import-time jnp -----------------------------------------------------
+
+    def _import_time_jnp(
+        self, relpath: str, tree: ast.Module
+    ) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in walk_skipping_functions(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            s = ast.unparse(node.func)
+            if s.startswith("jnp.") or s.startswith("jax.numpy."):
+                findings.append(
+                    Finding(
+                        PASS_ID, relpath, node.lineno,
+                        f"import-time-jnp:{relpath}:{s}",
+                        f"{s}(...) executes at module import time — it "
+                        "initializes the JAX backend on import, breaking "
+                        "import-direction lints and boot latency; compute "
+                        "it lazily inside the function that needs it",
+                    )
+                )
+        return findings

@@ -1,0 +1,358 @@
+"""Import-purity pass: the stdlib-only module pins, single-sourced.
+
+Five modules are deliberately importable without jax (and mostly without
+numpy): the flight recorder, tracer, and perf observatory (every layer
+imports telemetry, so telemetry must weigh nothing), the migration wire
+codec (CPU-only worker hosts decode and forward payloads), and the
+n-gram drafter (runs on the host thread and inside follower processes).
+Each pin used to live as a hand-rolled subprocess test in a different
+test file with its own stub-package boilerplate; PURITY_MANIFEST below
+is the one declarative statement of all of them, consumed twice:
+
+- **statically** (this pass): module-level imports of each pinned module
+  must resolve to stdlib + the entry's `allow` set. Lazy imports inside
+  functions are fine — that is the sanctioned escape hatch (config.py's
+  jax import, engine hooks) — so the check walks only code that executes
+  at import time.
+- **at runtime** (`run_probe`, called by the thin tier-1 tests): the
+  module is loaded by file path in a subprocess with stubbed parent
+  packages, its `exercise` snippet runs the happy path, and sys.modules
+  must contain nothing matching the entry's `forbidden` prefixes. This
+  catches what static analysis cannot: a *stdlib* import whose module
+  transitively drags in a forbidden one, or an exercise path that calls
+  a lazy import.
+
+Adding a pin = adding a manifest entry; both checks pick it up.
+"""
+
+from __future__ import annotations
+
+import ast
+import subprocess
+import sys
+import textwrap
+from dataclasses import dataclass, field
+
+from .core import Finding, RepoIndex
+
+PASS_ID = "import-purity"
+
+
+@dataclass
+class PurityEntry:
+    key: str
+    path: str  # repo-relative module path
+    # import-name prefixes allowed beyond stdlib at module level
+    allow: tuple[str, ...] = ()
+    # sys.modules prefixes that must be absent after the runtime probe
+    forbidden: tuple[str, ...] = ("jax", "numpy")
+    # parent packages to stub before loading by file path
+    stubs: tuple[str, ...] = ()
+    # extra modules to load (by file path, in order) before the module
+    deps: tuple[str, ...] = ()
+    # runtime snippet exercising the module (it is bound as `mod`);
+    # {tmp} substitutes a scratch dir when the test passes one
+    exercise: str = ""
+    why: str = ""
+
+
+PURITY_MANIFEST: tuple[PurityEntry, ...] = (
+    PurityEntry(
+        key="recorder",
+        path="llm_mcp_tpu/telemetry/recorder.py",
+        stubs=("llm_mcp_tpu", "llm_mcp_tpu.telemetry"),
+        forbidden=(
+            "llm_mcp_tpu.executor", "llm_mcp_tpu.api",
+            "llm_mcp_tpu.routing", "llm_mcp_tpu.worker",
+            "llm_mcp_tpu.rpc", "jax", "numpy",
+        ),
+        exercise=textwrap.dedent(
+            """
+            import json
+            rec = mod.FlightRecorder(capacity=16, dump_dir={tmp!r},
+                                     dump_interval_s=0.0)
+            rec.event("decode", trace_id="a" * 32, rows=1)
+            path = rec.dump("lint", force=True)
+            rows = [json.loads(l) for l in open(path)]
+            assert rows[0]["kind"] == "flight_dump"
+            assert rows[1]["etype"] == "decode"
+            """
+        ),
+        why="journals the hot path from every layer; must weigh nothing",
+    ),
+    PurityEntry(
+        key="tracing",
+        path="llm_mcp_tpu/telemetry/tracing.py",
+        stubs=("llm_mcp_tpu", "llm_mcp_tpu.telemetry"),
+        forbidden=(
+            "llm_mcp_tpu.executor", "llm_mcp_tpu.api",
+            "llm_mcp_tpu.routing", "llm_mcp_tpu.worker",
+            "llm_mcp_tpu.rpc", "jax", "numpy",
+        ),
+        exercise=textwrap.dedent(
+            """
+            tr = mod.Tracer(max_traces=8)
+            with tr.span("api") as sp:
+                pass
+            assert tr.get_trace(sp.trace_id), "span did not record"
+            """
+        ),
+        why="every request path carries a trace; imported by all layers",
+    ),
+    PurityEntry(
+        key="perf",
+        path="llm_mcp_tpu/telemetry/perf.py",
+        stubs=("llm_mcp_tpu", "llm_mcp_tpu.telemetry"),
+        forbidden=(
+            "llm_mcp_tpu.executor", "llm_mcp_tpu.api",
+            "llm_mcp_tpu.models", "llm_mcp_tpu.worker",
+            "llm_mcp_tpu.rpc", "jax", "numpy",
+        ),
+        exercise=textwrap.dedent(
+            """
+            shape = mod.ModelShape(dim=64, n_layers=2, n_heads=4,
+                                   n_kv_heads=2, head_dim=16,
+                                   param_count=1000)
+            obs = mod.PerfObservatory(shape)
+            obs.observe_itl(0.1, 2)
+            obs.finish_request(10.0, 5.0, 8)
+            obs.should_sample("decode")
+            obs.observe_phase("decode", 0.001, 0.01, tokens=8, rows=2,
+                              ctx_mean=32.0)
+            st = obs.stats()
+            assert set(st["roofline"]["layouts"]) == set(mod.CACHE_LAYOUTS)
+            """
+        ),
+        why="cost models + rooflines sampled from the engine loop",
+    ),
+    PurityEntry(
+        key="migration",
+        path="llm_mcp_tpu/executor/migration.py",
+        allow=("numpy", "llm_mcp_tpu.utils.locks",
+               "llm_mcp_tpu.executor.memory"),
+        stubs=("llm_mcp_tpu", "llm_mcp_tpu.utils", "llm_mcp_tpu.executor"),
+        deps=("llm_mcp_tpu/utils/locks.py", "llm_mcp_tpu/executor/memory.py"),
+        forbidden=("jax", "grpc"),
+        exercise=textwrap.dedent(
+            """
+            import numpy as np
+            h, t = mod.decode_payload(mod.encode_payload(
+                {{"x": 1}}, {{"k": np.ones((1, 1, 1, 2, 1), np.float32)}}))
+            assert h == {{"x": 1}} and t["k"].shape == (1, 1, 1, 2, 1)
+            """
+        ),
+        why="wire codec must run on CPU-only worker hosts (stdlib+numpy)",
+    ),
+    PurityEntry(
+        key="memory",
+        path="llm_mcp_tpu/executor/memory.py",
+        allow=("llm_mcp_tpu.utils.locks",),
+        stubs=("llm_mcp_tpu", "llm_mcp_tpu.utils", "llm_mcp_tpu.executor"),
+        deps=("llm_mcp_tpu/utils/locks.py",),
+        forbidden=("jax", "grpc", "numpy"),
+        exercise=textwrap.dedent(
+            """
+            pool = mod.KVPool(max_slots=2, max_seq_len=8, bytes_per_slot=64)
+            assert pool.admit_ok(0.0) and pool.hbm_bytes() == 128
+            """
+        ),
+        why="host-side HBM bookkeeping imported by the migration codec",
+    ),
+    PurityEntry(
+        key="drafter",
+        path="llm_mcp_tpu/executor/drafter.py",
+        stubs=("llm_mcp_tpu", "llm_mcp_tpu.executor"),
+        forbidden=("jax", "numpy"),
+        exercise=textwrap.dedent(
+            """
+            assert mod.NGramDrafter(2, 3).draft(4) == []
+            """
+        ),
+        why="runs on the engine host thread and in follower processes",
+    ),
+    PurityEntry(
+        key="locks",
+        path="llm_mcp_tpu/utils/locks.py",
+        stubs=("llm_mcp_tpu", "llm_mcp_tpu.utils"),
+        forbidden=("jax", "numpy", "grpc"),
+        exercise=textwrap.dedent(
+            """
+            lo = mod.OrderedLock("a", 1)
+            hi = mod.OrderedLock("b", 2)
+            with lo:
+                with hi:
+                    pass
+            try:
+                with hi:
+                    with lo:
+                        raise AssertionError("rank check dead")
+            except mod.LockOrderError:
+                pass
+            """
+        ),
+        why="the rank discipline itself must import nothing",
+    ),
+)
+
+
+def manifest_entry(key: str) -> PurityEntry:
+    for e in PURITY_MANIFEST:
+        if e.key == key:
+            return e
+    raise KeyError(f"no purity-manifest entry {key!r}")
+
+
+# -- static half -------------------------------------------------------------
+
+
+def _module_level_imports(tree: ast.Module) -> list[tuple[str, int]]:
+    """(absolute-ish import name, line) for imports that execute at module
+    import time — module body plus any non-function nesting (if/try)."""
+    out: list[tuple[str, int]] = []
+
+    def visit(body, in_func: bool):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Import):
+                out.extend((a.name, node.lineno) for a in node.names)
+            elif isinstance(node, ast.ImportFrom):
+                out.append((node.module or "", node.lineno))
+                # relative level recorded by caller via marker
+                if node.level:
+                    out[-1] = (f"{'.' * node.level}{node.module or ''}",
+                               node.lineno)
+            elif isinstance(node, (ast.If, ast.Try, ast.With)):
+                for attr in ("body", "orelse", "finalbody", "handlers"):
+                    sub = getattr(node, attr, [])
+                    for s in sub:
+                        if isinstance(s, ast.ExceptHandler):
+                            visit(s.body, in_func)
+                    if sub and not isinstance(sub[0], ast.ExceptHandler):
+                        visit(sub, in_func)
+            elif isinstance(node, ast.ClassDef):
+                visit(node.body, in_func)
+
+    visit(tree.body, False)
+    return out
+
+
+def _absolutize(name: str, module_relpath: str) -> str:
+    """Resolve a leading-dots relative import against the module's
+    package path (llm_mcp_tpu/executor/migration.py + '..utils.locks'
+    -> llm_mcp_tpu.utils.locks)."""
+    if not name.startswith("."):
+        return name
+    level = len(name) - len(name.lstrip("."))
+    pkg_parts = module_relpath.replace("\\", "/").split("/")[:-1]
+    base = pkg_parts[: len(pkg_parts) - (level - 1)]
+    tail = name.lstrip(".")
+    return ".".join(base + ([tail] if tail else []))
+
+
+def _stdlib_names() -> frozenset[str]:
+    return getattr(sys, "stdlib_module_names", frozenset())
+
+
+class ImportPurityPass:
+    pass_id = PASS_ID
+
+    def __init__(self, manifest: tuple[PurityEntry, ...] = PURITY_MANIFEST):
+        self.manifest = manifest
+
+    def run(self, index: RepoIndex) -> list[Finding]:
+        findings: list[Finding] = []
+        stdlib = _stdlib_names()
+        for entry in self.manifest:
+            tree = index.ast(entry.path)
+            if tree is None:
+                findings.append(
+                    Finding(
+                        PASS_ID, entry.path, 0,
+                        f"pinned-module-missing:{entry.key}",
+                        f"purity-pinned module {entry.path} "
+                        f"({entry.key}) does not exist — update "
+                        "PURITY_MANIFEST",
+                    )
+                )
+                continue
+            for name, line in _module_level_imports(tree):
+                absname = _absolutize(name, entry.path)
+                top = absname.split(".")[0]
+                if top == "__future__" or top in stdlib:
+                    continue
+                if any(
+                    absname == a or absname.startswith(a + ".")
+                    or a.startswith(absname + ".")
+                    for a in entry.allow
+                ):
+                    continue
+                findings.append(
+                    Finding(
+                        PASS_ID, entry.path, line,
+                        f"impure-import:{entry.key}:{absname}",
+                        f"{entry.path} is pinned "
+                        f"{'stdlib-only' if not entry.allow else 'to stdlib + ' + ', '.join(entry.allow)}"
+                        f" ({entry.why}) but imports {absname!r} at module "
+                        "level — make it lazy or amend the manifest",
+                    )
+                )
+        return findings
+
+
+# -- runtime half (called by the thin tier-1 tests) --------------------------
+
+_PROBE_TEMPLATE = """
+import importlib.util, sys, types
+for pkg in {stubs!r}:
+    m = types.ModuleType(pkg)
+    m.__path__ = []
+    sys.modules[pkg] = m
+mod = None
+for name, path in {loads!r}:
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+{exercise}
+bad = sorted(m for m in sys.modules if m.startswith({forbidden!r}))
+sys.exit("%s pulled in: %s" % ({key!r}, bad) if bad else 0)
+"""
+
+
+def probe_code(key: str, repo_root: str, tmp: str = "") -> str:
+    """The subprocess source for a manifest entry's runtime probe."""
+    import os
+
+    entry = manifest_entry(key)
+
+    def modname(relpath: str) -> str:
+        return relpath[:-3].replace("/", ".")
+
+    loads = [
+        (modname(dep), os.path.join(repo_root, dep)) for dep in entry.deps
+    ]
+    loads.append((modname(entry.path), os.path.join(repo_root, entry.path)))
+    exercise = textwrap.indent(
+        entry.exercise.format(tmp=tmp).strip(), ""
+    )
+    return _PROBE_TEMPLATE.format(
+        stubs=tuple(entry.stubs),
+        loads=loads,
+        exercise=exercise,
+        forbidden=tuple(entry.forbidden),
+        key=key,
+    )
+
+
+def run_probe(
+    key: str, repo_root: str, tmp: str = "", timeout: float = 120.0
+) -> subprocess.CompletedProcess:
+    """Run a manifest entry's runtime import probe in a subprocess.
+
+    Returns the CompletedProcess; rc 0 means the module loaded by file
+    path, passed its exercise snippet, and pulled in nothing forbidden."""
+    return subprocess.run(
+        [sys.executable, "-c", probe_code(key, repo_root, tmp)],
+        capture_output=True, text=True, timeout=timeout,
+    )

@@ -10,10 +10,8 @@ migration.py installable without jax/grpc.
 
 from __future__ import annotations
 
+import os
 import queue
-import subprocess
-import sys
-import textwrap
 import threading
 import time
 
@@ -174,44 +172,16 @@ def test_flatten_without_fallback_raises():
 
 
 def test_migration_module_never_imports_jax_or_grpc():
-    """Import-direction lint (the test_spec.py drafter pattern): the wire
-    path must stay stdlib + numpy so a CPU-only worker host can decode and
-    forward payloads without jax or grpc installed. migration.py's only
-    in-repo deps (utils.locks, executor.memory) are loaded by file path
-    too — package __init__s legitimately import jax and must not run."""
-    import llm_mcp_tpu.executor.memory as memory_mod
-    import llm_mcp_tpu.utils.locks as locks_mod
+    """Import-direction lint: the wire path must stay stdlib + numpy so a
+    CPU-only worker host can decode and forward payloads without jax or
+    grpc installed. migration.py's only in-repo deps (utils.locks,
+    executor.memory) are loaded by file path too — package __init__s
+    legitimately import jax and must not run. Probe single-sourced from
+    the purity manifest (llm_mcp_tpu/analysis/imports_lint.py)."""
+    from llm_mcp_tpu.analysis.imports_lint import run_probe
 
-    paths = {
-        "llm_mcp_tpu.utils.locks": locks_mod.__file__,
-        "llm_mcp_tpu.executor.memory": memory_mod.__file__,
-        "llm_mcp_tpu.executor.migration": migration.__file__,
-    }
-    code = textwrap.dedent(
-        """
-        import importlib.util, sys, types
-        import numpy as np
-        for pkg in ("llm_mcp_tpu", "llm_mcp_tpu.utils", "llm_mcp_tpu.executor"):
-            m = types.ModuleType(pkg)
-            m.__path__ = []
-            sys.modules[pkg] = m
-        for name, path in %r.items():
-            spec = importlib.util.spec_from_file_location(name, path)
-            mod = importlib.util.module_from_spec(spec)
-            sys.modules[name] = mod
-            spec.loader.exec_module(mod)
-        h, t = mod.decode_payload(
-            mod.encode_payload({"x": 1}, {"k": np.ones((1, 1, 1, 2, 1), np.float32)})
-        )
-        assert h == {"x": 1} and t["k"].shape == (1, 1, 1, 2, 1)
-        bad = [m for m in sys.modules if m.startswith(("jax", "grpc"))]
-        sys.exit("migration wire path pulled in: %%s" %% bad if bad else 0)
-        """
-        % (paths,)
-    )
-    proc = subprocess.run(
-        [sys.executable, "-c", code], capture_output=True, text=True, timeout=120
-    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = run_probe("migration", repo)
     assert proc.returncode == 0, proc.stderr or proc.stdout
 
 

@@ -9,9 +9,6 @@ an injected decode stall journals the ring exactly once."""
 
 import json
 import os
-import subprocess
-import sys
-import textwrap
 import threading
 import time
 
@@ -365,36 +362,16 @@ def test_compile_ledger_drain_fresh_exactly_once():
 
 def test_recorder_never_imports_executor(tmp_path):
     """The recorder is loaded by file path with stubbed parent packages
-    (the migration-lint pattern), so package __init__s never run: after a
-    full event→dump round trip, nothing from the serving stack — and no
-    jax or numpy — may be in sys.modules."""
-    code = textwrap.dedent(
-        """
-        import importlib.util, json, os, sys, types
-        for pkg in ("llm_mcp_tpu", "llm_mcp_tpu.telemetry"):
-            m = types.ModuleType(pkg)
-            m.__path__ = []
-            sys.modules[pkg] = m
-        spec = importlib.util.spec_from_file_location(
-            "llm_mcp_tpu.telemetry.recorder", %r)
-        mod = importlib.util.module_from_spec(spec)
-        sys.modules[spec.name] = mod
-        spec.loader.exec_module(mod)
-        rec = mod.FlightRecorder(capacity=16, dump_dir=%r, dump_interval_s=0.0)
-        rec.event("decode", trace_id="a" * 32, rows=1)
-        path = rec.dump("lint", force=True)
-        rows = [json.loads(l) for l in open(path)]
-        assert rows[0]["kind"] == "flight_dump" and rows[1]["etype"] == "decode"
-        bad = [m for m in sys.modules if m.startswith((
-            "llm_mcp_tpu.executor", "llm_mcp_tpu.api", "llm_mcp_tpu.routing",
-            "llm_mcp_tpu.worker", "llm_mcp_tpu.rpc", "jax", "numpy"))]
-        sys.exit("recorder pulled in: %%s" %% bad if bad else 0)
-        """
-        % (flight.__file__, str(tmp_path))
-    )
-    proc = subprocess.run(
-        [sys.executable, "-c", code], capture_output=True, text=True, timeout=120
-    )
+    (so package __init__s never run), exercised through a full event→dump
+    round trip, and nothing from the serving stack — and no jax or numpy
+    — may be in sys.modules. Stub code, exercise snippet, and forbidden
+    prefixes are single-sourced from the purity manifest
+    (llm_mcp_tpu/analysis/imports_lint.py); the static half of the same
+    pin runs in tests/test_analysis.py."""
+    from llm_mcp_tpu.analysis.imports_lint import run_probe
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = run_probe("recorder", repo, tmp=str(tmp_path))
     assert proc.returncode == 0, proc.stderr or proc.stdout
 
 

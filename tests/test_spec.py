@@ -10,8 +10,6 @@ cache index staying coherent with the LRU store.
 from __future__ import annotations
 
 import math
-import subprocess
-import sys
 
 import pytest
 
@@ -65,26 +63,18 @@ def test_drafter_last_occurrence_wins():
 
 
 def test_drafter_never_imports_jax():
-    """Import-direction lint (the tests/test_tracing.py pattern): the
-    drafter runs on the engine host thread and inside slice-engine follower
-    processes — it must stay pure stdlib, pulling in neither jax nor
-    numpy."""
-    # load by file path: importing through llm_mcp_tpu.executor would run
-    # the package __init__ (which legitimately imports jax) — the lint is
-    # about what drafter.py ITSELF pulls in
-    drafter_path = __import__("llm_mcp_tpu.executor.drafter", fromlist=["x"]).__file__
-    code = (
-        "import sys, importlib.util; "
-        f"spec = importlib.util.spec_from_file_location('drafter', {drafter_path!r}); "
-        "mod = importlib.util.module_from_spec(spec); "
-        "spec.loader.exec_module(mod); "
-        "assert mod.NGramDrafter(2, 3).draft(4) == []; "
-        "bad = [m for m in sys.modules if m.startswith(('jax', 'numpy'))]; "
-        "sys.exit('drafter pulled in: %s' % bad if bad else 0)"
-    )
-    proc = subprocess.run(
-        [sys.executable, "-c", code], capture_output=True, text=True, timeout=120
-    )
+    """Import-direction lint: the drafter runs on the engine host thread
+    and inside slice-engine follower processes — it must stay pure
+    stdlib, pulling in neither jax nor numpy. Loaded by file path so the
+    package __init__ (which legitimately imports jax) never runs; probe
+    single-sourced from the purity manifest
+    (llm_mcp_tpu/analysis/imports_lint.py)."""
+    import os
+
+    from llm_mcp_tpu.analysis.imports_lint import run_probe
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = run_probe("drafter", repo)
     assert proc.returncode == 0, proc.stderr or proc.stdout
 
 
