@@ -18,6 +18,22 @@ Env knobs for sweeps (defaults are the driver configuration):
                                                arrivals for the routing
                                                sweep's clients (aggregate
                                                requests/s; 0 = closed loop)
+  BENCH_TRACE=<path | synth:kind:n[:seed]>   — dedicated trace-replay mode:
+                                               re-issue a captured (or
+                                               synthesized chat/embed/
+                                               longctx/agent) workload
+                                               open-loop with faithful
+                                               inter-arrival gaps, then
+                                               print the replay line of
+                                               record and exit
+  BENCH_TRACE_COMPRESS=<x>                   — time-compression factor for
+                                               replay gaps (default 1 =
+                                               real time)
+  BENCH_TRACE_SEED=<n>                       — replay stream seed (two runs
+                                               with the same seed issue
+                                               byte-identical streams)
+  BENCH_REPLAY=0                             — skip the CPU capture→replay
+                                               smoke leg
 """
 
 from __future__ import annotations
@@ -26,6 +42,36 @@ import gc
 import json
 import os
 import time
+
+
+def bench_poisson_rps() -> float:
+    """BENCH_POISSON_RPS parsed in ONE place (it used to be read
+    independently at each sweep call site): the aggregate open-loop
+    arrival rate in requests/s; 0 keeps clients closed-loop."""
+    try:
+        return float(os.environ.get("BENCH_POISSON_RPS", "0") or 0.0)
+    except ValueError:
+        return 0.0
+
+
+def next_arrival_gap(
+    rng,
+    *,
+    poisson_rps: float = 0.0,
+    n_clients: int = 1,
+    trace_gap: float | None = None,
+    compress: float = 1.0,
+) -> float:
+    """The one arrival process every open-loop mode draws from: a captured
+    trace's inter-arrival gap scaled by the time-compression factor when
+    given, else a Poisson gap at the aggregate rate split across the
+    clients, else 0 (closed loop). `rng` is each caller's own seeded
+    random.Random — the draw sequence stays per-client deterministic."""
+    if trace_gap is not None:
+        return max(0.0, float(trace_gap)) / max(1e-9, compress)
+    if poisson_rps > 0:
+        return rng.expovariate(poisson_rps / max(1, n_clients))
+    return 0.0
 
 
 def raw_decode_tps(
@@ -395,6 +441,9 @@ def serve_path_metrics(
     # end-of-run ledger audit: sampled AFTER the direct window drained its
     # requests, so a nonzero count is a real refcount bug, not live traffic
     pg_end = eng.paging_stats()
+    # latency waterfall ledger: sampled before shutdown tears the engine
+    # down (the del below drops the reference the stats hang off)
+    wf_end = eng.waterfall_stats()
     srv.shutdown()
     eng.shutdown()
     # Drop every reference to the engine's device buffers (8B weights + KV)
@@ -531,6 +580,18 @@ def serve_path_metrics(
         out["p50_ttft_ms"] = statistics.median(ttfts)
         out["p95_ttft_ms"] = sorted(ttfts)[max(0, int(len(ttfts) * 0.95) - 1)]
         out["ttft_samples"] = float(len(ttfts))
+    # latency waterfall over the run (telemetry/workload.py): per-stage
+    # p95s of the exact wall partition — where a finished request's time
+    # actually went, beside the TTFT/ITL aggregates above
+    ws = wf_end
+    if ws.get("requests", 0):
+        out["waterfall_coverage"] = ws.get("coverage", 1.0)
+        for stage in ("admit_wait", "prefill_queue", "prefill_compute",
+                      "decode", "stall"):
+            out[f"waterfall_{stage}_p95_ms"] = (
+                (ws.get("stages") or {}).get(stage, {}).get("p95_ms", 0.0)
+            )
+        out["waterfall_total_p95_ms"] = ws.get("total_p95_ms", 0.0)
     return out
 
 
@@ -768,6 +829,34 @@ def main() -> None:
             return True
         return False
     on_tpu = platform != "cpu"
+
+    if os.environ.get("BENCH_TRACE"):
+        # deterministic trace replay as the line of record: re-issue a
+        # captured (or synth:<kind>:<n>[:seed]) workload open-loop with
+        # faithful inter-arrival gaps / BENCH_TRACE_COMPRESS, seeded by
+        # BENCH_TRACE_SEED so two runs issue byte-identical streams
+        src = os.environ["BENCH_TRACE"]
+        model = os.environ.get("BENCH_MODEL") or (
+            "llama-3.1-8b" if on_tpu else "tiny-llm"
+        )
+        rp = trace_replay_metrics(
+            src, model=model,
+            max_slots=int(os.environ.get("BENCH_B") or (112 if on_tpu else 4)),
+            max_seq_len=int(os.environ.get("BENCH_S") or (2048 if on_tpu else 512)),
+            decode_chunk=8 if on_tpu else 4,
+            quant="int8" if on_tpu else "",
+            kv_quant="int8" if on_tpu else "",
+            max_tokens_cap=0 if on_tpu else 16,
+        )
+        line = {
+            "metric": f"replay_tok_per_s_{model}_{platform}",
+            "value": rp.pop("replay_tok_per_s", 0.0),
+            "unit": "tok/s",
+            "vs_baseline": 0.0,
+            **{k: v for k, v in rp.items() if k != "outputs"},
+        }
+        print(json.dumps(line))
+        return
 
     if os.environ.get("BENCH_MODEL"):
         model = os.environ["BENCH_MODEL"]
@@ -1310,9 +1399,7 @@ def main() -> None:
                     decode_chunk=headline_chunk,
                     quant="int8", kv_quant="int8",
                     shared_tokens=320,
-                    poisson_rps=float(
-                        os.environ.get("BENCH_POISSON_RPS", "0") or 0.0
-                    ),
+                    poisson_rps=bench_poisson_rps(),
                 )
                 if "prefix_route_single_device" in pr:
                     secondary.update(pr)  # gated keys absent: [SKIP] + warn
@@ -1522,6 +1609,20 @@ def main() -> None:
                 # ITL p95 is the streaming-smoothness ceiling
                 line["itl_p50_ms"] = serve["itl_p50_ms"]
                 line["itl_p95_ms"] = serve["itl_p95_ms"]
+            if "waterfall_decode_p95_ms" in serve:
+                # latency waterfall over the headline window, promoted where
+                # scripts/perf_gate.py reads it: the per-stage p95s of the
+                # exact wall partition plus its coverage ratio (stages must
+                # sum to the measured wall — the acceptance invariant)
+                for wk in ("waterfall_admit_wait_p95_ms",
+                           "waterfall_prefill_queue_p95_ms",
+                           "waterfall_prefill_compute_p95_ms",
+                           "waterfall_decode_p95_ms",
+                           "waterfall_stall_p95_ms",
+                           "waterfall_total_p95_ms",
+                           "waterfall_coverage"):
+                    if wk in serve:
+                        line[wk] = serve[wk]
             if "goodput_tok_per_s" in serve:
                 # SLO-conforming tokens/s (DistServe's metric) beside the
                 # raw headline — the gap between them is the SLO-violating
@@ -1577,6 +1678,11 @@ def main() -> None:
                 smoke_line["itl_p95_ms"] = serve["itl_p95_ms"]
             if "goodput_tok_per_s" in serve:
                 smoke_line["goodput_tok_per_s"] = serve["goodput_tok_per_s"]
+            if "waterfall_coverage" in serve:
+                smoke_line["waterfall_coverage"] = serve["waterfall_coverage"]
+                smoke_line["waterfall_total_p95_ms"] = serve[
+                    "waterfall_total_p95_ms"
+                ]
             print(json.dumps(smoke_line))
             if smoke_line["recorder_dropped_events"] > 0:
                 # the smoke IS the recorder's no-drop proof: a drop here
@@ -1676,9 +1782,7 @@ def main() -> None:
                     "tiny-llm", n_clients=6, rounds=2, max_tokens=8,
                     max_slots=2, max_seq_len=512, decode_chunk=4,
                     shared_tokens=96, fetch_min=32,
-                    poisson_rps=float(
-                        os.environ.get("BENCH_POISSON_RPS", "0") or 0.0
-                    ),
+                    poisson_rps=bench_poisson_rps(),
                 )
                 if "prefix_route_single_device" in prs:
                     print(json.dumps({
@@ -1707,6 +1811,27 @@ def main() -> None:
                             "route_window_errors", 0.0
                         ),
                     }))
+            if os.environ.get("BENCH_REPLAY", "1") != "0":
+                # capture→replay smoke: serve greedy requests with workload
+                # capture armed, dump the trace, replay it through a fresh
+                # engine — replay_match == 1.0 proves the replayed stream
+                # reproduced the captured request count AND token-identical
+                # outputs (the deterministic-replay acceptance check)
+                gc.collect()
+                rps = capture_replay_smoke("tiny-llm")
+                print(json.dumps({
+                    "metric": "serve_replay_tiny-llm_cpu",
+                    "value": round(rps.get("replay_tok_per_s", 0.0), 1),
+                    "unit": "tok/s",
+                    "vs_baseline": 0.0,
+                    "replay_determinism": rps.get("replay_determinism", 0.0),
+                    "replay_match": rps.get("replay_match", 0.0),
+                    "replay_requests": rps.get("replay_requests", 0.0),
+                    "replay_finished": rps.get("replay_finished", 0.0),
+                    "replay_captured": rps.get("replay_captured", 0.0),
+                    "replay_stream_sha": rps.get("replay_stream_sha", ""),
+                    "waterfall_coverage": rps.get("waterfall_coverage", 0.0),
+                }))
             return
         model, B, S, K = "tiny-llm", 8, 256, 32
         tps = raw_decode_tps(model, B, S, K, rounds=2)
@@ -1721,6 +1846,247 @@ def main() -> None:
     if secondary:
         line["secondary"] = secondary
     print(json.dumps(line))
+
+
+def load_trace_source(src: str) -> tuple[list[dict], int]:
+    """(records, rejected) from a capture path or a `synth:<kind>:<n>[:seed]`
+    spec (kinds: chat / embed / longctx / agent — telemetry/workload.py)."""
+    from llm_mcp_tpu.telemetry import workload
+
+    if src.startswith("synth:"):
+        parts = src.split(":")
+        kind = parts[1] if len(parts) > 1 and parts[1] else "chat"
+        n = int(parts[2]) if len(parts) > 2 and parts[2] else 32
+        seed = int(parts[3]) if len(parts) > 3 and parts[3] else 0
+        return workload.synth_trace(kind, n, seed=seed), 0
+    return workload.load_trace(src)
+
+
+def build_replay_stream(
+    records: list[dict], *, seed: int = 0, compress: float = 1.0
+) -> tuple[list[tuple[float, dict, object]], str]:
+    """The deterministic issue plan: [(gap_s, record, prompt)] plus its
+    sha256 digest. `prompt` is the record's raw token ids when captured
+    with TPU_WORKLOAD_IDS=1 (token-identical replay), else deterministic
+    text derived from the prefix-chain head hash (prefix-sharing structure
+    survives). Same records + seed + compress -> byte-identical plan —
+    the digest is the proof perf_gate's replay_determinism check rides."""
+    import hashlib
+    import random
+
+    from llm_mcp_tpu.telemetry import workload
+
+    rng = random.Random(seed)
+    plan: list[tuple[float, dict, object]] = []
+    h = hashlib.sha256(f"seed={seed} compress={compress}".encode())
+    prev_ts: float | None = None
+    for rec in records:
+        ts = float(rec["ts"])
+        trace_gap = 0.0 if prev_ts is None else max(0.0, ts - prev_ts)
+        prev_ts = ts
+        gap = next_arrival_gap(rng, trace_gap=trace_gap, compress=compress)
+        prompt: object = (
+            list(rec["ids"]) if rec.get("ids")
+            else workload.prompt_text_for(rec)
+        )
+        plan.append((gap, rec, prompt))
+        h.update(json.dumps(
+            [round(gap, 9), prompt, rec.get("mt", 0), rec.get("temp", 0.0),
+             rec.get("top_k", 0), rec.get("top_p", 1.0)],
+            separators=(",", ":"),
+        ).encode())
+    return plan, h.hexdigest()
+
+
+def trace_replay_metrics(
+    trace_src: str,
+    *,
+    model: str = "tiny-llm",
+    max_slots: int = 4,
+    max_seq_len: int = 512,
+    decode_chunk: int = 4,
+    quant: str = "",
+    kv_quant: str = "",
+    compress: float | None = None,
+    seed: int | None = None,
+    max_tokens_cap: int = 0,
+    collect_outputs: bool = False,
+) -> dict:
+    """Open-loop deterministic replay of a captured (or synthesized)
+    workload trace against a fresh engine — the BENCH_TRACE mode.
+
+    Issues the trace's requests with faithful inter-arrival gaps divided
+    by the time-compression factor (BENCH_TRACE_COMPRESS), seeded by
+    BENCH_TRACE_SEED so two runs issue byte-identical request streams
+    (replay_determinism proves it by building the plan twice and comparing
+    digests). Records captured with raw ids replay token-identically;
+    hash-only records replay as deterministic text derived from their
+    prefix-chain head hashes. Returns replay_* metrics plus the engine's
+    latency-waterfall p95s over the replayed window."""
+    import hashlib
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from llm_mcp_tpu.executor import GenerationEngine
+    from llm_mcp_tpu.executor.engine import GenRequest
+
+    if compress is None:
+        compress = float(os.environ.get("BENCH_TRACE_COMPRESS", "1") or 1.0)
+    if seed is None:
+        seed = int(os.environ.get("BENCH_TRACE_SEED", "0") or 0)
+    records, rejected = load_trace_source(trace_src)
+    out: dict = {
+        "replay_requests": float(len(records)),
+        "replay_rejected_lines": float(rejected),
+        "replay_compress": float(compress),
+    }
+    if not records:
+        out["replay_determinism"] = 0.0
+        return out
+    plan, sha_a = build_replay_stream(records, seed=seed, compress=compress)
+    _, sha_b = build_replay_stream(records, seed=seed, compress=compress)
+    out["replay_determinism"] = 1.0 if sha_a == sha_b else 0.0
+    out["replay_stream_sha"] = sha_a[:16]
+
+    platform = jax.devices()[0].platform
+    dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
+    eng = GenerationEngine(
+        model, max_slots=max_slots, max_seq_len=max_seq_len, dtype=dtype,
+        decode_chunk=decode_chunk, quant=quant, kv_quant=kv_quant,
+    ).start()
+    results: dict[str, str] = {}
+    errors = [0]
+    lock = threading.Lock()
+    consumers: list[threading.Thread] = []
+
+    def consume(rid: str, req: GenRequest) -> None:
+        parts: list[str] = []
+        while True:
+            evt = req.out.get()
+            if not isinstance(evt, dict):
+                break
+            if evt.get("type") == "token":
+                parts.append(evt["text"])
+            elif evt.get("type") == "done":
+                break
+            elif evt.get("type") == "error":
+                with lock:
+                    errors[0] += 1
+                break
+        with lock:
+            results[rid] = "".join(parts)
+
+    try:
+        t0 = time.perf_counter()
+        for gap, rec, prompt in plan:
+            if gap > 0:
+                time.sleep(gap)
+            ids = (
+                prompt if isinstance(prompt, list)
+                else [int(t) for t in eng.tokenizer.encode(prompt)]
+            )
+            mt = int(rec.get("mt", 16)) or 1
+            if max_tokens_cap:
+                mt = min(mt, max_tokens_cap)
+            req = GenRequest(
+                prompt_ids=ids, max_tokens=mt,
+                temperature=float(rec.get("temp", 0.0)),
+                top_k=int(rec.get("top_k", 0)),
+                top_p=float(rec.get("top_p", 1.0)),
+            )
+            rid = str(rec.get("rid") or req.request_id)
+            eng.submit(req)
+            th = threading.Thread(target=consume, args=(rid, req), daemon=True)
+            th.start()
+            consumers.append(th)
+        # drain: open-loop issuance is done; wait for the tail to finish
+        deadline = time.time() + 120.0
+        for th in consumers:
+            th.join(timeout=max(0.1, deadline - time.time()))
+        wall = time.perf_counter() - t0
+        out["replay_finished"] = float(eng.finished_requests)
+        out["replay_admitted"] = float(eng.total_requests)
+        out["replay_window_errors"] = float(errors[0] + eng.total_errors)
+        out["replay_tok_per_s"] = round(eng.finished_tokens / wall, 1) if wall > 0 else 0.0
+        out["replay_wall_s"] = round(wall, 3)
+        ws = eng.waterfall_stats()
+        out["waterfall_coverage"] = ws.get("coverage", 1.0)
+        for stage in ("admit_wait", "prefill_queue", "prefill_compute",
+                      "decode", "stall"):
+            out[f"waterfall_{stage}_p95_ms"] = (
+                (ws.get("stages") or {}).get(stage, {}).get("p95_ms", 0.0)
+            )
+        out["waterfall_total_p95_ms"] = ws.get("total_p95_ms", 0.0)
+        h = hashlib.sha256()
+        for rid in sorted(results):
+            h.update(f"{rid}\x00{results[rid]}\x01".encode())
+        out["replay_output_sha"] = h.hexdigest()[:16]
+        if collect_outputs:
+            out["outputs"] = dict(results)
+    finally:
+        eng.shutdown()
+    return out
+
+
+def capture_replay_smoke(
+    model: str = "tiny-llm", n_requests: int = 5, max_tokens: int = 8
+) -> dict:
+    """CPU-smoke capture→replay round trip: serve a few greedy requests
+    with workload capture armed (raw ids embedded), dump the ring to a
+    trace file, replay it through a FRESH engine, and compare — the
+    replayed stream must reproduce the captured admitted-request count
+    and token-identical outputs (replay_match carries both)."""
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from llm_mcp_tpu.executor import GenerationEngine
+    from llm_mcp_tpu.telemetry import workload
+
+    prior = workload.get_workload()
+    cap = workload.WorkloadTrace(include_ids=True, trace_path="")
+    workload.set_workload(cap)
+    outputs: dict[str, str] = {}
+    try:
+        eng = GenerationEngine(
+            model, max_slots=2, max_seq_len=512, dtype=jnp.float32,
+            decode_chunk=4,
+        ).start()
+        try:
+            for i in range(n_requests):
+                out = eng.generate(
+                    f"capture request {i}: one plain line about replay.",
+                    max_tokens=max_tokens, temperature=0.0,
+                )
+                # the finished request's record is in the ring before its
+                # done event publishes — newest entry is this request
+                rec = cap.snapshot(1)[0]
+                outputs[rec["rid"]] = out["text"]
+            captured = eng.finished_requests
+        finally:
+            eng.shutdown()
+    finally:
+        workload.set_workload(prior)
+    fd, path = tempfile.mkstemp(suffix=".jsonl", prefix="llmtpu-trace-")
+    os.close(fd)
+    try:
+        cap.dump(path)
+        rp = trace_replay_metrics(
+            path, model=model, max_slots=2, max_seq_len=512, decode_chunk=4,
+            compress=1000.0, collect_outputs=True,
+        )
+    finally:
+        os.unlink(path)
+    replay_out = rp.pop("outputs", {})
+    rp["replay_captured"] = float(captured)
+    rp["replay_match"] = (
+        1.0
+        if replay_out == outputs and rp.get("replay_finished") == float(captured)
+        else 0.0
+    )
+    return rp
 
 
 def migration_sweep(
@@ -2128,9 +2494,12 @@ def prefix_routing_sweep(
                 def client(cid: int) -> None:
                     rng = random.Random(0xC0FFEE + cid)
                     for r in range(rounds):
-                        if poisson_rps > 0:
-                            time.sleep(rng.expovariate(
-                                poisson_rps / n_clients))
+                        gap = next_arrival_gap(
+                            rng, poisson_rps=poisson_rps,
+                            n_clients=n_clients,
+                        )
+                        if gap > 0:
+                            time.sleep(gap)
                         if rng.random() < shared_frac:
                             prompt = (shared_text + f"client {cid} round"
                                       f" {r}: one line on routing.")

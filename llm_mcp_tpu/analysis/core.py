@@ -55,8 +55,9 @@ DEFAULT_CONFIG: dict = {
     "knob_extra_roots": ["bench.py", "scripts"],
     "knob_prefixes": ("TPU_", "LLM_MCP_TPU_"),
     # etypes the recorder census must explicitly list even if the engine
-    # stops emitting them (tests/test_perf.py pinned these)
-    "required_etypes": ("pf_rag", "fused_rag", "perf"),
+    # stops emitting them (tests/test_perf.py pinned these; wl/wf are the
+    # workload-capture and latency-waterfall marks from telemetry/workload)
+    "required_etypes": ("pf_rag", "fused_rag", "perf", "wl", "wf"),
 }
 
 BASELINE_PATH = "llm_mcp_tpu/analysis/baseline.txt"

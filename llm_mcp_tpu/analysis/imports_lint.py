@@ -126,6 +126,39 @@ PURITY_MANIFEST: tuple[PurityEntry, ...] = (
         why="cost models + rooflines sampled from the engine loop",
     ),
     PurityEntry(
+        key="workload",
+        path="llm_mcp_tpu/telemetry/workload.py",
+        stubs=("llm_mcp_tpu", "llm_mcp_tpu.telemetry"),
+        forbidden=(
+            "llm_mcp_tpu.executor", "llm_mcp_tpu.api",
+            "llm_mcp_tpu.routing", "llm_mcp_tpu.worker",
+            "llm_mcp_tpu.rpc", "jax", "numpy",
+        ),
+        exercise=textwrap.dedent(
+            """
+            import os
+            wl = mod.WorkloadTrace(capacity=16, trace_path="",
+                                   include_ids=True)
+            rec = wl.record(ts=1.0, rid="r1", prompt_tokens=4,
+                            chain=[(4, "aa")], max_tokens=2,
+                            output_tokens=2, finish="length",
+                            ids=[1, 2, 3, 4])
+            assert rec is not None and rec["ids"] == [1, 2, 3, 4]
+            path = os.path.join({tmp!r}, "wl.jsonl")
+            assert wl.dump(path) == 1
+            recs, rej = mod.parse_trace(open(path).read().splitlines()
+                                        + ["garbage"])
+            assert len(recs) == 1 and rej == 1
+            assert mod.synth_trace("agent", 4, seed=1) == \\
+                mod.synth_trace("agent", 4, seed=1)
+            wf = mod.LatencyWaterfall(window=8)
+            wf.observe({{"decode": 0.5, "prefill_compute": 0.5}}, 1.0)
+            assert wf.stats()["coverage"] == 1.0
+            """
+        ),
+        why="capture ring + waterfall ledger fed from the decode hot path",
+    ),
+    PurityEntry(
         key="migration",
         path="llm_mcp_tpu/executor/migration.py",
         allow=("numpy", "llm_mcp_tpu.utils.locks",

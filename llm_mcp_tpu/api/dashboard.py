@@ -163,6 +163,32 @@ class DashboardAPI:
             for name, i in engines.items()
             if isinstance(i.get("perf"), dict)
         }
+        # condensed latency-waterfall + workload-capture view (full stats
+        # under engines[name]["waterfall"]/["workload"], per-request rows
+        # via /v1/debug/latency, the capture ring via /v1/debug/workload):
+        # where did a finished request's wall actually go, and is the
+        # traffic being captured for replay
+        latency = {
+            name: {
+                "requests": int(i["waterfall"].get("requests", 0)),
+                "coverage": i["waterfall"].get("coverage", 1.0),
+                "total_p95_ms": i["waterfall"].get("total_p95_ms", 0.0),
+                "p95_ms": {
+                    stage: (i["waterfall"].get("stages") or {})
+                    .get(stage, {})
+                    .get("p95_ms", 0.0)
+                    for stage in (
+                        "admit_wait", "shed", "prefill_queue",
+                        "prefill_compute", "decode", "stall", "preempt",
+                    )
+                },
+                "captured": int(
+                    (i.get("workload") or {}).get("records_total", 0)
+                ),
+            }
+            for name, i in engines.items()
+            if isinstance(i.get("waterfall"), dict)
+        }
         # condensed flight-recorder view (full stats under
         # engines[name]["flight"], raw ring via /v1/debug/flight): anomaly
         # dump history per engine plus watchdog transition counts — the
@@ -244,6 +270,7 @@ class DashboardAPI:
                 "paging": paging,
                 "prefill": prefill,
                 "perf": perf,
+                "latency": latency,
                 "migration": migration,
                 "routing": routing,
                 "anomalies": anomalies,

@@ -30,6 +30,7 @@ from ..state.db import Database
 from ..state.queue import JobQueue
 from ..telemetry import Metrics, tracing
 from ..telemetry import recorder as flight
+from ..telemetry import workload
 from ..utils.config import Config
 from .dashboard import DashboardAPI
 from .http import HTTPApi, Request, Response
@@ -90,6 +91,10 @@ class CoreServer:
         # perf observatory: sampled phase walls are cumulative per
         # engine+phase+bucket, bridged by delta like the rest
         self._perf_phase_s: dict[str, dict[str, float]] = {}
+        # latency waterfall (telemetry/workload.py): cumulative per-stage
+        # seconds per engine, bridged by delta to
+        # llmtpu_latency_stage_seconds{engine,stage}
+        self._latency_stage_s: dict[str, dict[str, float]] = {}
         # fleet prefix tier (routing/prefix.py): engine export/import
         # counters bridge by delta; route outcomes accumulate here for the
         # dashboard/debug surfaces. prefix_sources lets in-process peers
@@ -666,6 +671,25 @@ class CoreServer:
                 self._watchdog_counts[name] = {
                     state: float(v) for state, v in wts.items()
                 }
+            wfs = getattr(e, "waterfall_stats", None)
+            if wfs is not None:
+                w = wfs()
+                info[name]["waterfall"] = w
+                # per-request stage walls are cumulative per engine+stage;
+                # the counter advances by the delta between refreshes
+                prev_l = self._latency_stage_s.get(name, {})
+                cur_l: dict[str, float] = {}
+                for stage, cur in (w.get("stage_s") or {}).items():
+                    cur = float(cur)
+                    cur_l[stage] = cur
+                    if cur > prev_l.get(stage, 0.0):
+                        self.metrics.latency_stage_seconds.labels(
+                            engine=name, stage=stage
+                        ).inc(cur - prev_l.get(stage, 0.0))
+                self._latency_stage_s[name] = cur_l
+            wls = getattr(e, "workload_stats", None)
+            if wls is not None:
+                info[name]["workload"] = wls()
         # Process-wide flight ring + compile ledger (telemetry/recorder.py
         # singletons shared by every engine in this process): events advance
         # by delta, drops are a gauge (perf_gate hard-fails >0), and each
@@ -748,6 +772,8 @@ class CoreServer:
         r("GET", "/v1/debug/flight", self.handle_debug_flight)
         r("GET", "/v1/debug/compiles", self.handle_debug_compiles)
         r("GET", "/v1/debug/perf", self.handle_debug_perf)
+        r("GET", "/v1/debug/workload", self.handle_debug_workload)
+        r("GET", "/v1/debug/latency", self.handle_debug_latency)
         r("GET", "/v1/debug/prefix", self.handle_debug_prefix)
         r("GET", "/v1/debug/profile", self.handle_debug_profile)
         r("POST", "/v1/debug/profile", self.handle_debug_profile_start)
@@ -880,6 +906,53 @@ class CoreServer:
                 name: e.perf_stats()
                 for name, e in self.gen_engines.items()
                 if getattr(e, "perf_stats", None) is not None
+            }
+        )
+
+    def handle_debug_workload(self, req: Request, resp: Response) -> None:
+        """Workload capture (telemetry/workload.py): the process-shared
+        ring's health plus its newest records. `?limit=N` bounds the record
+        tail; `?dump=PATH` journals the whole ring to PATH as replayable
+        JSONL (the manual equivalent of streaming via TPU_WORKLOAD_TRACE)."""
+        try:
+            limit = int(req.query.get("limit") or 100)
+        except ValueError:
+            resp.write_error("limit must be an integer", 400)
+            return
+        wl = workload.get_workload()
+        out: dict[str, Any] = {
+            "workload": wl.stats(),
+            "records": wl.snapshot(limit=limit),
+        }
+        dump_path = (req.query.get("dump") or "").strip()
+        if dump_path:
+            try:
+                out["dumped"] = wl.dump(dump_path)
+                out["dump_path"] = dump_path
+            except OSError as e:
+                resp.write_error(f"dump failed: {e}", 400)
+                return
+        resp.write_json(out)
+
+    def handle_debug_latency(self, req: Request, resp: Response) -> None:
+        """Latency waterfall per engine: the per-stage decomposition of
+        every finished request's wall (admit_wait / shed / prefill_queue /
+        prefill_compute / decode / stall / preempt — an exact partition),
+        percentile windows, and the most recent per-request rows.
+        `?limit=N` bounds the recent-row tail."""
+        try:
+            limit = int(req.query.get("limit") or 32)
+        except ValueError:
+            resp.write_error("limit must be an integer", 400)
+            return
+        resp.write_json(
+            {
+                name: {
+                    **e.waterfall_stats(),
+                    "recent": e.waterfall_recent(limit),
+                }
+                for name, e in self.gen_engines.items()
+                if getattr(e, "waterfall_stats", None) is not None
             }
         )
 

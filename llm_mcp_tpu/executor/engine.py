@@ -75,6 +75,7 @@ from ..routing import prefix as prefix_fp
 from ..telemetry import perf
 from ..telemetry import recorder as flight
 from ..telemetry import tracing
+from ..telemetry import workload
 from .common import fine_bucket, pow2_bucket
 from .drafter import NGramDrafter
 from .memory import (
@@ -231,6 +232,10 @@ class GenRequest:
     # drain can ping-pong the queue head between two engines whose headroom
     # recovers alternately, and the bounced request starves.
     migrations: int = 0
+    # latency waterfall (telemetry/workload.py): admission-shed backoff the
+    # client spent before this submit landed. Stamped by the serving layer
+    # (bench clients, api handlers) — the engine only ever reads it.
+    shed_wait_s: float = 0.0
 
 
 @dataclass
@@ -268,6 +273,14 @@ class _Slot:
     perf_last_emit: float = 0.0
     itl_s_total: float = 0.0
     itl_samples: int = 0
+    # latency waterfall (telemetry/workload.py): synchronous prefill
+    # dispatch wall attributed to this request (token-share of each batch /
+    # chunk dispatch), inter-token gap beyond the stall threshold, and wall
+    # spent parked off-slot by preemption. _finish_slot clamps these into
+    # an exact partition of the request's measured wall.
+    prefill_compute_s: float = 0.0
+    stall_s: float = 0.0
+    preempted_s: float = 0.0
 
 
 @dataclass
@@ -310,6 +323,10 @@ class _PrefillState:
     # suffix prefill onto the activated _Slot (see _start_cached)
     shared_entry: Any = None
     shared_len: int = 0
+    # latency waterfall: prefill dispatch wall accumulated while this
+    # prompt was mid-chunk (token-share of each group dispatch), copied
+    # onto the activated _Slot's prefill_compute_s
+    prefill_s: float = 0.0
 
 
 @dataclass
@@ -1151,6 +1168,13 @@ class GenerationEngine:
             ),
             target_ttft_ms=self.target_ttft_ms,
         )
+        # Workload capture + latency waterfall (telemetry/workload.py).
+        # The capture ring is process-shared (like the flight recorder) so
+        # a fleet of engines streams one trace; the waterfall is per-engine
+        # — its stage windows describe THIS engine's scheduling. Both are
+        # stdlib modules; the engine hands them plain scalars/lists only.
+        self._workload = workload.get_workload()
+        self._waterfall = workload.LatencyWaterfall()
         # wall of the previous round completion: the sampled "wait" bucket
         # (scheduler/host gap between consecutive device rounds)
         self._perf_mark = time.perf_counter()
@@ -2120,6 +2144,21 @@ class GenerationEngine:
         them to the llmtpu_itl_seconds histogram exactly once."""
         return self._perf.drain_itl()
 
+    def waterfall_stats(self) -> dict[str, Any]:
+        """Latency-waterfall block (/v1/debug/latency + engines_info):
+        per-stage percentiles, cumulative stage seconds (the
+        llmtpu_latency_stage_seconds delta bridge reads these), and the
+        stage-coverage ratio. Lock-guarded inside, safe from any thread."""
+        return self._waterfall.stats()
+
+    def waterfall_recent(self, limit: int = 32) -> list[dict[str, Any]]:
+        """Most recent per-request waterfall rows (newest last)."""
+        return self._waterfall.recent(limit)
+
+    def workload_stats(self) -> dict[str, Any]:
+        """Workload-capture block: the process-shared ring's health."""
+        return self._workload.stats()
+
     # -- on-demand profiler capture (/v1/debug/profile) --------------------
 
     def start_profile(self, steps: int, trace_dir: str = "") -> dict[str, Any]:
@@ -2450,6 +2489,9 @@ class GenerationEngine:
         round writes the real token's KV at position `length` before any
         read attends there."""
         s = snap.slot_obj
+        # latency waterfall: wall spent parked off-slot is its own stage,
+        # not decode (clamped into the partition at finish)
+        s.preempted_s += max(0.0, time.time() - snap.preempted_at)
         t0 = time.perf_counter()
 
         def up(rows):
@@ -3795,8 +3837,15 @@ class GenerationEngine:
                 "admit", t0c, t_call,
                 sum(len(ids) for _, _, ids in batch), A,
             )
+        # latency waterfall: the fused admit dispatch is synchronous wall
+        # every batched prompt sat through — attribute it by token share
+        admit_wall = time.perf_counter() - t0c
+        tot_tok = sum(len(ids) for _, _, ids in batch) or 1
         for i, (slot, req, ids) in enumerate(batch):
             self._activate_state(slot, req, ids, int(toks0[i]))
+            s = self._slots[slot]
+            if s is not None:
+                s.prefill_compute_s += admit_wall * (len(ids) / tot_tok)
 
     def _activate_state(
         self, slot: int, req: GenRequest, ids: list[int], tok0: int
@@ -3814,6 +3863,10 @@ class GenerationEngine:
         if st is not None and st.shared_len:
             s.shared_entry = st.shared_entry
             s.shared_len = st.shared_len
+        if st is not None:
+            # chunked-path prefill walls accumulated while mid-chunk carry
+            # onto the live slot for the latency waterfall
+            s.prefill_compute_s += st.prefill_s
         # ledger: batch-path admissions create their table here; the
         # chunked/prefix-hit paths already reserved one (ensure extends it)
         mgr = self._paging
@@ -4131,6 +4184,7 @@ class GenerationEngine:
                 self._sched.observe_prefill(
                     group.n_tokens, wall, padded_tokens=group.bucket
                 )
+                self._credit_prefill_wall(group, wall)
                 self._flight.event(
                     "pf_rag", rows=len(group.metas), tokens=group.n_tokens,
                     packed=group.bucket, wall_ms=round(wall * 1e3, 2),
@@ -4163,6 +4217,7 @@ class GenerationEngine:
                 group.n_tokens, wall,
                 padded_tokens=group.tokens.shape[0] * group.bucket,
             )
+            self._credit_prefill_wall(group, wall)
             self._flight.event(
                 "chunk", rows=len(group.metas), tokens=group.n_tokens,
                 bucket=group.bucket, wall_ms=round(wall * 1e3, 2),
@@ -4171,6 +4226,15 @@ class GenerationEngine:
             self._fail_prefill_group(group, e)
             return
         self._finish_prefill_group(group)
+
+    def _credit_prefill_wall(self, group: _PrefillGroup, wall: float) -> None:
+        """Latency waterfall: attribute a synchronous chunk-dispatch wall to
+        the mid-prefill prompts that rode it, by valid-token share. (The
+        fused chunk path has no synchronous wall — its share surfaces as
+        prefill_queue, which is honest: the prompt rode a decode round.)"""
+        tot = group.n_tokens or 1
+        for _, st, n in group.metas:
+            st.prefill_s += wall * (n / tot)
 
     def _finish_prefill_group(self, group: _PrefillGroup) -> None:
         """Advance chunk progress for a dispatched group and activate the
@@ -4795,6 +4859,12 @@ class GenerationEngine:
         s.perf_last_emit = now
         s.itl_s_total += gap
         s.itl_samples += n_new
+        # latency waterfall: the part of an emission gap beyond the stall
+        # threshold is decode time the request did NOT spend computing its
+        # own tokens (compile pause, preempt-adjacent churn, wedged link)
+        thr = workload.stall_threshold_s()
+        if gap > thr:
+            s.stall_s += gap - thr
         self._anomaly.signal("itl_degradation", itl_ms=itl * 1e3)
 
     def _emit_token(self, slot_idx: int, s: _Slot, tok: int, pos: int) -> bool:
@@ -4907,6 +4977,61 @@ class GenerationEngine:
             tracing.get_tracer().record(
                 "engine.decode", s.first_token_at, now,
                 parent=req.trace_ctx, attrs=attrs,
+            )
+        # Latency waterfall (telemetry/workload.py): decompose this
+        # request's wall into an EXACT partition — the accumulated stage
+        # walls are clamped into their windows so the stages always sum to
+        # the measured total (residuals land in prefill_queue / decode,
+        # which is honest: unattributed time is queueing).
+        fin_ts = time.time()
+        admitted = req.admitted_at or req.created_at
+        admit_wait = max(0.0, admitted - req.created_at)
+        ft = s.first_token_at or admitted
+        pf_window = max(0.0, ft - admitted)
+        pf_compute = min(max(0.0, s.prefill_compute_s), pf_window)
+        dec_window = max(0.0, fin_ts - ft)
+        preempt = min(max(0.0, s.preempted_s), dec_window)
+        stall = min(max(0.0, s.stall_s), dec_window - preempt)
+        shed = max(0.0, req.shed_wait_s)
+        stages = {
+            "admit_wait": admit_wait,
+            "shed": shed,
+            "prefill_queue": pf_window - pf_compute,
+            "prefill_compute": pf_compute,
+            "decode": dec_window - preempt - stall,
+            "stall": stall,
+            "preempt": preempt,
+        }
+        total_s = admit_wait + shed + pf_window + dec_window
+        tid = self._tid(req)
+        self._waterfall.observe(
+            stages, total_s, trace_id=tid, rid=req.request_id[:8],
+            ts=req.created_at,
+        )
+        self._flight.event(
+            "wf", trace_id=tid, request_id=req.request_id[:8],
+            total_ms=round(total_s * 1e3, 2),
+            **{f"{k}_ms": round(v * 1e3, 2) for k, v in stages.items()},
+        )
+        # Workload capture: one compact record per finished admitted
+        # request — prefix-chain head hashes (routing/prefix.py digests),
+        # never raw text; raw token ids only behind TPU_WORKLOAD_IDS=1.
+        if self._workload.enabled():
+            chain = prefix_fp.chain_hashes(
+                req.prompt_ids, self._paging.block_tokens
+            )[: workload.CHAIN_HEAD]
+            self._workload.record(
+                ts=req.created_at, rid=req.request_id, trace_id=tid,
+                model=self.cfg.name, prompt_tokens=len(req.prompt_ids),
+                chain=chain, max_tokens=req.max_tokens,
+                temperature=req.temperature, top_k=req.top_k,
+                top_p=req.top_p, output_tokens=s.generated, finish=finish,
+                ids=req.prompt_ids, shed_s=shed,
+            )
+            self._flight.event(
+                "wl", trace_id=tid, request_id=req.request_id[:8],
+                prompt_tokens=len(req.prompt_ids),
+                output_tokens=s.generated, finish=finish,
             )
         req.out.put(
             {

@@ -376,6 +376,16 @@ class Metrics:
             ["engine", "phase", "bucket"],
             registry=r,
         )
+        # latency waterfall (telemetry/workload.py): each finished request's
+        # wall decomposed into an exact stage partition; cumulative per-stage
+        # seconds advance by delta in the engines_info bridge (server.py)
+        self.latency_stage_seconds = Counter(
+            "llmtpu_latency_stage_seconds",
+            "Finished-request wall seconds by waterfall stage (admit_wait / "
+            "shed / prefill_queue / prefill_compute / decode / stall / preempt)",
+            ["engine", "stage"],
+            registry=r,
+        )
 
     def render(self) -> tuple[bytes, str]:
         return generate_latest(self.registry), CONTENT_TYPE_LATEST

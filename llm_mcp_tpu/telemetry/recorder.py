@@ -34,7 +34,11 @@ An *event* is a tuple ``(seq, ts, etype, trace_id, fields)``:
             import from a peer) / migrate_out / migrate_in / shed /
             watchdog /
             compile / perf (sampled host/device/wait phase timing from
-            the perf observatory) / anomaly / profile
+            the perf observatory) / anomaly / profile / wl (workload
+            capture: one record per finished admitted request —
+            telemetry/workload.py) / wf (latency-waterfall stage marks:
+            per-request admit_wait/shed/prefill_queue/prefill_compute/
+            decode/stall/preempt milliseconds)
   trace_id  the request's 32-hex trace id ("" for engine-global events) —
             a dump stitches directly into /v1/traces
   fields    flat dict of scalars (or None)

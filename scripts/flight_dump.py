@@ -15,6 +15,7 @@ Usage:
     python scripts/flight_dump.py dump.jsonl --etype preempt,shed
     python scripts/flight_dump.py dump.jsonl --trace <32-hex>         # one lane
     python scripts/flight_dump.py dump.jsonl --tail 200
+    python scripts/flight_dump.py dump.jsonl --waterfall             # wf lanes
 
 Timeline lines look like:
 
@@ -139,6 +140,63 @@ def render(
             out.write(f"#   {tid}  {n} events\n")
 
 
+# waterfall stage order + one glyph per stage (the bar is built from
+# "wf" events' per-stage millisecond fields, widest request = full width)
+_WF_STAGES = (
+    ("admit_wait", "a"),
+    ("shed", "x"),
+    ("prefill_queue", "q"),
+    ("prefill_compute", "P"),
+    ("decode", "D"),
+    ("stall", "!"),
+    ("preempt", "~"),
+)
+
+
+def render_waterfall(
+    events: list[dict],
+    trace: str,
+    tail: int,
+    width: int = 60,
+    out=sys.stdout,
+) -> None:
+    """Per-request latency-waterfall lanes from "wf" events.
+
+    One line per finished request: the trace-id lane, total wall, and a
+    stacked bar whose glyph runs are proportional to each stage's share
+    (a=admit_wait x=shed q=prefill_queue P=prefill_compute D=decode
+    !=stall ~=preempt)."""
+    rows = [e for e in events if e.get("etype") == "wf"]
+    if trace:
+        rows = [e for e in rows if str(e.get("trace_id", "")).startswith(trace)]
+    rows.sort(key=lambda e: e.get("seq", 0))
+    if tail > 0:
+        rows = rows[-tail:]
+    if not rows:
+        out.write("(no wf events match — is the latency waterfall wired?)\n")
+        return
+    out.write(
+        "# waterfall lanes: "
+        + " ".join(f"{g}={name}" for name, g in _WF_STAGES)
+        + "\n\n"
+    )
+    max_ms = max(float((e.get("fields") or {}).get("total_ms", 0.0)) for e in rows)
+    max_ms = max(max_ms, 1e-6)
+    for e in rows:
+        f = e.get("fields") or {}
+        tid = str(e.get("trace_id") or f.get("request_id") or "")
+        lane = tid[:8] if tid else "-" * 8
+        total = float(f.get("total_ms", 0.0))
+        bar_w = max(1, int(round(width * total / max_ms)))
+        bar = ""
+        for name, glyph in _WF_STAGES:
+            ms = float(f.get(f"{name}_ms", 0.0))
+            n = int(round(bar_w * ms / total)) if total > 0 else 0
+            bar += glyph * n
+        bar = bar[:bar_w].ljust(bar_w)
+        out.write(f"[{lane}] {total:9.1f}ms |{bar}|\n")
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", nargs="?", help="flight journal (.jsonl)")
@@ -148,6 +206,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--tail", type=int, default=0, help="render only the last N events")
     ap.add_argument(
         "--limit", type=int, default=2000, help="events to pull with --core"
+    )
+    ap.add_argument(
+        "--waterfall", action="store_true",
+        help="render per-request latency-waterfall lanes from wf events",
     )
     args = ap.parse_args(argv)
     if bool(args.path) == bool(args.core):
@@ -161,6 +223,9 @@ def main(argv: list[str] | None = None) -> int:
     except OSError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.waterfall:
+        render_waterfall(events, args.trace.strip(), args.tail)
+        return 0
     etypes = {t.strip() for t in args.etype.split(",") if t.strip()} or None
     render(header, events, etypes, args.trace.strip(), args.tail)
     return 0

@@ -79,6 +79,16 @@ Checks, in order of authority:
      itl_p95_ms <= 500 absolute plus relative latency-class gating, and
      goodput_tok_per_s gates relatively like other throughput metrics.
 
+  9. Capture→replay + latency-waterfall checks, when the record carries
+     them (ISSUE 16): replay_determinism is an exact check (must be 1.0 —
+     two seeded builds of the replay stream hashed differently, i.e. the
+     replay harness itself went nondeterministic); waterfall_coverage must
+     sit within 5% of 1.0 (the stage partition is exact by construction —
+     drift means a stage went missing from the ledger); and the per-stage
+     p95 ceilings (waterfall_stall_p95_ms, waterfall_total_p95_ms) are
+     generous collapse bars, with relative latency-class gating when a
+     baseline carries them.
+
 Missing metrics are reported as [SKIP] with a stderr warning but never
 fail the gate (older records predate newer fields — a KeyError here
 would make every old BENCH_*.json ungateable); a metric PRESENT and
@@ -122,7 +132,8 @@ HIGHER_BETTER = (
 LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms", "cow_copies_per_req",
                 "attn_us_per_cell", "attn_us_per_cell_paged",
                 "prefill_pad_waste_pct", "prefill_executables",
-                "itl_p95_ms")
+                "itl_p95_ms", "waterfall_stall_p95_ms",
+                "waterfall_total_p95_ms")
 
 # absolute floors/ceilings applied regardless of baseline coverage (only
 # ever read with .get(): a floor for a metric the record lacks must skip,
@@ -212,6 +223,14 @@ ABS_MAX = {
     # tokens per slot (tens of ms each at the 8B headline); half a second
     # per token means rounds are stalling or emission is starved
     "itl_p95_ms": 500.0,
+    # latency waterfall (telemetry/workload.py): per-request p95 collapse
+    # ceilings. stall is decode wall beyond the TPU_WATERFALL_STALL_MS
+    # inter-token threshold — a healthy window keeps it near zero, but the
+    # ceiling stays generous enough to absorb first-compile pauses that
+    # land in early requests' decode gaps. total is the end-to-end request
+    # wall; past 30 s the serve loop is wedged, not slow.
+    "waterfall_stall_p95_ms": 2500.0,
+    "waterfall_total_p95_ms": 30000.0,
 }
 
 
@@ -323,6 +342,31 @@ def check(cand: dict, base: dict) -> list[tuple[str, str, str]]:
         results.append(
             ("recorder_dropped_events", "absent from candidate", "skip")
         )
+    # exact checks, no baseline leniency: two seeded builds of the replay
+    # stream hashing differently (determinism) or a replayed capture not
+    # reproducing the captured outputs (match) is a harness bug whatever
+    # the previous round did
+    for name in ("replay_determinism", "replay_match"):
+        c = metric(cand, name)
+        if c is not None:
+            results.append(
+                (name, f"{c:.3f} (must be 1.0)",
+                 "pass" if c >= 1.0 else "fail")
+            )
+        else:
+            results.append((name, "absent from candidate", "skip"))
+    # the waterfall stage partition is exact by construction: coverage
+    # (sum of stage seconds / measured wall) drifting past 5% of 1.0 means
+    # a stage fell out of the ledger, not that requests got slower
+    c = metric(cand, "waterfall_coverage")
+    if c is not None:
+        ok = 0.95 <= c <= 1.05
+        results.append(
+            ("waterfall_coverage", f"{c:.4f} (must be within 5% of 1.0)",
+             "pass" if ok else "fail")
+        )
+    else:
+        results.append(("waterfall_coverage", "absent from candidate", "skip"))
     return results
 
 

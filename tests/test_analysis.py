@@ -217,7 +217,7 @@ def test_census_flags_unregistered_kernel(tmp_path):
             "PHASE_COSTS = {}\n"
         ),
         "pkg/engine.py": "\n",
-        "pkg/recorder.py": '"""etypes: pf_rag fused_rag perf."""\n',
+        "pkg/recorder.py": '"""etypes: pf_rag fused_rag perf wl wf."""\n',
     })
     found = RegistryCensusPass().run(RepoIndex(root, {
         "package": "pkg",

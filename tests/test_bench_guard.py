@@ -88,3 +88,37 @@ def test_engine_counts_finished_and_errors():
         assert eng.total_errors == 0
     finally:
         eng.shutdown()
+
+
+def test_arrival_gap_helper():
+    """One arrival process for every open-loop mode: trace gaps (scaled by
+    the compression factor) take priority, Poisson splits the aggregate
+    rate across clients, and no configuration means closed loop."""
+    import random
+
+    from bench import next_arrival_gap
+
+    rng = random.Random(0)
+    assert next_arrival_gap(rng) == 0.0
+    assert next_arrival_gap(rng, trace_gap=2.0, compress=4.0) == 0.5
+    # trace gap wins even when a Poisson rate is also configured
+    assert next_arrival_gap(rng, poisson_rps=5.0, trace_gap=1.0) == 1.0
+    g = next_arrival_gap(rng, poisson_rps=4.0, n_clients=2)
+    assert g > 0.0
+
+
+def test_capture_replay_round_trip_cpu_smoke():
+    """ISSUE 16 acceptance: a captured CPU-smoke trace replayed through a
+    fresh engine reproduces the original admitted-request count and
+    greedy token-identical outputs, and two seeded builds of the replay
+    stream hash identical (replay_determinism)."""
+    from bench import capture_replay_smoke
+
+    rp = capture_replay_smoke("tiny-llm", n_requests=3, max_tokens=5)
+    assert rp["replay_determinism"] == 1.0, "seeded stream went nondeterministic"
+    assert rp["replay_captured"] == 3.0
+    assert rp["replay_finished"] == rp["replay_captured"]
+    assert rp["replay_match"] == 1.0, "replayed outputs diverged from capture"
+    assert rp["replay_rejected_lines"] == 0.0
+    # the replayed engine's waterfall must hold the exact-partition invariant
+    assert abs(rp["waterfall_coverage"] - 1.0) <= 0.05
