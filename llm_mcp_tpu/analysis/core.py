@@ -48,6 +48,7 @@ DEFAULT_CONFIG: dict = {
     "kernel_module": "llm_mcp_tpu/kernels/attention.py",
     "parity_registry": "tests/test_kernel_parity.py",
     "engine_module": "llm_mcp_tpu/executor/engine.py",
+    "dispatch_module": "llm_mcp_tpu/executor/dispatch.py",
     "perf_module": "llm_mcp_tpu/telemetry/perf.py",
     "recorder_module": "llm_mcp_tpu/telemetry/recorder.py",
     # knob-registry scan: the package plus the out-of-package readers the
@@ -365,9 +366,9 @@ class SuiteResult:
 
 
 def default_passes() -> list:
-    """The five registered passes, in report order. Imported lazily so
+    """The six registered passes, in report order. Imported lazily so
     `core` stays importable from any of them."""
-    from . import census, donation, imports_lint, knobs, lock_order
+    from . import census, dispatch_surface, donation, imports_lint, knobs, lock_order
 
     return [
         lock_order.LockOrderPass(),
@@ -375,6 +376,7 @@ def default_passes() -> list:
         knobs.KnobRegistryPass(),
         imports_lint.ImportPurityPass(),
         census.RegistryCensusPass(),
+        dispatch_surface.DispatchSurfacePass(),
     ]
 
 

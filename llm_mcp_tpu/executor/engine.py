@@ -77,6 +77,7 @@ from ..telemetry import recorder as flight
 from ..telemetry import tracing
 from ..telemetry import workload
 from .common import fine_bucket, pow2_bucket
+from .dispatch import DispatchBackend, LocalArraysBackend
 from .drafter import NGramDrafter
 from .memory import (
     KVPool,
@@ -107,8 +108,7 @@ def _tree2(fn, a, b):
     return fn(a, b)
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def _cow_block_fn(ck, cv, pk, pv, slot, blk, prow):
+def _cow_block_raw(ck, cv, pk, pv, slot, blk, prow):
     """Physical copy-on-write: copy ONE prefix-pool block (pool row `prow`)
     into a slot's arena at block index `blk` — the boundary block of an
     unaligned prefix hit. Whole-block always (the suffix prefill overwrites
@@ -129,8 +129,10 @@ def _cow_block_fn(ck, cv, pk, pv, slot, blk, prow):
     return _tree2(one, ck, pk), _tree2(one, cv, pv)
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def _pool_put_arena_fn(pk, pv, ck, cv, row, off, prow):
+_cow_block_fn = partial(jax.jit, donate_argnums=(0, 1))(_cow_block_raw)
+
+
+def _pool_put_arena_raw(pk, pv, ck, cv, row, off, prow):
     """Prefix store: copy one block of arena KV (slot row `row`, token
     offset `off`) into pool row `prow`."""
 
@@ -148,8 +150,10 @@ def _pool_put_arena_fn(pk, pv, ck, cv, row, off, prow):
     return _tree2(one, pk, ck), _tree2(one, pv, cv)
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def _pool_put_pool_fn(pk, pv, src_row, dst_row):
+_pool_put_arena_fn = partial(jax.jit, donate_argnums=(0, 1))(_pool_put_arena_raw)
+
+
+def _pool_put_pool_raw(pk, pv, src_row, dst_row):
     """Prefix store when the storing slot's block itself resolves to the
     pool (a sharer storing a longer prefix): pool-row → pool-row copy."""
 
@@ -164,8 +168,10 @@ def _pool_put_pool_fn(pk, pv, src_row, dst_row):
     return _tree2(one, pk, pk), _tree2(one, pv, pv)
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def _pool_put_host_fn(pk, pv, hk, hv, prow):
+_pool_put_pool_fn = partial(jax.jit, donate_argnums=(0, 1))(_pool_put_pool_raw)
+
+
+def _pool_put_host_raw(pk, pv, hk, hv, prow):
     """Remote prefix import: upload ONE wire-decoded host block (shaped
     [L, 1, heads, block_tokens, *rest], zero-padded past the chain's
     tail) into pool row `prow`. Block-shaped on purpose: one executable
@@ -178,6 +184,9 @@ def _pool_put_host_fn(pk, pv, hk, hv, prow):
         )
 
     return _tree2(one, pk, hk), _tree2(one, pv, hv)
+
+
+_pool_put_host_fn = partial(jax.jit, donate_argnums=(0, 1))(_pool_put_host_raw)
 
 
 def _host_block(x, off: int, bt: int):
@@ -345,7 +354,10 @@ class _PrefillGroup:
     bucket: int  # ragged: the packed buffer length T
     skey: int
     n_tokens: int  # total valid tokens staged (≤ the round's budget)
-    logits: Any = None  # device [Ab, V] once dispatched (ragged: [R, V])
+    # dispatch-plane group id: once dispatched, the group's boundary logits
+    # ([Ab, V]; ragged [R, V]) park on the op-owned _x_logits[gid] until the
+    # activation sample ("bsample") pops them
+    gid: int = 0
     # Ragged packed descriptors (tentpole path — _stage_ragged_group). metas
     # row i ↔ descriptor row i, so finish/fail indexing is shared with the
     # bucketed path.
@@ -378,6 +390,7 @@ class GenerationEngine:
         prefill_buckets: str = "fine",
         prefill_boost: float = 2.0,
         target_ttft_ms: float = 2000.0,
+        backend: DispatchBackend | None = None,
     ):
         # a config.json beside the weights is authoritative: any supported-
         # family checkpoint serves without a catalog entry (models/configs.py
@@ -385,6 +398,33 @@ class GenerationEngine:
         # discovery.go:482-560)
         self.cfg = resolve_config(model, weights_dir)
         self.mesh = mesh
+        # Dispatch plane (dispatch.py): every device mutation the loop makes
+        # goes through ONE funnel (_dx) that forwards the (op, payload) step
+        # to the backend before executing it locally. LocalArraysBackend is
+        # a no-op (today's single-process path, zero overhead); GSPMDBackend
+        # serializes the step-program to follower processes so the SAME op
+        # closures replay there — multi-controller JAX requires every
+        # process to execute every device computation in the same order.
+        self._backend = backend if backend is not None else LocalArraysBackend()
+        self._spmd = bool(self._backend.spmd)
+        if self._spmd and mesh is None:
+            raise ValueError("a GSPMD dispatch backend requires a mesh")
+        # non-empty = the dispatch plane died with this error. Under a GSPMD
+        # backend a poisoned dispatch cannot be recovered (followers already
+        # executed the step; re-initializing device state is not replayable),
+        # so the engine goes dead instead of rebuilding (_recover_cache).
+        self.dead: str = ""
+        self._dead_lock = threading.Lock()  # atomizes submit vs death
+        if self._spmd:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # identity jit with a replicated out_sharding: the reshard that
+            # turns a host array (or a sharded global) into a fully-
+            # replicated global every process can device_get locally
+            self._repl_sharding = NamedSharding(mesh, PartitionSpec())
+            self._put_repl = jax.jit(
+                lambda x: x, out_shardings=self._repl_sharding
+            )
         self.dtype = dtype
         self.max_slots = max_slots
         self.max_seq_len = max_seq_len
@@ -491,57 +531,101 @@ class GenerationEngine:
         )
         self._last_active_n = 0  # decode rows in the most recent dispatch
 
-        if params is None and _has_safetensors(weights_dir):
-            # Real checkpoint: stream safetensors shards straight into
-            # (sharded) HBM — already placed.
-            params = load_llama_checkpoint(self.cfg, weights_dir, dtype=dtype, mesh=mesh)
-        elif params is None:
-            if self.quant == "int8":
-                # Direct int8 init: an 8B bf16 tree (16 GB) cannot be
-                # materialized-then-quantized inside one v5e chip's HBM.
-                from ..models.quant import init_llama_params_quantized
-
-                params = init_llama_params_quantized(
-                    self.cfg, jax.random.PRNGKey(seed), scale_dtype=dtype
-                )
-            else:
-                params = init_llama_params(self.cfg, jax.random.PRNGKey(seed), dtype=dtype)
+        pspecs = llama_param_specs(self.cfg)
         if self.quant == "int8":
-            from ..models.quant import quantize_params
+            from ..models.quant import quantized_specs
 
-            params = quantize_params(params)  # no-op on already-int8 trees
-        if (
-            self.quant == "int8"
-            and mesh is None
-            and os.environ.get("LLM_MCP_TPU_FUSE_QKV", "1") != "0"
-        ):
-            # w8a8 layer-pass restructure: concat wq|wk|wv and w1|w3
-            # post-quantization (bitwise-exact — models/quant.py:
-            # fuse_layer_weights). Single-chip only: the fused output axis
-            # interleaves head groups and cannot shard over tp.
-            from ..models.quant import fuse_layer_weights
+            pspecs = quantized_specs(pspecs)
+        cspecs = kv_cache_specs(quantized=self.kv_quant == "int8",
+                                latent=bool(self.cfg.kv_lora_rank))
+        if self._spmd:
+            # Multi-controller placement: shard_pytree's device_put only
+            # works on fully-addressable inputs, so the tree is born sharded
+            # — init runs as ONE GSPMD program with explicit out_shardings
+            # (no process ever materializes the full tree), and checkpoints
+            # stream per-process shards via make_array_from_callback.
+            if params is None and _has_safetensors(weights_dir):
+                params = self._load_checkpoint_global(
+                    self.cfg, weights_dir, dtype, mesh, self._ns(pspecs),
+                    quant=self.quant,
+                )
+            elif params is None:
+                if self.quant == "int8":
+                    from ..models.quant import init_llama_params_quantized
 
-            params = fuse_layer_weights(params)
-        if mesh is not None:
-            specs = llama_param_specs(self.cfg)
+                    init_params = partial(
+                        init_llama_params_quantized, self.cfg,
+                        jax.random.PRNGKey(seed), scale_dtype=dtype,
+                    )
+                else:
+                    init_params = partial(
+                        init_llama_params, self.cfg, jax.random.PRNGKey(seed),
+                        dtype=dtype,
+                    )
+                with mesh:
+                    params = jax.jit(
+                        init_params, out_shardings=self._ns(pspecs)
+                    )()
+            self.params = params
+            with mesh:
+                cache = jax.jit(
+                    partial(init_kv_cache, self.cfg, max_slots, max_seq_len,
+                            dtype=dtype, quantized=self.kv_quant == "int8"),
+                    out_shardings=self._ns(cspecs),
+                )()
+        else:
+            if params is None and _has_safetensors(weights_dir):
+                # Real checkpoint: stream safetensors shards straight into
+                # (sharded) HBM — already placed.
+                params = load_llama_checkpoint(self.cfg, weights_dir, dtype=dtype, mesh=mesh)
+            elif params is None:
+                if self.quant == "int8":
+                    # Direct int8 init: an 8B bf16 tree (16 GB) cannot be
+                    # materialized-then-quantized inside one v5e chip's HBM.
+                    from ..models.quant import init_llama_params_quantized
+
+                    params = init_llama_params_quantized(
+                        self.cfg, jax.random.PRNGKey(seed), scale_dtype=dtype
+                    )
+                else:
+                    params = init_llama_params(self.cfg, jax.random.PRNGKey(seed), dtype=dtype)
             if self.quant == "int8":
-                from ..models.quant import quantized_specs
+                from ..models.quant import quantize_params
 
-                specs = quantized_specs(specs)
-            params = shard_pytree(params, specs, mesh)
-        self.params = params
+                params = quantize_params(params)  # no-op on already-int8 trees
+            if (
+                self.quant == "int8"
+                and mesh is None
+                and os.environ.get("LLM_MCP_TPU_FUSE_QKV", "1") != "0"
+            ):
+                # w8a8 layer-pass restructure: concat wq|wk|wv and w1|w3
+                # post-quantization (bitwise-exact — models/quant.py:
+                # fuse_layer_weights). Single-chip only: the fused output axis
+                # interleaves head groups and cannot shard over tp.
+                from ..models.quant import fuse_layer_weights
 
-        cache = init_kv_cache(
-            self.cfg, max_slots, max_seq_len, dtype=dtype,
-            quantized=self.kv_quant == "int8",
-        )
-        if mesh is not None:
-            cache = shard_pytree(
-                cache, kv_cache_specs(quantized=self.kv_quant == "int8",
-                               latent=bool(self.cfg.kv_lora_rank)), mesh
+                params = fuse_layer_weights(params)
+            if mesh is not None:
+                params = shard_pytree(params, pspecs, mesh)
+            self.params = params
+
+            cache = init_kv_cache(
+                self.cfg, max_slots, max_seq_len, dtype=dtype,
+                quantized=self.kv_quant == "int8",
             )
+            if mesh is not None:
+                cache = shard_pytree(cache, cspecs, mesh)
         self._ck = cache["k"]
         self._cv = cache["v"]
+        if self._spmd:
+            # named out_sharding kinds for _shard_out: host-read outputs come
+            # back fully replicated (every process device_gets locally — the
+            # slice decode_fn convention), cache outputs keep their specs
+            self._out_kinds = {
+                "repl": self._repl_sharding,
+                "k": self._ns(cspecs["k"]),
+                "v": self._ns(cspecs["v"]),
+            }
 
         # Host-side mirrors of per-slot device state. Invariant: only ACTIVE
         # (decoding) slots hold an in-range length; free/reserved slots park
@@ -577,12 +661,19 @@ class GenerationEngine:
         )
         mask = self._allowed_mask
         cfg_ = self.cfg
+        skey_base = self._base_key
 
-        @jax.jit
-        def sample1(logits, key, temp, topk, topp):
-            if mask is not None:
-                logits = jnp.where(mask, logits, -jnp.inf)
-            return sample_tokens(logits, key, temp, topk, topp)
+        # the RNG key derives from the counter INSIDE the jit (fold_in of a
+        # closed-over base key is a traced constant): an eagerly-folded key
+        # would be a process-local device array, which cannot ride into a
+        # GSPMD program beside global operands
+        sample1 = jax.jit(
+            lambda logits, counter, temp, topk, topp: sample_tokens(
+                jnp.where(mask, logits, -jnp.inf) if mask is not None else logits,
+                jax.random.fold_in(skey_base, counter), temp, topk, topp,
+            ),
+            **self._shard_out(["repl"]),
+        )
 
         self._sample1 = sample1
 
@@ -632,7 +723,14 @@ class GenerationEngine:
             and not cfg_.sliding_window
             and not cfg_.attn_softcap
         )
-        self._ragged_impl = resolve_ragged_impl() if self.ragged_prefill else ""
+        # Sharded plane: the packed-buffer math is GSPMD-safe (tp shards the
+        # head axis, pp the layer axis; neither touches the token packing),
+        # but the pallas kernels themselves run on fully-addressable arrays
+        # only — force the xla impl whenever the mesh spans devices.
+        if mesh is not None and mesh.size > 1:
+            self._ragged_impl = "xla" if self.ragged_prefill else ""
+        else:
+            self._ragged_impl = resolve_ragged_impl() if self.ragged_prefill else ""
         if self.ragged_prefill:
             hd = cfg_.resolved_head_dim
             cap = min(
@@ -679,6 +777,7 @@ class GenerationEngine:
                 )
             return ks, vs
 
+        self.pp_prefill = 1  # >1 when whole-prompt prefill rides the stage scan
         if self.sp > 1:
             from ..parallel.ring import llama_prefill_sp
 
@@ -690,16 +789,52 @@ class GenerationEngine:
                 return logits, ks, vs
 
         else:
+            # Pipeline-parallel prefill (parallel/pipeline.py): with a pp
+            # axis in the mesh, whole-prompt admission runs the bit-parity
+            # GPipe stage scan — layer-sharded params stay stage-local
+            # instead of all-gathering per layer, so a model too big for one
+            # slice's HBM serves across stages. Decode and chunked prefill
+            # keep the generic GSPMD path (their per-call work is small and
+            # correctness is sharding-independent). TPU_PP_PREFILL=0 falls
+            # back to the single-stage scan (the parity reference).
+            pp_ = 1
+            if mesh is not None and not cfg_.n_experts and not cfg_.kv_lora_rank:
+                pp_ = dict(zip(mesh.axis_names, mesh.devices.shape)).get("pp", 1)
+            use_pp = (
+                pp_ > 1
+                and self.sp == 1
+                and cfg_.n_layers % pp_ == 0
+                and os.environ.get("TPU_PP_PREFILL", "1")
+                not in ("", "0", "false", "no", "off")
+            )
+            self.pp_prefill = pp_ if use_pp else 1
+            if use_pp:
+                from ..parallel.pipeline import pipeline_prefill
 
-            # jax.jit caches one executable per input shape, so prompt buckets
-            # (power-of-two padded) each compile once without any manual cache.
-            # quant_kv quantizes per layer INSIDE the prefill scan: the
-            # stacked bf16 prompt KV of a batched admission never
-            # materializes (llama_prefill docstring).
-            def _prefill_body(params, tokens, lengths):
-                return llama_prefill(
-                    cfg_, params, tokens, lengths, attn_impl=impl, quant_kv=kv_q
-                )
+                log.info("pipeline-parallel prefill enabled: pp=%d", pp_)
+
+                def _prefill_body(params, tokens, lengths):
+                    # microbatch count must divide B (pipeline_prefill
+                    # asserts); B that doesn't split falls back to M=1
+                    m = pp_ if tokens.shape[0] % pp_ == 0 else 1
+                    logits, ks, vs = pipeline_prefill(
+                        cfg_, params, tokens, lengths, mesh,
+                        n_microbatches=m, attn_impl=impl,
+                    )
+                    ks, vs = _maybe_quant_kv(ks, vs)
+                    return logits, ks, vs
+
+            else:
+
+                # jax.jit caches one executable per input shape, so prompt
+                # buckets (power-of-two padded) each compile once without any
+                # manual cache. quant_kv quantizes per layer INSIDE the
+                # prefill scan: the stacked bf16 prompt KV of a batched
+                # admission never materializes (llama_prefill docstring).
+                def _prefill_body(params, tokens, lengths):
+                    return llama_prefill(
+                        cfg_, params, tokens, lengths, attn_impl=impl, quant_kv=kv_q
+                    )
 
         def _insert_row(ck, cv, ks, vs, i, slot):
             # ks/vs: batched prompt KV [L, A, Hkv, bucket, hd] (already in
@@ -755,7 +890,9 @@ class GenerationEngine:
         mask_ = self._allowed_mask
         base_key_ = self._base_key
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6),
+                 **self._shard_out(["k", "v", "repl", "repl", "repl", "repl",
+                                   "repl"]))
         def admit_fn(params, ck, cv, d_temp, d_topk, d_topp, d_last, tokens,
                      ipack, fpack):
             """Fused admission: prefill + cache insert + sampling-param
@@ -821,7 +958,7 @@ class GenerationEngine:
             d_last = d_last.at[row].set(toks0)
             return ck, cv, d_temp, d_topk, d_topp, d_last, toks0
 
-        @partial(jax.jit, donate_argnums=(0, 1))
+        @partial(jax.jit, donate_argnums=(0, 1), **self._shard_out(["k", "v"]))
         def insert_cached_fn(ck, cv, pk, pv, slots, live_n):
             """Prefix-cache hit admission: write ONE cached prompt-prefix's
             KV rows into N slots in one dispatch. pk/pv: the stored rows
@@ -842,7 +979,7 @@ class GenerationEngine:
             ck, cv = jax.lax.fori_loop(0, slots.shape[0], body, (ck, cv))
             return ck, cv
 
-        @partial(jax.jit, donate_argnums=(0, 1))
+        @partial(jax.jit, donate_argnums=(0, 1), **self._shard_out(["k", "v"]))
         def insert_at_fn(ck, cv, pk, pv, slot, start):
             """Paged restore, private tail: write pk/pv [L, 1, Hkv, R, hd]
             (int8 {"q","s"} pytree when the cache is) into slot's rows
@@ -887,7 +1024,8 @@ class GenerationEngine:
             )
             return ck, cv
 
-        @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("skey",))
+        @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("skey",),
+                 **self._shard_out(["repl", "k", "v"]))
         def prefill_chunk_fn(params, ck, cv, tokens, slots, starts, nvalid, skey,
                              paged=None):
             # `paged` rides at the END so the donation indices above never
@@ -897,7 +1035,8 @@ class GenerationEngine:
                 paged=paged,
             )
 
-        @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("skey",))
+        @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("skey",),
+                 **self._shard_out(["repl", "k", "v"]))
         def ragged_chunk_fn(params, ck, cv, tokens, rowids, positions, slots,
                             starts, last_idx, skey, paged=None):
             # standalone ragged dispatch (pure-prefill window); same trailing-
@@ -919,9 +1058,8 @@ class GenerationEngine:
         # is pure waste. Entries store device-resident KV rows for a prompt
         # PREFIX; a hit copies the rows into the slot (one fused dispatch
         # per hit group) and only the suffix runs through chunked prefill.
-        # LRU by bytes; 0 disables. Gated to single-chip + chunked prefill
-        # (the sp path prefills whole prompts by design; sharded entries
-        # under a mesh aren't worth the complexity).
+        # LRU by bytes; 0 disables. Gated to chunked prefill + sp == 1
+        # (the sp path prefills whole prompts by design).
         self._prefix_cache: "OrderedDict[tuple, dict]" = OrderedDict()
         # secondary index: stored-prefix length → {key: entry}. Stored
         # lengths are pow2-floored (_maybe_store_prefix), so a lookup is
@@ -930,10 +1068,15 @@ class GenerationEngine:
         # _prefix_cache at the insert and evict sites.
         self._prefix_by_len: dict[int, dict[tuple, dict]] = {}
         self._prefix_cache_bytes = 0
+        # Gated to chunked prefill + sp == 1 only (the sp path prefills
+        # whole prompts by design). The old single-chip gate is LIFTED:
+        # entries are eager slices of the (possibly sharded) global cache,
+        # and every entry mutation flows through the dispatch plane, so the
+        # prefix tier runs identically on local arrays, a local mesh, and
+        # the GSPMD leader/follower plane.
         self._prefix_budget = (
             int(prompt_cache_mb) * (1 << 20)
-            if (mesh is None or mesh.size == 1) and self.prefill_chunk > 0
-            and self.sp == 1
+            if self.prefill_chunk > 0 and self.sp == 1
             else 0
         )
         self._recent_prompts: deque[tuple] = deque(maxlen=16)
@@ -956,16 +1099,19 @@ class GenerationEngine:
         self.prefix_import_rejects_total = 0
         # device-resident sampling params (see admit_fn docstring); host
         # mirrors (self._temp/_topk/_topp) stay the source of truth for
-        # rebuild after a poisoned dispatch consumed the donated buffers
-        self._d_temp = jnp.asarray(self._temp)
-        self._d_topk = jnp.asarray(self._topk)
-        self._d_topp = jnp.asarray(self._topp)
+        # rebuild after a poisoned dispatch consumed the donated buffers.
+        # Under GSPMD these are born replicated globals (jnp.asarray would
+        # make process-local arrays no jit may mix with global operands).
+        _up = self._put_repl if self._spmd else jnp.asarray
+        self._d_temp = _up(self._temp)
+        self._d_topk = _up(self._topk)
+        self._d_topp = _up(self._topp)
         # device-resident last-token ring: decode rounds read their input
         # tokens from it and write their final tokens back, admissions write
         # first samples — so dispatching round N+1 never waits for round N's
         # fetch (decode_chunk_fn docstring). Host mirror: self._last_tok
         # (updated at fetch, for recovery after a poisoned dispatch).
-        self._d_last_tok = jnp.asarray(self._last_tok)
+        self._d_last_tok = _up(self._last_tok)
         # Pipeline depth: how many decode rounds may be in flight before the
         # oldest is fetched. Depth d hides a tunnel round-trip of up to
         # (d-1) x round-compute behind the device chain (a remote-TPU
@@ -1073,11 +1219,15 @@ class GenerationEngine:
         # the table) instead of duplicating entry rows into every slot.
         # TPU_PAGED_PHYSICAL=0 is a true escape hatch: no tables, no pool,
         # every dispatch takes the exact pre-physical trace. Gated to the
-        # same single-chip + chunked-prefill world as the prefix cache
-        # itself (_prefix_budget > 0 implies all of that), plus block sizes
-        # the attention kernels' paged arms accept.
+        # same chunked-prefill world as the prefix cache itself
+        # (_prefix_budget > 0 implies all of that), plus block sizes the
+        # attention kernels' paged arms accept.
         self._phys: PhysicalPool | None = None
         self._pool_k = self._pool_v = None
+        self._cow_fn = _cow_block_fn
+        self._pool_arena_fn = _pool_put_arena_fn
+        self._pool_pool_fn = _pool_put_pool_fn
+        self._pool_host_fn = _pool_put_host_fn
         bt_ = self._paging.block_tokens
         if (
             os.environ.get("TPU_PAGED_PHYSICAL", "1")
@@ -1096,18 +1246,70 @@ class GenerationEngine:
             # sampled at every shared admission (the sharing peak)
             self._phys_hbm_peak_ratio = 1.0
             self._phys_hbm_peak = (0.0, 0.0)
-            self._pool_k = pool_like(self._ck, self._paging.prefix_partition, bt_)
-            self._pool_v = pool_like(self._cv, self._paging.prefix_partition, bt_)
-            if self.mesh is not None:
-                # size-1 meshes pass the gate; keep the pool's placement
-                # commitment consistent with the arena's (pool-row axis
-                # replicates — rows are a global resource, not dp-sliced)
+            if self._spmd:
+                # born sharded (the multi-controller placement rule): build
+                # the pool shapes host-side, then allocate as one GSPMD
+                # program — pool_like's eager zeros would be process-local
                 specs = kv_pool_specs(
                     quantized=self.kv_quant == "int8",
                     latent=bool(self.cfg.kv_lora_rank),
                 )
-                self._pool_k = shard_pytree(self._pool_k, specs["k"], self.mesh)
-                self._pool_v = shard_pytree(self._pool_v, specs["v"], self.mesh)
+                rows_ = self._paging.prefix_partition
+
+                def _pool_shapes(cache):
+                    return jax.tree.map(
+                        lambda c: jax.ShapeDtypeStruct(
+                            (c.shape[0], rows_, c.shape[2], bt_) + c.shape[4:],
+                            c.dtype,
+                        ),
+                        cache,
+                    )
+
+                def _alloc(shapes):
+                    return jax.tree.map(
+                        lambda s: jnp.zeros(s.shape, s.dtype), shapes
+                    )
+
+                with self.mesh:
+                    self._pool_k = jax.jit(
+                        partial(_alloc, _pool_shapes(self._ck)),
+                        out_shardings=self._ns(specs["k"]),
+                    )()
+                    self._pool_v = jax.jit(
+                        partial(_alloc, _pool_shapes(self._cv)),
+                        out_shardings=self._ns(specs["v"]),
+                    )()
+                self._out_kinds["pk"] = self._ns(specs["k"])
+                self._out_kinds["pv"] = self._ns(specs["v"])
+                self._cow_fn = jax.jit(
+                    _cow_block_raw, donate_argnums=(0, 1),
+                    **self._shard_out(["k", "v"]),
+                )
+                self._pool_arena_fn = jax.jit(
+                    _pool_put_arena_raw, donate_argnums=(0, 1),
+                    **self._shard_out(["pk", "pv"]),
+                )
+                self._pool_pool_fn = jax.jit(
+                    _pool_put_pool_raw, donate_argnums=(0, 1),
+                    **self._shard_out(["pk", "pv"]),
+                )
+                self._pool_host_fn = jax.jit(
+                    _pool_put_host_raw, donate_argnums=(0, 1),
+                    **self._shard_out(["pk", "pv"]),
+                )
+            else:
+                self._pool_k = pool_like(self._ck, self._paging.prefix_partition, bt_)
+                self._pool_v = pool_like(self._cv, self._paging.prefix_partition, bt_)
+                if self.mesh is not None:
+                    # size-1 meshes pass the gate; keep the pool's placement
+                    # commitment consistent with the arena's (pool-row axis
+                    # replicates — rows are a global resource, not dp-sliced)
+                    specs = kv_pool_specs(
+                        quantized=self.kv_quant == "int8",
+                        latent=bool(self.cfg.kv_lora_rank),
+                    )
+                    self._pool_k = shard_pytree(self._pool_k, specs["k"], self.mesh)
+                    self._pool_v = shard_pytree(self._pool_v, specs["v"], self.mesh)
             log.info(
                 "physical paged KV: [%d, %d] block table + %d-row prefix pool"
                 " (%.1f MB)",
@@ -1259,6 +1461,372 @@ class GenerationEngine:
             k: 0.0 for k in ("dispatch", "fetch", "admit", "prefill", "emit", "idle")
         }
 
+        # Dispatch-plane device state owned by the op closures (replicated
+        # by construction on followers, because only ops mutate it):
+        # per-group prefill logits parked between the chunk dispatch and the
+        # activation sample, keyed by the leader-assigned group id riding
+        # the payload; prefix-entry device rows keyed by entry id.
+        self._x_logits: dict[int, Any] = {}
+        self._x_prefix: dict[int, tuple] = {}
+        self._gid_ctr = 0
+        self._eid_ctr = 0
+        self._ops = self._build_ops()
+
+    # -- dispatch plane ----------------------------------------------------
+
+    def _ns(self, specs):
+        """PartitionSpec tree → NamedSharding tree on this engine's mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+
+    def _shard_out(self, kinds: list[str]) -> dict:
+        """out_shardings kwargs for a jit definition: empty on the local
+        plane (XLA chooses), explicit under GSPMD so host-read outputs come
+        back fully replicated (every process device_gets its copy locally —
+        no separate collective) and cache/pool outputs keep their specs.
+        kinds name _out_kinds entries positionally: "repl", "k", "v",
+        "pk", "pv"."""
+        if not self._spmd:
+            return {}
+        outs = tuple(self._out_kinds[k] for k in kinds)
+        return {"out_shardings": outs if len(outs) > 1 else outs[0]}
+
+    def _fetch(self, tree):
+        """Device→host fetch that is legal on every plane: local arrays
+        device_get directly; under GSPMD a sharded global is resharded to
+        fully-replicated first (device_get only addresses local shards)."""
+        if self._spmd:
+            tree = jax.tree.map(self._put_repl, tree)
+        return jax.device_get(tree)
+
+    @staticmethod
+    def _load_checkpoint_global(cfg, ckpt_dir, dtype, mesh, shardings, quant: str = ""):
+        """Every process reads the safetensors dir (standard multi-host
+        practice) and contributes ONLY its addressable shards via
+        make_array_from_callback — the full tree is never resident per
+        process beyond the mmap'd host file."""
+        from contextlib import nullcontext
+
+        from ..models.weights import hf_to_llama_params, read_checkpoint_dir
+
+        host = hf_to_llama_params(cfg, read_checkpoint_dir(ckpt_dir))
+        if quant == "int8":
+            from ..models.quant import quantize_params
+
+            # quantize the host tree BEFORE placement so its structure matches
+            # the quantized PartitionSpecs; pin the work to the CPU backend —
+            # the tree must stay host-resident until make_array_from_callback
+            # streams per-process shards
+            try:
+                cpu = jax.local_devices(backend="cpu")[0]
+            except RuntimeError:
+                cpu = None
+            with jax.default_device(cpu) if cpu is not None else nullcontext():
+                host = quantize_params(host)
+        elif quant:
+            raise NotImplementedError(
+                f"engine quant={quant!r} with a checkpoint (only 'int8' is supported)"
+            )
+
+        def up(arr, sharding):
+            a = np.asarray(arr)
+            # int8 payloads must keep their dtype; only float leaves
+            # (weights, scales, norms) follow the engine compute dtype
+            if dtype is not None and np.issubdtype(a.dtype, np.floating):
+                a = a.astype(dtype)
+            return jax.make_array_from_callback(
+                a.shape, sharding, lambda idx: a[idx]
+            )
+
+        return jax.tree.map(up, host, shardings)
+
+    def _dx(self, op: str, *args):
+        """THE dispatch funnel: every device mutation the scheduling loop
+        makes goes through here — the backend sees the serialized (op,
+        payload) step first (followers will replay the same closure from
+        the same payload), then the op executes locally. Payloads are
+        host-only values (numpy/int/str/bytes trees); device state lives on
+        `self` and is read/written by the op closures alone. A step that
+        RAISES under GSPMD kills the engine: the frame already fanned out,
+        so followers executed (or wedged on) the same op and no local
+        recovery can put every process back in the same state."""
+        self._backend.emit(op, args)
+        try:
+            return self._ops[op](*args)
+        except Exception as e:
+            if self._spmd:
+                self._mark_dead(f"dispatch {op!r} failed: {e}")
+            raise
+
+    def run_follower(self) -> None:
+        """Blocking step-program replay loop for non-leader processes of a
+        GSPMD backend: every received (op, payload) step executes the SAME
+        op closure the leader ran, so device state stays replicated.
+        Returns on the leader's stop command."""
+        self._backend.run_follower(self._ops)
+
+    def _paged_payload(self):
+        """Host-side paged-dispatch descriptor riding the op payload: the
+        numpy block table (policy state followers don't have), or None when
+        the physical pool is off."""
+        return self._phys.table if self._phys is not None else None
+
+    def _paged_from(self, tbl):
+        """Rebuild a jit `paged` argument from an op payload. Local plane:
+        use the cached device table (one upload per mutation, not per
+        dispatch). GSPMD: the numpy table enters the jit directly as a
+        replicated operand."""
+        if tbl is None:
+            return None
+        dev = tbl if self._spmd else self._phys.device_table()
+        return {"tbl": dev, "k": self._pool_k, "v": self._pool_v}
+
+    def _mark_dead(self, msg: str) -> None:
+        """Poisoned dispatch under a GSPMD backend: the step already went
+        out to followers and device state cannot be rebuilt replayably —
+        the engine goes dead (submits reject, the loop exits, followers get
+        the stop command from the loop tail)."""
+        with self._dead_lock:
+            if not self.dead:
+                self.dead = msg or "dispatch failed"
+        self._stop_evt.set()
+        self._wake.set()
+
+    def _build_ops(self) -> dict:
+        """The step-program vocabulary: op name → closure holding ALL the
+        device work of that step. Closures take host-only payloads, read
+        and write device state through `self`, and are the ONLY code that
+        touches jits/eager device ops after __init__ — the dispatch-surface
+        lint pass reconciles this registry against dispatch.DISPATCH_OPS
+        and the engine's _dx call sites both ways."""
+        ops: dict[str, Any] = {}
+
+        def op_admit(tokens, ipack, fpack):
+            # jits read via self._admit_fn at call time (tests monkeypatch it)
+            (self._ck, self._cv, self._d_temp, self._d_topk, self._d_topp,
+             self._d_last_tok, toks0) = self._admit_fn(
+                self.params, self._ck, self._cv, self._d_temp, self._d_topk,
+                self._d_topp, self._d_last_tok, tokens, ipack, fpack,
+            )
+            return toks0
+
+        ops["admit"] = op_admit
+
+        def op_insert(eid, slots, live_n):
+            pk, pv = self._x_prefix[eid]
+            self._ck, self._cv = self._insert_cached_fn(
+                self._ck, self._cv, pk, pv, slots, np.int32(live_n)
+            )
+
+        ops["insert"] = op_insert
+
+        def op_insrows(hk, hv, slots, live_n):
+            # host KV rows ride the payload (restore / migrate-in: the
+            # follower never saw this KV) and enter the jit as replicated
+            # numpy operands
+            self._ck, self._cv = self._insert_cached_fn(
+                self._ck, self._cv, hk, hv, slots, np.int32(live_n)
+            )
+
+        ops["insrows"] = op_insrows
+
+        def op_insat(hk, hv, slot, start):
+            self._ck, self._cv = self._insert_at_fn(
+                self._ck, self._cv, hk, hv, np.int32(slot), np.int32(start)
+            )
+
+        ops["insat"] = op_insat
+
+        def op_chunk(gid, tokens, slots, starts, nvalid, skey, tbl):
+            logits, self._ck, self._cv = self._prefill_chunk_fn(
+                self.params, self._ck, self._cv, tokens, slots, starts,
+                nvalid, skey=skey, paged=self._paged_from(tbl),
+            )
+            self._x_logits[gid] = logits
+
+        ops["chunk"] = op_chunk
+
+        def op_ragged(gid, tokens, rowids, positions, slots, starts,
+                      last_idx, skey, tbl):
+            logits, self._ck, self._cv = self._ragged_chunk_fn(
+                self.params, self._ck, self._cv, tokens, rowids, positions,
+                slots, starts, last_idx, skey=skey, paged=self._paged_from(tbl),
+            )
+            jax.block_until_ready(self._ck)
+            self._x_logits[gid] = logits
+
+        ops["ragged"] = op_ragged
+
+        def op_bsample(gid, rows, slots_fin, temps, topks, topps, counter):
+            # activation sample off a parked chunk group's boundary logits +
+            # the sampling-param/token-ring writes for the finishing slots
+            logits = self._x_logits.pop(gid, None)
+            if logits is None or len(rows) == 0:
+                return None
+            toks0 = self._sample1(
+                logits[rows], np.int32(counter), temps, topks, topps
+            )
+            self._d_temp = self._d_temp.at[slots_fin].set(temps)
+            self._d_topk = self._d_topk.at[slots_fin].set(topks)
+            self._d_topp = self._d_topp.at[slots_fin].set(topps)
+            self._d_last_tok = self._d_last_tok.at[slots_fin].set(toks0)
+            return toks0
+
+        ops["bsample"] = op_bsample
+
+        def op_decode(kind, gid, packed, p_args, compact, skey, tbl):
+            paged = self._paged_from(tbl)
+            if kind == "plain":
+                out, self._ck, self._cv, self._d_last_tok = self._decode_fn(
+                    self.params, self._ck, self._cv, packed, self._d_temp,
+                    self._d_topk, self._d_topp, self._d_last_tok,
+                    compact=compact, paged=paged,
+                )
+                return out
+            fn = self._fused_fn if kind == "fused" else self._fused_ragged_fn
+            out, logits, self._ck, self._cv, self._d_last_tok = fn(
+                self.params, self._ck, self._cv, packed, self._d_temp,
+                self._d_topk, self._d_topp, self._d_last_tok, *p_args,
+                compact=compact, skey=skey, paged=paged,
+            )
+            self._x_logits[gid] = logits
+            return out
+
+        ops["decode"] = op_decode
+
+        def op_verify(tokens, slots, starts, nvalid, drafts, ndraft,
+                      counter, skey, tbl):
+            (n_acc, final, self._ck, self._cv,
+             self._d_last_tok) = self._verify_fn(
+                self.params, self._ck, self._cv, self._d_last_tok,
+                self._d_temp, self._d_topk, self._d_topp, tokens, slots,
+                starts, nvalid, drafts, ndraft, np.int32(counter),
+                skey=skey, paged=self._paged_from(tbl),
+            )
+            return n_acc, final
+
+        ops["verify"] = op_verify
+
+        def op_samprow(b, temp, topk, topp, last):
+            # single-slot sampling-state restore (preempt-restore path)
+            self._d_temp = self._d_temp.at[b].set(np.float32(temp))
+            self._d_topk = self._d_topk.at[b].set(np.int32(topk))
+            self._d_topp = self._d_topp.at[b].set(np.float32(topp))
+            self._d_last_tok = self._d_last_tok.at[b].set(np.int32(last))
+
+        ops["samprow"] = op_samprow
+
+        def op_snap(b, Lb, start, srcs):
+            # host copies of slot b's committed KV rows [start, Lb); the
+            # physical-table indirection rides the payload as (in_arena,
+            # row, off) triples so followers slice the same sources
+            bt = self._paging.block_tokens
+
+            def cut(arr, pool):
+                if isinstance(arr, dict):
+                    if not arr:  # fused GQA: "v" is the empty-dict placeholder
+                        return {}
+                    return {
+                        k: cut(arr[k], None if pool is None else pool[k])
+                        for k in arr
+                    }
+                if srcs is None:
+                    return self._fetch(arr[:, b : b + 1, :, start:Lb])
+                parts = [
+                    arr[:, row : row + 1, :, off : off + bt]
+                    if in_arena
+                    else pool[:, row : row + 1]
+                    for in_arena, row, off in srcs
+                ]
+                whole = jnp.concatenate(parts, axis=3) if len(parts) > 1 else parts[0]
+                return self._fetch(whole[:, :, :, start:Lb])
+
+            return cut(self._ck, self._pool_k), cut(self._cv, self._pool_v)
+
+        ops["snap"] = op_snap
+
+        def op_pfxput(eid, slot, p0):
+            # park a slot's prefix rows [0, p0) as a device prefix entry
+            pk = _tree2(lambda c, _: c[:, slot : slot + 1, :, :p0], self._ck, self._ck)
+            pv = _tree2(lambda c, _: c[:, slot : slot + 1, :, :p0], self._cv, self._cv)
+            self._x_prefix[eid] = (pk, pv)
+            return pk, pv
+
+        ops["pfxput"] = op_pfxput
+
+        def op_pfxdrop(eid):
+            self._x_prefix.pop(eid, None)
+
+        ops["pfxdrop"] = op_pfxdrop
+
+        def op_pfximp(eid, hk, hv):
+            # fleet-tier import: wire-decoded host rows become a device
+            # entry (replicated under GSPMD — any consistent placement
+            # works; insert jits reshard on use)
+            up = self._put_repl if self._spmd else jnp.asarray
+            pk = jax.tree.map(up, hk)
+            pv = jax.tree.map(up, hv)
+            self._x_prefix[eid] = (pk, pv)
+            return pk, pv
+
+        ops["pfximp"] = op_pfximp
+
+        def op_pfxexp(eid):
+            pk, pv = self._x_prefix[eid]
+            return self._fetch((pk, pv))
+
+        ops["pfxexp"] = op_pfxexp
+
+        def op_poolexp(prows, p0):
+            # physical-entry export: gather the entry's pool rows into one
+            # contiguous [L, 1, H, p0, ...] host tree (dict-aware)
+            def cut(pool):
+                if isinstance(pool, dict):
+                    if not pool:
+                        return {}
+                    return {k: cut(pool[k]) for k in pool}
+                parts = [pool[:, r : r + 1] for r in prows]
+                whole = jnp.concatenate(parts, axis=3) if len(parts) > 1 else parts[0]
+                return self._fetch(whole[:, :, :, :p0])
+
+            return cut(self._pool_k), cut(self._pool_v)
+
+        ops["poolexp"] = op_poolexp
+
+        def op_cow(slot, blk, prow):
+            self._ck, self._cv = self._cow_fn(
+                self._ck, self._cv, self._pool_k, self._pool_v,
+                np.int32(slot), np.int32(blk), np.int32(prow),
+            )
+
+        ops["cow"] = op_cow
+
+        def op_pput(kind, a, b, prow):
+            # prefix-pool row stores: "arena" copies a slot block (a=row,
+            # b=off), "pool" copies a pool row (a=src_row), "host" uploads a
+            # wire-decoded block (a=hk, b=hv)
+            if kind == "arena":
+                self._pool_k, self._pool_v = self._pool_arena_fn(
+                    self._pool_k, self._pool_v, self._ck, self._cv,
+                    np.int32(a), np.int32(b), np.int32(prow),
+                )
+            elif kind == "pool":
+                self._pool_k, self._pool_v = self._pool_pool_fn(
+                    self._pool_k, self._pool_v, np.int32(a), np.int32(prow)
+                )
+            else:
+                self._pool_k, self._pool_v = self._pool_host_fn(
+                    self._pool_k, self._pool_v, a, b, np.int32(prow)
+                )
+
+        ops["pput"] = op_pput
+
+        return ops
+
     # -- jit builders ------------------------------------------------------
 
     def _build_decode(self):
@@ -1332,7 +1900,8 @@ class GenerationEngine:
                 d_last = last
             return out, ck, cv, d_last  # out: [K, Ba]
 
-        @partial(jax.jit, donate_argnums=(1, 2, 7), static_argnames=("compact",))
+        @partial(jax.jit, donate_argnums=(1, 2, 7), static_argnames=("compact",),
+                 **self._shard_out(["repl", "k", "v", "repl"]))
         def decode_chunk_fn(params, ck, cv, packed, d_temp, d_topk, d_topp,
                             d_last, compact, paged=None):
             return decode_body(params, ck, cv, packed, d_temp, d_topk,
@@ -1341,6 +1910,7 @@ class GenerationEngine:
         @partial(
             jax.jit, donate_argnums=(1, 2, 7),
             static_argnames=("compact", "skey"),
+            **self._shard_out(["repl", "repl", "k", "v", "repl"]),
         )
         def fused_step_fn(params, ck, cv, packed, d_temp, d_topk, d_topp,
                           d_last, p_tokens, p_slots, p_starts, p_nvalid,
@@ -1371,6 +1941,7 @@ class GenerationEngine:
         @partial(
             jax.jit, donate_argnums=(1, 2, 7),
             static_argnames=("compact", "skey"),
+            **self._shard_out(["repl", "repl", "k", "v", "repl"]),
         )
         def fused_ragged_fn(params, ck, cv, packed, d_temp, d_topk, d_topp,
                             d_last, p_tokens, p_rowids, p_positions, p_slots,
@@ -1409,7 +1980,8 @@ class GenerationEngine:
         base_key = self._base_key
         B = self.max_slots
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3), static_argnames=("skey",))
+        @partial(jax.jit, donate_argnums=(1, 2, 3), static_argnames=("skey",),
+                 **self._shard_out(["repl", "repl", "k", "v", "repl"]))
         def verify_fn(params, ck, cv, d_last, d_temp, d_topk, d_topp,
                       tokens, slots, starts, nvalid, drafts, ndraft,
                       counter, skey, paged=None):
@@ -1538,13 +2110,13 @@ class GenerationEngine:
         self._rng_counter += 1
         return self._rng_counter
 
-    def _next_key(self):
-        return jax.random.fold_in(self._base_key, self._next_counter())
-
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "GenerationEngine":
         if self._thread is None:
+            # leader-side channel setup first (blocking accept of every
+            # follower) — the loop must never emit into a half-built channel
+            self._backend.start()
             self._thread = threading.Thread(target=self._run, name="gen-engine", daemon=True)
             self._thread.start()
         return self
@@ -1555,6 +2127,10 @@ class GenerationEngine:
         if self._thread:
             self._thread.join(timeout=10)
             self._thread = None
+        # release the followers (idempotent — the loop tail already sent
+        # stop on a dead engine) and drop the command channel
+        self._backend.stop()
+        self._backend.close()
         # Drain every waiter — callers blocked in req.out.get() must not
         # deadlock when the engine stops mid-request.
         self._abort_all("engine shutdown")
@@ -1578,6 +2154,12 @@ class GenerationEngine:
     # -- public API --------------------------------------------------------
 
     def submit(self, req: GenRequest) -> GenRequest:
+        if self.dead:
+            req.out.put(
+                {"type": "error", "error": f"engine dead: {self.dead}"}
+            )
+            req.out.put(_DONE)
+            return req
         if self._stop_evt.is_set():
             req.out.put({"type": "error", "error": "engine shutdown"})
             req.out.put(_DONE)
@@ -1849,6 +2431,14 @@ class GenerationEngine:
             deleted = False
         if not deleted:
             return False
+        if self._spmd:
+            # The poisoned step already fanned out: followers executed (or
+            # wedged on) the same dispatch, and freshly-allocated buffers
+            # here could never be re-synchronized through replay. The engine
+            # goes dead instead — submits reject, the loop exits, followers
+            # get the stop command from the loop tail.
+            self._mark_dead("kv cache lost in a failed dispatch")
+            return True
         # the device sampling rows and token ring are also donated; host
         # mirrors are the source of truth, so rebuilding them is lossless
         # (the ring may lag by the in-flight rounds that were lost — their
@@ -1960,20 +2550,6 @@ class GenerationEngine:
 
     # -- physical paged KV (block tables + prefix pool, physical.py) -------
 
-    def _paged_arg(self) -> dict | None:
-        """The `paged` operand threaded into every model-pass jit call:
-        {"tbl": [B, nbs] i32 device table, "k"/"v": prefix pools} when
-        physical paging is on, None otherwise. The two states have distinct
-        pytree treedefs, so each compiles its own executable — the None
-        trace is bit-identical to the pre-physical one."""
-        if self._phys is None:
-            return None
-        return {
-            "tbl": self._phys.device_table(),
-            "k": self._pool_k,
-            "v": self._pool_v,
-        }
-
     def _phys_reset(self, slot: int) -> None:
         """Slot released (free/preempt): its table row back to identity,
         then reclaim pool rows whose ledger ids just died. Driven from the
@@ -2018,11 +2594,7 @@ class GenerationEngine:
             blk = int(ent["P"]) // self._paging.block_tokens
             first = self._note_exec_shape("cow")
             t0 = time.perf_counter()
-            self._ck, self._cv = _cow_block_fn(
-                self._ck, self._cv, self._pool_k, self._pool_v,
-                np.int32(slot), np.int32(blk),
-                np.int32(prow - self._phys.pool_base),
-            )
+            self._dx("cow", int(slot), int(blk), int(prow - self._phys.pool_base))
             if first:
                 self._compile_obs("cow", (self._paging.block_tokens,),
                                   time.perf_counter() - t0)
@@ -2070,15 +2642,9 @@ class GenerationEngine:
             first = self._note_exec_shape("pool_put", in_arena)
             t0 = time.perf_counter()
             if in_arena:
-                self._pool_k, self._pool_v = _pool_put_arena_fn(
-                    self._pool_k, self._pool_v, self._ck, self._cv,
-                    np.int32(src_row), np.int32(off), np.int32(prow),
-                )
+                self._dx("pput", "arena", int(src_row), int(off), int(prow))
             else:
-                self._pool_k, self._pool_v = _pool_put_pool_fn(
-                    self._pool_k, self._pool_v,
-                    np.int32(src_row), np.int32(prow),
-                )
+                self._dx("pput", "pool", int(src_row), 0, int(prow))
             if first:
                 self._compile_obs("pool_put", (in_arena,),
                                   time.perf_counter() - t0)
@@ -2313,27 +2879,7 @@ class GenerationEngine:
             _, sn = self._paging.table_view(b)
             if sn > 0 and start < sn * bt:
                 srcs = self._phys.row_sources(b, -(-Lb // bt))
-
-        def cut(arr, pool):
-            if isinstance(arr, dict):
-                if not arr:  # fused GQA: "v" is the empty-dict placeholder
-                    return {}
-                return {
-                    k: cut(arr[k], None if pool is None else pool[k])
-                    for k in arr
-                }
-            if srcs is None:
-                return jax.device_get(arr[:, b : b + 1, :, start:Lb])
-            parts = [
-                arr[:, row : row + 1, :, off : off + bt]
-                if in_arena
-                else pool[:, row : row + 1]
-                for in_arena, row, off in srcs
-            ]
-            whole = jnp.concatenate(parts, axis=3) if len(parts) > 1 else parts[0]
-            return jax.device_get(whole[:, :, :, start:Lb])
-
-        return cut(self._ck, self._pool_k), cut(self._cv, self._pool_v)
+        return self._dx("snap", int(b), int(Lb), int(start), srcs)
 
     def _preempt_one(self) -> bool:
         """Offload one victim slot to host memory and free it. The caller
@@ -2493,12 +3039,6 @@ class GenerationEngine:
         # not decode (clamped into the partition at finish)
         s.preempted_s += max(0.0, time.time() - snap.preempted_at)
         t0 = time.perf_counter()
-
-        def up(rows):
-            if isinstance(rows, dict):
-                return {k: jax.device_put(v) for k, v in rows.items()}
-            return jax.device_put(rows)
-
         ledgered = False
         if snap.shared_len and snap.shared_entry is not None:
             # Paged two-stage restore, private rows at start=shared_len. R
@@ -2510,10 +3050,12 @@ class GenerationEngine:
             ent = snap.shared_entry
             if "k" in ent:
                 first = self._note_exec_shape("restore", snap.shared_len)
-                self._ck, self._cv = self._insert_cached_fn(
-                    self._ck, self._cv, ent["k"], ent["v"],
-                    jnp.asarray([b], dtype=jnp.int32), np.int32(1),
-                )
+                eid = ent.get("eid")
+                if eid is None:  # entry predates the plane (raw test pokes)
+                    self._eid_ctr += 1
+                    eid = ent["eid"] = self._eid_ctr
+                    self._x_prefix[eid] = (ent["k"], ent["v"])
+                self._dx("insert", eid, np.asarray([b], dtype=np.int32), 1)
             else:
                 # ledger pins FIRST: a migrated-in adopt with an unaligned
                 # stored length redoes the boundary COW out of the entry's
@@ -2532,24 +3074,24 @@ class GenerationEngine:
                 first = self._note_exec_shape("restore", snap.shared_len)
             R = snap.bucket - snap.shared_len
             first = self._note_exec_shape("restore_at", R) or first
-            self._ck, self._cv = self._insert_at_fn(
-                self._ck, self._cv, up(snap.k_rows), up(snap.v_rows),
-                np.int32(b), np.int32(snap.shared_len),
+            self._dx(
+                "insat", snap.k_rows, snap.v_rows, int(b),
+                int(snap.shared_len),
             )
         else:
             # one executable per (bucket, group=1) — same cache as prefix-hit
             # admission, so a restore compiles nothing the serve loop hasn't
             first = self._note_exec_shape("restore", snap.bucket)
-            self._ck, self._cv = self._insert_cached_fn(
-                self._ck, self._cv, up(snap.k_rows), up(snap.v_rows),
-                jnp.asarray([b], dtype=jnp.int32), np.int32(1),
+            self._dx(
+                "insrows", snap.k_rows, snap.v_rows,
+                np.asarray([b], dtype=np.int32), 1,
             )
         # device sampling rows + token ring, then host mirrors (the source
         # of truth for recovery), then the table entry
-        self._d_temp = self._d_temp.at[b].set(snap.temperature)
-        self._d_topk = self._d_topk.at[b].set(snap.top_k)
-        self._d_topp = self._d_topp.at[b].set(snap.top_p)
-        self._d_last_tok = self._d_last_tok.at[b].set(snap.last_tok)
+        self._dx(
+            "samprow", int(b), float(snap.temperature), int(snap.top_k),
+            float(snap.top_p), int(snap.last_tok),
+        )
         self._lengths[b] = snap.length
         self._last_tok[b] = snap.last_tok
         self._temp[b] = snap.temperature
@@ -2626,22 +3168,6 @@ class GenerationEngine:
             return None
         return [row for _, row, _ in srcs]
 
-    def _pool_entry_rows(self, rows: list[int], P0: int):
-        """Host copies of a PHYSICAL prefix entry's KV rows [0, P0), shaped
-        exactly like a contiguous entry's ([L, 1, H, P0, *rest]) — gathered
-        from the prefix pool rows for the migration wire's fallback rows."""
-
-        def cut(pool):
-            if isinstance(pool, dict):
-                if not pool:
-                    return {}
-                return {k: cut(pool[k]) for k in pool}
-            parts = [pool[:, r : r + 1] for r in rows]
-            whole = jnp.concatenate(parts, axis=3) if len(parts) > 1 else parts[0]
-            return jax.device_get(whole[:, :, :, :P0])
-
-        return cut(self._pool_k), cut(self._pool_v)
-
     def _wire_item(self, snap: KVSnapshot, source: str) -> dict[str, Any]:
         """Serialize a host-side snapshot into an outbox item. When the
         snapshot is paged private-only, the shared prefix ships as its
@@ -2667,16 +3193,21 @@ class GenerationEngine:
                 snap.shared_len = 0
             elif "k" in snap.shared_entry:
                 snap.shared_key = key
-                shared_k = self._host_tree(snap.shared_entry["k"])
-                shared_v = self._host_tree(snap.shared_entry["v"])
+                if snap.shared_entry.get("eid") is not None:
+                    shared_k, shared_v = self._dx(
+                        "pfxexp", snap.shared_entry["eid"]
+                    )
+                else:  # entry predates the plane (raw test pokes)
+                    shared_k = self._host_tree(snap.shared_entry["k"])
+                    shared_v = self._host_tree(snap.shared_entry["v"])
             elif snap.shared_pool_rows is not None:
                 # PHYSICAL entry: no device row copies exist — the fallback
                 # rows gather from the prefix-pool rows captured at snapshot
                 # time (still alive: the parked pins / exporting table hold
                 # their ledger ids)
                 snap.shared_key = key
-                shared_k, shared_v = self._pool_entry_rows(
-                    snap.shared_pool_rows, snap.shared_len
+                shared_k, shared_v = self._dx(
+                    "poolexp", list(snap.shared_pool_rows), snap.shared_len
                 )
             else:
                 # tripwire: physical entry with no resolvable pool rows —
@@ -2993,6 +3524,7 @@ class GenerationEngine:
             # watchdog's next poll (up to 30 s) would keep rejecting
             # submits from an engine that is demonstrably serving again.
             self.last_progress = time.time()
+            self._backend.idle()  # liveness beacon while the queue is quiet
             if self.stalled:
                 self.stalled = False
                 self._watchdog_transition("recovered")
@@ -3154,6 +3686,23 @@ class GenerationEngine:
             except Exception:  # pragma: no cover — device died at shutdown
                 log.exception("in-flight round lost at shutdown")
                 break
+        if self.dead:
+            # dead-on-poison: fail live slots and everything still queued —
+            # their consumers must not hang on a loop that will never
+            # iterate again
+            self._abort_all(f"engine dead: {self.dead}")
+            while True:
+                try:
+                    req = self._admit.get_nowait()
+                except queue.Empty:
+                    break
+                req.out.put(
+                    {"type": "error", "error": f"engine dead: {self.dead}"}
+                )
+                req.out.put(_DONE)
+        # release the followers: replay ends exactly where the leader's
+        # scheduling loop ends (clean shutdown and dead engine alike)
+        self._backend.stop()
 
     def _fail_round(self, slots: list[int], e: Exception) -> None:
         log.exception("decode round failed; failing %d active slots", len(slots))
@@ -3340,9 +3889,15 @@ class GenerationEngine:
             slots = np.zeros(nb, dtype=np.int32)
             for i, (slot, _, _) in enumerate(group):
                 slots[i] = slot
-            self._ck, self._cv = self._insert_cached_fn(
-                self._ck, self._cv, ent["k"], ent["v"], jnp.asarray(slots), np.int32(n)
-            )
+            eid = ent.get("eid")
+            if eid is None:
+                # entry predates the dispatch plane (tests poke entries in
+                # raw): register its device rows locally so the insert op
+                # resolves them — never reachable under a live follower
+                self._eid_ctr += 1
+                eid = ent["eid"] = self._eid_ctr
+                self._x_prefix[eid] = (ent["k"], ent["v"])
+            self._dx("insert", eid, slots, n)
         for slot, req, ids in group:
             self._prefills[slot] = _PrefillState(
                 req=req, ids=list(ids), done=ent["P"],
@@ -3427,26 +3982,11 @@ class GenerationEngine:
                 p0, nbytes / 1e6, len(self._prefix_cache),
             )
             return
-        if isinstance(self._ck, dict):
-            pk = {
-                "q": self._ck["q"][:, slot : slot + 1, :, :p0],
-                "s": self._ck["s"][:, slot : slot + 1, :, :p0],
-            }
-            # fused GQA caches carry V inside pk's head axis; "v" stays the
-            # empty-dict placeholder through store and re-insert
-            pv = (
-                {}
-                if not self._cv
-                else {
-                    "q": self._cv["q"][:, slot : slot + 1, :, :p0],
-                    "s": self._cv["s"][:, slot : slot + 1, :, :p0],
-                }
-            )
-        else:
-            pk = self._ck[:, slot : slot + 1, :, :p0]
-            pv = self._cv[:, slot : slot + 1, :, :p0]
+        self._eid_ctr += 1
+        eid = self._eid_ctr
+        pk, pv = self._dx("pfxput", eid, int(slot), p0)
         nbytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves((pk, pv)))
-        ent = {"P": p0, "k": pk, "v": pv, "bytes": nbytes, "key": key}
+        ent = {"P": p0, "k": pk, "v": pv, "bytes": nbytes, "key": key, "eid": eid}
         self._prefix_cache[key] = ent
         self._prefix_by_len.setdefault(p0, {})[key] = ent
         self._prefix_cache_bytes += nbytes
@@ -3465,6 +4005,8 @@ class GenerationEngine:
         and the by-length index."""
         old_key, old = self._prefix_cache.popitem(last=False)
         self._prefix_cache_bytes -= old["bytes"]
+        if old.get("eid") is not None:
+            self._dx("pfxdrop", old["eid"])
         with self._prefix_pub_lock:
             self._prefix_pub.pop(old_key, None)
         self._paging.prefix_release(old.get("key", old_key))
@@ -3610,7 +4152,10 @@ class GenerationEngine:
             return None
         t0 = time.perf_counter()
         if "k" in ent:
-            hk, hv = self._host_tree(ent["k"]), self._host_tree(ent["v"])
+            if ent.get("eid") is not None:
+                hk, hv = self._dx("pfxexp", ent["eid"])
+            else:  # entry predates the plane (tests poke entries in raw)
+                hk, hv = self._host_tree(ent["k"]), self._host_tree(ent["v"])
         else:
             lids = self._paging.prefix_ids(key)
             if lids is None or self._phys is None:
@@ -3622,7 +4167,7 @@ class GenerationEngine:
                     self._phys.missing_pins += 1
                     return None
                 rows.append(prow - self._phys.pool_base)
-            hk, hv = self._pool_entry_rows(rows, P0)
+            hk, hv = self._dx("poolexp", rows, P0)
         if P0 < int(ent["P"]) and "k" in ent:
             # contiguous entry: token axis is 3 ([L, 1, H, P, *rest]),
             # dict leaves are the fused-int8 live sentinel
@@ -3700,12 +4245,14 @@ class GenerationEngine:
             )
             ent = {"P": P0, "bytes": nbytes, "key": key}
         else:
-            pk = jax.tree.map(jnp.asarray, hk)
-            pv = jax.tree.map(jnp.asarray, hv) if hv is not None else {}
+            self._eid_ctr += 1
+            eid = self._eid_ctr
+            pk, pv = self._dx("pfximp", eid, hk, hv if hv is not None else {})
             nbytes = sum(
                 x.size * x.dtype.itemsize for x in jax.tree.leaves((pk, pv))
             )
-            ent = {"P": P0, "k": pk, "v": pv, "bytes": nbytes, "key": key}
+            ent = {"P": P0, "k": pk, "v": pv, "bytes": nbytes, "key": key,
+                   "eid": eid}
         self._prefix_cache[key] = ent
         self._prefix_by_len.setdefault(P0, {})[key] = ent
         self._prefix_cache_bytes += nbytes
@@ -3768,10 +4315,10 @@ class GenerationEngine:
         for j, prow in enumerate(rows):
             first = self._note_exec_shape("pool_put_host")
             t0 = time.perf_counter()
-            self._pool_k, self._pool_v = _pool_put_host_fn(
-                self._pool_k, self._pool_v,
+            self._dx(
+                "pput", "host",
                 _host_block(hk, j * bt, bt), _host_block(hv, j * bt, bt),
-                np.int32(prow),
+                int(prow),
             )
             if first:
                 self._compile_obs("pool_put_host", (bt,),
@@ -3822,12 +4369,7 @@ class GenerationEngine:
         # rows + first-token sample (see admit_fn)
         first = self._note_exec_shape("admit", Ab, bucket)
         t0c = time.perf_counter()
-        (self._ck, self._cv, self._d_temp, self._d_topk, self._d_topp,
-         self._d_last_tok, toks0) = self._admit_fn(
-            self.params, self._ck, self._cv,
-            self._d_temp, self._d_topk, self._d_topp, self._d_last_tok,
-            jnp.asarray(tokens), jnp.asarray(ipack), jnp.asarray(fpack),
-        )
+        toks0 = self._dx("admit", tokens, ipack, fpack)
         t_call = time.perf_counter()  # jit returned; device running
         toks0 = np.asarray(toks0)  # host sync: first-call wall ≈ compile time
         if first:
@@ -4161,11 +4703,12 @@ class GenerationEngine:
                                               group.skey,
                                               self._phys is not None)
                 t0 = time.perf_counter()
-                group.logits, self._ck, self._cv = self._ragged_chunk_fn(
-                    self.params, self._ck, self._cv, group.tokens,
-                    group.rowids_arr, group.positions_arr, group.slots_arr,
-                    group.starts_arr, group.last_idx_arr, group.skey,
-                    paged=self._paged_arg(),
+                self._gid_ctr += 1
+                group.gid = self._gid_ctr
+                self._dx(
+                    "ragged", group.gid, group.tokens, group.rowids_arr,
+                    group.positions_arr, group.slots_arr, group.starts_arr,
+                    group.last_idx_arr, group.skey, self._paged_payload(),
                 )
                 t_call = time.perf_counter()  # jit returned; device running
                 jax.block_until_ready(self._ck)
@@ -4195,10 +4738,12 @@ class GenerationEngine:
                                           group.bucket, group.skey,
                                           self._phys is not None)
             t0 = time.perf_counter()
-            group.logits, self._ck, self._cv = self._prefill_chunk_fn(
-                self.params, self._ck, self._cv, group.tokens,
-                group.slots_arr, group.starts_arr, group.nv_arr, group.skey,
-                paged=self._paged_arg(),
+            self._gid_ctr += 1
+            group.gid = self._gid_ctr
+            self._dx(
+                "chunk", group.gid, group.tokens, group.slots_arr,
+                group.starts_arr, group.nv_arr, group.skey,
+                self._paged_payload(),
             )
             t_call = time.perf_counter()  # jit returned; device running
             jax.block_until_ready(self._ck)
@@ -4246,27 +4791,22 @@ class GenerationEngine:
                 st.done += n
                 if st.done >= len(st.ids):
                     fin.append((i, slot, st))
+            # BATCHED activation: one first-token sample + one update per
+            # device sampling array for the whole finishing group (per-slot
+            # activation cost ~5 host<->device round trips — with
+            # prefix-cache hits riding this path, that tax would dominate
+            # admission again). Dispatched even with nothing finishing: the
+            # op pops the group's parked logits on every process.
+            rows = np.asarray([i for i, _, _ in fin], dtype=np.int32)
+            slots_fin = np.asarray([s for _, s, _ in fin], dtype=np.int32)
+            temps = np.asarray([st.req.temperature for _, _, st in fin], np.float32)
+            topks = np.asarray([st.req.top_k for _, _, st in fin], np.int32)
+            topps = np.asarray([st.req.top_p for _, _, st in fin], np.float32)
+            toks0 = self._dx(
+                "bsample", group.gid, rows, slots_fin, temps, topks, topps,
+                self._next_counter(),
+            )
             if fin:
-                # BATCHED activation: one first-token sample + one update
-                # per device sampling array for the whole finishing group
-                # (per-slot activation cost ~5 host<->device round trips —
-                # with prefix-cache hits riding this path, that tax would
-                # dominate admission again)
-                rows = np.asarray([i for i, _, _ in fin])
-                slots_fin = jnp.asarray([s for _, s, _ in fin])
-                temps = np.asarray([st.req.temperature for _, _, st in fin], np.float32)
-                topks = np.asarray([st.req.top_k for _, _, st in fin], np.int32)
-                topps = np.asarray([st.req.top_p for _, _, st in fin], np.float32)
-                toks0 = self._sample1(
-                    group.logits[rows], self._next_key(), temps, topks, topps
-                )
-                self._d_temp = self._d_temp.at[slots_fin].set(jnp.asarray(temps))
-                self._d_topk = self._d_topk.at[slots_fin].set(jnp.asarray(topks))
-                self._d_topp = self._d_topp.at[slots_fin].set(jnp.asarray(topps))
-                # first tokens into the device ring (decode rounds read
-                # their inputs from it — decode_chunk_fn); toks0 is still
-                # on device here, so this costs no extra transfer
-                self._d_last_tok = self._d_last_tok.at[slots_fin].set(toks0)
                 toks0 = np.asarray(toks0)
                 for k, (_, slot, st) in enumerate(fin):
                     self._prefill_q.remove(slot)
@@ -4381,14 +4921,9 @@ class GenerationEngine:
         )
         first = self._note_exec_shape("verify", A, C, skey,
                                       self._phys is not None)
-        n_acc, final, self._ck, self._cv, self._d_last_tok = self._verify_fn(
-            self.params, self._ck, self._cv, self._d_last_tok,
-            self._d_temp, self._d_topk, self._d_topp,
-            jnp.asarray(tokens), jnp.asarray(slots_arr),
-            jnp.asarray(starts_arr), jnp.asarray(nv_arr),
-            jnp.asarray(drafts_arr), jnp.asarray(nd_arr),
-            np.int32(self._next_counter()), skey=skey,
-            paged=self._paged_arg(),
+        n_acc, final = self._dx(
+            "verify", tokens, slots_arr, starts_arr, nv_arr, drafts_arr,
+            nd_arr, self._next_counter(), skey, self._paged_payload(),
         )
         t_call = time.perf_counter()  # jit returned (dispatch is async)
         n_acc = np.asarray(n_acc)  # the round's host sync point
@@ -4490,7 +5025,8 @@ class GenerationEngine:
         With a staged prefill chunk `group`, the round goes through
         fused_step_fn: the same dispatch also writes the group's prompt
         tokens (budget-bounded, slot-disjoint from the active rows) and
-        returns its boundary logits un-fetched on `group.logits`."""
+        parks its boundary logits un-fetched on the dispatch plane
+        (_x_logits[group.gid]) for the activation sample."""
         # chaos site: a failed round must fail active slots with error
         # events, not hang callers (the poisoned-round guard in _run)
         maybe_fail("engine.decode", f"active={len(active)}")
@@ -4556,25 +5092,13 @@ class GenerationEngine:
                     self._phys is not None,
                 )
                 t0c = time.perf_counter()
-                (out, group.logits, self._ck, self._cv,
-                 self._d_last_tok) = self._fused_ragged_fn(
-                    self.params,
-                    self._ck,
-                    self._cv,
-                    jnp.asarray(packed),
-                    self._d_temp,
-                    self._d_topk,
-                    self._d_topp,
-                    self._d_last_tok,
-                    group.tokens,
-                    group.rowids_arr,
-                    group.positions_arr,
-                    group.slots_arr,
-                    group.starts_arr,
-                    group.last_idx_arr,
-                    compact=compact,
-                    skey=group.skey,
-                    paged=self._paged_arg(),
+                self._gid_ctr += 1
+                group.gid = self._gid_ctr
+                out = self._dx(
+                    "decode", "fusedrag", group.gid, packed,
+                    (group.tokens, group.rowids_arr, group.positions_arr,
+                     group.slots_arr, group.starts_arr, group.last_idx_arr),
+                    compact, group.skey, self._paged_payload(),
                 )
                 if first:
                     self._compile_obs(
@@ -4589,23 +5113,13 @@ class GenerationEngine:
                     group.bucket, group.skey, self._phys is not None,
                 )
                 t0c = time.perf_counter()
-                (out, group.logits, self._ck, self._cv,
-                 self._d_last_tok) = self._fused_fn(
-                    self.params,
-                    self._ck,
-                    self._cv,
-                    jnp.asarray(packed),
-                    self._d_temp,
-                    self._d_topk,
-                    self._d_topp,
-                    self._d_last_tok,
-                    group.tokens,
-                    group.slots_arr,
-                    group.starts_arr,
-                    group.nv_arr,
-                    compact=compact,
-                    skey=group.skey,
-                    paged=self._paged_arg(),
+                self._gid_ctr += 1
+                group.gid = self._gid_ctr
+                out = self._dx(
+                    "decode", "fused", group.gid, packed,
+                    (group.tokens, group.slots_arr, group.starts_arr,
+                     group.nv_arr),
+                    compact, group.skey, self._paged_payload(),
                 )
                 if first:
                     # dispatch is async but jit trace+compile is synchronous
@@ -4621,17 +5135,9 @@ class GenerationEngine:
             first = self._note_exec_shape("decode", Ba, compact,
                                           self._phys is not None)
             t0c = time.perf_counter()
-            out, self._ck, self._cv, self._d_last_tok = self._decode_fn(
-                self.params,
-                self._ck,
-                self._cv,
-                jnp.asarray(packed),
-                self._d_temp,
-                self._d_topk,
-                self._d_topp,
-                self._d_last_tok,
-                compact=compact,
-                paged=self._paged_arg(),
+            out = self._dx(
+                "decode", "plain", 0, packed, (), compact, 0,
+                self._paged_payload(),
             )
             if first:
                 self._compile_obs(

@@ -1,250 +1,65 @@
-"""Multi-host serving engine: ONE GSPMD data plane spanning every process
-of a `jax.distributed` cluster, driven by a leader/follower command channel.
+"""Multi-host serving engine — compatibility facade over the unified plane.
 
-The reference's multi-host story is one schedulable device per Ollama
-endpoint (`core/internal/discovery/discovery.go:266-280`) — each host serves
-alone. A TPU slice is different: the MODEL spans hosts, so serving it means
-every process of the slice must dispatch the same XLA program over one
-global `jax.sharding.Mesh` while exactly one process talks HTTP. This module
-is that per-slice device:
+Historically this module held a second, hand-mirrored scheduling loop: every
+engine feature (chunked prefill, speculation, preemption, paging, the prefix
+tier) existed twice, once in `GenerationEngine` and once here as a
+per-feature command (`chunk`/`verify`/`preempt`/`restore`/`blk`/…) the
+leader broadcast and followers pattern-matched. That fork is gone.
 
-  - **Process 0 (leader)** owns all host-side state: the request queue, slot
-    table, sampling params, stop/EOS handling, SSE emission. It exposes the
-    same `generate_stream` interface `GenerationEngine` gives CoreServer, so
-    the slice registers through discovery as ONE device and serves
-    `/v1/chat/completions` unchanged.
-  - **Processes 1..n-1 (followers)** are stateless executors: they block on
-    a TCP command channel (the cluster-plane analog of the reference's
-    HTTP/gRPC control plane — SURVEY.md §2.2) and mirror every dispatch.
-    Commands carry the full host-side inputs (tokens, lengths, masks, RNG
-    counter), so a follower needs no scheduling logic and cannot diverge:
-    multi-controller JAX treats identical numpy inputs as replicated global
-    arrays, and the jitted programs are identical by construction.
-  - **Device state** (weights, KV cache) is born sharded: params and cache
-    init run as jitted programs with explicit `out_shardings` over the
-    global mesh, so no process ever materializes the full tree and a real
-    checkpoint streams per-process shards (`make_array_from_callback`).
+`GenerationEngine` (executor/engine.py) is now the ONLY scheduling loop; the
+multi-host behavior lives entirely in the `DispatchBackend` seam
+(executor/dispatch.py): every device mutation the loop makes flows through
+one funnel (`_dx`) that serializes an (op, host-payload) step-program to
+follower processes, which replay it through the same op registry. No
+scheduling state crosses the wire and no per-feature mirror code exists —
+the dispatch-surface lint pass (analysis/dispatch_surface.py) enforces that
+it never comes back.
 
-The decode round returns its sampled tokens with a REPLICATED out-sharding
-(XLA inserts the all-gather across dp), so the leader fetches the full
-token block locally — followers fetch nothing and stay async.
+`SliceEngine` survives as a thin constructor shim for existing callers and
+boot scripts: it is `GenerationEngine` wired to a `GSPMDBackend`, keeping
+the old keyword surface (`cmd_addr`, `connect_timeout_s`, the strict
+quant-with-checkpoint error, the `max_slots % dp` check). Construct it in
+EVERY process of the cluster with identical arguments; `.start()` on the
+leader (process 0), `.run_follower()` everywhere else — both inherited.
 
-Scheduling: with `prefill_chunk > 0` long prompts prefill chunk-by-chunk
-under the SAME token-budget policy as `GenerationEngine`
-(executor/scheduler.py): the leader asks the shared `TokenBudgetScheduler`
-for a per-iteration prefill token budget, stages one bounded chunk group,
-and broadcasts it as a "chunk" command before each decode round — decode
-cadence on the slice is bounded by budget arithmetic, not backlog depth.
-Followers replay the dispatches and need no policy.
-
-Scope vs `GenerationEngine`: no prompt-prefix cache / pipelined rings /
-slot compaction yet — the single-host engine keeps those; this engine's
-job is the cross-process data plane.
+The command channel primitives (`CmdLeader`, `CmdFollower`,
+`PING_INTERVAL_S`) moved to executor/dispatch.py and are re-exported here
+for import compatibility.
 """
 
 from __future__ import annotations
 
-import base64
-import logging
-import os
-import pickle
-import queue
-import socket
-import struct
-import threading
-import time
-from collections import deque
-from dataclasses import dataclass, field
-from functools import partial
-from typing import Any, Iterator
-
-from contextlib import nullcontext
+from typing import Any
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from ..models import (
-    init_kv_cache,
-    init_llama_params,
-    llama_decode_step,
-    llama_prefill,
+from ..models.configs import ModelConfig
+from .dispatch import (  # noqa: F401  (compat re-exports)
+    PING_INTERVAL_S,
+    CmdFollower,
+    CmdLeader,
+    GSPMDBackend,
 )
-from ..models.configs import ModelConfig, resolve_config
-from ..telemetry import recorder as _flight
-from ..models.llama import llama_prefill_chunk_batch
-from ..ops.sampling import sample_tokens, spec_verify
-from . import migration
-from .common import pow2_bucket
-from .drafter import NGramDrafter
-from .memory import (
-    KVPool,
-    KVSnapshot,
-    RESTORE_AGING_TTFT_MULT,
-    bucket_len,
-    pytree_nbytes,
-)
-from .paging import PagedKVManager
-from .scheduler import TokenBudgetScheduler
-from .tokenizer import Tokenizer, load_tokenizer
+from .engine import GenerationEngine, GenRequest
+from .tokenizer import Tokenizer
 
-log = logging.getLogger("slice")
+__all__ = [
+    "SliceEngine",
+    "SliceRequest",
+    "CmdLeader",
+    "CmdFollower",
+    "PING_INTERVAL_S",
+]
 
-_DONE = object()
+# The slice request type was always structurally identical to the engine's;
+# now it IS the engine's (one loop, one queue, one request dataclass).
+SliceRequest = GenRequest
 
 
-# ---------------------------------------------------------------------------
-# Command channel: leader → followers, length-prefixed pickles over TCP
-# ---------------------------------------------------------------------------
-
-
-PING_INTERVAL_S = 5.0  # leader liveness beacon cadence while the queue is idle
-
-
-class CmdLeader:
-    """Leader side: accept one connection per follower, broadcast commands."""
-
-    def __init__(self, bind_addr: str, n_followers: int, timeout_s: float = 60.0):
-        host, _, port = bind_addr.rpartition(":")
-        self._srv = socket.create_server((host or "0.0.0.0", int(port)))
-        self._srv.settimeout(timeout_s)
-        self.conns: list[socket.socket] = []
-        # send() is called from the engine loop AND shutdown()'s thread (the
-        # "stop" frame); interleaved sendall() would corrupt the frame stream
-        self._send_lock = threading.Lock()
-        self.last_send_t = time.monotonic()
-        for _ in range(n_followers):
-            c, _addr = self._srv.accept()
-            c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self.conns.append(c)
-
-    def send(self, obj: Any) -> None:
-        blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-        frame = struct.pack("<I", len(blob)) + blob
-        with self._send_lock:
-            for c in self.conns:
-                c.sendall(frame)
-            self.last_send_t = time.monotonic()
-
-    def ping_if_idle(self, interval_s: float = PING_INTERVAL_S) -> None:
-        """Beacon so followers can tell a quiet leader from a dead one."""
-        if time.monotonic() - self.last_send_t >= interval_s:
-            self.send(("ping",))
-
-    def close(self) -> None:
-        for c in self.conns:
-            try:
-                c.close()
-            except OSError:
-                pass
-        self._srv.close()
-
-
-class CmdFollower:
-    """Follower side: connect (with retry — the leader may boot later) and
-    wait on recv with a liveness bound: the leader beacons ("ping") every
-    PING_INTERVAL_S while idle, so a follower that sees NO bytes for
-    `idle_timeout_s` concludes the leader process is dead (not merely quiet)
-    and raises instead of blocking forever on a half-open socket."""
-
-    def __init__(self, addr: str, timeout_s: float = 60.0, idle_timeout_s: float = 600.0):
-        host, _, port = addr.rpartition(":")
-        deadline = time.time() + timeout_s
-        while True:
-            try:
-                self._c = socket.create_connection((host, int(port)), timeout=5.0)
-                break
-            except OSError:
-                if time.time() > deadline:
-                    raise
-                time.sleep(0.2)
-        self._c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        # finite so recv wakes periodically to check the liveness deadline.
-        # idle_timeout_s is deliberately generous: the leader stops beaconing
-        # while ITS dispatch blocks (first-admit XLA compiles can run
-        # minutes), so this guards against a dead leader, not a slow one.
-        self.idle_timeout_s = max(idle_timeout_s, 1.0)
-        self._c.settimeout(min(PING_INTERVAL_S, self.idle_timeout_s))
-
-    def recv(self) -> Any:
-        hdr = self._recv_exact(4)
-        (n,) = struct.unpack("<I", hdr)
-        return pickle.loads(self._recv_exact(n))
-
-    def _recv_exact(self, n: int) -> bytes:
-        buf = b""
-        deadline = time.monotonic() + self.idle_timeout_s
-        while len(buf) < n:
-            try:
-                chunk = self._c.recv(n - len(buf))
-            except TimeoutError:
-                if time.monotonic() > deadline:
-                    raise ConnectionError(
-                        f"leader sent nothing for {self.idle_timeout_s:.0f}s "
-                        "(no command or ping): presumed dead"
-                    ) from None
-                continue
-            if not chunk:
-                raise ConnectionError("command channel closed")
-            buf += chunk
-            deadline = time.monotonic() + self.idle_timeout_s
-        return buf
-
-    def close(self) -> None:
-        self._c.close()
-
-
-# ---------------------------------------------------------------------------
-# Requests / slots (leader-side bookkeeping)
-# ---------------------------------------------------------------------------
-
-
-@dataclass
-class SliceRequest:
-    prompt_ids: list[int]
-    max_tokens: int = 256
-    temperature: float = 0.7
-    top_k: int = 0
-    top_p: float = 1.0
-    stop: list[str] = field(default_factory=list)
-    # KV-pool preemption rank (memory.py): higher survives longer; only
-    # read when TPU_KV_HOST_OFFLOAD is on (GenRequest parity)
-    priority: int = 0
-    out: "queue.Queue[Any]" = field(default_factory=queue.Queue)
-
-
-@dataclass
-class _Slot:
-    req: SliceRequest
-    prompt_len: int
-    generated: int = 0
-    text: str = ""
-    pending: bytes = b""
-    spec: Any = None  # NGramDrafter when speculation is on (leader-only)
-    # KV pool victim signals (stamped only when the pool is on)
-    active_at: float = 0.0
-    last_emit: float = 0.0
-
-
-@dataclass
-class _SlicePrefill:
-    """A reserved slot whose prompt is mid-way through chunked prefill on
-    the slice (leader-side bookkeeping; followers just replay the "chunk"
-    dispatches). The slot's length mirror is PARKED at max_seq_len while
-    chunks land: decode rounds write K/V unconditionally at every row's
-    length, and the out-of-bounds position drops the write instead of
-    corrupting the prompt KV under construction."""
-
-    req: SliceRequest
-    ids: list[int]
-    done: int = 0  # tokens already written into the cache
-    t0: float = 0.0  # submit time (scheduler deadline + TTFT stat)
-
-
-class SliceEngine:
-    """See module docstring. Construct in EVERY process of the cluster with
-    identical arguments; then `.start()` on the leader (process 0) and
-    `.run_follower()` everywhere else."""
+class SliceEngine(GenerationEngine):
+    """`GenerationEngine` over a `GSPMDBackend` — the multi-host spelling of
+    the one unified engine. See module docstring."""
 
     def __init__(
         self,
@@ -263,1540 +78,38 @@ class SliceEngine:
         connect_timeout_s: float = 60.0,
         prefill_chunk: int = 0,
         target_ttft_ms: float = 2000.0,
+        **engine_kw: Any,
     ):
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from ..models.quant import quantized_specs
-        from ..parallel.sharding import kv_cache_specs, llama_param_specs
-
-        self.cfg = resolve_config(model, weights_dir) if isinstance(model, str) else model
-        self.mesh = mesh
-        self.max_slots = max_slots
-        self.max_seq_len = max_seq_len
-        self.decode_chunk = decode_chunk
-        self.prefill_chunk = max(0, prefill_chunk)
-        # Ragged packed prefill (GenerationEngine.ragged_prefill) stays OFF
-        # on the sliced path regardless of TPU_RAGGED_PREFILL: every follower
-        # replays broadcast dispatch commands by shape, and the ragged
-        # descriptors assume the single-program engine's slot/ledger
-        # ownership. Guarded passthrough — the bucketed chunk machinery below
-        # is the multi-host path of record.
-        self.ragged_prefill = False
-        self.target_ttft_ms = max(1.0, float(target_ttft_ms))
-        self.quant = quant
-        self.tokenizer = tokenizer or load_tokenizer(weights_dir)
+        if quant not in ("", "int8") and weights_dir:
+            # The unified engine downgrades unknown quant modes to a warning;
+            # a multi-host boot must not silently serve different bytes than
+            # the operator asked for across a whole slice.
+            raise NotImplementedError(
+                f"slice engine quant={quant!r} with a checkpoint "
+                f"(only 'int8' is supported)"
+            )
+        if mesh is not None:
+            dp = dict(mesh.shape).get("dp", 1)
+            if max_slots % max(dp, 1) != 0:
+                raise ValueError(
+                    f"max_slots {max_slots} must divide over dp={dp}"
+                )
+        super().__init__(
+            model,
+            mesh=mesh,
+            backend=GSPMDBackend(cmd_addr, connect_timeout_s=connect_timeout_s),
+            max_slots=max_slots,
+            max_seq_len=max_seq_len,
+            dtype=dtype,
+            decode_chunk=decode_chunk,
+            quant=quant,
+            weights_dir=weights_dir,
+            tokenizer=tokenizer,
+            seed=seed,
+            prefill_chunk=prefill_chunk,
+            target_ttft_ms=target_ttft_ms,
+            **engine_kw,
+        )
         self.process_index = jax.process_index()
         self.process_count = jax.process_count()
         self.is_leader = self.process_index == 0
-        self._cmd_addr = cmd_addr
-        self._connect_timeout_s = connect_timeout_s
-        cfg = self.cfg
-
-        dp = mesh.shape.get("dp", 1)
-        if max_slots % max(dp, 1) != 0:
-            raise ValueError(f"max_slots {max_slots} must divide over dp={dp}")
-
-        def ns(spec):
-            return jax.tree.map(
-                lambda s: NamedSharding(mesh, s), spec,
-                is_leaf=lambda x: isinstance(x, P),
-            )
-
-        pspecs = llama_param_specs(cfg)
-        if quant == "int8":
-            from ..models.quant import init_llama_params_quantized
-
-            pspecs = quantized_specs(pspecs)
-            init_params = partial(
-                init_llama_params_quantized, cfg, jax.random.PRNGKey(seed),
-                scale_dtype=dtype,
-            )
-        else:
-            init_params = partial(
-                init_llama_params, cfg, jax.random.PRNGKey(seed), dtype=dtype
-            )
-        cspecs = kv_cache_specs()
-        repl = NamedSharding(mesh, P())
-
-        with mesh:
-            if weights_dir:
-                self.params = self._load_checkpoint_global(
-                    cfg, weights_dir, dtype, mesh, ns(pspecs), quant=quant
-                )
-            else:
-                # born sharded: the init runs as ONE GSPMD program with
-                # explicit out_shardings — no process materializes the tree
-                self.params = jax.jit(init_params, out_shardings=ns(pspecs))()
-            cache = jax.jit(
-                partial(init_kv_cache, cfg, max_slots, max_seq_len, dtype=dtype),
-                out_shardings=jax.tree.map(
-                    lambda s: NamedSharding(mesh, s), cspecs,
-                    is_leaf=lambda x: isinstance(x, P),
-                ),
-            )()
-        self._ck, self._cv = cache["k"], cache["v"]
-        self._base_key = jax.random.PRNGKey(seed + 1)
-        base_key = self._base_key
-
-        cache_out = (
-            jax.tree.map(lambda s: NamedSharding(mesh, s), cspecs["k"],
-                         is_leaf=lambda x: isinstance(x, P)),
-            jax.tree.map(lambda s: NamedSharding(mesh, s), cspecs["v"],
-                         is_leaf=lambda x: isinstance(x, P)),
-        )
-
-        K = decode_chunk
-
-        @partial(
-            jax.jit,
-            donate_argnums=(1, 2),
-            out_shardings=((repl,) + cache_out),
-        )
-        def decode_fn(params, ck, cv, toks, lens, active, temps, topks, topps,
-                      counter):
-            """K chained steps + fused sampling. `toks`/`lens`/`active` and
-            the sampling params arrive as identical numpy on every process
-            (replicated by multi-controller semantics). Output tokens are
-            REPLICATED [K, B] so the leader fetches them without a separate
-            collective; inactive rows freeze (their lengths do not advance
-            and their token repeats)."""
-
-            cmd_key = jax.random.fold_in(base_key, counter)
-
-            def step(carry, i):
-                ck, cv, toks, lens = carry
-                logits, ck, cv = llama_decode_step(cfg, params, ck, cv, toks, lens)
-                key = jax.random.fold_in(cmd_key, i)  # i < K; admit uses K
-                new = sample_tokens(logits, key, temps, topks, topps,
-                                    active=active)
-                new = jnp.where(active, new, toks)
-                lens = lens + active.astype(jnp.int32)
-                return (ck, cv, new, lens), new
-
-            (ck, cv, _, _), out = jax.lax.scan(
-                step, (ck, cv, toks, lens), jnp.arange(K)
-            )
-            return out, ck, cv
-
-        kv_axes = 5  # [L, B, Hkv, S, hd]
-
-        @partial(jax.jit, donate_argnums=(1, 2),
-                 out_shardings=(cache_out + (repl,)))
-        def admit_fn(params, ck, cv, tokens, lengths, slots, live_n, temps,
-                     topks, topps, counter):
-            """Whole-prompt batched prefill + cache insert + first-token
-            sample, one dispatch (the slice analog of GenerationEngine's
-            fused admit_fn). Pad rows (i >= live_n) write nothing."""
-            logits, ks, vs = llama_prefill(cfg, params, tokens, lengths)
-
-            def body(i, cc):
-                ck, cv = cc
-
-                def ins(cc):
-                    ck, cv = cc
-                    kr = jax.lax.dynamic_slice_in_dim(ks, i, 1, 1)
-                    vr = jax.lax.dynamic_slice_in_dim(vs, i, 1, 1)
-                    start = (0, slots[i]) + (0,) * (kv_axes - 2)
-                    ck = jax.lax.dynamic_update_slice(ck, kr.astype(ck.dtype), start)
-                    cv = jax.lax.dynamic_update_slice(cv, vr.astype(cv.dtype), start)
-                    return ck, cv
-
-                return jax.lax.cond(i < live_n, ins, lambda cc: cc, (ck, cv))
-
-            ck, cv = jax.lax.fori_loop(0, tokens.shape[0], body, (ck, cv))
-            # fold (counter, K): disjoint from decode's (counter, i<K) space
-            key = jax.random.fold_in(jax.random.fold_in(base_key, counter), K)
-            toks0 = sample_tokens(logits, key, temps, topks, topps,
-                                  active=jnp.arange(tokens.shape[0]) < live_n)
-            return ck, cv, toks0
-
-        @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("skey",),
-                 out_shardings=((repl,) + cache_out))
-        def chunk_fn(params, ck, cv, tokens, slots, starts, nvalid, skey):
-            """One chunked-prefill group dispatch (GenerationEngine's
-            prefill_chunk_fn, slice flavor): inputs arrive as identical
-            numpy on every process; the boundary logits come back
-            REPLICATED so the leader samples first tokens locally."""
-            return llama_prefill_chunk_batch(
-                cfg, params, ck, cv, tokens, slots, starts, nvalid, skey=skey
-            )
-
-        # Self-speculative decoding (engine.py policy, slice flavor): the
-        # LEADER drafts host-side (NGramDrafter) and broadcasts a budgeted
-        # "verify" command; followers replay the dispatch like any other.
-        # The env knobs must match across processes (same contract as every
-        # other constructor argument). TPU_SPEC=0 is the kill switch.
-        self.spec_k = max(0, int(os.environ.get("TPU_SPEC_K", "") or 7))
-        self.spec_min_ngram = max(
-            1, int(os.environ.get("TPU_SPEC_MIN_NGRAM", "") or 2)
-        )
-        self.spec_max_ngram = max(self.spec_min_ngram, 3)
-        self.spec_enabled = (
-            os.environ.get("TPU_SPEC", "1") != "0" and self.spec_k > 0
-        )
-        self.spec_drafted = 0
-        self.spec_accepted = 0
-        self.spec_emitted = 0
-        self.spec_calls = 0
-        self._spec_cooldown = 0
-        B = max_slots
-
-        @partial(jax.jit, donate_argnums=(1, 2), static_argnames=("skey",),
-                 out_shardings=((repl, repl) + cache_out))
-        def verify_fn(params, ck, cv, tokens, slots, starts, nvalid,
-                      drafts, ndraft, temps, topks, topps, counter, skey):
-            """Speculative verify: ONE chunk pass over [token, draft_1..
-            draft_K] per slot with full-position logits, then accept/reject
-            + the follow-on sample on device (spec_verify). (n_acc, final)
-            come back REPLICATED so the leader reads them locally; pad rows
-            carry slot id B (writes drop out of bounds, and `active`
-            excludes them from the sampler's homogeneity reductions)."""
-            logits, ck, cv = llama_prefill_chunk_batch(
-                cfg, params, ck, cv, tokens, slots, starts, nvalid,
-                skey=skey, all_logits=True,
-            )  # [A, C, V]
-            rng = jax.random.fold_in(base_key, counter)
-            n_acc, final = spec_verify(
-                logits, drafts, ndraft, rng, temps, topks, topps,
-                active=slots < B,
-            )
-            return n_acc, final, ck, cv
-
-        # KV pool preempt/restore (memory.py), mirrored as leader commands.
-        # Both jits are built in EVERY process (identical by the same
-        # contract as every other constructor argument) and trace lazily —
-        # a slice that never preempts compiles neither.
-
-        @partial(jax.jit, static_argnames=("bucket",),
-                 out_shardings=(repl, repl))
-        def snapshot_fn(ck, cv, slot, bucket):
-            """A slot's committed KV rows [0, bucket), REPLICATED so every
-            process device_gets its own full host copy (the restore command
-            then ships only (slot, snap_id) — no KV over the channel). No
-            donation: the cache stays live for the next round."""
-
-            def cut(c):
-                return jax.lax.dynamic_slice(
-                    c, (0, slot, 0, 0, 0),
-                    (c.shape[0], 1, c.shape[2], bucket, c.shape[4]),
-                )
-
-            return cut(ck), cut(cv)
-
-        @partial(jax.jit, donate_argnums=(0, 1), out_shardings=cache_out)
-        def restore_fn(ck, cv, pk, pv, slot):
-            """Write a snapshot's rows back into `slot` (the admit insert
-            path, single-row flavor). Writing the full pow2 bucket is exact:
-            rows past the committed length are dead and the first
-            post-restore decode round overwrites position `length` before
-            any read attends there."""
-            start = (0, slot, 0, 0, 0)
-            ck = jax.lax.dynamic_update_slice(ck, pk.astype(ck.dtype), start)
-            cv = jax.lax.dynamic_update_slice(cv, pv.astype(cv.dtype), start)
-            return ck, cv
-
-        self._decode_fn = decode_fn
-        self._admit_fn = admit_fn
-        self._chunk_fn = chunk_fn
-        self._verify_fn = verify_fn
-        self._snapshot_fn = snapshot_fn
-        self._restore_fn = restore_fn
-        # per-process host copies of offloaded rows, keyed by snap_id (the
-        # follower side of the mirrored preempt/restore commands; the leader
-        # keeps its copy here too)
-        self._snaps: dict[int, tuple[Any, Any]] = {}
-        self._snap_ctr = 0
-        # Leader-side admission/preemption policy: same KVPool as
-        # GenerationEngine. TPU_KV_HOST_OFFLOAD=0 (default) never
-        # constructs it — the leader loop's pool hooks are all guarded.
-        self._pool: KVPool | None = None
-        if os.environ.get("TPU_KV_HOST_OFFLOAD", "0") not in ("", "0", "false", "no", "off"):
-            self._pool = KVPool(
-                max_slots=max_slots,
-                max_seq_len=max_seq_len,
-                bytes_per_slot=pytree_nbytes({"k": self._ck, "v": self._cv})
-                // max(1, max_slots),
-                watermark=float(os.environ.get("TPU_ADMIT_WATERMARK", "") or 1.5),
-                policy=os.environ.get("TPU_PREEMPT_POLICY", "") or "priority",
-            )
-
-        # KV migration inbox (executor/migration.py): a slice can serve as
-        # a decode-role TARGET — payloads land here from migrate_import
-        # (any thread) and the leader loop restores them into free slots.
-        # Unlike pool restore, followers never saw this KV, so the mirrored
-        # "migin" command ships the rows themselves. TPU_MIGRATE=0 keeps
-        # the inbox None and no migration codepath runs.
-        self._migrate_in: "queue.Queue[tuple] | None" = None
-        self.migrated_in_total = 0
-        self.migrate_in_bytes_total = 0
-        if os.environ.get("TPU_MIGRATE", "0") not in ("", "0", "false", "no", "off"):
-            self._migrate_in = queue.Queue()
-
-        # Paged-KV ledger (executor/paging.py): constructed in EVERY process
-        # from the same constructor arguments, so the follower mirror starts
-        # identical. The leader buffers every mutator's op list and flushes
-        # one ("blk", ops) command per loop iteration — ops carry block ids,
-        # never KV bytes — and followers replay them via apply_ops. The
-        # slice has no prefix cache, so the prefix partition is zero and
-        # every admission allocates private blocks.
-        #
-        # Physical paged KV (executor/physical.py): NOT constructed here,
-        # deliberately. With prefix_budget_bytes=0 nothing is ever shared,
-        # so every slot's block table would be the identity map — the
-        # engine's block-indirect gather reduces to exactly the contiguous
-        # read this slice already performs, and the mirror's op stream
-        # ("pin"/"cow" replay below) stays forward-compatible if a future
-        # slice grows a prefix partition. Keeping the pool out keeps the
-        # multi-host dispatch trace bit-identical to pre-physical engines.
-        self._paging = PagedKVManager(
-            max_slots=max_slots,
-            max_seq_len=max_seq_len,
-            bytes_per_token=pytree_nbytes({"k": self._ck, "v": self._cv})
-            // max(1, max_slots * max_seq_len),
-            prefix_budget_bytes=0,
-        )
-        self._blk_ops: list[tuple] = []
-
-        # leader-side bookkeeping
-        self._queue: "queue.Queue[Any]" = queue.Queue()
-        self._slots: list[_Slot | None] = [None] * max_slots
-        self._toks = np.zeros(max_slots, np.int32)
-        self._lens = np.zeros(max_slots, np.int32)
-        self._temps = np.zeros(max_slots, np.float32)
-        self._topks = np.zeros(max_slots, np.int32)
-        self._topps = np.ones(max_slots, np.float32)
-        self._counter = 0
-        # chunked-prefill reservations (leader-only; see _SlicePrefill) and
-        # the shared token-budget policy (executor/scheduler.py) — the SAME
-        # object GenerationEngine uses, so single-host and slice serving
-        # make identical scheduling decisions
-        self._prefills: dict[int, _SlicePrefill] = {}
-        self._prefill_q: deque[int] = deque()
-        self._sched = TokenBudgetScheduler(
-            target_ttft_ms=self.target_ttft_ms,
-            min_budget=min(64, self.prefill_chunk) if self.prefill_chunk else 1,
-        )
-        # Flight recorder + compile ledger (telemetry/recorder.py): leader
-        # methods record dispatch events and first-sighting compile walls
-        # into the SAME process-wide singletons GenerationEngine feeds —
-        # followers construct the references but never call them (all hooks
-        # live in leader-only methods).
-        self._flight = _flight.get_recorder()
-        self._ledger = _flight.get_compile_ledger()
-        self._seen_exec_shapes: set[tuple] = set()
-        self._shutdown = threading.Event()
-        self._thread: threading.Thread | None = None
-        self._leader_ch: CmdLeader | None = None
-        self.total_tokens = 0
-        self.total_requests = 0
-        self.total_errors = 0
-        self._ttfts: deque[float] = deque(maxlen=512)
-        self._tps_marks: deque[tuple[float, int]] = deque(maxlen=256)
-        self.attn_impl = "xla"
-        self.dead: str = ""  # non-empty = engine loop died with this error
-        self._dead_lock = threading.Lock()  # atomizes submit vs shutdown drain
-
-    # -- checkpoint -------------------------------------------------------
-
-    @staticmethod
-    def _load_checkpoint_global(cfg, ckpt_dir, dtype, mesh, shardings, quant: str = ""):
-        """Every process reads the safetensors dir (standard multi-host
-        practice) and contributes ONLY its addressable shards via
-        make_array_from_callback — the full tree is never resident per
-        process beyond the mmap'd host file."""
-        from ..models.weights import hf_to_llama_params, read_checkpoint_dir
-
-        host = hf_to_llama_params(cfg, read_checkpoint_dir(ckpt_dir))
-        if quant == "int8":
-            from ..models.quant import quantize_params
-
-            # quantize the host tree BEFORE placement so its structure matches
-            # the quantized PartitionSpecs; pin the work to the CPU backend —
-            # the tree must stay host-resident until make_array_from_callback
-            # streams per-process shards
-            try:
-                cpu = jax.local_devices(backend="cpu")[0]
-            except RuntimeError:
-                cpu = None
-            with jax.default_device(cpu) if cpu is not None else nullcontext():
-                host = quantize_params(host)
-        elif quant:
-            raise NotImplementedError(
-                f"slice engine quant={quant!r} with a checkpoint (only 'int8' is supported)"
-            )
-
-        def up(arr, sharding):
-            a = np.asarray(arr)
-            # int8 payloads must keep their dtype; only float leaves
-            # (weights, scales, norms) follow the engine compute dtype
-            if dtype is not None and np.issubdtype(a.dtype, np.floating):
-                a = a.astype(dtype)
-            return jax.make_array_from_callback(
-                a.shape, sharding, lambda idx: a[idx]
-            )
-
-        return jax.tree.map(up, host, shardings)
-
-    # -- follower ---------------------------------------------------------
-
-    def run_follower(self) -> None:
-        """Blocking command loop; returns on the leader's stop command."""
-        assert not self.is_leader
-        ch = CmdFollower(self._cmd_addr, timeout_s=self._connect_timeout_s)
-        try:
-            while True:
-                cmd = ch.recv()
-                op = cmd[0]
-                if op == "ping":  # leader liveness beacon, no work
-                    continue
-                if op == "stop":
-                    return
-                if op == "admit":
-                    _, tokens, lengths, slots, live_n, temps, topks, topps, ctr = cmd
-                    with self.mesh:
-                        self._ck, self._cv, _ = self._admit_fn(
-                            self.params, self._ck, self._cv, tokens, lengths,
-                            slots, live_n, temps, topks, topps, ctr,
-                        )
-                elif op == "decode":
-                    _, toks, lens, active, temps, topks, topps, ctr = cmd
-                    with self.mesh:
-                        _, self._ck, self._cv = self._decode_fn(
-                            self.params, self._ck, self._cv, toks, lens,
-                            active, temps, topks, topps, ctr,
-                        )
-                elif op == "chunk":
-                    # budget-bounded chunked-prefill group (token-budget
-                    # scheduler); the leader samples from the logits, a
-                    # follower only needs the cache writes
-                    _, tokens, slots, starts, nvalid, skey = cmd
-                    with self.mesh:
-                        _, self._ck, self._cv = self._chunk_fn(
-                            self.params, self._ck, self._cv, tokens,
-                            slots, starts, nvalid, int(skey),
-                        )
-                elif op == "verify":
-                    # budgeted speculative verify round: replay the dispatch
-                    # for the cache writes; (n_acc, final) are replicated and
-                    # only the leader consumes them
-                    (_, tokens, slots, starts, nvalid, drafts, ndraft,
-                     temps, topks, topps, ctr, skey) = cmd
-                    with self.mesh:
-                        _, _, self._ck, self._cv = self._verify_fn(
-                            self.params, self._ck, self._cv, tokens, slots,
-                            starts, nvalid, drafts, ndraft, temps, topks,
-                            topps, ctr, int(skey),
-                        )
-                elif op == "preempt":
-                    # KV-pool offload: slice the victim's committed rows
-                    # (replicated) and keep a HOST copy keyed by snap_id —
-                    # the matching "restore" ships no KV payload
-                    _, slot, bucket, snap_id = cmd
-                    with self.mesh:
-                        kr, vr = self._snapshot_fn(
-                            self._ck, self._cv, np.int32(slot), int(bucket)
-                        )
-                    self._snaps[int(snap_id)] = (
-                        jax.device_get(kr), jax.device_get(vr)
-                    )
-                elif op == "restore":
-                    _, slot, snap_id = cmd
-                    kr, vr = self._snaps.pop(int(snap_id))
-                    with self.mesh:
-                        self._ck, self._cv = self._restore_fn(
-                            self._ck, self._cv, kr, vr, np.int32(slot)
-                        )
-                elif op == "migin":
-                    # migrated-in KV: the rows were computed on ANOTHER
-                    # engine, so no local host copy exists — the command
-                    # carries them (the only data-plane command that ships
-                    # KV bytes over the channel)
-                    _, slot, kr, vr = cmd
-                    with self.mesh:
-                        self._ck, self._cv = self._restore_fn(
-                            self._ck, self._cv, kr, vr, np.int32(slot)
-                        )
-                elif op == "blk":
-                    # mirrored paging-ledger mutations: block ids only, no
-                    # KV bytes — replayed so every process can answer block
-                    # economy queries and audit for leaks identically
-                    self._paging.apply_ops(cmd[1])
-                else:  # pragma: no cover
-                    raise ValueError(f"unknown slice command {op!r}")
-        finally:
-            ch.close()
-
-    # -- leader -----------------------------------------------------------
-
-    def start(self) -> "SliceEngine":
-        assert self.is_leader, "start() is leader-only; followers run_follower()"
-        self._leader_ch = CmdLeader(
-            self._cmd_addr, self.process_count - 1,
-            timeout_s=self._connect_timeout_s,
-        )
-        self._thread = threading.Thread(
-            target=self._engine_loop, name="slice-engine", daemon=True
-        )
-        self._thread.start()
-        return self
-
-    def submit(self, req: SliceRequest) -> None:
-        # the dead-check and the put must be atomic against shutdown()'s
-        # queue drain: a submit that passed the check pre-drain would
-        # otherwise land in a dead queue and hang its consumer forever
-        with self._dead_lock:
-            if self.dead:
-                req.out.put({"type": "error", "error": f"engine dead: {self.dead}"})
-                req.out.put(_DONE)
-                return
-            self._queue.put(req)
-
-    def generate_stream(
-        self,
-        prompt: str,
-        *,
-        max_tokens: int = 256,
-        temperature: float = 0.7,
-        top_k: int = 0,
-        top_p: float = 1.0,
-        stop: list[str] | None = None,
-        priority: int = 0,
-    ) -> Iterator[dict[str, Any]]:
-        ids = self.tokenizer.encode(prompt)
-        req = SliceRequest(
-            prompt_ids=ids, max_tokens=max_tokens, temperature=temperature,
-            top_k=top_k, top_p=top_p, stop=stop or [], priority=priority,
-        )
-        req._t0 = time.time()  # type: ignore[attr-defined]
-        self.submit(req)
-        while True:
-            evt = req.out.get()
-            if evt is _DONE:
-                return
-            yield evt
-            if evt.get("type") in ("done", "error"):
-                return
-
-    def generate(self, prompt: str, **kw: Any) -> dict[str, Any]:
-        parts: list[str] = []
-        final: dict[str, Any] = {}
-        for evt in self.generate_stream(prompt, **kw):
-            if evt["type"] == "token":
-                parts.append(evt["text"])
-            elif evt["type"] == "done":
-                final = evt
-            elif evt["type"] == "error":
-                raise RuntimeError(evt.get("error", "generation failed"))
-        return {
-            "text": "".join(parts),
-            "usage": final.get("usage", {}),
-            "finish_reason": final.get("finish_reason", "stop"),
-        }
-
-    # CoreServer dashboard interface (GenerationEngine parity)
-    decode_compact = "off"  # compaction is a single-host engine feature
-    stalled = False
-
-    def slots_in_use(self) -> int:
-        return sum(1 for s in self._slots if s is not None)
-
-    def current_tps(self) -> float:
-        now = time.time()
-        window = [(t, n) for t, n in self._tps_marks if now - t <= 10.0]
-        return sum(n for _, n in window) / 10.0 if window else 0.0
-
-    def prefix_cache_stats(self) -> dict[str, Any]:
-        return {"enabled": False}
-
-    def phase_budget(self) -> dict[str, float]:
-        return {}  # per-phase accounting is a single-host engine feature
-
-    def scheduler_stats(self) -> dict[str, float]:
-        """Token-budget scheduler observability (GenerationEngine parity)."""
-        out = self._sched.stats()
-        out["decode_batch_occupancy"] = (
-            self.slots_in_use() / self.max_slots if self.max_slots else 0.0
-        )
-        return out
-
-    def speculation_stats(self) -> dict[str, float]:
-        """Self-speculative decoding observability (GenerationEngine
-        parity — see engine.speculation_stats)."""
-        drafted = float(self.spec_drafted)
-        calls = float(self.spec_calls)
-        return {
-            "enabled": 1.0 if self.spec_enabled else 0.0,
-            "k": float(self.spec_k),
-            "min_ngram": float(self.spec_min_ngram),
-            "drafted_tokens": drafted,
-            "accepted_tokens": float(self.spec_accepted),
-            "emitted_tokens": float(self.spec_emitted),
-            "verify_calls": calls,
-            "accept_rate": (self.spec_accepted / drafted) if drafted else 0.0,
-            "tok_per_call": (self.spec_emitted / calls) if calls else 0.0,
-        }
-
-    def _offered_load(self) -> float:
-        """Offered load in slot-equivalents. With the pool on, this is the
-        paging ledger's unique-block accounting (engine.py parity): live
-        tables and parked snapshot pins count once, plus committed decode
-        growth, snapshot restore needs, and the EMA-priced admit queue."""
-        queued = self._queue.qsize()
-        if self._pool is None:
-            return float(self.slots_in_use() + len(self._prefills) + queued)
-        mgr = self._paging
-        K = self.decode_chunk
-        wants: dict[int, int] = {}
-        for b, s in enumerate(self._slots):
-            if s is None:
-                continue
-            rem = max(0, s.req.max_tokens - s.generated)
-            wants[b] = min(int(self._lens[b]) + rem + K, self.max_seq_len)
-        for slot, st in list(self._prefills.items()):
-            wants[slot] = min(
-                len(st.ids) + max(0, st.req.max_tokens) + K, self.max_seq_len
-            )
-        return mgr.offered_blocks(wants, queued) / max(1, mgr.blocks_per_slot)
-
-    def paging_stats(self) -> dict[str, float]:
-        """Paged-KV block economy (GenerationEngine parity — engines_info
-        paging block, dashboard, llmtpu_kv_block* metrics)."""
-        out = self._paging.stats()
-        out["enabled"] = 1.0
-        out["leaks"] = float(self._paging.leak_count())
-        return out
-
-    def memory_stats(self) -> dict[str, float]:
-        """KV pool observability (GenerationEngine parity)."""
-        pool = self._pool
-        if pool is None:
-            return {"enabled": 0.0}
-        out = pool.stats()
-        out["enabled"] = 1.0
-        offered = self._offered_load()
-        out["offered"] = float(offered)
-        out["headroom"] = pool.headroom(offered)
-        return out
-
-    def admission_state(self) -> tuple[bool, float]:
-        """(shed, retry_after_s) — side-effect free (GenerationEngine
-        parity; see engine.admission_state)."""
-        pool = self._pool
-        if pool is None:
-            return False, 0.0
-        offered = self._offered_load()
-        if pool.admit_ok(offered):
-            return False, 0.0
-        mean_tokens = (
-            self.total_tokens / self.total_requests if self.total_requests else 64.0
-        )
-        n_waiting = self._queue.qsize() + pool.preempted_count()
-        retry = self._sched.drain_estimate_s(
-            max(1, n_waiting), mean_tokens, self.decode_chunk, self.max_slots
-        )
-        return True, min(600.0, max(1.0, retry))
-
-    def note_shed(self, n: int = 1) -> None:
-        if self._pool is not None:
-            self._pool.note_shed(n)
-
-    def ttft_percentiles(self) -> tuple[float, float, int]:
-        if not self._ttfts:
-            return 0.0, 0.0, 0
-        xs = sorted(self._ttfts)
-        return (
-            xs[len(xs) // 2],
-            xs[min(len(xs) - 1, int(len(xs) * 0.95))],
-            len(xs),
-        )
-
-    def shutdown(self) -> None:
-        with self._dead_lock:
-            if not self.dead:
-                self.dead = "engine shut down"  # submit() rejects from here on
-        self._shutdown.set()
-        if self._thread is not None:
-            self._thread.join(timeout=30)
-        # drain: active slots and queued requests must get terminal events —
-        # an SSE handler blocked in req.out.get() would otherwise hang the
-        # server's shutdown forever (GenerationEngine.shutdown parity). The
-        # drain runs under the same lock as submit's dead-check+put, so no
-        # request can slip into the queue after it.
-        with self._dead_lock:
-            self._drain_requests("engine shut down")
-        if self._leader_ch is not None:
-            try:
-                self._leader_ch.send(("stop",))
-            except OSError:
-                pass
-            self._leader_ch.close()
-
-    # -- engine loop ------------------------------------------------------
-
-    def _free_slots(self) -> list[int]:
-        # mid-prefill reservations are neither free nor decodable
-        return [
-            i for i, s in enumerate(self._slots)
-            if s is None and i not in self._prefills
-        ]
-
-    # -- KV pool: preemption with host offload (leader-side policy) --------
-
-    def _aging_s(self) -> float:
-        return RESTORE_AGING_TTFT_MULT * self.target_ttft_ms / 1000.0
-
-    def _peek_queue_head(self) -> SliceRequest | None:
-        # the leader loop is the queue's only consumer, so peeking is stable
-        try:
-            return self._queue.queue[0]
-        except IndexError:
-            return None
-
-    def _maybe_preempt(self) -> bool:
-        """At most one eviction per loop iteration, mirrored as a "preempt"
-        command: every process slices the victim's committed rows and keeps
-        its own host copy under snap_id. The loop is fully synchronous, so
-        _lens/_toks are committed-exact — no pipeline drain needed (the
-        single-host engine's extra step)."""
-        pool = self._pool
-        if self._queue.empty() or not pool.may_preempt():
-            return False
-        live = [
-            (b, s) for b, s in enumerate(self._slots) if s is not None
-        ]
-        if not live or self._free_slots():
-            return False
-        head = self._peek_queue_head()
-        if head is None:
-            return False
-        min_pri = min(s.req.priority for _, s in live)
-        head_t0 = getattr(head, "_t0", None)
-        aged = head_t0 is not None and time.time() - head_t0 > self._aging_s()
-        if head.priority <= min_pri and not aged:
-            return False
-        victim = pool.pick_victim([
-            {
-                "slot": b,
-                "priority": s.req.priority,
-                "last_activity": s.last_emit or s.active_at,
-                "tokens_remaining": max(0, s.req.max_tokens - s.generated),
-            }
-            for b, s in live
-        ])
-        if victim is None:
-            return False
-        b = victim["slot"]
-        s = self._slots[b]
-        L = int(self._lens[b])
-        Lb = bucket_len(L, self.max_seq_len)
-        snap_id = self._snap_ctr
-        self._snap_ctr += 1
-        t0 = time.perf_counter()
-        cmd = ("preempt", np.int32(b), np.int32(Lb), np.int32(snap_id))
-        if self._leader_ch is not None:
-            self._leader_ch.send(cmd)
-        with self.mesh:
-            kr, vr = self._snapshot_fn(
-                self._ck, self._cv, np.int32(b), int(Lb)
-            )
-        rows = (jax.device_get(kr), jax.device_get(vr))
-        dt = time.perf_counter() - t0
-        self._snaps[snap_id] = rows
-        snap = KVSnapshot(
-            req_id="",
-            priority=s.req.priority,
-            length=L,
-            bucket=Lb,
-            last_tok=int(self._toks[b]),
-            temperature=float(self._temps[b]),
-            top_k=int(self._topks[b]),
-            top_p=float(self._topps[b]),
-            k_rows=None,  # rows live in _snaps[snap_id] on EVERY process
-            v_rows=None,
-            nbytes=pytree_nbytes(rows[0]) + pytree_nbytes(rows[1]),
-            preempted_at=time.time(),
-            slot_obj=s,
-            snap_id=snap_id,
-        )
-        pool.offload(snap, dt)
-        # park the ledger's view under snap_id (no shared pins on the slice
-        # — the whole table is private and its rows are in the snapshot)
-        self._blk_ops += self._paging.preempt_slot(b, snap_id)
-        # release the slot WITHOUT terminal events (the request is
-        # suspended); the stale length mirror is harmless — decode rounds
-        # exclude the row via active0, and restore rewrites the rows
-        self._slots[b] = None
-        log.info(
-            "slice preempted slot %d (%d tokens, %.1f MB, snap %d)",
-            b, L, snap.nbytes / (1 << 20), snap_id,
-        )
-        return True
-
-    def _maybe_restore(self) -> bool:
-        """Restore at most one offloaded snapshot into a free slot,
-        mirrored as a "restore" command carrying only (slot, snap_id)."""
-        pool = self._pool
-        if not pool.has_preempted():
-            return False
-        free = self._free_slots()
-        if not free:
-            return False
-        snap = pool.pop_restore()
-        if snap is None:
-            return False
-        s = snap.slot_obj
-        head = self._peek_queue_head()
-        aged = time.time() - snap.preempted_at > self._aging_s()
-        if head is not None and head.priority >= snap.priority and not aged:
-            pool.requeue(snap)
-            return False
-        b = free[0]
-        t0 = time.perf_counter()
-        cmd = ("restore", np.int32(b), np.int32(snap.snap_id))
-        if self._leader_ch is not None:
-            self._leader_ch.send(cmd)
-        kr, vr = self._snaps.pop(snap.snap_id)
-        with self.mesh:
-            self._ck, self._cv = self._restore_fn(
-                self._ck, self._cv, kr, vr, np.int32(b)
-            )
-        self._slots[b] = s
-        self._toks[b] = snap.last_tok
-        self._lens[b] = snap.length
-        self._temps[b] = snap.temperature
-        self._topks[b] = snap.top_k
-        self._topps[b] = snap.top_p
-        self._blk_ops += self._paging.restore_slot(b, snap.snap_id, snap.length)
-        pool.note_restored(snap, time.perf_counter() - t0)
-        log.info(
-            "slice restored snap %d into slot %d (%d tokens) after %.1f s",
-            snap.snap_id, b, snap.length, time.time() - snap.preempted_at,
-        )
-        return True
-
-    # -- KV migration: decode-role import (executor/migration.py) ----------
-
-    def migrate_import(self, payload: bytes, out: Any = None) -> SliceRequest:
-        """Accept a migration payload from another engine; the leader loop
-        restores it into a free slot and decode resumes at the snapshot's
-        length. Callable from any thread (coordinator tick, rpc transfer
-        handler). The slice has no prefix cache, so shared-prefix payloads
-        always fold their fallback rows into a whole-bucket snapshot."""
-        if self._migrate_in is None:
-            raise RuntimeError("migration disabled (TPU_MIGRATE=0)")
-        header, snap = migration.wire_to_snapshot(payload)
-        if snap.shared_len:
-            migration.flatten_to_whole_bucket(snap)
-        if isinstance(snap.k_rows, dict) or isinstance(snap.v_rows, dict):
-            raise ValueError(
-                "slice engine migration supports bare-array KV only "
-                "(no kv_quant payloads)"
-            )
-        if snap.bucket > self.max_seq_len:
-            raise ValueError(
-                f"snapshot bucket {snap.bucket} exceeds max_seq_len {self.max_seq_len}"
-            )
-        req = SliceRequest(
-            prompt_ids=[int(t) for t in header.get("prompt_ids", [])],
-            max_tokens=int(header["max_tokens"]),
-            temperature=float(header["temperature"]),
-            top_k=int(header["top_k"]),
-            top_p=float(header["top_p"]),
-            stop=list(header.get("stop", [])),
-            priority=int(header.get("priority", 0)),
-        )
-        if out is not None:
-            req.out = out
-        now = time.time()
-        s = _Slot(
-            req=req,
-            prompt_len=int(header["prompt_len"]),
-            generated=int(header["generated"]),
-            text=header.get("text", ""),
-            pending=base64.b64decode(header.get("pending_b64", "")),
-            active_at=now,
-            last_emit=now,
-        )
-        snap.slot_obj = s
-        with self._dead_lock:
-            if self.dead:
-                raise RuntimeError(f"engine dead: {self.dead}")
-            self._migrate_in.put((snap, header, len(payload), s))
-        return req
-
-    def _migrate_restore_pending(self) -> bool:
-        """Leader loop: restore at most the free-slot count of migrated-in
-        snapshots, shipping the rows to followers via "migin"."""
-        did = False
-        while self._migrate_in is not None and not self._migrate_in.empty():
-            free = self._free_slots()
-            if not free:
-                break
-            try:
-                snap, _header, nbytes, s = self._migrate_in.get_nowait()
-            except queue.Empty:
-                break
-            b = free[0]
-            kr, vr = snap.k_rows, snap.v_rows
-            if self._leader_ch is not None:
-                self._leader_ch.send(("migin", np.int32(b), kr, vr))
-            with self.mesh:
-                self._ck, self._cv = self._restore_fn(
-                    self._ck, self._cv, kr, vr, np.int32(b)
-                )
-            self._slots[b] = s
-            self._toks[b] = snap.last_tok
-            self._lens[b] = snap.length
-            self._temps[b] = snap.temperature
-            self._topks[b] = snap.top_k
-            self._topps[b] = snap.top_p
-            # unknown snap_id → the ledger charges a fresh private table
-            self._blk_ops += self._paging.restore_slot(b, -1, snap.length)
-            self.total_requests += 1
-            self.migrated_in_total += 1
-            self.migrate_in_bytes_total += nbytes
-            did = True
-            log.info(
-                "slice imported migrated snapshot into slot %d (%d tokens, %.1f KB)",
-                b, snap.length, nbytes / 1024,
-            )
-        return did
-
-    def migration_stats(self) -> dict[str, float]:
-        if self._migrate_in is None:
-            return {"enabled": 0.0}
-        return {
-            "enabled": 1.0,
-            "migrated_out_total": 0.0,  # slices are import-only targets
-            "migrated_in_total": float(self.migrated_in_total),
-            "migrate_out_bytes_total": 0.0,
-            "migrate_in_bytes_total": float(self.migrate_in_bytes_total),
-            "outbox_depth": 0.0,
-            "inbox_depth": float(self._migrate_in.qsize()),
-        }
-
-    def _drain_requests(self, msg: str) -> None:
-        """Fail every active slot, mid-prefill reservation, and queued
-        request with a terminal event. Caller holds _dead_lock (both the
-        shutdown and crash paths — one copy, so the two drains cannot drift
-        apart)."""
-        for b in range(self.max_slots):
-            s = self._slots[b]
-            if s is not None:
-                s.req.out.put({"type": "error", "error": msg})
-                s.req.out.put(_DONE)
-                self._slots[b] = None
-            self._paging.free_slot(b)  # ops discarded: the mirror is dying too
-        for slot, st in self._prefills.items():
-            st.req.out.put({"type": "error", "error": msg})
-            st.req.out.put(_DONE)
-            self._paging.free_slot(slot)
-        self._prefills.clear()
-        self._prefill_q.clear()
-        if self._pool is not None:
-            # preempted-and-offloaded requests wait on a restore that will
-            # never come — their consumers must not hang either
-            for snap in self._pool.drain():
-                self._paging.drop_snap(snap.snap_id)
-                s = snap.slot_obj
-                if s is not None:
-                    s.req.out.put({"type": "error", "error": msg})
-                    s.req.out.put(_DONE)
-            self._snaps.clear()
-        self._blk_ops.clear()
-        while self._migrate_in is not None and not self._migrate_in.empty():
-            try:
-                _snap, _header, _nb, s = self._migrate_in.get_nowait()
-            except queue.Empty:
-                break
-            s.req.out.put({"type": "error", "error": msg})
-            s.req.out.put(_DONE)
-        while True:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            req.out.put({"type": "error", "error": msg})
-            req.out.put(_DONE)
-
-    def _engine_loop(self) -> None:
-        try:
-            while not self._shutdown.is_set():
-                pooled = False
-                if self._pool is not None:
-                    # budgeted: at most ONE restore then ONE preempt per
-                    # iteration, mirrored to followers as commands — pool
-                    # traffic never crowds out the decode cadence
-                    pooled = self._maybe_restore()
-                migrated = self._migrate_restore_pending()
-                admitted = self._try_admit()
-                if self._pool is not None and self._maybe_preempt():
-                    pooled = True
-                # stage speculation FIRST so its chunk positions can be
-                # reserved out of this iteration's prefill token budget
-                # (verify rides the same chunk machinery as prompt chunks)
-                spec_entries = self._stage_spec()
-                reserved = (
-                    sum(1 + len(d) for _, d in spec_entries)
-                    if spec_entries else 0
-                )
-                # one budget-bounded chunk group per iteration BEFORE the
-                # decode round: the token-budget scheduler caps the group so
-                # in-flight streams' cadence stays within ~2x pure decode
-                prefilled = self._try_prefill(reserved_tokens=reserved)
-                if spec_entries:
-                    decoded = self._try_verify(spec_entries)
-                else:
-                    decoded = self._try_decode()
-                self._flush_blk_ops()
-                if not (admitted or prefilled or decoded or pooled or migrated):
-                    if self._leader_ch is not None:
-                        self._leader_ch.ping_if_idle()
-                    time.sleep(0.002)
-        except Exception as e:
-            # The donated KV buffers died with the failed dispatch, so this
-            # engine cannot recover: mark it dead (submit() rejects from now
-            # on), fail every active AND queued request loudly, and release
-            # the followers — they must not block on recv() forever.
-            log.exception("slice engine loop died")
-            self.total_errors += 1
-            with self._dead_lock:  # same atomicity as shutdown's drain
-                self.dead = repr(e)
-                self._drain_requests(repr(e))
-            if self._leader_ch is not None:
-                try:
-                    self._leader_ch.send(("stop",))
-                except OSError:
-                    pass
-
-    def _flush_blk_ops(self) -> None:
-        """Broadcast this iteration's buffered paging-ledger mutations as
-        ONE compact ("blk", ops) command. The single TCP stream preserves
-        order against the data-plane commands; the ledger is metadata only,
-        so relative timing vs. the KV dispatches doesn't matter."""
-        ops, self._blk_ops = self._blk_ops, []
-        if ops and self._leader_ch is not None:
-            self._leader_ch.send(("blk", ops))
-
-    def _note_shape(self, *key) -> bool:
-        """First sighting of a dispatch shape on this slice: the first call
-        of a shape pays jit trace + compile synchronously, so its wall IS
-        the compile time (GenerationEngine._note_exec_shape contract)."""
-        if key in self._seen_exec_shapes:
-            return False
-        self._seen_exec_shapes.add(key)
-        return True
-
-    def _compile_obs(self, phase: str, key: tuple, wall_s: float) -> None:
-        ks = ":".join(str(p) for p in key)
-        e = self._ledger.observe(phase, ks, wall_s)
-        self._flight.event(
-            "compile", phase=phase, key=ks,
-            wall_ms=round(wall_s * 1000.0, 3), hit=e["hit"],
-        )
-
-    def _try_admit(self) -> bool:
-        free = self._free_slots()
-        if not free:
-            return False
-        pulled: list[SliceRequest] = []
-        while len(pulled) < len(free):
-            try:
-                pulled.append(self._queue.get_nowait())
-            except queue.Empty:
-                break
-        if not pulled:
-            return False
-        self.total_requests += len(pulled)
-        free_q = deque(free)
-        batch: list[tuple[int, SliceRequest, list[int]]] = []
-        reserved = False
-        for r in pulled:
-            # keep the TAIL of over-long prompts (the latest context is what
-            # matters in chat — same policy as GenerationEngine), and
-            # reserve a full decode round of KV headroom past the prompt
-            limit = max(self.max_seq_len - self.decode_chunk - 1, 1)
-            ids = r.prompt_ids[-limit:] or [0]
-            slot = free_q.popleft()
-            if self.prefill_chunk and len(ids) > self.prefill_chunk:
-                # long prompt: reserve the slot; chunks ride the token-budget
-                # scheduler (_try_prefill). PARK the length mirror at S so
-                # decode rounds' unconditional K/V writes drop out-of-bounds
-                # instead of landing inside the prompt KV under construction.
-                self._prefills[slot] = _SlicePrefill(
-                    req=r, ids=list(ids),
-                    t0=getattr(r, "_t0", None) or time.time(),
-                )
-                self._prefill_q.append(slot)
-                self._lens[slot] = self.max_seq_len
-                self._blk_ops += self._paging.admit_slot(slot, len(ids))
-                reserved = True
-                continue
-            batch.append((slot, r, ids))
-        if not batch:
-            return reserved
-        A = len(batch)
-        maxlen = max(len(ids) for _, _, ids in batch)
-        bucket = pow2_bucket(min(maxlen, self.max_seq_len - 1), self.max_seq_len)
-        tokens = np.zeros((A, bucket), np.int32)
-        lengths = np.zeros(A, np.int32)
-        slots = np.zeros(A, np.int32)
-        temps = np.zeros(A, np.float32)
-        topks = np.zeros(A, np.int32)
-        topps = np.ones(A, np.float32)
-        for i, (slot, r, ids) in enumerate(batch):
-            tokens[i, : len(ids)] = ids
-            lengths[i] = len(ids)
-            slots[i] = slot
-            temps[i] = r.temperature
-            topks[i] = r.top_k
-            topps[i] = r.top_p
-        ctr = self._counter
-        self._counter += 1
-        cmd = ("admit", tokens, lengths, slots, np.int32(A), temps, topks,
-               topps, np.int32(ctr))
-        first = self._note_shape("admit", A, bucket)
-        t0c = time.perf_counter()
-        try:
-            if self._leader_ch is not None:
-                self._leader_ch.send(cmd)
-            with self.mesh:
-                self._ck, self._cv, toks0 = self._admit_fn(
-                    self.params, self._ck, self._cv, tokens, lengths, slots,
-                    np.int32(A), temps, topks, topps, np.int32(ctr),
-                )
-            toks0 = np.asarray(toks0)
-            if first:
-                self._compile_obs("admit", (A, bucket), time.perf_counter() - t0c)
-        except Exception as e:
-            # these requests were already popped off the queue — the loop's
-            # crash handler can no longer see them, so fail them HERE or
-            # their consumers block in out.get() forever
-            for _, r, _ in batch:
-                r.out.put({"type": "error", "error": repr(e)})
-                r.out.put(_DONE)
-            raise
-        now = time.time()
-        mgr = self._paging
-        for i, (b, r, ids) in enumerate(batch):
-            self._blk_ops += mgr.admit_slot(b, len(ids))
-            want = min(
-                len(ids) + max(0, r.max_tokens) + self.decode_chunk,
-                self.max_seq_len,
-            )
-            mgr.note_admit_cost(mgr.blocks_for(want))
-            slot = _Slot(req=r, prompt_len=int(lengths[i]), active_at=now)
-            if self.spec_enabled:
-                # seed the drafter with the prompt BEFORE the first emit so
-                # tok0 lands on top of the prompt history
-                slot.spec = NGramDrafter(self.spec_min_ngram, self.spec_max_ngram)
-                slot.spec.extend(ids)
-            self._slots[b] = slot
-            self._toks[b] = toks0[i]
-            self._lens[b] = lengths[i]
-            self._temps[b] = r.temperature
-            self._topks[b] = r.top_k
-            self._topps[b] = r.top_p
-            t0 = getattr(r, "_t0", None)
-            if t0 is not None:
-                self._ttfts.append((now - t0) * 1000.0)
-            self._emit_token(b, int(toks0[i]))
-        return True
-
-    def _chunk_shape(self, slot: int, cap: int = 0) -> tuple[int, int, int, int]:
-        """(start, n, bucket, skey) for a reserved slot's next chunk, with
-        `cap` (>0) bounding n to the scheduler's remaining budget — same
-        shape rules as GenerationEngine._chunk_shape (one executable per
-        (group size, bucket, skey) forever)."""
-        st = self._prefills[slot]
-        start = st.done
-        n = min(self.prefill_chunk, len(st.ids) - start)
-        if cap > 0:
-            n = min(n, cap)
-        bucket = min(pow2_bucket(n, self.prefill_chunk), self.max_seq_len - start)
-        skey = (
-            min(pow2_bucket(start, self.max_seq_len), self.max_seq_len)
-            if start
-            else min(128, self.max_seq_len)
-        )
-        return start, n, bucket, skey
-
-    def _try_prefill(self, reserved_tokens: int = 0) -> bool:
-        """One budget-bounded chunk group per loop iteration: ask the shared
-        TokenBudgetScheduler for this round's prefill token budget, stage a
-        group of reserved slots' next chunks under it, broadcast the "chunk"
-        command, and dispatch. Finished prompts activate (first token
-        sampled from the replicated boundary logits, leader-locally).
-        `reserved_tokens` is chunk work this iteration already owes to a
-        staged speculative verify round."""
-        n_active = sum(1 for s in self._slots if s is not None)
-        if not self._prefill_q:
-            self._sched.decide(0, n_active, 0.0)
-            return False
-        backlog = sum(len(st.ids) - st.done for st in self._prefills.values())
-        oldest = min(self._prefills[s].t0 for s in self._prefill_q)
-        budget = self._sched.decide(
-            backlog, n_active, time.time() - oldest,
-            reserved_tokens=reserved_tokens,
-        )
-        if budget <= 0:
-            return False
-        first = self._prefill_q[0]
-        _, f_n, f_bucket, f_skey = self._chunk_shape(first, cap=budget)
-        group = [first]
-        used = f_n
-        for slot in list(self._prefill_q)[1:]:
-            if len(group) >= 4 or used >= budget:
-                break
-            start2, n2, _, s2 = self._chunk_shape(
-                slot, cap=min(budget - used, f_bucket)
-            )
-            if s2 == f_skey and n2 > 0 and start2 + f_bucket <= self.max_seq_len:
-                group.append(slot)
-                used += n2
-        Ab = 1 << (len(group) - 1).bit_length()
-        tokens = np.zeros((Ab, f_bucket), np.int32)
-        slots_arr = np.zeros((Ab,), np.int32)
-        starts_arr = np.zeros((Ab,), np.int32)
-        nv_arr = np.ones((Ab,), np.int32)
-        metas: list[tuple[int, _SlicePrefill, int]] = []
-        rem = budget
-        for i, slot in enumerate(group):
-            st = self._prefills[slot]
-            start, n, _, _ = self._chunk_shape(
-                slot, cap=min(rem, f_bucket) if i else budget
-            )
-            tokens[i, :n] = st.ids[start : start + n]
-            slots_arr[i] = slot
-            starts_arr[i] = start
-            nv_arr[i] = n
-            metas.append((slot, st, n))
-            rem -= n
-        for i in range(len(group), Ab):  # pad rows dup row 0: identical writes
-            tokens[i] = tokens[0]
-            slots_arr[i] = slots_arr[0]
-            starts_arr[i] = starts_arr[0]
-            nv_arr[i] = nv_arr[0]
-        cmd = ("chunk", tokens, slots_arr, starts_arr, nv_arr,
-               np.int32(f_skey))
-        first = self._note_shape("chunk", Ab, f_bucket, f_skey)
-        try:
-            if self._leader_ch is not None:
-                self._leader_ch.send(cmd)
-            t0 = time.perf_counter()
-            with self.mesh:
-                logits, self._ck, self._cv = self._chunk_fn(
-                    self.params, self._ck, self._cv, tokens,
-                    slots_arr, starts_arr, nv_arr, int(f_skey),
-                )
-            jax.block_until_ready(self._ck)
-            wall = time.perf_counter() - t0
-            if first:
-                self._compile_obs("chunk", (Ab, f_bucket, f_skey), wall)
-            self._flight.event(
-                "chunk", rows=len(group),
-                tokens=sum(n for _, _, n in metas), bucket=f_bucket,
-                wall_ms=round(wall * 1e3, 1),
-            )
-            self._sched.observe_prefill(
-                sum(n for _, _, n in metas), wall,
-                padded_tokens=Ab * f_bucket,
-            )
-        except Exception as e:
-            # fail the group's waiters HERE (the loop's crash handler drains
-            # the rest): the donated cache died with the dispatch
-            for slot, st, _ in metas:
-                self._prefills.pop(slot, None)
-                try:
-                    self._prefill_q.remove(slot)
-                except ValueError:
-                    pass
-                self._paging.free_slot(slot)
-                st.req.out.put({"type": "error", "error": repr(e)})
-                st.req.out.put(_DONE)
-            raise
-        now = time.time()
-        for i, (slot, st, n) in enumerate(metas):
-            st.done += n
-            if st.done < len(st.ids):
-                continue
-            # last chunk landed: activate. The logits are replicated, so the
-            # leader samples locally — followers never need the token (every
-            # decode command ships the full token block from the leader).
-            r = st.req
-            key = jax.random.fold_in(self._base_key, self._counter)
-            self._counter += 1
-            tok0 = int(np.asarray(sample_tokens(
-                jnp.asarray(np.asarray(logits)[i : i + 1]), key,
-                np.asarray([r.temperature], np.float32),
-                np.asarray([r.top_k], np.int32),
-                np.asarray([r.top_p], np.float32),
-            ))[0])
-            self._prefill_q.remove(slot)
-            del self._prefills[slot]
-            self._blk_ops += self._paging.ensure_slot(slot, len(st.ids))
-            want = min(
-                len(st.ids) + max(0, r.max_tokens) + self.decode_chunk,
-                self.max_seq_len,
-            )
-            self._paging.note_admit_cost(self._paging.blocks_for(want))
-            new_slot = _Slot(req=r, prompt_len=len(st.ids), active_at=now)
-            if self.spec_enabled:
-                new_slot.spec = NGramDrafter(
-                    self.spec_min_ngram, self.spec_max_ngram
-                )
-                new_slot.spec.extend(st.ids)
-            self._slots[slot] = new_slot
-            self._toks[slot] = tok0
-            self._lens[slot] = len(st.ids)  # un-park
-            self._temps[slot] = r.temperature
-            self._topks[slot] = r.top_k
-            self._topps[slot] = r.top_p
-            self._ttfts.append((now - st.t0) * 1000.0)
-            self._emit_token(slot, tok0)
-        return True
-
-    def _stage_spec(self) -> list[tuple[int, list[int]]] | None:
-        """Propose drafts for a speculative verify round (engine.py policy,
-        slice flavor), or None to run a normal decode round. Every active
-        slot joins (zero-draft rows degenerate to one-token decode steps);
-        the round runs only when a MAJORITY of slots have drafts and every
-        row has C = K+1 positions of cache headroom (dynamic_update_slice
-        CLAMPS out-of-range starts — a clamped verify write would overwrite
-        live KV)."""
-        if not self.spec_enabled:
-            return None
-        if self._spec_cooldown > 0:
-            self._spec_cooldown -= 1
-            return None
-        C = self.spec_k + 1
-        entries: list[tuple[int, list[int]]] = []
-        n_drafting = 0
-        for b, s in enumerate(self._slots):
-            if s is None:
-                continue
-            if s.spec is None:
-                return None
-            if int(self._lens[b]) + C > self.max_seq_len - 1:
-                return None
-            d = s.spec.draft(self.spec_k)
-            if d:
-                n_drafting += 1
-            entries.append((b, d))
-        if not entries or n_drafting == 0 or 2 * n_drafting < len(entries):
-            return None
-        return entries
-
-    def _try_verify(self, entries: list[tuple[int, list[int]]]) -> bool:
-        """One speculative verify round in place of the decode round:
-        broadcast the budgeted "verify" command, dispatch the chunk pass over
-        [token, draft_1..draft_nd] per slot, accept the longest agreeing
-        prefix, and roll lengths forward to the accepted position (rows past
-        it are dead by the parked-slot OOB invariant — rollback is pure
-        arithmetic)."""
-        B = self.max_slots
-        Kd = self.spec_k
-        C = Kd + 1
-        n = len(entries)
-        A = 1 << (n - 1).bit_length()
-        tokens = np.zeros((A, C), np.int32)
-        slots_arr = np.full((A,), B, np.int32)  # pads OOB: writes drop
-        starts_arr = np.zeros((A,), np.int32)
-        nv_arr = np.ones((A,), np.int32)
-        drafts_arr = np.zeros((A, Kd), np.int32)
-        nd_arr = np.zeros((A,), np.int32)
-        temps = np.ones((A,), np.float32)
-        topks = np.zeros((A,), np.int32)
-        topps = np.ones((A,), np.float32)
-        total = 0
-        for i, (b, d) in enumerate(entries):
-            nd = len(d)
-            tokens[i, 0] = self._toks[b]
-            if nd:
-                tokens[i, 1 : 1 + nd] = d
-                drafts_arr[i, :nd] = d
-            slots_arr[i] = b
-            starts_arr[i] = self._lens[b]
-            nv_arr[i] = 1 + nd
-            nd_arr[i] = nd
-            temps[i] = self._temps[b]
-            topks[i] = self._topks[b]
-            topps[i] = self._topps[b]
-            total += 1 + nd
-        skey = min(
-            pow2_bucket(int(starts_arr[:n].max()), self.max_seq_len),
-            self.max_seq_len,
-        )
-        ctr = self._counter
-        self._counter += 1
-        cmd = ("verify", tokens, slots_arr, starts_arr, nv_arr, drafts_arr,
-               nd_arr, temps, topks, topps, np.int32(ctr), np.int32(skey))
-        first = self._note_shape("verify", A, C, skey)
-        t0 = time.perf_counter()
-        if self._leader_ch is not None:
-            self._leader_ch.send(cmd)
-        with self.mesh:
-            n_acc, final, self._ck, self._cv = self._verify_fn(
-                self.params, self._ck, self._cv, tokens, slots_arr,
-                starts_arr, nv_arr, drafts_arr, nd_arr, temps, topks, topps,
-                np.int32(ctr), int(skey),
-            )
-        n_acc = np.asarray(n_acc)  # replicated: local fetch
-        final = np.asarray(final)
-        if first:
-            self._compile_obs("verify", (A, C, skey), time.perf_counter() - t0)
-        self._sched.observe_verify(total, time.perf_counter() - t0)
-        K = self.decode_chunk
-        drafted_round = accepted_round = emitted_round = 0
-        blk_wants: dict[int, int] = {}
-        for i, (b, d) in enumerate(entries):
-            s = self._slots[b]
-            if s is None:
-                continue
-            na = min(int(n_acc[i]), len(d))
-            base_b = int(starts_arr[i])
-            drafted_round += len(d)
-            accepted_round += na
-            for tok in list(d[:na]) + [int(final[i])]:
-                emitted_round += 1
-                self._emit_token(b, int(tok))
-                if self._slots[b] is not s:
-                    break  # finished mid-round (eos / stop / max_tokens)
-            if self._slots[b] is s:
-                # commit: KV valid through base+na; `final`'s KV is written
-                # by the next round at the rolled-forward length
-                self._lens[b] = base_b + 1 + na
-                self._toks[b] = np.int32(final[i])
-                blk_wants[b] = base_b + 1 + na
-                if int(self._lens[b]) + K > self.max_seq_len - 1:
-                    self._finish_slot(b, "length")
-        if blk_wants:
-            self._blk_ops += self._paging.extend_many(blk_wants)
-        self._tps_marks.append((time.time(), emitted_round))
-        self.spec_calls += 1
-        self.spec_drafted += drafted_round
-        self.spec_accepted += accepted_round
-        self.spec_emitted += emitted_round
-        self._flight.event(
-            "verify", rows=n, drafted=drafted_round, accepted=accepted_round,
-        )
-        if drafted_round and accepted_round * 4 < drafted_round:
-            # drafts aren't landing: a verify round emits >=1 token per slot
-            # where a decode round emits K — back off before re-probing
-            self._spec_cooldown = 50
-        return True
-
-    def _try_decode(self) -> bool:
-        active0 = np.asarray([s is not None for s in self._slots], bool)
-        if not active0.any():
-            return False
-        t_round = time.perf_counter()
-        ctr = self._counter
-        self._counter += 1
-        cmd = ("decode", self._toks.copy(), self._lens.copy(), active0.copy(),
-               self._temps.copy(), self._topks.copy(), self._topps.copy(),
-               np.int32(ctr))
-        first = self._note_shape("decode", self.max_slots, self.decode_chunk)
-        if self._leader_ch is not None:
-            self._leader_ch.send(cmd)
-        with self.mesh:
-            out, self._ck, self._cv = self._decode_fn(
-                self.params, self._ck, self._cv, self._toks, self._lens,
-                active0, self._temps, self._topks, self._topps, np.int32(ctr),
-            )
-        out = np.asarray(out)  # [K, B] replicated
-        if first:
-            self._compile_obs(
-                "decode", (self.max_slots, self.decode_chunk),
-                time.perf_counter() - t_round,
-            )
-        # decode rounds here are never fused with prefill, so every round
-        # teaches the scheduler's decode-round EMA directly
-        self._sched.observe_decode(time.perf_counter() - t_round)
-        K = out.shape[0]
-        self._flight.event("decode", rows=int(active0.sum()))
-        self._tps_marks.append((time.time(), int(active0.sum()) * K))
-        for k in range(K):
-            for b in range(self.max_slots):
-                if not active0[b] or self._slots[b] is None:
-                    continue  # finished mid-round: ignore its later tokens
-                self._emit_token(b, int(out[k, b]))
-        live = np.asarray([s is not None for s in self._slots], bool)
-        self._toks = np.where(live, out[-1], self._toks).astype(np.int32)
-        # the device advanced lengths once per step for every row active at
-        # round START (its `active` is constant through the scan)
-        adv = np.where(active0, K, 0).astype(np.int32)
-        self._lens = self._lens + adv
-        self._blk_ops += self._paging.extend_many({
-            b: int(self._lens[b])
-            for b in range(self.max_slots)
-            if active0[b] and self._slots[b] is not None
-        })
-        # a round writes K/V at positions lens..lens+K-1: a slot without a
-        # full round of headroom must finish NOW — an out-of-bounds cache
-        # write would be clamped/dropped and the tokens sampled from that
-        # corrupted attention state would stream to the client
-        for b in range(self.max_slots):
-            if self._slots[b] is not None and (
-                int(self._lens[b]) + K > self.max_seq_len - 1
-            ):
-                self._finish_slot(b, "length")
-        return True
-
-    def _emit_token(self, b: int, tok: int) -> None:
-        slot = self._slots[b]
-        if slot is None:
-            return
-        req = slot.req
-        self.total_tokens += 1
-        slot.generated += 1
-        eos = getattr(self.tokenizer, "eos_id", -1)
-        finish = None
-        if eos is not None and tok == eos:
-            finish = "stop"
-            text = ""
-        else:
-            text, slot.pending = self.tokenizer.decode_stream(slot.pending, [tok])
-            if slot.spec is not None:
-                slot.spec.append(tok)  # drafter history = committed tokens
-        if text:
-            slot.text += text
-            for stop_s in req.stop:
-                idx = slot.text.find(stop_s)
-                if idx >= 0:
-                    # emit up to the stop string, then finish
-                    keep = idx - (len(slot.text) - len(text))
-                    if keep > 0:
-                        req.out.put({"type": "token", "text": text[:keep]})
-                    finish = "stop"
-                    text = ""
-                    break
-            if text and finish is None:
-                req.out.put({"type": "token", "text": text})
-                if self._pool is not None:
-                    slot.last_emit = time.time()
-        if finish is None and slot.generated >= req.max_tokens:
-            finish = "length"
-        if finish is not None:
-            self._finish_slot(b, finish)
-
-    def _finish_slot(self, b: int, finish: str) -> None:
-        slot = self._slots[b]
-        if slot is None:
-            return
-        req = slot.req
-        tail = self.tokenizer.decode_flush(slot.pending)
-        if tail and finish != "stop":
-            req.out.put({"type": "token", "text": tail})
-        req.out.put({
-            "type": "done",
-            "finish_reason": finish,
-            "usage": {
-                "prompt_tokens": slot.prompt_len,
-                "completion_tokens": slot.generated,
-                "total_tokens": slot.prompt_len + slot.generated,
-            },
-        })
-        req.out.put(_DONE)
-        self._slots[b] = None
-        self._blk_ops += self._paging.free_slot(b)

@@ -9,7 +9,12 @@ collectives:
   w2       [L, F, D]:    shard F on tp → psum after down-projection
   embed    [V, D]:       shard vocab on tp (vocab-parallel logits; top-k/argmax
                          over the sharded vocab axis gathers only [B, k])
-  KV cache [L, B, Hkv, S, hd]: heads on tp, batch slots on dp
+  KV cache [L, B, Hkv, S, hd]: layers on pp, heads on tp, batch slots on dp
+
+The stacked layer axis L shards on pp everywhere (params and cache): each
+pipeline stage then holds only its own layers' weights and KV rows in HBM —
+the capacity unlock pipeline_prefill's stage scan relies on. At pp=1 the
+axis is a no-op and the specs reduce to the pure-TP mapping above.
 
 GQA note: Llama-3.1-8B has 8 KV heads — exactly one per chip on a v5e-8 TP
 mesh; Q heads (32) shard 4-per-chip. No KV replication needed up to tp=8.
@@ -32,32 +37,32 @@ def llama_param_specs(cfg: ModelConfig) -> dict[str, Any]:
         # latent down-projection and its norm replicate (the latent is
         # per-token global state every head reads).
         attn: dict[str, Any] = {
-            "attn_norm": P(None, None),
-            "wq_mla": P(None, None, "tp"),
-            "w_dkv": P(None, None, None),
-            "kv_norm": P(None, None),
-            "w_ukv": P(None, None, "tp"),
-            "wo_mla": P(None, "tp", None),
-            "ffn_norm": P(None, None),
+            "attn_norm": P("pp", None),
+            "wq_mla": P("pp", None, "tp"),
+            "w_dkv": P("pp", None, None),
+            "kv_norm": P("pp", None),
+            "w_ukv": P("pp", None, "tp"),
+            "wo_mla": P("pp", "tp", None),
+            "ffn_norm": P("pp", None),
         }
         dense_ffn = {
-            "w1": P(None, None, "tp"),
-            "w3": P(None, None, "tp"),
-            "w2": P(None, "tp", None),
+            "w1": P("pp", None, "tp"),
+            "w3": P("pp", None, "tp"),
+            "w2": P("pp", "tp", None),
         }
         if cfg.n_experts:
             ffn: dict[str, Any] = {
-                "router": P(None, None, None),
-                "w1e": P(None, "ep", None, "tp"),
-                "w3e": P(None, "ep", None, "tp"),
-                "w2e": P(None, "ep", "tp", None),
+                "router": P("pp", None, None),
+                "w1e": P("pp", "ep", None, "tp"),
+                "w3e": P("pp", "ep", None, "tp"),
+                "w2e": P("pp", "ep", "tp", None),
             }
             if cfg.n_shared_experts:
                 ffn.update(
                     {
-                        "w1s": P(None, None, "tp"),
-                        "w3s": P(None, None, "tp"),
-                        "w2s": P(None, "tp", None),
+                        "w1s": P("pp", None, "tp"),
+                        "w3s": P("pp", None, "tp"),
+                        "w2s": P("pp", "tp", None),
                     }
                 )
         else:
@@ -73,49 +78,49 @@ def llama_param_specs(cfg: ModelConfig) -> dict[str, Any]:
             specs["lm_head"] = P(None, "tp")
         return specs
     layers: dict[str, Any] = {
-        "attn_norm": P(None, None),
-        "wq": P(None, None, "tp"),
-        "wk": P(None, None, "tp"),
-        "wv": P(None, None, "tp"),
-        "wo": P(None, "tp", None),
-        "ffn_norm": P(None, None),
+        "attn_norm": P("pp", None),
+        "wq": P("pp", None, "tp"),
+        "wk": P("pp", None, "tp"),
+        "wv": P("pp", None, "tp"),
+        "wo": P("pp", "tp", None),
+        "ffn_norm": P("pp", None),
     }
     if cfg.qkv_bias:
         # biases follow their projection's output sharding
-        layers.update({"bq": P(None, "tp"), "bk": P(None, "tp"), "bv": P(None, "tp")})
+        layers.update({"bq": P("pp", "tp"), "bk": P("pp", "tp"), "bv": P("pp", "tp")})
     if cfg.qk_norm:
         # per-head norm weights are [L, hd] — every tp shard applies the
-        # same head-local norm, so they replicate
-        layers.update({"q_norm": P(None, None), "k_norm": P(None, None)})
+        # same head-local norm, so they replicate over tp
+        layers.update({"q_norm": P("pp", None), "k_norm": P("pp", None)})
     if cfg.post_norms:
         layers.update(
-            {"post_attn_norm": P(None, None), "post_ffn_norm": P(None, None)}
+            {"post_attn_norm": P("pp", None), "post_ffn_norm": P("pp", None)}
         )
     if cfg.n_experts:
         # Experts on ep, expert FFN hidden on tp: the dispatch einsums in
         # models/moe.py become the token all-to-all over ep under GSPMD.
         layers.update(
             {
-                "router": P(None, None, None),
-                "w1e": P(None, "ep", None, "tp"),
-                "w3e": P(None, "ep", None, "tp"),
-                "w2e": P(None, "ep", "tp", None),
+                "router": P("pp", None, None),
+                "w1e": P("pp", "ep", None, "tp"),
+                "w3e": P("pp", "ep", None, "tp"),
+                "w2e": P("pp", "ep", "tp", None),
             }
         )
         if cfg.n_shared_experts:
             layers.update(
                 {
-                    "w1s": P(None, None, "tp"),
-                    "w3s": P(None, None, "tp"),
-                    "w2s": P(None, "tp", None),
+                    "w1s": P("pp", None, "tp"),
+                    "w3s": P("pp", None, "tp"),
+                    "w2s": P("pp", "tp", None),
                 }
             )
     else:
         layers.update(
             {
-                "w1": P(None, None, "tp"),
-                "w3": P(None, None, "tp"),
-                "w2": P(None, "tp", None),
+                "w1": P("pp", None, "tp"),
+                "w3": P("pp", None, "tp"),
+                "w2": P("pp", "tp", None),
             }
         )
     specs: dict[str, Any] = {
@@ -184,19 +189,19 @@ def embedder_param_specs(cfg: ModelConfig) -> dict[str, Any]:
 
 
 def kv_cache_specs(quantized: bool = False, latent: bool = False) -> dict[str, Any]:
-    # [L, B, Hkv, S, hd] — batch slots on dp, KV heads on tp. The int8 cache
-    # ({"q", "s"} pytrees) shards the payload identically; scales [L,B,Hkv,S]
-    # drop the trailing head_dim axis.
+    # [L, B, Hkv, S, hd] — layers on pp, batch slots on dp, KV heads on tp.
+    # The int8 cache ({"q", "s"} pytrees) shards the payload identically;
+    # scales [L,B,Hkv,S] drop the trailing head_dim axis.
     if latent:
         # MLA latent cache [L, B, 1, S, R]: the fake one-head axis cannot
         # shard — every tp shard's heads read the SAME latent row, so it
         # replicates over tp and shards batch on dp only (models/mla.py).
-        row = P(None, "dp", None, None, None)
+        row = P("pp", "dp", None, None, None)
         if quantized:
-            entry = {"q": row, "s": P(None, "dp", None, None)}
+            entry = {"q": row, "s": P("pp", "dp", None, None)}
             return {"k": entry, "v": entry}
         return {"k": row, "v": row}
-    row = P(None, "dp", "tp", None, None)
+    row = P("pp", "dp", "tp", None, None)
     if quantized:
         # Fused GQA layout: one payload block [L, B, 2*Hkv + p, S, hd] holding
         # K rows, V rows, and (when p == 1) a bit-packed scale pseudo-head.
@@ -205,8 +210,8 @@ def kv_cache_specs(quantized: bool = False, latent: bool = False) -> dict[str, A
         # XLA path, which reads whole heads anyway).
         return {
             "k": {
-                "q": P(None, "dp", None, None, None),
-                "s": P(None, "dp", None, None),
+                "q": P("pp", "dp", None, None, None),
+                "s": P("pp", "dp", None, None),
             },
             "v": {},
         }
@@ -237,12 +242,17 @@ def supports_ragged_prefill(mesh: Mesh | None) -> bool:
 
     The ragged kernels take the packed [T] token buffer and the per-row
     (slot, start, len) descriptors as whole-array operands and stream cache
-    blocks by absolute physical index — there is no clean axis left to
-    shard: rows bound for different dp shards interleave inside one packed
-    buffer, and tp would split the per-row DMA descriptors mid-stream.
-    Single-program regime only; any real mesh keeps the bucketed chunk path,
-    which shards per kv_cache_specs."""
-    return mesh is None or mesh.size == 1
+    blocks by absolute physical index. Rows bound for different dp shards
+    interleave inside one packed buffer, and sp would split the per-row DMA
+    descriptors mid-stream — any mesh with dp/sp/ep > 1 keeps the bucketed
+    chunk path, which shards per kv_cache_specs. Pure pp×tp meshes are fine:
+    the packed buffer replicates, heads/layers shard cleanly, and the engine
+    forces the XLA ragged impl (no Pallas DMA descriptors) whenever
+    mesh.size > 1."""
+    if mesh is None or mesh.size == 1:
+        return True
+    shape = dict(mesh.shape)
+    return all(shape.get(ax, 1) == 1 for ax in ("dp", "sp", "ep"))
 
 
 def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:

@@ -59,6 +59,13 @@ Checks, in order of authority:
      already holds the prefix (or pulls it over the fetch path). Same
      single-device escape hatch as the migration sweep: a marker key
      instead, and the metric [SKIP]s with a warning.
+  5c. Unified-dispatch floors, when the record carries them: the pp×tp
+     sweep must report dispatch_parity == 1.0 (GSPMD leader/follower
+     step-program replay is token- and state-identical to the
+     local-arrays engine; any fraction under 1.0 is a divergence, not a
+     slowdown) and pp_tp_serve_tok_per_s >= 1.0 as a liveness floor for
+     the pipeline×tensor boot. Hosts without enough devices for the
+     mesh emit the dispatch_single_device marker and both [SKIP].
   6. Raw-decode kernel floors, when the record carries them: the B=112
      headline-shape sweep >= 5600 tok/s (the pre-fusion starting line —
      the fused-layout work climbs FROM here), the MLA S=32k int8-latent
@@ -121,6 +128,8 @@ HIGHER_BETTER = (
     "migration_count",
     "migrate_ttft_gain",
     "prefix_route_hit_rate",
+    "dispatch_parity",
+    "pp_tp_serve_tok_per_s",
     "raw_decode_tok_per_s_llama-3.1-8b-int8_kv8_b112_tpu",
     "raw_decode_tok_per_s_mla-8b-int8_kv8_b4_s32768_tpu",
     "layers_gbps",
@@ -176,6 +185,16 @@ ABS_MIN = {
     # cannot give each engine its own silicon emit a marker instead and
     # the key [SKIP]s with a warning.
     "prefix_route_hit_rate": 0.5,
+    # unified dispatch plane (pp×tp sweep): parity is pass/fail, not a
+    # throughput — anything under 1.0 means the GSPMD leader/follower
+    # step-program diverged from the local-arrays engine (wrong tokens or
+    # non-replicated device state) and the dispatch refactor regressed.
+    # The serve key is a liveness floor only (the sweep runs the tiny
+    # model); round-to-round drift is the relative check's job. Hosts
+    # without enough devices for the mesh emit the dispatch_single_device
+    # marker and both keys [SKIP] with a warning.
+    "dispatch_parity": 1.0,
+    "pp_tp_serve_tok_per_s": 1.0,
     # raw-decode kernel floors (promoted top-level by bench.py). The b112
     # headline-shape sweep measured 5609 tok/s pre-fusion (r5): the fused
     # cache layout + wqkv/w13 layer pass must never regress BELOW that

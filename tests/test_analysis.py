@@ -295,7 +295,7 @@ def test_suite_splits_new_baselined_stale(tmp_path):
 
 
 def test_suite_clean_on_real_tree():
-    """The tier-1 gate: all five passes over the real package with the
+    """The tier-1 gate: all six passes over the real package with the
     committed baseline must report zero new findings — and stay well
     inside the 30 s CPU budget (AST-only, no jax import)."""
     res = run_suite(REPO)
@@ -308,7 +308,7 @@ def test_suite_clean_on_real_tree():
     ]
     assert {r.pass_id for r in res.results} == {
         "lock-order", "donation", "knob-registry", "import-purity",
-        "registry-census",
+        "registry-census", "dispatch-surface",
     }
     assert res.seconds < 30.0
 

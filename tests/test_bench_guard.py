@@ -122,3 +122,20 @@ def test_capture_replay_round_trip_cpu_smoke():
     assert rp["replay_rejected_lines"] == 0.0
     # the replayed engine's waterfall must hold the exact-partition invariant
     assert abs(rp["waterfall_coverage"] - 1.0) <= 0.05
+
+
+@pytest.mark.slow
+def test_dispatch_parity_sweep_cpu_smoke():
+    """ISSUE 17 acceptance: the pp×tp leader/follower sweep serves greedy
+    token-identical to the local-arrays engine and leaves the follower's
+    device state bit-equal (dispatch_parity == 1.0), with a live
+    pp_tp_serve_tok_per_s reading."""
+    import jax
+
+    from bench import dispatch_parity_sweep
+
+    if len(jax.devices()) < 4:
+        pytest.skip("pp=2,tp=2 sweep needs 4 devices")
+    dp = dispatch_parity_sweep("tiny-llm", n_requests=4, max_tokens=8)
+    assert dp.get("dispatch_parity") == 1.0, dp
+    assert dp.get("pp_tp_serve_tok_per_s", 0.0) > 0.0, dp

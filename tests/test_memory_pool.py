@@ -420,7 +420,7 @@ def test_slice_engine_preempt_restore_token_identical(monkeypatch):
         st = eng.memory_stats()
         assert st["preempted_total"] >= 1
         assert st["restored_total"] >= 1
-        assert not eng._snaps  # every snapshot's host rows were consumed
+        assert not eng._pool._snaps  # every snapshot's host rows were consumed
         ref = eng.generate(prompt, max_tokens=48, temperature=0.0)
         assert results[prompt]["text"] == ref["text"]
         assert eng.total_errors == 0

@@ -126,8 +126,9 @@ def test_moe_sharded_ep_tp_matches_single_device(moe_params):
     """jit over a dp×ep×tp mesh with expert sharding == single-device."""
     mesh = make_mesh("dp=2,ep=2,tp=2")
     specs = llama_param_specs(MOE)
+    # stacked layer axis rides pp (size-1 here), experts on ep, ffn on tp
     assert specs["layers"]["w1e"] == __import__("jax").sharding.PartitionSpec(
-        None, "ep", None, "tp"
+        "pp", "ep", None, "tp"
     )
     sharded = shard_pytree(moe_params, specs, mesh)
     prompt = jax.random.randint(jax.random.PRNGKey(4), (4, 8), 3, MOE.vocab_size)

@@ -16,9 +16,10 @@ Four layers of coverage:
      token-identical true no-op vs the physical block pool, and a threaded
      admit/diverge/finish/preempt soak quiesces with zero leaked and zero
      double-freed blocks for all four cache layouts.
-  4. SliceEngine mirrored variant — the leader's flushed ("blk", ops)
-     stream, replayed into a fresh mirror manager, matches the leader's
-     ledger at quiesce.
+  4. Unified dispatch variant — the SliceEngine (GenerationEngine over a
+     GSPMD dispatch backend) emits ONLY ops from the DISPATCH_OPS
+     vocabulary while paging churns: no ("blk", ops) mirror stream exists,
+     the ledger stays leader-side policy, and output is token-identical.
 """
 
 from __future__ import annotations
@@ -672,15 +673,17 @@ def test_soak_zero_leaks_all_layouts(monkeypatch, model, kv_quant):
         eng.shutdown()
 
 
-# -- 4. SliceEngine mirrored variant -----------------------------------------
+# -- 4. Unified dispatch variant ---------------------------------------------
 
 
-def test_slice_mirror_replays_to_identical_ledger(monkeypatch):
-    """The leader's flushed ("blk", ops) stream, replayed into a fresh
-    mirror manager (what every follower runs), reproduces the leader's
-    ledger exactly — through admit, decode extends, preempt, restore, and
-    finish — and both audit clean at quiesce."""
+def test_slice_dispatch_stream_is_the_whole_protocol(monkeypatch):
+    """Paging under the GSPMD dispatch backend: the leader emits ONLY ops
+    from the DISPATCH_OPS vocabulary — block ids never cross the wire as a
+    per-feature ("blk", ops) mirror stream, the ledger is leader-side
+    policy, and preempt/restore replay through the generic insert/sample
+    ops — while the engine stays token-identical and audits clean."""
     from llm_mcp_tpu.executor import SliceEngine
+    from llm_mcp_tpu.executor.dispatch import DISPATCH_OPS
     from llm_mcp_tpu.parallel.mesh import make_mesh
 
     monkeypatch.setenv("TPU_KV_HOST_OFFLOAD", "1")
@@ -692,14 +695,14 @@ def test_slice_mirror_replays_to_identical_ledger(monkeypatch):
     )
     captured: list[tuple] = []
     cap_lock = threading.Lock()
-    orig_flush = eng._flush_blk_ops
+    orig_emit = eng._backend.emit
 
-    def capture_flush():
+    def capture_emit(op, args):
         with cap_lock:
-            captured.extend(eng._blk_ops)
-        orig_flush()
+            captured.append((op, args))
+        orig_emit(op, args)
 
-    eng._flush_blk_ops = capture_flush
+    eng._backend.emit = capture_emit
     eng.start()
     try:
         results: dict[str, dict] = {}
@@ -730,23 +733,25 @@ def test_slice_mirror_replays_to_identical_ledger(monkeypatch):
         assert not any(t.is_alive() for t in threads)
         st = eng.memory_stats()
         assert st["preempted_total"] >= 1 and st["restored_total"] >= 1
-        # let the loop flush the final finish ops, then quiesce-check
+        # let the loop drain the final finishes, then quiesce-check
         deadline = time.time() + 10
-        while (eng._blk_ops or eng.slots_in_use()) and time.time() < deadline:
+        while eng.slots_in_use() and time.time() < deadline:
             time.sleep(0.01)
-        mirror = PagedKVManager(
-            max_slots=eng.max_slots,
-            max_seq_len=eng.max_seq_len,
-            block_tokens=eng._paging.block_tokens,
-            bytes_per_token=eng._paging.bytes_per_token,
-            prefix_budget_bytes=0,
-        )
         with cap_lock:
-            mirror.apply_ops(list(captured))
-        assert _structural(mirror.stats()) == _structural(eng._paging.stats())
+            steps = list(captured)
+        assert steps, "dispatch stream never emitted"
+        # the step-program IS the whole protocol: every emitted op comes
+        # from the published vocabulary; the retired per-feature mirrors
+        # ("blk"/"preempt"/"restore"/...) must never reappear on the wire
+        assert {op for op, _ in steps} <= set(DISPATCH_OPS), {
+            op for op, _ in steps
+        } - set(DISPATCH_OPS)
+        # the preempt/restore cycle replays through the generic KV-insert
+        # ops (host rows ride the payload), not a paging-specific command
+        assert any(op in ("insat", "insrows") for op, _ in steps)
+        assert any(op == "samprow" for op, _ in steps)
         assert eng._paging.stats()["blocks_used"] == 0.0
         _assert_clean(eng._paging)
-        _assert_clean(mirror)
         ps = eng.paging_stats()
         assert ps["enabled"] == 1.0 and ps["leaks"] == 0.0
         ref = eng.generate(prompt, max_tokens=32, temperature=0.0)

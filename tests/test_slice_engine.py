@@ -204,8 +204,9 @@ def test_slice_engine_capacity_headroom():
         prompt = "z" * 300  # byte tokenizer: way over the 64-token cache
         out = eng.generate(prompt, max_tokens=500, temperature=0.0)
         assert out["finish_reason"] == "length"
-        # left-truncated to max_seq_len - decode_chunk - 1
-        assert out["usage"]["prompt_tokens"] == 64 - K - 1
+        # left-truncated to max_seq_len - decode_chunk (the unified engine's
+        # admission rule: leave room for at least one decode chunk)
+        assert out["usage"]["prompt_tokens"] == 64 - K
         # every KV write stayed inside the cache: prompt + generated ≤ cap
         assert out["usage"]["prompt_tokens"] + out["usage"]["completion_tokens"] <= 64
         assert out["usage"]["completion_tokens"] >= 1
@@ -349,7 +350,13 @@ def test_two_process_slice_serves_sse_through_core():
             if line.startswith("HTTP READY"):
                 port = int(line.split()[2])
                 break
+            if "Multiprocess computations aren't implemented" in line:
+                break  # XLA:CPU cannot run 2-process GSPMD at all
             assert leader.poll() is None, "leader died:\n" + "".join(lines)
+        if any("Multiprocess computations aren't implemented" in l
+               for l in lines):
+            pytest.skip("platform cannot run 2-process GSPMD "
+                        "(CPU backend limit)")
         assert port is not None, "".join(lines)
         base = f"http://127.0.0.1:{port}"
 
