@@ -134,7 +134,9 @@ def main() -> None:
             # peers' routers can pull prefixes from this process.
             eng = next(iter(gen_engines.values()))
             grpc_server.enable_kv_transfer(
-                eng.migrate_import_stream, prefix_export=server.prefix_export
+                eng.migrate_import_stream,
+                prefix_export=server.prefix_export,
+                prefix_export_hash=server.prefix_export_hash,
             )
             server.transfer_addr = server.transfer_addr or cfg.grpc_addr
         grpc_server.start(f"{ghost or '0.0.0.0'}:{gport or 9090}")

@@ -17,6 +17,7 @@ Route inventory (reference server.go:32-62 ↔ here):
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
@@ -379,9 +380,125 @@ class CoreServer:
                 return payload
         return None
 
+    def prefix_export_hash(self, hash16: str) -> bytes | None:
+        """Hash-keyed PrefixFetch callback (boot-time peer warm-fill): the
+        requester knows only the fleet digest's head hashes, not the token
+        ids behind them — first local engine holding a resident chain whose
+        digest head hash matches wins."""
+        for e in self.gen_engines.values():
+            fn = getattr(e, "prefix_export_by_hash", None)
+            if fn is None:
+                continue
+            payload = fn(hash16)
+            if payload is not None:
+                return payload
+        return None
+
     def route_prefix_stats(self) -> dict[str, float]:
         with self._route_prefix_lock:
             return dict(self._route_prefix)
+
+    # -- cold start (executor/warmup.py; doc/performance.md) ---------------
+
+    def boot_warmup(self) -> None:
+        """Kick every local gen engine's warmup planner: the critical
+        prefix (one admit bucket + one prefill executable + one decode
+        shape) compiles synchronously here — start() calls this before
+        device registration, so the first request never pays a cold XLA
+        compile and the first advertisement already carries the warming
+        tag — and the rest of the shape zoo fills in on the planner's
+        background thread while serving."""
+        priors = self._warmup_pack_priors()
+        for e in self.gen_engines.values():
+            fn = getattr(e, "start_warmup", None)
+            if fn is None:
+                continue
+            try:
+                fn(priors=priors)
+            except Exception:
+                log.exception("warmup planner failed to start")
+
+    @staticmethod
+    def _warmup_pack_priors() -> list[dict] | None:
+        """Measured compile costs shipped with the compile cache: a warmup
+        pack import (scripts/warmup_pack.py) drops warmup_plan.json next to
+        the cache entries, and boot auto-loads it so the plan order
+        reflects the exporting fleet's cost × hit aggregates even on a
+        process with an empty local ledger."""
+        from ..utils import config as ucfg
+
+        cache_dir = ucfg.compile_cache_dir or ucfg.compile_cache_path()
+        if not cache_dir:
+            return None
+        try:
+            with open(os.path.join(cache_dir, "warmup_plan.json")) as f:
+                rows = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return rows if isinstance(rows, list) else None
+
+    def boot_prefix_warm(self, peers: int | None = None) -> int:
+        """Peer warm-fill: pull the fleet's hottest resident prefix chains
+        at boot. Head hashes are ranked by popularity across online peer
+        devices' prefix_digest tags (peers holding the chain, then chain
+        length), the top TPU_BOOT_PREFILL_PEERS of them are pulled through
+        the hash-keyed PrefixFetch RPC, and the payloads import into the
+        local engines — a joining engine serves its first shared-prefix
+        request from fetched blocks instead of recomputing them. 0 (the
+        default) disables. Returns the number of chains imported."""
+        if peers is None:
+            try:
+                peers = int(os.environ.get("TPU_BOOT_PREFILL_PEERS", "0") or 0)
+            except ValueError:
+                peers = 0
+        if peers <= 0 or not self.gen_engines:
+            return 0
+        heads: dict[str, dict[str, Any]] = {}
+        for dev in self.catalog.list_devices(online_only=True):
+            if str(dev.get("id")) == self.device_id:
+                continue
+            dig = (dev.get("tags") or {}).get("prefix_digest") or {}
+            for h, toks in (dig.get("heads") or {}).items():
+                ent = heads.setdefault(str(h), {"count": 0, "tokens": 0, "devs": []})
+                ent["count"] += 1
+                try:
+                    ent["tokens"] = max(ent["tokens"], int(toks or 0))
+                except (TypeError, ValueError):
+                    pass
+                ent["devs"].append(dev)
+        ranked = sorted(
+            heads.items(), key=lambda kv: (-kv[1]["count"], -kv[1]["tokens"], kv[0])
+        )
+        imported = 0
+        for h, ent in ranked[: int(peers)]:
+            payload = None
+            for dev in ent["devs"]:
+                src = self._prefix_source_for(dev)
+                fetch = getattr(src, "prefix_fetch_hash", None)
+                if fetch is None:
+                    continue
+                try:
+                    payload = fetch(h)
+                except ConnectionError as e:
+                    log.warning(
+                        "boot prefix fetch from %s failed: %s", dev.get("id"), e
+                    )
+                    payload = None
+                if payload:
+                    break
+            if not payload:
+                continue
+            for e in self.gen_engines.values():
+                imp = getattr(e, "prefix_import", None)
+                try:
+                    if imp is not None and imp(payload):
+                        imported += 1
+                        break
+                except Exception:
+                    log.exception("boot prefix import failed")
+        if imported:
+            log.info("boot prefix warm-fill: imported %d chain(s)", imported)
+        return imported
 
     # -- local engine device registration ----------------------------------
 
@@ -418,6 +535,16 @@ class CoreServer:
             # candidates (routing/router.py banding): a saturated device
             # that can drain itself recovers faster than one that sheds
             tags["migration"] = True
+        if any(
+            getattr(e, "warmup_stats", None) is not None
+            and e.warmup_stats().get("state") != "fully_warm"
+            for e in self.gen_engines.values()
+        ):
+            # warmup planner still compiling (executor/warmup.py): the
+            # device serves, but router banding ranks it behind fully-warm
+            # peers until its background compiles drain — a request routed
+            # here may still hit an XLA compile stall.
+            tags["warming"] = True
         # Prefix-locality routing inputs (routing/prefix.py + router.py):
         # the resident-chain digest, the live admission-queue depth, and
         # the measured prefill cost — refreshed on every discovery tick.
@@ -771,6 +898,7 @@ class CoreServer:
         r("POST", "/v1/debug/test", self.dashboard.handle_smoke_test)
         r("GET", "/v1/debug/flight", self.handle_debug_flight)
         r("GET", "/v1/debug/compiles", self.handle_debug_compiles)
+        r("GET", "/v1/debug/warmup", self.handle_debug_warmup)
         r("GET", "/v1/debug/perf", self.handle_debug_perf)
         r("GET", "/v1/debug/workload", self.handle_debug_workload)
         r("GET", "/v1/debug/latency", self.handle_debug_latency)
@@ -791,7 +919,17 @@ class CoreServer:
         # Executor identity fields feed peer discovery: probes read platform/
         # chips/hbm_gb to tag the device and derive its limits (the analog of
         # the reference deriving limits from reported RAM, limits.go:124-160).
-        resp.write_json({"status": "ok", "service": "llm-mcp-tpu", **self._device_identity()})
+        # The prefix tier's dynamic fields ride along so HTTP-discovered
+        # peers can score prefix locality and boot-warm from this device
+        # (discovery copies them into the catalog tags): the resident-chain
+        # digest and the gRPC address PrefixFetch answers on.
+        body = {"status": "ok", "service": "llm-mcp-tpu", **self._device_identity()}
+        digest = self._prefix_digest_tag()
+        if digest:
+            body["prefix_digest"] = digest
+        if self.transfer_addr:
+            body["transfer_addr"] = self.transfer_addr
+        resp.write_json(body)
 
     def _device_identity(self) -> dict[str, Any]:
         # Platform/chips/HBM are static for the life of the process, and
@@ -893,6 +1031,22 @@ class CoreServer:
                 "stats": led.stats(),
                 "table": led.table(),
                 "entries": led.entries(limit=limit),
+            }
+        )
+
+    def handle_debug_warmup(self, req: Request, resp: Response) -> None:
+        """Warmup readiness per engine (executor/warmup.py): planner state
+        (cold / first_token_ready / fully_warm), per-step plan status, and
+        background-compile progress — plus the boot-time peer warm-fill
+        outcome."""
+        resp.write_json(
+            {
+                "engines": {
+                    name: e.warmup_stats()
+                    for name, e in self.gen_engines.items()
+                    if getattr(e, "warmup_stats", None) is not None
+                },
+                "boot_prefix_imported": getattr(self, "_boot_prefix_imported", 0),
             }
         )
 
@@ -1180,8 +1334,17 @@ class CoreServer:
             if 0 < p < 65536 and p not in ports:
                 ports.append(p)
         self.discovery.ports = ports
+        # Cold-start path (doc/performance.md "Cold start & warmup"):
+        # critical-prefix AOT compiles run synchronously before the device
+        # registers — no request can route here and hit a cold compile —
+        # then registration advertises `warming` while the background zoo
+        # fills in, then peer warm-fill pulls the fleet's hottest prefix
+        # chains so the first shared-prefix request decodes from fetched
+        # blocks. TPU_WARMUP=0 / TPU_BOOT_PREFILL_PEERS=0 skip each leg.
+        self.boot_warmup()
         # register AFTER the addr is known so peers can proxy to us
         self.register_local_device()
+        self._boot_prefix_imported = self.boot_prefix_warm()
         self.limits.apply_specs()
         if self.migration is not None:
             self.migration.start()

@@ -224,8 +224,12 @@ class Runner:
             "latency_ms": probe.latency_ms,
             "probes": probe.probes,
         }
-        # Surface executor identity from /health (chips, platform, hbm).
-        for key in ("platform", "chips", "hbm_gb", "service"):
+        # Surface executor identity from /health (chips, platform, hbm),
+        # plus the prefix tier's dynamic fields: the peer's resident-chain
+        # digest (route-time locality scoring + boot warm-fill ranking)
+        # and its PrefixFetch gRPC address.
+        for key in ("platform", "chips", "hbm_gb", "service",
+                    "prefix_digest", "transfer_addr"):
             if key in probe.info:
                 tags[key] = probe.info[key]
         self.catalog.upsert_device(

@@ -1428,6 +1428,12 @@ class GenerationEngine:
         # during that window is detected one timeout later.
         self._seen_exec_shapes: set[tuple] = set()
         self._compile_grace_until = 0.0
+        # Warmup planner (executor/warmup.py): built by start_warmup() at
+        # boot (serving entrypoints / bench coldstart), None on the plain
+        # test path and under TPU_WARMUP=0 — readiness then reads as
+        # fully_warm (an unwarmed engine is not "warming", it is simply
+        # pre-warmup-era cold, and must route exactly as before).
+        self._warmup = None
         if self.stall_timeout_s > 0:
             threading.Thread(
                 target=self._watchdog, name="engine-watchdog", daemon=True
@@ -2124,6 +2130,10 @@ class GenerationEngine:
     def shutdown(self) -> None:
         self._stop_evt.set()
         self._wake.set()
+        if self._warmup is not None:
+            # stop the background AOT thread first: a compile in flight
+            # holds jit internals the teardown below must not race
+            self._warmup.stop()
         if self._thread:
             self._thread.join(timeout=10)
             self._thread = None
@@ -2150,6 +2160,236 @@ class GenerationEngine:
                 break
             s.req.out.put({"type": "error", "error": "engine shutdown"})
             s.req.out.put(_DONE)
+
+    # -- warmup (executor/warmup.py; ROADMAP item 5) -----------------------
+
+    def warmup_shape_zoo(self) -> list[tuple[str, tuple]]:
+        """The engine's serving-shape zoo: the (phase, shape key) pairs its
+        config can dispatch, in `_note_exec_shape`'s own vocabulary — the
+        same keys the CompileLedger aggregates, so an imported warmup plan
+        (prior boots' measurements) matches these entries by string.
+
+        Enumeration is DELIBERATELY first-hit-bounded, not exhaustive:
+        admit and decode ladders are small and fully listed; chunked
+        prefill lists only the zero-context skey (every boot's first long
+        prompt — later skeys depend on live context lengths and ride the
+        ledger priors instead); fused/verify depend on the live fill mix
+        and never enumerate from config (warmup.py PLANNABLE_PHASES)."""
+        zoo: list[tuple[str, tuple]] = []
+        phys = self._phys is not None
+        S = self.max_seq_len
+        buckets: list[int] = []
+        n = 1
+        while True:
+            b = self._bucket(n)
+            if not buckets or b > buckets[-1]:
+                buckets.append(b)
+            if b >= S:
+                break
+            n = b + 1
+        ab_cap = 1 << max(0, self.admit_batch - 1).bit_length()
+        ab = 1
+        while ab <= ab_cap:
+            for bk in buckets:
+                zoo.append(("admit", (ab, bk)))
+            ab <<= 1
+        B = self.max_slots
+        if self.decode_compact:
+            ba = min(8, B)
+            while ba < B:
+                zoo.append(("decode", (ba, True, phys)))
+                ba <<= 1
+        zoo.append(("decode", (B, False, phys)))
+        if self.ragged_prefill and self._ragged_cap:
+            skey0 = 0 if self._ragged_impl == "kernel" else min(128, S)
+            t = min(32, self._ragged_cap)
+            while t <= self._ragged_cap:
+                zoo.append(("pf_rag", (t, skey0, phys)))
+                t <<= 1
+        elif self.prefill_chunk > 0:
+            skey0 = min(128, S)
+            cap = self._bucket(self.prefill_chunk)
+            rows = 1
+            while rows <= ab_cap:
+                for bk in [b for b in buckets if b <= cap]:
+                    zoo.append(("chunk", (rows, bk, skey0, phys)))
+                rows <<= 1
+        return zoo
+
+    @staticmethod
+    def parse_ledger_key(ks: str) -> tuple:
+        """Invert `_compile_obs`'s colon-joined key encoding back into a
+        typed tuple — shape keys only ever carry ints and bools (the
+        dispatch-surface lint pins the vocabulary), so the round-trip is
+        exact for every real ledger row."""
+        out: list = []
+        for part in ks.split(":"):
+            if part == "True":
+                out.append(True)
+            elif part == "False":
+                out.append(False)
+            else:
+                try:
+                    out.append(int(part))
+                except ValueError:
+                    out.append(part)
+        return tuple(out)
+
+    def _warmup_key_fits(self, phase: str, key: tuple) -> bool:
+        """Whether a plan step's shape key is dispatchable by THIS engine's
+        config. The compile ledger is process-shared and warmup packs ship
+        between hosts, so priors can carry shapes from other configs — an
+        admit bucket beyond max_seq_len fails to lower (the cache operand
+        is too small), a decode batch beyond max_slots was never built.
+        Out-of-config keys record skip, like the phys-flag mismatches."""
+        try:
+            cap = self._bucket(self.max_seq_len)
+            ab_cap = 1 << max(0, self.admit_batch - 1).bit_length()
+            if phase == "admit":
+                ab, bucket = int(key[0]), int(key[1])
+                return (1 <= ab <= ab_cap and 0 < bucket <= cap
+                        and self._bucket(bucket) == bucket)
+            if phase == "decode":
+                return 1 <= int(key[0]) <= self.max_slots
+            if phase == "chunk":
+                rows, bucket, skey = int(key[0]), int(key[1]), int(key[2])
+                return (self.prefill_chunk > 0 and 1 <= rows <= ab_cap
+                        and 0 < bucket <= cap and 0 <= skey <= cap)
+            if phase == "pf_rag":
+                t, skey = int(key[0]), int(key[1])
+                return (bool(self.ragged_prefill and self._ragged_cap)
+                        and 1 <= t <= self._ragged_cap and 0 <= skey <= cap)
+            return True
+        except (TypeError, ValueError, IndexError):
+            return False
+
+    def warmup_compile(self, phase: str, key: tuple) -> float | None:
+        """AOT-compile one executable shape via jit lower().compile() —
+        the warmup planner's compile hook. This populates the persistent
+        XLA compile cache (TPU_COMPILE_CACHE), NOT jit's dispatch cache:
+        the first real dispatch of the shape still traces, then
+        deserializes the cached executable in well under TPU_COMPILE_HIT_S
+        instead of paying the 1-2 min XLA compile. Returns the compile
+        wall, or None for phases whose argument shapes cannot be
+        synthesized from the key alone (fused/verify/restore — they
+        compile on first real dispatch, exactly as before warmup).
+
+        ShapeDtypeStruct mirrors of the live params/cache/sampling arrays
+        carry their committed shardings so the lowered module (and its
+        cache key) matches what the serve path will build."""
+        if phase not in ("admit", "chunk", "decode", "pf_rag"):
+            return None
+        if not self._warmup_key_fits(phase, key):
+            return None  # stale prior from a different engine config
+
+        def sds(tree):
+            return jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+                ),
+                tree,
+            )
+
+        def host(shape, dtype=jnp.int32):
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+        paged = None
+        if self._phys is not None:
+            paged = sds(self._paged_from(self._paged_payload()))
+        t0 = time.perf_counter()
+        P, CK, CV = sds(self.params), sds(self._ck), sds(self._cv)
+        if phase == "admit":
+            ab, bucket = int(key[0]), int(key[1])
+            self._admit_fn.lower(
+                P, CK, CV, sds(self._d_temp), sds(self._d_topk),
+                sds(self._d_topp), sds(self._d_last_tok),
+                host((ab, bucket)), host((3 * ab + 2,)),
+                host((2 * ab,), jnp.float32),
+            ).compile()
+        elif phase == "decode":
+            ba, compact = int(key[0]), bool(key[1])
+            if bool(key[2]) != (self._phys is not None):
+                return None  # stale prior from a different pool config
+            packed = host(((2 * ba + 1,) if compact else (self.max_slots + 1,)))
+            self._decode_fn.lower(
+                P, CK, CV, packed, sds(self._d_temp), sds(self._d_topk),
+                sds(self._d_topp), sds(self._d_last_tok),
+                compact=compact, paged=paged,
+            ).compile()
+        elif phase == "chunk":
+            rows, bucket, skey = int(key[0]), int(key[1]), int(key[2])
+            if bool(key[3]) != (self._phys is not None):
+                return None
+            self._prefill_chunk_fn.lower(
+                P, CK, CV, host((rows, bucket)), host((rows,)),
+                host((rows,)), host((rows,)), skey=skey, paged=paged,
+            ).compile()
+        else:  # pf_rag
+            t, skey = int(key[0]), int(key[1])
+            if bool(key[2]) != (self._phys is not None):
+                return None
+            rows = max(1, self.admit_batch)
+            self._ragged_chunk_fn.lower(
+                P, CK, CV, host((t,)), host((t,)), host((t,)),
+                host((rows,)), host((rows,)), host((rows,)),
+                skey=skey, paged=paged,
+            ).compile()
+        wall = time.perf_counter() - t0
+        self._compile_obs(phase, key, wall, src="warmup")
+        return wall
+
+    def start_warmup(self, priors: list[dict] | None = None):
+        """Build and run the warmup plan (TPU_WARMUP=0: a TRUE no-op —
+        returns None, no planner, no compiles, greedy output is
+        token-identical either way). The critical first-token prefix (one
+        admit bucket + one prefill executable + one decode shape) compiles
+        SYNCHRONOUSLY before this returns; the rest of the zoo compiles on
+        a low-priority background thread while the engine serves
+        (TPU_WARMUP_BG=0 skips it). `priors` takes CompileLedger table
+        rows — the live ledger's, or an imported warmup pack's — to order
+        the plan by measured compile cost x hit count. Idempotent."""
+        from . import warmup as warmup_mod
+
+        if not warmup_mod.warmup_enabled():
+            return None
+        if self._warmup is not None:
+            return self._warmup
+        rows = list(priors or [])
+        rows.extend(self._ledger.table())
+        prior_idx = warmup_mod.priors_from_table(rows)
+        zoo = self.warmup_shape_zoo()
+        for (ph, ks) in list(prior_idx):
+            # measured shapes from prior boots join the zoo with exact
+            # typed keys; unplannable phases ride along and record as skip
+            key = self.parse_ledger_key(ks)
+            if (ph, key) not in zoo:
+                zoo.append((ph, key))
+        steps = warmup_mod.plan_steps(zoo, prior_idx)
+        self._warmup = warmup_mod.WarmupPlanner(
+            self.warmup_compile, steps,
+            throttle_s=float(os.environ.get("TPU_WARMUP_THROTTLE_S", "0.05") or 0),
+            event=self._flight.event,
+        )
+        self._warmup.run_critical()
+        if warmup_mod.warmup_bg_enabled():
+            self._warmup.start_background()
+        else:
+            for s in self._warmup.steps:
+                if s.status == "pending":
+                    s.status = "skip"
+            self._warmup.start_background()  # immediate fully_warm
+        return self._warmup
+
+    def warmup_stats(self) -> dict[str, Any]:
+        """Readiness + plan progress for /v1/debug/warmup and the router's
+        warming tag. No planner (warmup off / plain test boot) reads as
+        fully_warm with zero steps: an unwarmed engine routes exactly as
+        the pre-warmup era."""
+        if self._warmup is None:
+            return {"state": "fully_warm", "steps": 0, "enabled": False}
+        st = self._warmup.stats()
+        st["enabled"] = True
+        return st
 
     # -- public API --------------------------------------------------------
 
@@ -2520,11 +2760,15 @@ class GenerationEngine:
             )
         self._flight.event("watchdog", state=state)
 
-    def _compile_obs(self, phase: str, key: tuple, wall_s: float) -> None:
+    def _compile_obs(self, phase: str, key: tuple, wall_s: float,
+                     src: str = "serve") -> None:
         """First dispatch of an executable shape → compile ledger entry +
-        recorder event (the ROADMAP item-5 cold-start measurement)."""
+        recorder event (the ROADMAP item-5 cold-start measurement).
+        `src` is provenance: "serve" for real dispatches, "warmup" for the
+        planner's AOT compiles — /v1/debug/compiles shows whether the
+        serve path ever ate a cold compile warmup should have absorbed."""
         ks = ":".join(str(p) for p in key)
-        e = self._ledger.observe(phase, ks, wall_s)
+        e = self._ledger.observe(phase, ks, wall_s, src=src)
         self._flight.event(
             "compile", phase=phase, key=ks,
             wall_ms=round(wall_s * 1e3, 1), hit=e["hit"],
@@ -4071,6 +4315,26 @@ class GenerationEngine:
         if not ev.wait(timeout_s):
             return None
         return box.get("payload")
+
+    def prefix_export_by_hash(self, hash16: str, timeout_s: float = 30.0) -> bytes | None:
+        """Resolve a digest head hash (routing/prefix.py chain_hashes) back
+        to the resident chain's token ids and export it — the boot
+        warm-fill path: a joining node learns the fleet's hottest chains
+        only as digest hashes from discovery tags, never the ids behind
+        them, so the ids must be recovered on the side that HAS them."""
+        if not self._prefix_budget:
+            return None
+        want = str(hash16 or "").strip().lower()
+        if not want:
+            return None
+        bt = self._paging.block_tokens
+        with self._prefix_pub_lock:
+            chains = list(self._prefix_pub.items())
+        for key, n in sorted(chains, key=lambda kv: -kv[1]):
+            bounds = prefix_fp.chain_hashes(list(key), bt)
+            if bounds and bounds[-1][1] == want:
+                return self.prefix_export(list(key), timeout_s=timeout_s)
+        return None
 
     def prefix_import(self, payload: bytes, timeout_s: float = 30.0) -> bool:
         """Adopt a peer's exported prefix chain into the local cache (the

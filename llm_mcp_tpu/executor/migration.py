@@ -300,6 +300,22 @@ class MigrationCoordinator:
         self._remote[name] = target
         self.roles[name] = role
 
+    def add_engine(self, name: str, eng: Any, role: str = "both") -> None:
+        """Elastic join: register a full local engine mid-flight. The next
+        tick sees it as both drain target and (if saturated) drain source —
+        a freshly warmed engine joining a shedding fleet starts absorbing
+        the backlog within one interval, no coordinator restart."""
+        if role not in ROLES:
+            raise ValueError(f"unknown role {role!r}; expected one of {ROLES}")
+        with self._lock:
+            # swap, don't mutate: tick() iterates self.engines lock-free,
+            # and in-place insertion mid-iteration would raise
+            self.engines = {**self.engines, name: eng}
+            self.roles = {**self.roles, name: role}
+            if role == "prefill" and getattr(eng, "_migrate_outbox", None) is not None:
+                eng.migrate_after_prefill = True
+        self._pressure.set()  # drain toward the newcomer now, not next tick
+
     def note_pressure(self) -> None:
         """Admission-path hook: a shed decision (429) kicks the next tick
         into draining immediately instead of waiting out the interval."""

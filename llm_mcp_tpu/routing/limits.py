@@ -106,6 +106,18 @@ def device_migration(tags: dict | None) -> bool:
     return bool((tags or {}).get("migration", False))
 
 
+def device_warming(tags: dict | None) -> bool:
+    """Whether the device is still compiling its executable zoo (the
+    `warming` tag: any local engine's warmup readiness below fully_warm —
+    server.register_local_device). A warming device SERVES — its critical
+    first-token prefix compiled synchronously at boot — but a never-seen
+    shape can still eat a cold XLA compile, so the router ranks it behind
+    fully-warm healthy peers instead of letting fresh traffic discover
+    the remaining cold shapes the hard way. Devices without the tag
+    (pre-warmup executors, warmup off) read as not warming."""
+    return bool((tags or {}).get("warming", False))
+
+
 def device_prefix_digest(tags: dict | None, now: float | None = None) -> dict | None:
     """The device's advertised prefix-chain digest (routing/prefix.py
     build_digest shape), or None when absent or stale — a stale digest

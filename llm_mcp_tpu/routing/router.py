@@ -33,6 +33,7 @@ from .limits import (
     device_prefill_cost,
     device_prefix_digest,
     device_queue_depth,
+    device_warming,
 )
 from .prefix import match_digest, prefix_route_enabled, request_hashes_for
 
@@ -258,12 +259,19 @@ class Router:
         hash_memo: dict[int, list] = {}
         scores: dict[str, tuple[float, int, bool]] = {}
 
-        def _band(r) -> tuple[bool, bool, float]:
+        # A WARMING device (warmup readiness below fully_warm) ranks behind
+        # fully-warm healthy peers but ahead of the saturated bands: it
+        # serves fine on its compiled critical prefix, yet a fresh shape
+        # can still eat a cold XLA compile — reduced capacity, not zero.
+        def _band(r) -> tuple[bool, bool, bool, float]:
             tags = Database.from_json(r["tags"], {})
             saturated = device_headroom(tags) <= 0.0
             sc = self._prefix_score(tags, px_ids, hash_memo) if px_ids else (0.0, 0, False)
             scores[r["id"]] = sc
-            return (saturated and not device_migration(tags), saturated, -sc[0])
+            return (
+                saturated and not device_migration(tags), saturated,
+                device_warming(tags), -sc[0],
+            )
 
         rows = sorted(rows, key=_band)
         for r in rows:

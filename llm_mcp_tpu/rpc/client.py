@@ -297,6 +297,24 @@ class GrpcTransferClient:
                 return None
             raise ConnectionError(f"grpc {e.code().name}: {e.details()}") from e
 
+    def prefix_fetch_hash(
+        self, hash16: str, *, timeout_s: float | None = None
+    ) -> bytes | None:
+        """Pull the peer's resident chain whose digest head hash matches
+        `hash16` (boot-time peer warm-fill: the joining engine knows the
+        fleet's hottest head hashes from discovery tags, not the token ids
+        behind them). Same miss/failure semantics as prefix_fetch."""
+        try:
+            return self._prefix_fetch(
+                json.dumps({"hash16": str(hash16)}).encode(),
+                timeout=timeout_s if timeout_s is not None else self.timeout_s,
+                metadata=GrpcCoreClient._trace_metadata(),
+            )
+        except grpc.RpcError as e:
+            if e.code() in (grpc.StatusCode.NOT_FOUND, grpc.StatusCode.UNIMPLEMENTED):
+                return None
+            raise ConnectionError(f"grpc {e.code().name}: {e.details()}") from e
+
 
 class RemoteMigrationTarget:
     """Duck-typed migration target for MigrationCoordinator.add_remote: a

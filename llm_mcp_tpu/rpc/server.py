@@ -90,13 +90,21 @@ class GrpcCoreServer:
         self,
         import_stream: Callable[[bytes], Any],
         prefix_export: Callable[[list[int]], bytes | None] | None = None,
+        prefix_export_hash: Callable[[str], bytes | None] | None = None,
     ) -> None:
         """Register the KV transfer service on this server — must run
         before start() (gRPC handlers are fixed at server start).
         `prefix_export` additionally serves the PrefixFetch RPC (the
-        fleet prefix tier's source side)."""
+        fleet prefix tier's source side); `prefix_export_hash` extends it
+        to digest-head lookups (boot-time peer warm-fill)."""
         self._server.add_generic_rpc_handlers(
-            (KVTransferService(import_stream, prefix_export=prefix_export).handler(),)
+            (
+                KVTransferService(
+                    import_stream,
+                    prefix_export=prefix_export,
+                    prefix_export_hash=prefix_export_hash,
+                ).handler(),
+            )
         )
 
     # -- service wiring (hand-rolled: no grpc_tools plugin in the env) -----
@@ -379,13 +387,19 @@ class KVTransferService:
         self,
         import_stream: Callable[[bytes], Any],
         prefix_export: Callable[[list[int]], bytes | None] | None = None,
+        prefix_export_hash: Callable[[str], bytes | None] | None = None,
     ):
         # import_stream: engine.migrate_import_stream — payload in, iterator
         # of event dicts out (raises on a payload this engine cannot run)
         # prefix_export: engine.prefix_export — prompt token ids in, wire
         # payload of the longest resident chain out (None on miss)
+        # prefix_export_hash: engine.prefix_export_by_hash — digest head
+        # hash (16 hex chars) in, whole-chain wire payload out (None on
+        # miss). Serves boot-time peer warm-fill, where the requester knows
+        # only the fleet digest's head hashes, not the token ids behind them.
         self._import_stream = import_stream
         self._prefix_export = prefix_export
+        self._prefix_export_hash = prefix_export_hash
         self._server: grpc.Server | None = None
         self.port = 0
 
@@ -410,26 +424,30 @@ class KVTransferService:
                     yield json.dumps(evt).encode()
 
         def prefix_fetch(request: bytes, ctx) -> bytes:
-            # request: JSON {"ids": [prompt token ids]} — response: the raw
+            # request: JSON {"ids": [prompt token ids]} or
+            # {"hash16": "<digest head hash>"} — response: the raw
             # migration-codec payload of this engine's longest resident
-            # chain prefixing those ids. NOT_FOUND on miss keeps the
-            # requester's recompute path cheap (no payload decode).
-            if self._prefix_export is None:
-                ctx.abort(grpc.StatusCode.UNIMPLEMENTED, "prefix tier disabled")
+            # chain prefixing those ids (resp. the whole chain whose head
+            # hash matches). NOT_FOUND on miss keeps the requester's
+            # recompute path cheap (no payload decode).
             try:
-                ids = [int(x) for x in json.loads(request.decode())["ids"]]
+                req = json.loads(request.decode())
+                hash16 = str(req["hash16"]) if "hash16" in req else None
+                ids = None if hash16 else [int(x) for x in req["ids"]]
             except (ValueError, KeyError, UnicodeDecodeError) as e:
                 ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad prefix request: {e}")
+            export = self._prefix_export_hash if hash16 else self._prefix_export
+            if export is None:
+                ctx.abort(grpc.StatusCode.UNIMPLEMENTED, "prefix tier disabled")
             tp = GrpcCoreServer._traceparent(ctx)
+            attrs = {"hash": hash16} if hash16 else {"tokens": len(ids)}
             span = (
-                tracing.get_tracer().span(
-                    "rpc.PrefixFetch", parent=tp, attrs={"tokens": len(ids)}
-                )
+                tracing.get_tracer().span("rpc.PrefixFetch", parent=tp, attrs=attrs)
                 if tp
                 else nullcontext()
             )
             with span:
-                payload = self._prefix_export(ids)
+                payload = export(hash16 if hash16 else ids)
             if payload is None:
                 ctx.abort(grpc.StatusCode.NOT_FOUND, "no resident prefix")
             return payload

@@ -61,6 +61,7 @@ __all__ = [
     "DISPATCH_PHASES",
     "ModelShape",
     "PerfObservatory",
+    "WARMUP_PHASES",
     "decode_flops_per_token",
     "decode_hbm_bytes_per_token",
     "kv_bytes_per_token",
@@ -79,6 +80,13 @@ DISPATCH_PHASES = (
 # prefix-tier import path, preemption restore) with no steady-state
 # cadence worth sampling — the ledger's first-dispatch wall is the story.
 AUX_COMPILE_PHASES = ("cow", "pool_put", "pool_put_host", "restore")
+# Warmup-plannable subset of the dispatch surface (executor/warmup.py):
+# phases whose jit argument shapes are a pure function of the engine's
+# config (so an AOT lower().compile() can be synthesized from a shape key
+# alone, without live traffic). fused/fused_rag/verify depend on the live
+# fill mix and speculation state — the planner lists their ledger-observed
+# keys but marks them unplannable (they compile on first real dispatch).
+WARMUP_PHASES = ("admit", "chunk", "decode", "pf_rag")
 
 CACHE_LAYOUTS = ("gqa_bf16", "gqa_int8", "mla_bf16", "mla_int8")
 

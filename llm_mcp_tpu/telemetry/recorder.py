@@ -38,7 +38,10 @@ An *event* is a tuple ``(seq, ts, etype, trace_id, fields)``:
             capture: one record per finished admitted request —
             telemetry/workload.py) / wf (latency-waterfall stage marks:
             per-request admit_wait/shed/prefill_queue/prefill_compute/
-            decode/stall/preempt milliseconds)
+            decode/stall/preempt milliseconds) / wu (one warmup-planner
+            AOT compile: phase, key, wall, outcome) / warmup (readiness
+            state transition: cold / first_token_ready / fully_warm —
+            executor/warmup.py)
   trace_id  the request's 32-hex trace id ("" for engine-global events) —
             a dump stitches directly into /v1/traces
   fields    flat dict of scalars (or None)
@@ -586,7 +589,12 @@ class CompileLedger:
         self._total_s = 0.0
 
     def observe(self, phase: str, key: str, wall_s: float,
-                hit: bool | None = None) -> dict[str, Any]:
+                hit: bool | None = None, src: str = "serve") -> dict[str, Any]:
+        """`src` is provenance: which path paid (or skipped) this compile —
+        "serve" (first real dispatch), "warmup" (AOT warmup planner), or
+        "import" (a warmup-pack plan entry adopted without compiling).
+        Per-entry so /v1/debug/compiles can show whether the serve path
+        ever ate a cold compile that warmup should have absorbed."""
         if hit is None:
             hit = wall_s < self.hit_threshold_s
         entry = {
@@ -595,6 +603,7 @@ class CompileLedger:
             "key": key,
             "wall_s": round(float(wall_s), 6),
             "hit": bool(hit),
+            "src": str(src),
         }
         with self._lock:
             self._entries.append(entry)
@@ -605,11 +614,14 @@ class CompileLedger:
                 self._by_key[key] = agg = {
                     "key": key, "phase": phase, "count": 0,
                     "hits": 0, "misses": 0, "total_s": 0.0, "max_s": 0.0,
+                    "by_src": {},
                 }
             agg["count"] += 1
             agg["hits" if hit else "misses"] += 1
             agg["total_s"] = round(agg["total_s"] + wall_s, 6)
-            agg["max_s"] = round(max(agg["max_s"], wall_s), 6)
+            agg["max_s"] = round(max(agg["max_s"], float(wall_s)), 6)
+            agg.setdefault("by_src", {})
+            agg["by_src"][entry["src"]] = agg["by_src"].get(entry["src"], 0) + 1
         return entry
 
     def table(self) -> list[dict[str, Any]]:
@@ -637,12 +649,17 @@ class CompileLedger:
             hits = sum(1 for e in self._entries if e["hit"])
             shapes = len(self._by_key)
             total = self._total_s
+            by_src: dict[str, int] = {}
+            for e in self._entries:
+                s = e.get("src", "serve")
+                by_src[s] = by_src.get(s, 0) + 1
         return {
             "entries": n,
             "hits": hits,
             "misses": n - hits,
             "shapes": shapes,
             "total_s": round(total, 6),
+            "by_src": by_src,
         }
 
 

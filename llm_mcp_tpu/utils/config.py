@@ -198,28 +198,69 @@ class Config:
             )
 
 
-def enable_compile_cache(path: str | None = None) -> None:
-    """Persistent XLA compile cache (serving entrypoints + bench): first 8B
-    compiles cost 1-2 min each on a remote chip, and engine restarts would
-    otherwise re-pay the whole executable zoo (prompt buckets, compact
-    buckets, admit shapes).
+# enable_compile_cache outcomes, counted not raised: a bad cache dir must
+# never take a serving boot down (the engine runs fine, just cold), but the
+# failure has to be visible somewhere — warmup_stats()/bench read these.
+compile_cache_failures = 0
+compile_cache_dir: str | None = None
 
-    STRICTLY OPT-IN via JAX_COMPILATION_CACHE_DIR: measured on the CPU
-    backend, cached AOT executables can carry target-machine features the
-    loader host lacks (+prefer-no-scatter et al.) — XLA loads them anyway
-    with SIGILL warnings and a large slowdown. Only enable where you've
-    verified the backend round-trips its own cache."""
+
+def compile_cache_path() -> str:
+    """Resolve the ONE compile-cache knob. `TPU_COMPILE_CACHE` wins: a path
+    enables the cache there; `0`/`off`/`false` force-disables (even when
+    JAX_COMPILATION_CACHE_DIR is set — conftest vs production isolation);
+    unset falls through to the legacy `JAX_COMPILATION_CACHE_DIR`. Empty
+    return = disabled."""
+    knob = getenv("TPU_COMPILE_CACHE", "").strip()
+    if knob.lower() in ("0", "off", "false", "no"):
+        return ""
+    if knob:
+        return knob
+    return getenv("JAX_COMPILATION_CACHE_DIR", "")
+
+
+def enable_compile_cache(
+    path: str | None = None, min_compile_s: float = 1.0
+) -> str | None:
+    """Persistent XLA compile cache (serving entrypoints, bench, AND
+    tests/conftest.py — the one knobbed path): first 8B compiles cost 1-2
+    min each on a remote chip, and engine restarts would otherwise re-pay
+    the whole executable zoo (prompt buckets, compact buckets, admit
+    shapes). The warmup planner's background AOT compiles land here too,
+    which is what makes them stick for the next boot (warmup_pack.py).
+
+    STRICTLY OPT-IN via TPU_COMPILE_CACHE (fallback:
+    JAX_COMPILATION_CACHE_DIR): measured on the CPU backend, cached AOT
+    executables can carry target-machine features the loader host lacks
+    (+prefer-no-scatter et al.) — XLA loads them anyway with SIGILL
+    warnings and a large slowdown. Only enable where you've verified the
+    backend round-trips its own cache.
+
+    Failures COUNT (module counter `compile_cache_failures`), never raise:
+    an unwritable cache dir degrades to a cold boot, not a dead one.
+    Returns the active cache dir, or None when disabled/failed."""
     import logging as _logging
 
-    cache_dir = path if path is not None else getenv("JAX_COMPILATION_CACHE_DIR", "")
+    global compile_cache_failures, compile_cache_dir
+    cache_dir = path if path is not None else compile_cache_path()
     if not cache_dir:
-        return
+        return None
     # jax imports only on the enabled path — proxy-only workers deliberately
     # never import jax (worker/__main__.py lazy-imports inside its engines
     # branch), and this must stay a no-op for them
     import jax
     try:
+        os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:  # pragma: no cover — older jax
-        _logging.getLogger("config").debug("compile cache unavailable", exc_info=True)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", float(min_compile_s)
+        )
+    except Exception:  # noqa: BLE001 — counted, not raised (see docstring)
+        compile_cache_failures += 1
+        _logging.getLogger("config").warning(
+            "compile cache at %s unavailable (failure #%d)",
+            cache_dir, compile_cache_failures, exc_info=True,
+        )
+        return None
+    compile_cache_dir = cache_dir
+    return cache_dir

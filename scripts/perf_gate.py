@@ -142,7 +142,9 @@ LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms", "cow_copies_per_req",
                 "attn_us_per_cell", "attn_us_per_cell_paged",
                 "prefill_pad_waste_pct", "prefill_executables",
                 "itl_p95_ms", "waterfall_stall_p95_ms",
-                "waterfall_total_p95_ms")
+                "waterfall_total_p95_ms",
+                "coldstart_first_token_s", "coldstart_first_token_cold_s",
+                "coldstart_fully_warm_s")
 
 # absolute floors/ceilings applied regardless of baseline coverage (only
 # ever read with .get(): a floor for a metric the record lacks must skip,
@@ -250,6 +252,16 @@ ABS_MAX = {
     # wall; past 30 s the serve loop is wedged, not slow.
     "waterfall_stall_p95_ms": 2500.0,
     "waterfall_total_p95_ms": 30000.0,
+    # cold start (ISSUE 18 acceptance): boot-to-first-token in a fresh
+    # process. With a warm shipped compile cache (TPU_COMPILE_CACHE) the
+    # critical-prefix warmup deserializes executables instead of compiling
+    # them — over 10 s means the cache keyed wrong (recompiling) or the
+    # critical prefix grew past "one admit bucket + one prefill + one
+    # decode". The cold (empty-cache) leg pays real XLA compiles; 60 s
+    # ceilings a compile-queue pileup without flaking on one slow compile.
+    # Hosts that skip the coldstart sweep omit both keys → [SKIP]+warning.
+    "coldstart_first_token_s": 10.0,
+    "coldstart_first_token_cold_s": 60.0,
 }
 
 

@@ -25,12 +25,23 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent XLA compilation cache: engine tests rebuild the same tiny-model
 # executables dozens of times across files; dedupe the compiles within (and
 # across) suite runs. env-first so subprocess tests (distributed, slice,
-# worker) inherit the same cache.
+# worker) inherit the same cache; the jax.config.update side goes through
+# THE helper serving entrypoints use (utils/config.enable_compile_cache) —
+# one knobbed path, not a conftest fork of it.
 _cache_dir = os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR", "/tmp/llm_mcp_tpu_test_xla_cache")
+    "TPU_COMPILE_CACHE", "/tmp/llm_mcp_tpu_test_xla_cache")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+
+from llm_mcp_tpu.utils.config import enable_compile_cache  # noqa: E402
+
+enable_compile_cache(min_compile_s=0.2)
+
+# Serving boots warm up by default (CoreServer.start → boot_warmup); the
+# dozens of tests that start a CoreServer around a tiny engine must not
+# each pay the shape-zoo AOT sweep. Tests that exercise the planner
+# (test_warmup.py) opt back in per-test via monkeypatch.
+os.environ.setdefault("TPU_WARMUP", "0")
 
 import pytest  # noqa: E402
 

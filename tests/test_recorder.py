@@ -337,7 +337,7 @@ def test_compile_ledger_aggregates_and_hit_heuristic():
     st = led.stats()
     assert st == {
         "entries": 4, "hits": 2, "misses": 2, "shapes": 2,
-        "total_s": pytest.approx(7.31),
+        "total_s": pytest.approx(7.31), "by_src": {"serve": 4},
     }
     assert len(led.entries(limit=2)) == 2
 
