@@ -247,17 +247,26 @@ class RegistryCensusPass:
             ]
         doc = ast.get_docstring(rtree) or ""
         census = set(_IDENT_RE.findall(doc))
-        emitted = call_string_args(etree, ("event",))["event"]
+        # the zoo joins the engine as an etype emitter (swap_in/swap_out/
+        # zoo, executor/zoo.py) — its emissions face the same catalog
+        emitters = [(eng_rel, etree)]
+        zoo_rel = index.config.get("zoo_module", "")
+        if zoo_rel:
+            ztree = index.ast(zoo_rel)
+            if ztree is not None:
+                emitters.append((zoo_rel, ztree))
         findings: list[Finding] = []
-        for etype in sorted(emitted - census):
-            findings.append(
-                Finding(
-                    PASS_ID, eng_rel, 0, f"etype-uncensused:{etype}",
-                    f"engine emits flight etype {etype!r} absent from the "
-                    f"{rec_rel} docstring census — flight_dump.py renders "
-                    "from that catalog; add the etype there",
+        for mod_rel, mtree in emitters:
+            emitted = call_string_args(mtree, ("event",))["event"]
+            for etype in sorted(emitted - census):
+                findings.append(
+                    Finding(
+                        PASS_ID, mod_rel, 0, f"etype-uncensused:{etype}",
+                        f"{mod_rel} emits flight etype {etype!r} absent from "
+                        f"the {rec_rel} docstring census — flight_dump.py "
+                        "renders from that catalog; add the etype there",
+                    )
                 )
-            )
         for etype in sorted(
             set(index.config["required_etypes"]) - census
         ):

@@ -49,6 +49,7 @@ DEFAULT_CONFIG: dict = {
     "parity_registry": "tests/test_kernel_parity.py",
     "engine_module": "llm_mcp_tpu/executor/engine.py",
     "dispatch_module": "llm_mcp_tpu/executor/dispatch.py",
+    "zoo_module": "llm_mcp_tpu/executor/zoo.py",
     "perf_module": "llm_mcp_tpu/telemetry/perf.py",
     "recorder_module": "llm_mcp_tpu/telemetry/recorder.py",
     # knob-registry scan: the package plus the out-of-package readers the
@@ -57,8 +58,13 @@ DEFAULT_CONFIG: dict = {
     "knob_prefixes": ("TPU_", "LLM_MCP_TPU_"),
     # etypes the recorder census must explicitly list even if the engine
     # stops emitting them (tests/test_perf.py pinned these; wl/wf are the
-    # workload-capture and latency-waterfall marks from telemetry/workload)
-    "required_etypes": ("pf_rag", "fused_rag", "perf", "wl", "wf"),
+    # workload-capture and latency-waterfall marks from telemetry/workload;
+    # zoo/swap_in/swap_out are the model-zoo residency trail from
+    # executor/zoo.py)
+    "required_etypes": (
+        "pf_rag", "fused_rag", "perf", "wl", "wf",
+        "zoo", "swap_in", "swap_out",
+    ),
 }
 
 BASELINE_PATH = "llm_mcp_tpu/analysis/baseline.txt"

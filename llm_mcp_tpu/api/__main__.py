@@ -56,7 +56,7 @@ def main() -> None:
                 # the leader SliceEngine inside CoreServer (registers via
                 # discovery exactly like a single-host engine); every other
                 # process mirrors dispatches over the command channel and
-                # never binds HTTP (executor/slice_engine.py).
+                # never binds HTTP (executor/engine.py SliceEngine).
                 import jax
 
                 from ..executor import SliceEngine
@@ -110,9 +110,50 @@ def main() -> None:
             quant=cfg.tpu_embed_quant,
         )
 
+    zoo = None
+    if gen_engines and cfg.tpu_zoo_models:
+        # Model zoo (executor/zoo.py): TPU_ZOO_MODELS co-hosts extra models
+        # on this chip. The factory owns every construction kwarg, so a
+        # swap-in builds engines identical to the primary one; host_params
+        # is None on the cold first load, a parked host tree afterwards.
+        from ..executor import ModelZoo
+
+        def _zoo_factory(name, host_params, _mesh=mesh):
+            return GenerationEngine(
+                name,
+                mesh=_mesh,
+                params=host_params,
+                max_slots=cfg.tpu_max_slots,
+                max_seq_len=cfg.tpu_max_seq_len,
+                dtype=jnp.bfloat16,
+                weights_dir=cfg.tpu_weights_dir,
+                quant=cfg.tpu_quant,
+                kv_quant=cfg.tpu_kv_quant,
+                prefill_chunk=cfg.tpu_prefill_chunk,
+                decode_compact=cfg.tpu_decode_compact,
+                prompt_cache_mb=cfg.tpu_prompt_cache_mb,
+                prefill_buckets=cfg.tpu_prefill_buckets,
+                target_ttft_ms=cfg.tpu_target_ttft_ms,
+            )
+
+        zoo = ModelZoo(_zoo_factory, hot=cfg.tpu_zoo_hot, swap=cfg.tpu_zoo_swap)
+        catalog = [
+            m.strip() for m in cfg.tpu_zoo_models.split(",")
+            if m.strip() and m.strip() not in gen_engines
+        ]
+        for i, name in enumerate(catalog):
+            # the first TPU_ZOO_HOT catalog models load at boot (the hot
+            # set); the tail parks until a request pays the swap-in
+            zoo.register(name, resident=i < cfg.tpu_zoo_hot)
+        log.info(
+            "model zoo: %d models (%s resident), hot=%d swap=%s",
+            len(catalog), ",".join(zoo.resident_models()) or "none",
+            cfg.tpu_zoo_hot, cfg.tpu_zoo_swap,
+        )
+
     host, _, port = cfg.http_addr.rpartition(":")
     server = CoreServer(
-        cfg, gen_engines=gen_engines, embed_engines=embed_engines
+        cfg, gen_engines=gen_engines, embed_engines=embed_engines, zoo=zoo
     ).start(host or "0.0.0.0", int(port or 8080))
 
     grpc_server = None

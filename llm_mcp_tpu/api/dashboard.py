@@ -36,6 +36,7 @@ class DashboardAPI:
         cfg: Config,
         engines_info=None,  # callable -> dict with local engine stats
         route_stats=None,  # callable -> prefix-route outcome counters
+        zoo_stats=None,  # callable -> ModelZoo.stats() | None (no zoo)
     ):
         self.db = db
         self.queue = queue
@@ -44,6 +45,7 @@ class DashboardAPI:
         self.cfg = cfg
         self.engines_info = engines_info or (lambda: {})
         self.route_stats = route_stats or (lambda: {})
+        self.zoo_stats = zoo_stats or (lambda: None)
         self.started_at = time.time()
 
     # -- dashboard ---------------------------------------------------------
@@ -246,6 +248,44 @@ class DashboardAPI:
                 if isinstance(i.get("prefix_tier"), dict)
             },
         }
+        # condensed model-zoo + tenancy view (full residency document via
+        # /v1/debug/zoo, per-tenant detail under engines[name]["perf"]
+        # ["tenants"] and /v1/debug/perf): who is resident vs parked, the
+        # swap churn, and each tenant's goodput split + 429s — the "is
+        # tenant B still inside its SLO while A is hammered" row
+        zs = self.zoo_stats()
+        zoo = (
+            {
+                "resident": int(zs.get("resident", 0)),
+                "parked": int(zs.get("parked", 0)),
+                "hot": int(zs.get("hot", 0)),
+                "swaps_in": int(zs.get("swaps_in_total", 0.0)),
+                "swaps_out": int(zs.get("swaps_out_total", 0.0)),
+                "hbm_resident_mb": round(
+                    zs.get("hbm_resident_bytes", 0.0) / 2**20, 1
+                ),
+                "models": {
+                    m: s.get("residency", "unknown")
+                    for m, s in (zs.get("models") or {}).items()
+                },
+            }
+            if isinstance(zs, dict)
+            else {}
+        )
+        tenants = {
+            name: {
+                tenant: {
+                    "goodput_ratio": round(t.get("goodput_ratio", 1.0), 3),
+                    "goodput_tok_per_s": round(
+                        t.get("goodput_tok_per_s", 0.0), 1
+                    ),
+                    "shed": int(t.get("shed", 0.0)),
+                }
+                for tenant, t in (i["perf"].get("tenants") or {}).items()
+            }
+            for name, i in engines.items()
+            if isinstance(i.get("perf"), dict) and i["perf"].get("tenants")
+        }
         # condensed compile-ledger view (full table via /v1/debug/compiles):
         # the ledger is process-wide — one block, costliest shapes first,
         # so cold-boot compile spend is visible without grepping logs
@@ -275,6 +315,8 @@ class DashboardAPI:
                 "routing": routing,
                 "anomalies": anomalies,
                 "compiles": compiles,
+                "zoo": zoo,
+                "tenants": tenants,
                 "issues": issues,
             }
         )

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import sqlite3
 import time
 import uuid
@@ -45,6 +46,19 @@ CHAT_PROXY_TIMEOUT_S = 120.0
 EMBED_PROXY_TIMEOUT_S = 120.0
 EMBED_RETRIES = 3
 
+# Tenancy (model zoo): the header whose value becomes GenRequest.tenant —
+# per-tenant quotas, goodput ledgers and 429s all key off it. Operators
+# fronting with an API-key gateway point TPU_TENANT_HEADER at their key
+# header. Dynamic (read per request) so a live process can be re-keyed.
+DEFAULT_TENANT_HEADER = "X-Tenant-Id"
+
+
+def request_tenant(req: Request) -> str:
+    """The request's tenant id, "" when the header is absent (unmetered —
+    the single-tenant path touches none of the tenancy machinery)."""
+    header = os.environ.get("TPU_TENANT_HEADER", "") or DEFAULT_TENANT_HEADER
+    return (req.headers.get(header) or "").strip()
+
 
 class InferenceAPI:
     def __init__(
@@ -59,6 +73,7 @@ class InferenceAPI:
         embed_engines: dict[str, EmbeddingEngine] | None = None,
         cloud: Any = None,  # providers.CloudClient | None
         prefix_fetch: Any = None,  # CoreServer.maybe_prefix_fetch | None
+        zoo: Any = None,  # executor.zoo.ModelZoo | None
     ):
         self.catalog = catalog
         self.queue = queue
@@ -69,12 +84,22 @@ class InferenceAPI:
         self.embed_engines = embed_engines or {}
         self.cloud = cloud
         self.prefix_fetch = prefix_fetch
+        self.zoo = zoo
 
     # -- helpers -----------------------------------------------------------
 
     def _local_gen(self, model: str) -> GenerationEngine | None:
         if model in self.gen_engines:
             return self.gen_engines[model]
+        if self.zoo is not None and model in self.zoo.models():
+            # zoo-managed model: resident engines return instantly; a
+            # parked one pays its swap-in here, on the request thread —
+            # the cold model's first token INCLUDES the swap, which is
+            # exactly the latency the bench zoo_sweep measures
+            try:
+                return self.zoo.get(model)
+            except (KeyError, RuntimeError):
+                return None
         return None
 
     def _local_embed(self, model: str) -> EmbeddingEngine | None:
@@ -285,24 +310,42 @@ class InferenceAPI:
             self.metrics.chat_requests.labels(model=model, provider="tpu", status="error").inc()
             return
 
-        # Load shedding (executor/memory.py watermark): above the admission
-        # watermark, queueing more work only grows every stream's latency —
-        # reject NOW with a drain estimate so well-behaved clients back off
-        # (and the router's headroom tag steers new traffic elsewhere).
-        # admission_state is side-effect free; the shed is recorded here,
-        # where the 429 actually happens. Embed engines lack the method.
-        shed, retry_after = getattr(
-            engine, "admission_state", lambda: (False, 0.0)
-        )()
+        # Load shedding (executor/memory.py watermark + per-tenant quotas):
+        # above the admission watermark, queueing more work only grows
+        # every stream's latency — reject NOW with a drain estimate so
+        # well-behaved clients back off (and the router's headroom tag
+        # steers new traffic elsewhere). A request carrying a tenant id
+        # also passes that tenant's token-bucket gate: an over-quota
+        # tenant 429s HERE, per tenant, while in-quota tenants sail
+        # through. admission_state is side-effect free; the shed is
+        # recorded here, where the 429 actually happens. Embed engines
+        # (and test stand-ins predating tenancy) lack the kwarg/method.
+        tenant = request_tenant(req)
+        adm = getattr(engine, "admission_state", None)
+        if adm is None:
+            shed, retry_after = False, 0.0
+        elif tenant:
+            try:
+                shed, retry_after = adm(tenant=tenant)
+            except TypeError:
+                shed, retry_after = adm()
+        else:
+            shed, retry_after = adm()
         if shed:
-            engine.note_shed()
+            try:
+                engine.note_shed(tenant=tenant)
+            except TypeError:
+                engine.note_shed()
             self.metrics.chat_requests.labels(
                 model=model, provider="tpu", status="shed"
             ).inc()
+            # (llmtpu_tenant_shed_total advances through the engines_info
+            # delta bridge off the perf ledger note_shed just charged —
+            # incrementing here too would double-count)
             resp.extra_headers["Retry-After"] = str(max(1, int(retry_after + 0.5)))
             resp.write_error(
-                "server overloaded: KV pool above admission watermark; "
-                "retry after the indicated delay",
+                "server overloaded: admission watermark or tenant quota "
+                "exceeded; retry after the indicated delay",
                 429,
             )
             return
@@ -315,6 +358,10 @@ class InferenceAPI:
             max_tokens=max_tokens, temperature=temperature, top_p=top_p, stop=stop,
             priority=priority,
         )
+        if tenant:
+            # only metered requests carry the kwarg: the zero-tenant call
+            # signature (and the GenRequest it builds) stays byte-identical
+            gen_kwargs["tenant"] = tenant
         created = int(t0)
         cmpl_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
 

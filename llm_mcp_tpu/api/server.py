@@ -65,6 +65,7 @@ class CoreServer:
         embed_engines: dict[str, EmbeddingEngine] | None = None,
         device_id: str = "tpu-local",
         advertise_addr: str = "",
+        zoo: Any = None,  # executor.zoo.ModelZoo | None (TPU_ZOO_MODELS boot)
     ):
         self.cfg = cfg or Config()
         self.db = db or Database(self.cfg.db_path)
@@ -92,6 +93,9 @@ class CoreServer:
         # perf observatory: sampled phase walls are cumulative per
         # engine+phase+bucket, bridged by delta like the rest
         self._perf_phase_s: dict[str, dict[str, float]] = {}
+        # per-tenant shed counts (perf tenant ledgers) bridge by delta to
+        # llmtpu_tenant_shed_total{engine,tenant}; goodput gauges set direct
+        self._tenant_shed: dict[str, dict[str, float]] = {}
         # latency waterfall (telemetry/workload.py): cumulative per-stage
         # seconds per engine, bridged by delta to
         # llmtpu_latency_stage_seconds{engine,stage}
@@ -129,6 +133,12 @@ class CoreServer:
         self.advertise_addr = advertise_addr
         self.gen_engines = gen_engines or {}
         self.embed_engines = embed_engines or {}
+        # Model zoo (executor/zoo.py): the router resolves quality tiers
+        # resident-first through it, and the inference path swaps parked
+        # models in on demand. None ⇒ single-model serving, no zoo code on
+        # any path.
+        self.zoo = zoo
+        self.router.zoo = zoo
 
         self.inference = InferenceAPI(
             catalog=self.catalog,
@@ -140,6 +150,7 @@ class CoreServer:
             embed_engines=self.embed_engines,
             cloud=self.cloud,
             prefix_fetch=self.maybe_prefix_fetch,
+            zoo=zoo,
         )
         self.jobs = JobsAPI(
             queue=self.queue,
@@ -157,6 +168,7 @@ class CoreServer:
             cfg=self.cfg,
             engines_info=self.engines_info,
             route_stats=self.route_prefix_stats,
+            zoo_stats=lambda: (self.zoo.stats() if self.zoo is not None else None),
         )
 
         # Process-default tracer: the HTTP layer, router, engines, and
@@ -580,7 +592,16 @@ class CoreServer:
 
     def engines_info(self) -> dict[str, Any]:
         info: dict[str, Any] = {}
-        for name, e in self.gen_engines.items():
+        engines = dict(self.gen_engines)
+        if self.zoo is not None:
+            # zoo residents that were swapped in after boot report like any
+            # other engine; parked models are /v1/debug/zoo territory
+            for name in self.zoo.resident_models():
+                try:
+                    engines.setdefault(name, self.zoo.get(name))
+                except (KeyError, RuntimeError):
+                    pass
+        for name, e in engines.items():
             p50, p95, n = e.ttft_percentiles()
             info[name] = {
                 "kind": "generate",
@@ -754,6 +775,26 @@ class CoreServer:
                 self.metrics.decode_mbu.labels(engine=name).set(
                     rl.get("decode_mbu", 0.0)
                 )
+                # per-tenant goodput (model zoo tenancy): gauges set
+                # direct; shed counts advance by delta like every other
+                # cumulative bridge. No tenants ⇒ empty dict ⇒ no series.
+                tns = pf.get("tenants") or {}
+                prev_ts = self._tenant_shed.get(name, {})
+                cur_ts: dict[str, float] = {}
+                for tenant, tgp in tns.items():
+                    self.metrics.goodput_tok_per_s_tenant.labels(
+                        engine=name, tenant=tenant
+                    ).set(tgp.get("goodput_tok_per_s", 0.0))
+                    self.metrics.goodput_ratio_tenant.labels(
+                        engine=name, tenant=tenant
+                    ).set(tgp.get("goodput_ratio", 1.0))
+                    cur_shed = float(tgp.get("shed", 0.0))
+                    cur_ts[tenant] = cur_shed
+                    if cur_shed > prev_ts.get(tenant, 0.0):
+                        self.metrics.tenant_shed_total.labels(
+                            engine=name, tenant=tenant
+                        ).inc(cur_shed - prev_ts.get(tenant, 0.0))
+                self._tenant_shed[name] = cur_ts
                 # sampled phase walls advance by delta, per (phase, bucket)
                 prev_ph = self._perf_phase_s.get(name, {})
                 cur_ph: dict[str, float] = {}
@@ -900,6 +941,7 @@ class CoreServer:
         r("GET", "/v1/debug/compiles", self.handle_debug_compiles)
         r("GET", "/v1/debug/warmup", self.handle_debug_warmup)
         r("GET", "/v1/debug/perf", self.handle_debug_perf)
+        r("GET", "/v1/debug/zoo", self.handle_debug_zoo)
         r("GET", "/v1/debug/workload", self.handle_debug_workload)
         r("GET", "/v1/debug/latency", self.handle_debug_latency)
         r("GET", "/v1/debug/prefix", self.handle_debug_prefix)
@@ -1052,16 +1094,44 @@ class CoreServer:
 
     def handle_debug_perf(self, req: Request, resp: Response) -> None:
         """Perf observatory (telemetry/perf.py) per engine: ITL/TPOT
-        percentiles, the goodput split against the TTFT+ITL SLO, sampled
-        per-phase {host, device, wait} attribution (TPU_PERF_SAMPLE), and
-        the four-layout roofline (MFU/MBU vs TPU_PEAK_* chip peaks)."""
-        resp.write_json(
-            {
-                name: e.perf_stats()
-                for name, e in self.gen_engines.items()
-                if getattr(e, "perf_stats", None) is not None
-            }
-        )
+        percentiles, the goodput split against the TTFT+ITL SLO — both
+        engine-wide and per tenant ("tenants": goodput + shed counts per
+        tenant id) — sampled per-phase {host, device, wait} attribution
+        (TPU_PERF_SAMPLE), and the four-layout roofline (MFU/MBU vs
+        TPU_PEAK_* chip peaks)."""
+        engines = dict(self.gen_engines)
+        if self.zoo is not None:
+            for name in self.zoo.resident_models():
+                try:
+                    engines.setdefault(name, self.zoo.get(name))
+                except (KeyError, RuntimeError):
+                    pass
+        out = {
+            name: e.perf_stats()
+            for name, e in engines.items()
+            if getattr(e, "perf_stats", None) is not None
+        }
+        # per-tenant quota state (scheduler token buckets) joins each
+        # engine's document so one fetch answers "who is being throttled
+        # and why" — ledger (finished) and bucket (admission) side by side
+        for name, e in engines.items():
+            ss = getattr(e, "scheduler_tenant_stats", None)
+            if ss is not None and name in out:
+                out[name]["tenant_quotas"] = ss()
+        resp.write_json(out)
+
+    def handle_debug_zoo(self, req: Request, resp: Response) -> None:
+        """Model zoo residency (executor/zoo.py): per-model
+        resident/parked state, the HBM partition (weight bytes from the
+        zoo census, KV bytes from each resident engine's pool), swap
+        counters and last swap latencies. `{"enabled": false}` when no
+        zoo is configured (TPU_ZOO_MODELS unset)."""
+        if self.zoo is None:
+            resp.write_json({"enabled": False})
+            return
+        st = self.zoo.stats()
+        st["enabled"] = True
+        resp.write_json(st)
 
     def handle_debug_workload(self, req: Request, resp: Response) -> None:
         """Workload capture (telemetry/workload.py): the process-shared
@@ -1416,4 +1486,6 @@ class CoreServer:
         self.api.shutdown()
         for e in self.gen_engines.values():
             e.shutdown()
+        if self.zoo is not None:
+            self.zoo.shutdown()
         self.db.close()

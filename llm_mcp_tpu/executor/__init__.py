@@ -1,7 +1,7 @@
 from .tokenizer import ByteTokenizer, load_tokenizer
-from .engine import GenerationEngine, GenRequest
+from .engine import GenerationEngine, GenRequest, SliceEngine, SliceRequest
 from .embedding import EmbeddingEngine
-from .slice_engine import SliceEngine, SliceRequest
+from .zoo import ModelZoo
 
 __all__ = [
     "ByteTokenizer",
@@ -11,4 +11,5 @@ __all__ = [
     "EmbeddingEngine",
     "SliceEngine",
     "SliceRequest",
+    "ModelZoo",
 ]

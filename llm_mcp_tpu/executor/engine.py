@@ -77,7 +77,7 @@ from ..telemetry import recorder as flight
 from ..telemetry import tracing
 from ..telemetry import workload
 from .common import fine_bucket, pow2_bucket
-from .dispatch import DispatchBackend, LocalArraysBackend
+from .dispatch import DispatchBackend, GSPMDBackend, LocalArraysBackend
 from .drafter import NGramDrafter
 from .memory import (
     KVPool,
@@ -89,7 +89,7 @@ from .memory import (
 from . import migration
 from .paging import PagedKVManager
 from .physical import PhysicalPool, pool_like
-from .scheduler import TokenBudgetScheduler
+from .scheduler import TokenBudgetScheduler, parse_tenant_quotas
 from .tokenizer import ByteTokenizer, Tokenizer, load_tokenizer
 from ..utils.locks import OrderedLock
 
@@ -245,6 +245,11 @@ class GenRequest:
     # client spent before this submit landed. Stamped by the serving layer
     # (bench clients, api handlers) — the engine only ever reads it.
     shed_wait_s: float = 0.0
+    # Tenancy (model zoo): the API-key-derived tenant id this request bills
+    # against. "" (the default) is unmetered — per-tenant quotas, goodput
+    # ledgers, and SLO-debt preemption all key off a non-empty value, so
+    # single-tenant serving never touches any of that machinery.
+    tenant: str = ""
 
 
 @dataclass
@@ -528,6 +533,9 @@ class GenerationEngine:
         self._sched = TokenBudgetScheduler(
             target_ttft_ms=self.target_ttft_ms,
             min_budget=min(64, self.prefill_chunk) if self.prefill_chunk else 1,
+            tenant_quotas=parse_tenant_quotas(
+                os.environ.get("TPU_TENANT_QUOTAS", "")
+            ),
         )
         self._last_active_n = 0  # decode rows in the most recent dispatch
 
@@ -2380,6 +2388,15 @@ class GenerationEngine:
             self._warmup.start_background()  # immediate fully_warm
         return self._warmup
 
+    def warmup_priors(self) -> list[dict]:
+        """This engine's compile-ledger rows in warmup-prior form — what
+        the model zoo captures at swap-out so the NEXT residency's
+        start_warmup() re-plans from measured compile cost × hit count
+        (executor/warmup.py: pack_priors)."""
+        from . import warmup as warmup_mod
+
+        return warmup_mod.pack_priors(self._ledger.table())
+
     def warmup_stats(self) -> dict[str, Any]:
         """Readiness + plan progress for /v1/debug/warmup and the router's
         warming tag. No planner (warmup off / plain test boot) reads as
@@ -2427,6 +2444,7 @@ class GenerationEngine:
         top_p: float = 1.0,
         stop: list[str] | None = None,
         priority: int = 0,
+        tenant: str = "",
     ) -> Iterator[dict[str, Any]]:
         """Yield {"type":"token","text":...} events then a final
         {"type":"done", "usage":..., "finish_reason":...}."""
@@ -2440,6 +2458,7 @@ class GenerationEngine:
             stop=stop or [],
             priority=priority,
             trace_ctx=tracing.current_traceparent(),
+            tenant=tenant,
         )
         self.submit(req)
         while True:
@@ -2506,6 +2525,11 @@ class GenerationEngine:
             self._last_active_n / self.max_slots if self.max_slots else 0.0
         )
         return out
+
+    def scheduler_tenant_stats(self) -> dict[str, dict[str, float]]:
+        """Per-tenant quota detail (token-bucket level, throttle and
+        charge counters) for /v1/debug/perf. Empty without quotas."""
+        return self._sched.tenant_stats()
 
     def speculation_stats(self) -> dict[str, float]:
         """Self-speculative decoding observability (telemetry/metrics.py
@@ -2588,11 +2612,19 @@ class GenerationEngine:
             out["physical"] = 0.0
         return out
 
-    def admission_state(self) -> tuple[bool, float]:
+    def admission_state(self, tenant: str = "") -> tuple[bool, float]:
         """(shed, retry_after_s) for the API's load-shedding gate. SIDE-
-        EFFECT FREE — dashboards and the jobs claim path call it too; only
-        a caller that actually rejects work records it via note_shed().
-        (False, 0.0) with zero pool bookkeeping when the pool is off."""
+        EFFECT FREE except the tenant-quota throttle counter — dashboards
+        and the jobs claim path call the zero-arg form; only a caller that
+        actually rejects work records it via note_shed(). A non-empty
+        `tenant` additionally consults that tenant's token-bucket quota
+        (scheduler.tenant_admit): over-quota tenants shed HERE, per
+        tenant, even while the pool itself has headroom. (False, 0.0)
+        with zero pool bookkeeping when pool and quotas are both off."""
+        if tenant:
+            ok, retry = self._sched.tenant_admit(tenant)
+            if not ok:
+                return True, min(600.0, max(1.0, retry))
         pool = self._pool
         if pool is None:
             return False, 0.0
@@ -2608,11 +2640,14 @@ class GenerationEngine:
         )
         return True, min(600.0, max(1.0, retry))
 
-    def note_shed(self, n: int = 1) -> None:
+    def note_shed(self, n: int = 1, tenant: str = "") -> None:
         """Record that the API shed work on this engine's behalf (429 or a
-        deferred job claim)."""
+        deferred job claim). A non-empty `tenant` also charges the shed to
+        that tenant's goodput ledger (per-tenant 429 visibility)."""
         if self._pool is not None:
             self._pool.note_shed(n)
+        if tenant:
+            self._perf.note_tenant_shed(tenant, n)
         in_grace = time.time() < self._compile_grace_until
         self._flight.event("shed", n=n, in_grace=in_grace)
         if in_grace:
@@ -3132,6 +3167,14 @@ class GenerationEngine:
         exactly the KV rows on device and the snapshot rolls back to a
         token-identical resume point."""
         pool = self._pool
+        # SLO debt (model zoo tenancy): preemption prefers the slot whose
+        # tenant is furthest AHEAD of the worst-served tenant's goodput
+        # ratio — surplus, not idleness, picks who gives capacity back.
+        # With no tenants the ratio map is empty, every surplus is 0.0,
+        # and pick_victim's ordering is byte-identical to the pre-zoo
+        # policies (true no-op).
+        ratios = self._perf.tenant_goodput_ratios()
+        floor_ratio = min(ratios.values()) if ratios else 0.0
         cands = []
         for b, s in enumerate(self._slots):
             if s is None or s.done or s.aborted:
@@ -3141,6 +3184,10 @@ class GenerationEngine:
                 "priority": s.req.priority,
                 "last_activity": s.last_emit or s.first_token_at,
                 "tokens_remaining": max(0, s.req.max_tokens - s.generated),
+                "slo_surplus": (
+                    ratios.get(s.req.tenant, floor_ratio) - floor_ratio
+                    if ratios and s.req.tenant else 0.0
+                ),
             })
         victim = pool.pick_victim(cands)
         if victim is None:
@@ -5723,9 +5770,16 @@ class GenerationEngine:
         itl_mean_ms = (
             s.itl_s_total / s.itl_samples * 1e3 if s.itl_samples else 0.0
         )
-        # goodput ledger: classify against the joint TTFT+ITL SLO
+        # goodput ledger: classify against the joint TTFT+ITL SLO (the
+        # tenant id lands the request in that tenant's ledger too)
         if s.first_token_at:
-            self._perf.finish_request(ttft_ms, itl_mean_ms, s.generated)
+            self._perf.finish_request(
+                ttft_ms, itl_mean_ms, s.generated, tenant=req.tenant
+            )
+        if req.tenant:
+            # bill the tenant's token bucket: prompt + generated tokens
+            # drain the quota the admission gate refills against
+            self._sched.tenant_charge(req.tenant, s.prompt_len + s.generated)
         # record BEFORE the done/_DONE events publish: a caller unblocked by
         # the queue must be able to see the completed trace immediately
         if req.trace_ctx and s.first_token_at:
@@ -5821,3 +5875,76 @@ class GenerationEngine:
         # request whose slot state must not be clobbered
         if self._slots[slot_idx] is s:
             self._free_now(slot_idx)
+
+
+# -- multi-host spelling ----------------------------------------------------
+# (Folded in from the retired executor/slice_engine.py shim: one loop, one
+# queue, one request dataclass — the multi-host behavior lives entirely in
+# the GSPMDBackend dispatch seam, and SliceEngine is just the constructor
+# that wires it.)
+
+# The slice request type was always structurally identical to the engine's;
+# now it IS the engine's.
+SliceRequest = GenRequest
+
+
+class SliceEngine(GenerationEngine):
+    """`GenerationEngine` over a `GSPMDBackend` — the multi-host spelling of
+    the one unified engine. Construct it in EVERY process of the cluster
+    with identical arguments; `.start()` on the leader (process 0),
+    `.run_follower()` everywhere else — both inherited. Keeps the old
+    keyword surface (`cmd_addr`, `connect_timeout_s`, the strict
+    quant-with-checkpoint error, the `max_slots % dp` check)."""
+
+    def __init__(
+        self,
+        model: str | ModelConfig = "tiny-llm",
+        *,
+        mesh: Any,
+        cmd_addr: str,
+        max_slots: int = 8,
+        max_seq_len: int = 256,
+        dtype: Any = jnp.bfloat16,
+        decode_chunk: int = 8,
+        quant: str = "",
+        weights_dir: str = "",
+        tokenizer: Tokenizer | None = None,
+        seed: int = 0,
+        connect_timeout_s: float = 60.0,
+        prefill_chunk: int = 0,
+        target_ttft_ms: float = 2000.0,
+        **engine_kw: Any,
+    ):
+        if quant not in ("", "int8") and weights_dir:
+            # The unified engine downgrades unknown quant modes to a warning;
+            # a multi-host boot must not silently serve different bytes than
+            # the operator asked for across a whole slice.
+            raise NotImplementedError(
+                f"slice engine quant={quant!r} with a checkpoint "
+                f"(only 'int8' is supported)"
+            )
+        if mesh is not None:
+            dp = dict(mesh.shape).get("dp", 1)
+            if max_slots % max(dp, 1) != 0:
+                raise ValueError(
+                    f"max_slots {max_slots} must divide over dp={dp}"
+                )
+        super().__init__(
+            model,
+            mesh=mesh,
+            backend=GSPMDBackend(cmd_addr, connect_timeout_s=connect_timeout_s),
+            max_slots=max_slots,
+            max_seq_len=max_seq_len,
+            dtype=dtype,
+            decode_chunk=decode_chunk,
+            quant=quant,
+            weights_dir=weights_dir,
+            tokenizer=tokenizer,
+            seed=seed,
+            prefill_chunk=prefill_chunk,
+            target_ttft_ms=target_ttft_ms,
+            **engine_kw,
+        )
+        self.process_index = jax.process_index()
+        self.process_count = jax.process_count()
+        self.is_leader = self.process_index == 0

@@ -16,7 +16,14 @@ SLO-aware admission, without repaginating the cache:
     that cannot run.
   - **Preemption**: `pick_victim` orders candidates by policy — "priority"
     (lowest priority, then longest-idle, then most-tokens-remaining),
-    "idle" (longest-idle first), "tokens" (most-remaining first). The
+    "idle" (longest-idle first), "tokens" (most-remaining first),
+    "slo_debt" (largest per-tenant goodput surplus first — the tenant
+    whose SLO ratio is furthest ABOVE its peers has the most slack to
+    give back). Every policy first prefers candidates with a larger
+    `slo_surplus` (the engine stamps it from the perf observatory's
+    per-tenant goodput ratios); with tenancy off the key is absent,
+    every surplus reads 0.0, and ordering is byte-identical to the
+    pre-zoo policies. The
     engine snapshots the victim's committed KV rows to host memory
     (`jax.device_get` of a dynamic slice — exact by the committed-lengths
     invariant: rows past the committed length are dead and rewritten in
@@ -41,7 +48,7 @@ from ..utils.locks import OrderedLock
 
 __all__ = ["KVPool", "KVSnapshot", "pytree_nbytes", "bucket_len"]
 
-POLICIES = ("priority", "idle", "tokens")
+POLICIES = ("priority", "idle", "tokens", "slo_debt")
 
 # Thrash guards: at most one preemption per interval, and restores are
 # aged past fairness after this many multiples of the scheduler's TTFT
@@ -202,16 +209,25 @@ class KVPool:
     def pick_victim(self, candidates: list[dict]) -> dict | None:
         """Choose the slot to evict. Each candidate dict carries `priority`
         (int), `last_activity` (monotonic-ish seconds), `tokens_remaining`
-        (int), plus any engine-side handle keys (`slot`, ...). Returns the
-        chosen candidate unmodified, or None when empty."""
+        (int), optionally `slo_surplus` (float: the owning tenant's
+        goodput_ratio surplus over the worst-served tenant), plus any
+        engine-side handle keys (`slot`, ...). Returns the chosen
+        candidate unmodified, or None when empty.
+
+        SLO debt leads every policy: the slot whose tenant is furthest
+        AHEAD of its SLO is preempted first — it has slack to give back,
+        while preempting an already-behind tenant digs its debt deeper.
+        Candidates without the key (single-tenant serving) all read 0.0,
+        so ordering degrades exactly to the historical per-policy keys."""
         if not candidates:
             return None
         if self.policy == "idle":
-            key = lambda c: (c["last_activity"], c["priority"], -c["tokens_remaining"])
+            base = lambda c: (c["last_activity"], c["priority"], -c["tokens_remaining"])
         elif self.policy == "tokens":
-            key = lambda c: (-c["tokens_remaining"], c["priority"], c["last_activity"])
-        else:  # "priority": lowest priority, then longest-idle, then most-remaining
-            key = lambda c: (c["priority"], c["last_activity"], -c["tokens_remaining"])
+            base = lambda c: (-c["tokens_remaining"], c["priority"], c["last_activity"])
+        else:  # "priority"/"slo_debt": lowest priority, longest-idle, most-remaining
+            base = lambda c: (c["priority"], c["last_activity"], -c["tokens_remaining"])
+        key = lambda c: (-float(c.get("slo_surplus", 0.0)), *base(c))
         return min(candidates, key=key)
 
     # -- offload / restore bookkeeping --------------------------------------

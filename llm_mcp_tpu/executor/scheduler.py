@@ -38,12 +38,40 @@ leader (followers replay dispatches and need no policy).
 from __future__ import annotations
 
 import math
+import time
 
 from ..telemetry.recorder import get_recorder
 
-__all__ = ["TokenBudgetScheduler"]
+__all__ = ["TokenBudgetScheduler", "parse_tenant_quotas"]
 
 _EMA = 0.7  # keep-fraction; matches the engine's old decode-time smoothing
+
+# Per-tenant quota burst window: a tenant's token bucket holds this many
+# seconds of its rate, so short bursts ride through while sustained
+# overload throttles within a couple of windows.
+TENANT_BURST_S = 2.0
+
+
+def parse_tenant_quotas(spec: str) -> dict[str, float]:
+    """`TPU_TENANT_QUOTAS="alice=600,bob=300"` -> {"alice": 600.0, ...}.
+
+    Values are tokens/second. A `*` key sets the default for tenants not
+    named explicitly; tenants with no quota (and the empty tenant id) are
+    unmetered. Malformed entries are dropped rather than raised — a typo'd
+    quota must not take the serve path down."""
+    out: dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        name, _, val = part.partition("=")
+        try:
+            rate = float(val)
+        except ValueError:
+            continue
+        if name.strip() and rate > 0:
+            out[name.strip()] = rate
+    return out
 
 
 class TokenBudgetScheduler:
@@ -54,6 +82,7 @@ class TokenBudgetScheduler:
         min_budget: int = 64,
         decode_seed_s: float = 0.05,
         prefill_tok_seed_s: float = 1e-4,
+        tenant_quotas: dict[str, float] | None = None,
     ):
         self.target_ttft_s = max(1.0, float(target_ttft_ms)) / 1000.0
         # floor: a chunk dispatch costs ~a weight pass regardless of size, so
@@ -78,6 +107,20 @@ class TokenBudgetScheduler:
         self.prefill_true_tokens = 0
         self.prefill_padded_tokens = 0
         self.pad_waste = 0.0  # EMA of per-dispatch waste fraction
+        # Per-tenant quotas (model zoo tenancy): tokens/second per tenant,
+        # enforced as token buckets holding TENANT_BURST_S of rate. The
+        # EMA-costed budget machinery above stays global — quotas act at
+        # ADMISSION (tenant_admit -> per-tenant 429), so an over-quota
+        # tenant sheds at the door instead of starving in-flight streams.
+        # Empty dict ⇒ every tenant unmetered ⇒ zero behavior change.
+        self.tenant_quotas = {
+            k: float(v) for k, v in (tenant_quotas or {}).items()
+            if float(v) > 0
+        }
+        self._tenant_level: dict[str, float] = {}  # bucket fill, tokens
+        self._tenant_ts: dict[str, float] = {}     # last refill stamp
+        self.tenant_throttled: dict[str, int] = {}  # tenant -> 429 count
+        self.tenant_charged: dict[str, int] = {}    # tenant -> tokens billed
 
     # -- cost observation --------------------------------------------------
 
@@ -189,6 +232,79 @@ class TokenBudgetScheduler:
         )
         return budget
 
+    # -- per-tenant quotas -------------------------------------------------
+
+    def _tenant_rate(self, tenant: str) -> float:
+        """Quota for `tenant` in tokens/s; 0 ⇒ unmetered. The `*` entry is
+        the default for tenants with no explicit row."""
+        if not tenant or not self.tenant_quotas:
+            return 0.0
+        return self.tenant_quotas.get(tenant, self.tenant_quotas.get("*", 0.0))
+
+    def _refill(self, tenant: str, rate: float, now: float) -> float:
+        """Advance `tenant`'s bucket to `now` and return its level."""
+        burst = rate * TENANT_BURST_S
+        level = self._tenant_level.get(tenant, burst)
+        last = self._tenant_ts.get(tenant, now)
+        level = min(burst, level + rate * max(0.0, now - last))
+        self._tenant_level[tenant] = level
+        self._tenant_ts[tenant] = now
+        return level
+
+    def tenant_charge(
+        self, tenant: str, tokens: int, now: float | None = None
+    ) -> None:
+        """Bill `tokens` (prompt + generated) against `tenant`'s bucket.
+        The level may go negative — a large request pushes the tenant's
+        next admission out proportionally — but is floored at one burst of
+        debt so a single huge request can't lock a tenant out forever."""
+        rate = self._tenant_rate(tenant)
+        if rate <= 0 or tokens <= 0:
+            return
+        now = time.monotonic() if now is None else now
+        level = self._refill(tenant, rate, now)
+        burst = rate * TENANT_BURST_S
+        self._tenant_level[tenant] = max(-burst, level - tokens)
+        self.tenant_charged[tenant] = (
+            self.tenant_charged.get(tenant, 0) + int(tokens)
+        )
+
+    def tenant_admit(
+        self, tenant: str, now: float | None = None
+    ) -> tuple[bool, float]:
+        """Quota gate for one arriving request: (admit, retry_after_s).
+        Unmetered tenants always admit. A drained bucket sheds with the
+        seconds until it refills past zero — the API turns that into a
+        per-tenant 429 + Retry-After."""
+        rate = self._tenant_rate(tenant)
+        if rate <= 0:
+            return True, 0.0
+        now = time.monotonic() if now is None else now
+        level = self._refill(tenant, rate, now)
+        if level >= 0.0:
+            return True, 0.0
+        self.tenant_throttled[tenant] = self.tenant_throttled.get(tenant, 0) + 1
+        return False, -level / rate
+
+    def tenant_stats(self) -> dict[str, dict[str, float]]:
+        """Per-tenant quota detail for /v1/debug/perf and the dashboard."""
+        now = time.monotonic()
+        out: dict[str, dict[str, float]] = {}
+        for tenant in sorted(
+            set(self.tenant_quotas) - {"*"}
+            | set(self._tenant_level) | set(self.tenant_throttled)
+        ):
+            rate = self._tenant_rate(tenant)
+            out[tenant] = {
+                "quota_tok_per_s": rate,
+                "bucket_tokens": (
+                    self._refill(tenant, rate, now) if rate > 0 else 0.0
+                ),
+                "throttled_total": float(self.tenant_throttled.get(tenant, 0)),
+                "charged_tokens": float(self.tenant_charged.get(tenant, 0)),
+            }
+        return out
+
     def drain_estimate_s(
         self,
         n_waiting: int,
@@ -222,5 +338,14 @@ class TokenBudgetScheduler:
                 * (1.0 - self.prefill_true_tokens / self.prefill_padded_tokens)
                 if self.prefill_padded_tokens
                 else 0.0
+            ),
+            # per-tenant quota contract keys (flat rollups; detail in
+            # tenant_stats()) — pinned by tests/test_scheduler.py
+            "tenant_quota_tenants": float(len(self.tenant_quotas)),
+            "tenant_throttled_total": float(
+                sum(self.tenant_throttled.values())
+            ),
+            "tenant_charged_tokens": float(
+                sum(self.tenant_charged.values())
             ),
         }

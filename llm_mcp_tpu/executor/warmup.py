@@ -50,6 +50,7 @@ __all__ = [
     "WarmupPlanner",
     "WarmupStep",
     "key_str",
+    "pack_priors",
     "plan_steps",
     "priors_from_table",
     "select_critical",
@@ -126,6 +127,29 @@ def priors_from_table(table: list[dict[str, Any]]) -> dict[tuple, dict]:
             continue
         priors[(phase, ks)] = {"count": count, "cost_s": total / count}
     return priors
+
+
+def pack_priors(
+    table: list[dict[str, Any]], cap: int = 256
+) -> list[dict[str, Any]]:
+    """Normalize ledger rows for cross-residency reuse (the model zoo
+    captures these at swap-out and feeds them to the next swap-in's
+    start_warmup). Keeps only well-formed rows, ordered by total compile
+    seconds descending — the shapes worth re-warming first — capped so a
+    long residency's ledger can't bloat the parked entry."""
+    rows: list[dict[str, Any]] = []
+    for row in table or []:
+        try:
+            rows.append({
+                "phase": str(row["phase"]),
+                "key": str(row["key"]),
+                "count": max(1, int(row.get("count", 1))),
+                "total_s": float(row.get("total_s", 0.0)),
+            })
+        except (KeyError, TypeError, ValueError):
+            continue
+    rows.sort(key=lambda r: -r["total_s"])
+    return rows[: max(1, int(cap))]
 
 
 def _score(phase: str, key: tuple, priors: dict[tuple, dict]) -> float:

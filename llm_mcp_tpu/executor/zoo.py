@@ -1,0 +1,334 @@
+"""Model zoo: multi-model HBM residency with LRU host-RAM paging.
+
+The reference routes across MANY models with quality tiers and per-device
+RAM→params limits; our engines each serve exactly one model. This module is
+the layer between the serve path and the engines that closes that gap on a
+single chip: a few *hot* models stay resident in HBM, the long tail parks
+its weights in host RAM, and a request for a parked model triggers a
+swap — evict the least-recently-used resident, page the requested weights
+back in, and ride the warmup path so the swapped-in model's first token
+reuses the AOT plan + persistent compile cache instead of paying cold
+XLA walls.
+
+Mechanics, all built from machinery that already exists:
+
+  - **Residency accounting** rides KVPool's layout-agnostic byte census
+    (`pytree_nbytes` over the live param tree — bf16, int8 `{q, s}` dicts
+    and MLA latents all count without layout-specific code). The zoo
+    partitions an HBM budget (`hbm_budget_bytes`; 0 = count-only) across
+    residents: a swap-in that would overflow it evicts LRU residents
+    first, exactly like the pool's watermark sheds work it cannot hold.
+  - **Swap-out** is `jax.device_get` of the engine's param tree — the
+    same host-offload move KVPool makes for preempted KV — followed by
+    engine shutdown (which frees HBM weights, KV cache and slots).
+  - **Swap-in** constructs a fresh engine around the parked host tree
+    (`GenerationEngine(params=host_tree)` — quantize/fuse re-run but are
+    idempotent no-ops on an already-processed tree) and calls
+    `start_warmup(priors)` with the compile-ledger rows captured at the
+    model's last residency, so the critical first-token prefix compiles
+    from the persistent cache before the first request lands.
+  - **Routing**: `residency_band()` gives the router a 0/1/2 sort key
+    (resident / parked / unknown) so quality tiers resolve to a resident
+    model first and a swappable one second (routing/router.py).
+
+Flight-recorder etypes (telemetry/recorder.py census): `zoo` on
+registration and residency changes, `swap_in` / `swap_out` with byte
+counts and wall seconds — the post-mortem trail for "why did this
+request's first token take 4 s".
+
+Thread safety: swaps serialize on one lock (a swap is seconds of work;
+two concurrent swaps of the same 16 GB tree would be memory suicide).
+`get()` on a resident model is lock-cheap and touch-only. Everything here
+is opt-in: no ModelZoo ⇒ single-engine serving byte-identical to the
+pre-zoo era.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable
+
+from ..telemetry.recorder import get_recorder
+from .memory import pytree_nbytes
+
+__all__ = ["ModelZoo"]
+
+log = logging.getLogger("zoo")
+
+
+class _ZooEntry:
+    __slots__ = (
+        "name", "engine", "host_params", "priors", "weight_bytes",
+        "last_used", "swaps_in", "swaps_out", "last_swap_in_s",
+        "last_swap_out_s",
+    )
+
+    def __init__(self, name: str):
+        self.name = name
+        self.engine: Any = None       # resident GenerationEngine, or None
+        self.host_params: Any = None  # parked host-RAM param tree, or None
+        self.priors: list[dict] = []  # compile-ledger rows from last residency
+        self.weight_bytes = 0
+        self.last_used = 0.0
+        self.swaps_in = 0
+        self.swaps_out = 0
+        self.last_swap_in_s = -1.0
+        self.last_swap_out_s = -1.0
+
+
+class ModelZoo:
+    """Co-host several models on one chip; see module docstring.
+
+    `engine_factory(model_name, host_params)` builds (and does NOT start)
+    a `GenerationEngine` for `model_name`; `host_params=None` means a cold
+    first load (checkpoint / init), a tree means a swap-in of parked
+    weights. The factory owns every construction kwarg (mesh, dtype,
+    quant, slots) so boot wires them exactly once (api/__main__.py).
+    """
+
+    def __init__(
+        self,
+        engine_factory: Callable[[str, Any], Any],
+        *,
+        hot: int = 1,
+        swap: bool = True,
+        hbm_budget_bytes: int = 0,
+    ):
+        self._factory = engine_factory
+        self.hot = max(1, int(hot))
+        self.swap = bool(swap)
+        self.hbm_budget_bytes = max(0, int(hbm_budget_bytes))
+        self._entries: dict[str, _ZooEntry] = {}
+        self._lock = threading.RLock()
+        self.swaps_in_total = 0
+        self.swaps_out_total = 0
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, name: str, *, resident: bool = False) -> None:
+        """Add `name` to the zoo's catalog. `resident=True` loads and
+        starts it immediately (boot-time hot set); otherwise the first
+        request pays the swap-in."""
+        with self._lock:
+            if name in self._entries:
+                return
+            self._entries[name] = _ZooEntry(name)
+            get_recorder().event(
+                "zoo", model=name, action="register", resident=resident,
+                catalog=len(self._entries),
+            )
+        if resident:
+            self.swap_in(name)
+
+    def models(self) -> list[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def resident_models(self) -> list[str]:
+        with self._lock:
+            return sorted(
+                n for n, e in self._entries.items() if e.engine is not None
+            )
+
+    def residency(self, name: str) -> str:
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None:
+                return "unknown"
+            return "resident" if e.engine is not None else "parked"
+
+    def residency_band(self, name: str) -> int:
+        """Router sort key: resident models first (0), swappable second
+        (1), models the zoo does not manage last (2)."""
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None:
+                return 2
+            if e.engine is not None:
+                return 0
+            return 1 if self.swap else 2
+
+    # -- request path ------------------------------------------------------
+
+    def get(self, name: str) -> Any:
+        """The engine serving `name`, swapping it in if parked. Raises
+        KeyError for models outside the catalog and RuntimeError when the
+        model is parked and swapping is disabled (TPU_ZOO_SWAP=0: the
+        router should never have sent the request here — band 2)."""
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None:
+                raise KeyError(f"model {name!r} not in the zoo")
+            if e.engine is not None:
+                e.last_used = time.monotonic()
+                return e.engine
+            if not self.swap:
+                raise RuntimeError(
+                    f"model {name!r} is parked and TPU_ZOO_SWAP is off"
+                )
+        return self.swap_in(name)
+
+    # -- swap machinery ----------------------------------------------------
+
+    def _hbm_resident_bytes_locked(self) -> int:
+        return sum(
+            e.weight_bytes for e in self._entries.values()
+            if e.engine is not None
+        )
+
+    def _evict_for_locked(self, incoming_bytes: int) -> list[str]:
+        """LRU residents that must leave before `incoming_bytes` fit:
+        count over `hot`, or bytes over the HBM budget (when set)."""
+        victims: list[str] = []
+        residents = sorted(
+            (e for e in self._entries.values() if e.engine is not None),
+            key=lambda e: e.last_used,
+        )
+        n_res = len(residents)
+        used = self._hbm_resident_bytes_locked()
+        for e in residents:
+            # +1 for the incoming model, which is not yet in `residents`
+            over_count = n_res - len(victims) + 1 > self.hot
+            over_bytes = (
+                self.hbm_budget_bytes > 0
+                and used + incoming_bytes > self.hbm_budget_bytes
+            )
+            if not (over_count or over_bytes):
+                break
+            victims.append(e.name)
+            used -= e.weight_bytes
+        return victims
+
+    def swap_in(self, name: str) -> Any:
+        """Make `name` resident: evict LRU residents past the hot/budget
+        limits, build an engine around the parked tree (or cold-load on
+        first touch), start it, and warm it from the model's last
+        residency's compile priors. Returns the started engine."""
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None:
+                raise KeyError(f"model {name!r} not in the zoo")
+            if e.engine is not None:
+                e.last_used = time.monotonic()
+                return e.engine
+            # size the incoming tree from its parked bytes; a cold first
+            # load is unknown (0) and only the count limit applies to it
+            incoming = pytree_nbytes(e.host_params) if e.host_params is not None else 0
+            for victim in self._evict_for_locked(incoming):
+                self._swap_out_locked(self._entries[victim])
+            t0 = time.perf_counter()
+            eng = self._factory(name, e.host_params)
+            eng.start()
+            eng.start_warmup(e.priors or None)
+            dt = time.perf_counter() - t0
+            e.engine = eng
+            e.host_params = None  # the tree lives in HBM now; drop host copy
+            e.weight_bytes = pytree_nbytes(eng.params)
+            e.last_used = time.monotonic()
+            e.swaps_in += 1
+            e.last_swap_in_s = dt
+            self.swaps_in_total += 1
+            get_recorder().event(
+                "swap_in", model=name, seconds=round(dt, 3),
+                bytes=e.weight_bytes, warm_priors=len(e.priors),
+                resident=len(self.resident_models()),
+            )
+            log.info(
+                "zoo swap-in %s: %.2fs, %.1f MB weights, %d residents",
+                name, dt, e.weight_bytes / 1e6,
+                sum(1 for x in self._entries.values() if x.engine is not None),
+            )
+            return eng
+
+    def swap_out(self, name: str) -> None:
+        """Park `name`'s weights in host RAM and free its engine."""
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None or e.engine is None:
+                return
+            self._swap_out_locked(e)
+
+    def _swap_out_locked(self, e: _ZooEntry) -> None:
+        import jax
+
+        eng = e.engine
+        t0 = time.perf_counter()
+        # host offload first (mirrors KVPool's device_get of preempted KV):
+        # the tree must be safe in host RAM before shutdown frees HBM
+        e.host_params = jax.device_get(eng.params)
+        # carry the compile priors to the next residency so swap-in's
+        # warmup re-plans from measured cost × hit count, not from scratch
+        try:
+            e.priors = eng.warmup_priors()
+        except Exception:
+            e.priors = []
+        eng.shutdown()
+        dt = time.perf_counter() - t0
+        e.engine = None
+        e.weight_bytes = pytree_nbytes(e.host_params)
+        e.swaps_out += 1
+        e.last_swap_out_s = dt
+        self.swaps_out_total += 1
+        get_recorder().event(
+            "swap_out", model=e.name, seconds=round(dt, 3),
+            bytes=e.weight_bytes,
+        )
+        log.info(
+            "zoo swap-out %s: %.2fs, %.1f MB parked", e.name, dt,
+            e.weight_bytes / 1e6,
+        )
+
+    def shutdown(self) -> None:
+        """Stop every resident engine (process teardown; nothing parks)."""
+        with self._lock:
+            for e in self._entries.values():
+                if e.engine is not None:
+                    e.engine.shutdown()
+                    e.engine = None
+
+    # -- telemetry ---------------------------------------------------------
+
+    def stats(self) -> dict[str, Any]:
+        """The /v1/debug/zoo document: per-model residency + HBM
+        partition (weights from the zoo census, KV from each resident
+        engine's own pool accounting)."""
+        with self._lock:
+            models: dict[str, Any] = {}
+            for name, e in self._entries.items():
+                kv_bytes = 0.0
+                if e.engine is not None:
+                    try:
+                        kv_bytes = float(
+                            e.engine.memory_stats().get("hbm_bytes", 0.0)
+                        )
+                    except Exception:
+                        kv_bytes = 0.0
+                models[name] = {
+                    "residency": (
+                        "resident" if e.engine is not None else "parked"
+                    ),
+                    "weight_bytes": float(e.weight_bytes),
+                    "kv_bytes": kv_bytes,
+                    "swaps_in": float(e.swaps_in),
+                    "swaps_out": float(e.swaps_out),
+                    "last_swap_in_s": e.last_swap_in_s,
+                    "last_swap_out_s": e.last_swap_out_s,
+                    "warm_priors": float(len(e.priors)),
+                }
+            return {
+                "hot": float(self.hot),
+                "swap_enabled": self.swap,
+                "hbm_budget_bytes": float(self.hbm_budget_bytes),
+                "hbm_resident_bytes": float(self._hbm_resident_bytes_locked()),
+                "resident": sum(
+                    1 for e in self._entries.values() if e.engine is not None
+                ),
+                "parked": sum(
+                    1 for e in self._entries.values() if e.engine is None
+                ),
+                "swaps_in_total": float(self.swaps_in_total),
+                "swaps_out_total": float(self.swaps_out_total),
+                "models": models,
+            }

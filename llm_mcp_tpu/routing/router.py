@@ -156,6 +156,13 @@ class Router:
         self.has_openai = (
             has_openai if has_openai is not None else bool(getenv("OPENAI_API_KEY", ""))
         )
+        # Model zoo (executor/zoo.py), attached by the serving layer when
+        # TPU_ZOO_MODELS is set: quality tiers then resolve to a RESIDENT
+        # model first, a swappable (parked) one second — the zoo's
+        # residency_band supplies the 0/1/2 sort key. None (the default)
+        # skips the residency sort entirely: candidate order is
+        # byte-identical to the pre-zoo router (stable sorts + no call).
+        self.zoo: Any = None
 
     # -- device selection --------------------------------------------------
 
@@ -584,6 +591,15 @@ class Router:
         # thinking preference: stable partition, preferred first
         if thinking is not None:
             rows.sort(key=lambda r: 0 if bool(r["thinking"]) == thinking else 1)
+        # zoo residency (applied last = outermost key): resident models
+        # first, swappable second, models the zoo does not manage last —
+        # a request resolves to a model already in HBM when one fits its
+        # tier, and only pays a swap when none does. Stable partition, so
+        # within a band the thinking and SQL load/size order still
+        # decide. No zoo attached ⇒ no sort at all ⇒ candidate order is
+        # byte-identical to the pre-zoo router.
+        if self.zoo is not None:
+            rows.sort(key=lambda r: self.zoo.residency_band(r["model_id"]))
         for r in rows:
             dev_id = r["device_id"]
             if not self.circuit.allow(dev_id):

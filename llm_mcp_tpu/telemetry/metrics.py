@@ -357,6 +357,27 @@ class Metrics:
             ["engine"],
             registry=r,
         )
+        # per-tenant split of the same ledgers (model zoo tenancy): series
+        # only materialize for tenants that actually sent traffic, so the
+        # single-tenant scrape surface is unchanged
+        self.goodput_tok_per_s_tenant = Gauge(
+            "llmtpu_goodput_tok_per_s_tenant",
+            "Per-tenant tokens/s from requests meeting the joint SLO (60s window)",
+            ["engine", "tenant"],
+            registry=r,
+        )
+        self.goodput_ratio_tenant = Gauge(
+            "llmtpu_goodput_ratio_tenant",
+            "Per-tenant SLO-conforming / finished tokens (cumulative)",
+            ["engine", "tenant"],
+            registry=r,
+        )
+        self.tenant_shed_total = Counter(
+            "llmtpu_tenant_shed_total",
+            "Admission 429s charged to a tenant (quota or capacity shed)",
+            ["engine", "tenant"],
+            registry=r,
+        )
         self.decode_mfu = Gauge(
             "llmtpu_decode_mfu",
             "Model FLOPs utilization of sampled decode rounds vs TPU_PEAK_TFLOPS",

@@ -330,6 +330,12 @@ class PerfObservatory:
         self.finished_tokens = 0
         self.good_tokens = 0
         self._finish_window = deque(maxlen=4096)
+        # per-tenant goodput ledgers (model zoo tenancy): tenant id ->
+        # the same lifetime counters + rolling window as the engine-wide
+        # ledger, plus the tenant's shed (429) count. Empty until a
+        # request actually carries a tenant id — the single-tenant path
+        # allocates nothing here.
+        self._tenants: dict[str, dict[str, Any]] = {}
         # sampled phase attribution {phase: {host_s, device_s, wait_s,
         # samples, tokens}} — tokens only for the decode family (the MFU/MBU
         # denominator); dispatch counters drive the every-Nth cadence
@@ -405,11 +411,14 @@ class PerfObservatory:
     # -- goodput accounting ------------------------------------------------
 
     def finish_request(
-        self, ttft_ms: float, itl_mean_ms: float, tokens: int
+        self, ttft_ms: float, itl_mean_ms: float, tokens: int,
+        tenant: str = "",
     ) -> bool:
         """Classify one finished request against the joint SLO. A target of
         0 means that axis is unconstrained (matching TTFTBurnDetector's
-        no-SLO convention). Returns whether the request was good."""
+        no-SLO convention). Returns whether the request was good. A
+        non-empty `tenant` also lands the request in that tenant's ledger
+        (per-tenant goodput for the zoo scheduler and /v1/debug/perf)."""
         good = (
             (self.target_ttft_ms <= 0 or ttft_ms <= self.target_ttft_ms)
             and (self.target_itl_ms <= 0 or itl_mean_ms <= self.target_itl_ms)
@@ -421,7 +430,74 @@ class PerfObservatory:
                 self.good_requests += 1
                 self.good_tokens += tokens
             self._finish_window.append((time.time(), tokens, good))
+            if tenant:
+                t = self._tenant_locked(tenant)
+                t["finished_requests"] += 1
+                t["finished_tokens"] += tokens
+                if good:
+                    t["good_requests"] += 1
+                    t["good_tokens"] += tokens
+                t["window"].append((time.time(), tokens, good))
         return good
+
+    def _tenant_locked(self, tenant: str) -> dict[str, Any]:
+        """Ledger for `tenant`, created on first touch. Caller holds the
+        lock."""
+        t = self._tenants.get(tenant)
+        if t is None:
+            t = {
+                "finished_requests": 0, "good_requests": 0,
+                "finished_tokens": 0, "good_tokens": 0, "shed": 0,
+                "window": deque(maxlen=1024),
+            }
+            self._tenants[tenant] = t
+        return t
+
+    def note_tenant_shed(self, tenant: str, n: int = 1) -> None:
+        """A per-tenant admission 429: quota or capacity shed charged to
+        `tenant`'s ledger (surfaced in /v1/debug/perf and the
+        llmtpu_tenant_shed_total metric)."""
+        if not tenant:
+            return
+        with self._lock:
+            self._tenant_locked(tenant)["shed"] += int(n)
+
+    def tenant_goodput(self, window_s: float = 60.0) -> dict[str, dict[str, float]]:
+        """Per-tenant goodput split, same shape as `goodput()` per entry
+        plus the tenant's shed count. Empty dict when no request ever
+        carried a tenant id."""
+        now = time.time()
+        out: dict[str, dict[str, float]] = {}
+        with self._lock:
+            for name, t in self._tenants.items():
+                rows = [r for r in t["window"] if now - r[0] <= window_s]
+                ftok, gtok = t["finished_tokens"], t["good_tokens"]
+                out[name] = {
+                    "goodput_tok_per_s": sum(
+                        tok for _, tok, g in rows if g
+                    ) / window_s,
+                    "raw_finished_tok_per_s": sum(
+                        tok for _, tok, _ in rows
+                    ) / window_s,
+                    "good_requests": float(t["good_requests"]),
+                    "finished_requests": float(t["finished_requests"]),
+                    "good_tokens": float(gtok),
+                    "finished_tokens": float(ftok),
+                    "goodput_ratio": (gtok / ftok) if ftok else 1.0,
+                    "shed": float(t["shed"]),
+                }
+        return out
+
+    def tenant_goodput_ratios(self) -> dict[str, float]:
+        """Lifetime goodput_ratio per tenant — the SLO-debt signal the
+        engine's preemption victim selection reads every preempt
+        decision (cheap: no window scan)."""
+        with self._lock:
+            return {
+                name: (t["good_tokens"] / t["finished_tokens"])
+                if t["finished_tokens"] else 1.0
+                for name, t in self._tenants.items()
+            }
 
     def goodput(self, window_s: float = 60.0) -> dict[str, float]:
         now = time.time()
@@ -563,6 +639,7 @@ class PerfObservatory:
                 if self._itl_count else 0.0
             ),
             "goodput": self.goodput(),
+            "tenants": self.tenant_goodput(),
             "phases": self.phase_attribution(),
             "roofline": self.roofline(),
         }

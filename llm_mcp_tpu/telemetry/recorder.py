@@ -41,7 +41,11 @@ An *event* is a tuple ``(seq, ts, etype, trace_id, fields)``:
             decode/stall/preempt milliseconds) / wu (one warmup-planner
             AOT compile: phase, key, wall, outcome) / warmup (readiness
             state transition: cold / first_token_ready / fully_warm —
-            executor/warmup.py)
+            executor/warmup.py) / zoo (model-zoo catalog change:
+            registration with residency — executor/zoo.py) / swap_in /
+            swap_out (zoo residency moves, with byte counts and wall
+            seconds: page parked host weights into HBM / park a resident
+            engine's tree back to host RAM)
   trace_id  the request's 32-hex trace id ("" for engine-global events) —
             a dump stitches directly into /v1/traces
   fields    flat dict of scalars (or None)

@@ -121,7 +121,7 @@ class Config:
     tpu_max_slots: int = field(default_factory=lambda: getenv_int("TPU_MAX_SLOTS", 32))
     tpu_max_seq_len: int = field(default_factory=lambda: getenv_int("TPU_MAX_SEQ_LEN", 2048))
     tpu_mesh_shape: str = field(default_factory=lambda: getenv("TPU_MESH_SHAPE", ""))  # e.g. "dp=1,tp=8"
-    # multi-PROCESS serving (executor/slice_engine.py): leader→follower
+    # multi-PROCESS serving (executor/engine.py SliceEngine): leader→follower
     # command channel address; non-empty + a jax.distributed triplet puts
     # process 0 in CoreServer as the slice leader, every other process in
     # the follower loop — the whole slice registers as ONE device
@@ -155,8 +155,9 @@ class Config:
     # a true no-op (the pool is never constructed — byte-identical scheduler
     # decisions vs the pool-less engine). TPU_ADMIT_WATERMARK is the offered
     # load multiple of max_slots above which the API sheds (429+Retry-After,
-    # deferred job claims); TPU_PREEMPT_POLICY ∈ priority|idle|tokens picks
-    # the eviction victim ordering. Engines read the env directly at
+    # deferred job claims); TPU_PREEMPT_POLICY ∈ priority|idle|tokens|
+    # slo_debt picks the eviction victim ordering (slo_debt prefers the
+    # tenant with the most goodput surplus). Engines read the env directly at
     # construction (TPU_PIPELINE_DEPTH pattern); these fields surface the
     # knobs in config dumps.
     tpu_kv_host_offload: bool = field(default_factory=lambda: getenv_bool("TPU_KV_HOST_OFFLOAD"))
@@ -166,6 +167,20 @@ class Config:
     # OLLAMA_PORTS pattern) — multiple executor processes on one host get
     # probed automatically instead of only the pinned self port
     tpu_extra_ports: str = field(default_factory=lambda: getenv("TPU_EXTRA_PORTS", ""))
+    # model zoo (executor/zoo.py): TPU_ZOO_MODELS is a comma-separated model
+    # catalog co-hosted on this chip ("" = no zoo, byte-identical single-model
+    # serving); TPU_ZOO_HOT caps how many stay HBM-resident at once; cold
+    # models park as host-RAM param trees and TPU_ZOO_SWAP=0 turns demand
+    # swap-in into a hard 503 instead (residency becomes static).
+    tpu_zoo_models: str = field(default_factory=lambda: getenv("TPU_ZOO_MODELS", ""))
+    tpu_zoo_hot: int = field(default_factory=lambda: getenv_int("TPU_ZOO_HOT", 1))
+    tpu_zoo_swap: bool = field(default_factory=lambda: getenv("TPU_ZOO_SWAP", "1") != "0")
+    # per-tenant goodput quotas (executor/scheduler.py token buckets):
+    # "alice=600,bob=300,*=1000" in tok/s; "" = unmetered (no tenant gate).
+    # TPU_TENANT_HEADER renames the request header the tenant id is read
+    # from (default X-Tenant-Id, api/inference.py).
+    tpu_tenant_quotas: str = field(default_factory=lambda: getenv("TPU_TENANT_QUOTAS", ""))
+    tpu_tenant_header: str = field(default_factory=lambda: getenv("TPU_TENANT_HEADER", ""))
 
     def __post_init__(self) -> None:
         # DB_DSN was documented but never read by any backend (the store is

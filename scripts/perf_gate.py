@@ -96,6 +96,13 @@ Checks, in order of authority:
      generous collapse bars, with relative latency-class gating when a
      baseline carries them.
 
+  10. Model-zoo + tenancy checks, when the record carries them (ISSUE
+     19): tenant_isolation >= 0.5 — tenant B's goodput_ratio while
+     tenant A is driven far past its quota on the same engine — and
+     zoo_swap_in_s <= 60, the wall for paging a parked model back into
+     HBM through the warmup path. Hosts that skip the zoo sweep omit
+     both keys and [SKIP].
+
 Missing metrics are reported as [SKIP] with a stderr warning but never
 fail the gate (older records predate newer fields — a KeyError here
 would make every old BENCH_*.json ungateable); a metric PRESENT and
@@ -137,6 +144,7 @@ HIGHER_BETTER = (
     "goodput_tok_per_s",
     "goodput_ratio",
     "decode_mbu",
+    "tenant_isolation",
 )
 LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms", "cow_copies_per_req",
                 "attn_us_per_cell", "attn_us_per_cell_paged",
@@ -144,7 +152,7 @@ LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms", "cow_copies_per_req",
                 "itl_p95_ms", "waterfall_stall_p95_ms",
                 "waterfall_total_p95_ms",
                 "coldstart_first_token_s", "coldstart_first_token_cold_s",
-                "coldstart_fully_warm_s")
+                "coldstart_fully_warm_s", "zoo_swap_in_s")
 
 # absolute floors/ceilings applied regardless of baseline coverage (only
 # ever read with .get(): a floor for a metric the record lacks must skip,
@@ -225,6 +233,12 @@ ABS_MIN = {
     # above it (layers_gbps ~570/819 ≈ 0.70 on the weight stream alone)
     "goodput_ratio": 0.5,
     "decode_mbu": 0.3,
+    # model zoo + tenancy (ISSUE 19, bench.py zoo_sweep): with tenant A
+    # driven far past its token-bucket quota, tenant B's goodput_ratio on
+    # the same engine must stay at least half-healthy — under 0.5 the
+    # per-tenant admission gate and SLO-debt preemption are not isolating
+    # and TPU_TENANT_QUOTAS is a decoration, not a quota
+    "tenant_isolation": 0.5,
 }
 ABS_MAX = {
     "p95_ttft_ms": 5000.0,
@@ -262,6 +276,13 @@ ABS_MAX = {
     # Hosts that skip the coldstart sweep omit both keys → [SKIP]+warning.
     "coldstart_first_token_s": 10.0,
     "coldstart_first_token_cold_s": 60.0,
+    # model zoo (ISSUE 19): a parked model's swap-in — evict LRU, rebuild
+    # the engine around the host tree, warm from the model's own compile
+    # priors — rides the same warmup path as cold start, so it inherits
+    # the same pileup ceiling: over 60 s means the swap re-paid compiles
+    # the persistent cache + priors should have amortized. Hosts that skip
+    # the zoo sweep omit the key → [SKIP]+warning.
+    "zoo_swap_in_s": 60.0,
 }
 
 

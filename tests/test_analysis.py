@@ -217,7 +217,10 @@ def test_census_flags_unregistered_kernel(tmp_path):
             "PHASE_COSTS = {}\n"
         ),
         "pkg/engine.py": "\n",
-        "pkg/recorder.py": '"""etypes: pf_rag fused_rag perf wl wf."""\n',
+        "pkg/recorder.py": (
+            '"""etypes: pf_rag fused_rag perf wl wf zoo swap_in '
+            'swap_out."""\n'
+        ),
     })
     found = RegistryCensusPass().run(RepoIndex(root, {
         "package": "pkg",

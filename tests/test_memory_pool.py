@@ -1,4 +1,4 @@
-"""HBM-aware KV pool (executor/memory.py + engine/slice_engine wiring).
+"""HBM-aware KV pool (executor/memory.py + engine/SliceEngine wiring).
 
 Three layers of coverage:
 

@@ -329,6 +329,7 @@ def test_stats_document_shape():
     st = PerfObservatory(SHAPE).stats()
     assert set(st) == {
         "sample_every", "itl", "itl_mean_ms", "goodput", "phases", "roofline",
+        "tenants",
     }
     assert set(st["phases"]) == set(DISPATCH_PHASES)
     assert set(st["roofline"]["layouts"]) == set(CACHE_LAYOUTS)

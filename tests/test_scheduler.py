@@ -21,7 +21,11 @@ import jax.numpy as jnp
 import pytest
 
 from llm_mcp_tpu.executor import GenerationEngine
-from llm_mcp_tpu.executor.scheduler import TokenBudgetScheduler
+from llm_mcp_tpu.executor.scheduler import (
+    TENANT_BURST_S,
+    TokenBudgetScheduler,
+    parse_tenant_quotas,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -113,6 +117,8 @@ def test_stats_contract():
         "verify_rounds", "verify_tokens",
         "prefill_true_tokens", "prefill_padded_tokens",
         "prefill_pad_waste_pct",
+        "tenant_quota_tenants", "tenant_throttled_total",
+        "tenant_charged_tokens",
     }
     assert all(isinstance(v, float) for v in st.values())
 
@@ -149,6 +155,194 @@ def test_observe_verify_counts_and_feeds_prefill_ema():
     st = s.stats()
     assert st["verify_rounds"] == 2.0
     assert st["verify_tokens"] == 48.0
+
+
+# ------------------------------------------------------- per-tenant quotas --
+
+
+def test_parse_tenant_quotas():
+    assert parse_tenant_quotas("") == {}
+    assert parse_tenant_quotas(None) == {}
+    q = parse_tenant_quotas("alice=600, bob=300,*=1000")
+    assert q == {"alice": 600.0, "bob": 300.0, "*": 1000.0}
+    # malformed / non-positive / nameless entries drop; the rest survive —
+    # a typo'd quota must not take the serve path down
+    assert parse_tenant_quotas("alice=x,=5,bob=-3,carol=10,stray") == {
+        "carol": 10.0
+    }
+
+
+def test_tenant_bucket_admits_burst_then_throttles():
+    s = TokenBudgetScheduler(tenant_quotas={"alice": 100.0})
+    t0 = 1000.0
+    # new buckets start full (one burst of rate) — a tenant's first
+    # request never 429s
+    ok, retry = s.tenant_admit("alice", now=t0)
+    assert ok and retry == 0.0
+    # burn past the burst: the level goes negative (floored at -burst)
+    s.tenant_charge("alice", 500, now=t0)
+    ok, retry = s.tenant_admit("alice", now=t0)
+    assert not ok and retry > 0.0
+    # retry_after is deficit/rate: floored debt = burst ⇒ exactly BURST_S
+    assert retry == pytest.approx(TENANT_BURST_S)
+    # refill: after enough seconds the bucket crosses zero again
+    ok, _ = s.tenant_admit("alice", now=t0 + TENANT_BURST_S + 0.01)
+    assert ok
+    st = s.tenant_stats()["alice"]
+    assert st["quota_tok_per_s"] == 100.0
+    assert st["throttled_total"] == 1.0
+    assert st["charged_tokens"] == 500.0
+    flat = s.stats()
+    assert flat["tenant_quota_tenants"] == 1.0
+    assert flat["tenant_throttled_total"] == 1.0
+    assert flat["tenant_charged_tokens"] == 500.0
+
+
+def test_unmetered_tenants_never_throttle():
+    """No quota config ⇒ tenant_admit is a constant-true no-op: the
+    single-tenant serve path cannot change behavior."""
+    s = TokenBudgetScheduler()
+    s.tenant_charge("whoever", 10**9)
+    ok, retry = s.tenant_admit("whoever")
+    assert ok and retry == 0.0
+    assert s.stats()["tenant_quota_tenants"] == 0.0
+    assert s.stats()["tenant_throttled_total"] == 0.0
+    # quota'd scheduler, but the EMPTY tenant id (no header) is unmetered
+    s2 = TokenBudgetScheduler(tenant_quotas={"alice": 10.0})
+    s2.tenant_charge("", 10**9)
+    assert s2.tenant_admit("") == (True, 0.0)
+
+
+def test_default_star_quota_applies_to_unknown_tenants():
+    s = TokenBudgetScheduler(tenant_quotas={"*": 50.0, "vip": 5000.0})
+    t0 = 2000.0
+    s.tenant_charge("mystery", 10_000, now=t0)
+    ok, retry = s.tenant_admit("mystery", now=t0)
+    assert not ok and retry > 0.0
+    # the explicit row wins over the default
+    s.tenant_charge("vip", 10_000, now=t0)
+    assert s.tenant_admit("vip", now=t0 + 2.1)[0]
+
+
+def test_tenant_quota_contention_bounds_admissions():
+    """Threaded contention: N workers hammering one metered tenant admit at
+    most burst + rate·wall tokens' worth of requests — the bucket is the
+    bound, not the thread count."""
+    rate, cost = 200.0, 100  # tokens/s quota; tokens billed per request
+    s = TokenBudgetScheduler(tenant_quotas={"hammered": rate})
+    admitted = []
+    lock = threading.Lock()
+    stop_at = time.monotonic() + 0.5
+
+    def worker():
+        while time.monotonic() < stop_at:
+            ok, _ = s.tenant_admit("hammered")
+            if ok:
+                s.tenant_charge("hammered", cost)
+                with lock:
+                    admitted.append(1)
+            time.sleep(0.001)
+
+    ts = [threading.Thread(target=worker) for _ in range(6)]
+    t0 = time.monotonic()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    wall = time.monotonic() - t0
+    # bucket arithmetic upper bound, generously padded for scheduling
+    # jitter: one full burst + refill over the wall, in request units
+    bound = (rate * TENANT_BURST_S + rate * wall) / cost + len(ts)
+    assert len(admitted) <= bound
+    assert s.stats()["tenant_throttled_total"] > 0  # the flood did throttle
+
+
+def test_slo_debt_victim_selection():
+    """slo_debt preemption: the slot whose tenant is furthest AHEAD of its
+    SLO is evicted first; surplus ties fall back to the per-policy keys;
+    and candidates WITHOUT the key order byte-identically to the historical
+    policies (the single-tenant no-op guarantee)."""
+    from llm_mcp_tpu.executor.memory import KVPool
+
+    def pool(policy):
+        return KVPool(
+            max_slots=4, max_seq_len=128, bytes_per_slot=1024, policy=policy
+        )
+
+    cands = [
+        # the worst-served tenant's slot: surplus 0 — never the victim
+        {"slot": 0, "priority": 0, "last_activity": 10.0,
+         "tokens_remaining": 5, "slo_surplus": 0.0},
+        # two slots from well-served tenants, tied on surplus
+        {"slot": 1, "priority": 5, "last_activity": 50.0,
+         "tokens_remaining": 50, "slo_surplus": 0.4},
+        {"slot": 2, "priority": 0, "last_activity": 1.0,
+         "tokens_remaining": 100, "slo_surplus": 0.4},
+    ]
+    v = pool("slo_debt").pick_victim(cands)
+    # surplus leads; the 0.4 tie breaks on the priority-policy base key
+    assert v["slot"] == 2
+    # absent key reads 0.0: ordering degrades exactly to each base policy
+    plain = [
+        {k: v for k, v in c.items() if k != "slo_surplus"} for c in cands
+    ]
+    for pol in ("priority", "idle", "tokens"):
+        with_zero = [dict(c, slo_surplus=0.0) for c in plain]
+        assert (
+            pool(pol).pick_victim(plain)["slot"]
+            == pool(pol).pick_victim(with_zero)["slot"]
+        )
+    assert pool("slo_debt").pick_victim([]) is None
+
+
+def test_two_tenant_isolation_soak():
+    """The zoo tenancy invariant, at the scheduler + observatory layer:
+    tenant A flooding far past its quota (and violating its own SLO) must
+    not move tenant B's goodput_ratio — B sheds nothing, B's ledger stays
+    clean, and A's overflow turns into A's 429s."""
+    from llm_mcp_tpu.telemetry.perf import PerfObservatory
+
+    sched = TokenBudgetScheduler(tenant_quotas={"alice": 200.0})
+    perf = PerfObservatory(target_ttft_ms=100.0, target_itl_ms=0.0)
+    sheds = {"alice": 0, "bob": 0}
+    lock = threading.Lock()
+    stop_at = time.monotonic() + 0.8
+
+    def run(tenant, ttft_ms, tokens, pace_s):
+        while time.monotonic() < stop_at:
+            ok, _ = sched.tenant_admit(tenant)
+            if not ok:
+                perf.note_tenant_shed(tenant)
+                with lock:
+                    sheds[tenant] += 1
+                time.sleep(0.002)
+                continue
+            perf.finish_request(ttft_ms, 0.0, tokens, tenant=tenant)
+            sched.tenant_charge(tenant, tokens)
+            if pace_s:
+                time.sleep(pace_s)
+
+    ts = [
+        threading.Thread(target=run, args=("alice", 500.0, 120, 0.0))
+        for _ in range(3)
+    ]
+    ts.append(threading.Thread(target=run, args=("bob", 20.0, 30, 0.01)))
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sheds["alice"] > 0  # the flood actually hit the quota
+    assert sheds["bob"] == 0  # unmetered tenant never sheds
+    ratios = perf.tenant_goodput_ratios()
+    # bob's every token met the SLO: ratio pinned at 1.0, well inside the
+    # perf_gate tenant_isolation floor (0.5) — A's overload never reached
+    # B's ledger
+    assert ratios["bob"] == 1.0
+    # alice's admitted requests all violated TTFT: her debt is visible
+    assert ratios["alice"] < 0.5
+    tg = perf.tenant_goodput()
+    assert tg["alice"]["shed"] == float(sheds["alice"])
+    assert tg["bob"]["goodput_ratio"] == 1.0
 
 
 # ------------------------------------------------- engine-loop integration --
@@ -417,3 +611,30 @@ def test_gate_paged_kv_floors(tmp_path):
     assert gate.main([str(tmp_path / "low_ratio.json"), base]) == 1
     assert gate.main([str(tmp_path / "churny.json"), base]) == 1
     assert gate.main([str(tmp_path / "leaky.json"), base]) == 1
+
+
+def test_gate_zoo_tenancy_floors(tmp_path, capsys):
+    """ISSUE 19 pair: tenant_isolation >= 0.5 (floor) and zoo_swap_in_s <=
+    60 (ceiling) fail when present-and-regressed, [SKIP] when absent (old
+    records and hosts that skipped the zoo sweep)."""
+    import json
+
+    good = {"value": 2400.0, "window_errors": 0.0,
+            "tenant_isolation": 0.93, "zoo_swap_in_s": 4.2}
+    starved = dict(good, tenant_isolation=0.2)
+    slow_swap = dict(good, zoo_swap_in_s=120.0)
+    for n, doc in (("good", good), ("starved", starved),
+                   ("slow_swap", slow_swap)):
+        (tmp_path / f"{n}.json").write_text(json.dumps(doc))
+    base = _bench("BASELINE.json")
+    assert gate.main([str(tmp_path / "good.json"), base]) == 0
+    assert gate.main([str(tmp_path / "starved.json"), base]) == 1
+    assert gate.main([str(tmp_path / "slow_swap.json"), base]) == 1
+    # absent keys skip with a warning, never KeyError
+    (tmp_path / "old.json").write_text(
+        json.dumps({"value": 2400.0, "window_errors": 0.0})
+    )
+    assert gate.main([str(tmp_path / "old.json"), base]) == 0
+    captured = capsys.readouterr()
+    assert "tenant_isolation" in captured.err
+    assert "zoo_swap_in_s" in captured.err

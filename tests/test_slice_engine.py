@@ -126,7 +126,7 @@ def test_slice_engine_int8_from_checkpoint(tmp_path):
 def test_slice_engine_unknown_quant_with_checkpoint_fails_loud(tmp_path):
     from llm_mcp_tpu.models import get_config, init_llama_params, llama_to_hf_tensors
     from llm_mcp_tpu.models.weights import write_safetensors
-    from llm_mcp_tpu.executor.slice_engine import SliceEngine as SE
+    from llm_mcp_tpu.executor.engine import SliceEngine as SE
 
     cfg = get_config("tiny-llm")
     params = init_llama_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
@@ -145,7 +145,7 @@ def test_cmd_follower_presumes_dead_leader():
     """A connected-but-silent leader (hung process, half-open socket) must
     fail the follower's recv within idle_timeout_s — it used to block on a
     recv with NO timeout, wedging the follower process forever."""
-    from llm_mcp_tpu.executor.slice_engine import CmdFollower
+    from llm_mcp_tpu.executor.dispatch import CmdFollower
 
     srv = socket.create_server(("127.0.0.1", 0))
     port = srv.getsockname()[1]
@@ -165,7 +165,7 @@ def test_cmd_follower_presumes_dead_leader():
 def test_cmd_leader_ping_keeps_follower_alive():
     """The leader's idle beacon resets the follower's liveness deadline, and
     pings are visible as ("ping",) frames the command loop skips."""
-    from llm_mcp_tpu.executor.slice_engine import CmdFollower, CmdLeader
+    from llm_mcp_tpu.executor.dispatch import CmdFollower, CmdLeader
 
     port = _free_port()
     fol_box: list = []
