@@ -2035,7 +2035,16 @@ def trace_replay_metrics(
     digests). Records captured with raw ids replay token-identically;
     hash-only records replay as deterministic text derived from their
     prefix-chain head hashes. Returns replay_* metrics plus the engine's
-    latency-waterfall p95s over the replayed window."""
+    latency-waterfall p95s over the replayed window.
+
+    BENCH_CONSTRAIN=1 arms grammar-constrained decoding for records that
+    carry a `schema` field (the synth:agent kind stamps one per tool-call
+    burst): each such request replays under a json_schema constraint, and
+    the run promotes constrain_mask_us_per_tok / schema_valid_rate /
+    constrain_spec_accept_rate into the line of record. The agent schemas
+    are closed (every field enum/boolean), so the accepting state has no
+    outgoing transitions and the mask forces EOS — schema_valid_rate is
+    exactly 1.0 on any model, which is what perf_gate demands."""
     import hashlib
     import threading
 
@@ -2091,6 +2100,7 @@ def trace_replay_metrics(
         with lock:
             results[rid] = "".join(parts)
 
+    constrain = os.environ.get("BENCH_CONSTRAIN", "") == "1"
     try:
         t0 = time.perf_counter()
         for gap, rec, prompt in plan:
@@ -2103,11 +2113,29 @@ def trace_replay_metrics(
             mt = int(rec.get("mt", 16)) or 1
             if max_tokens_cap:
                 mt = min(mt, max_tokens_cap)
+            constraint = (
+                {"type": "json_schema", "schema": rec["schema"]}
+                if constrain and rec.get("schema") else None
+            )
+            if constraint is not None:
+                # a closed agent schema forces ~30-60 byte tokens before
+                # its EOS-only accepting state; the CPU smoke cap (16)
+                # would cut every request off at finish="length" and
+                # schema_valid_rate could never reach its exact-1.0 gate
+                mt = max(mt, 64)
+                # and the completion needs real sequence headroom: agent
+                # prompts run to the context edge, and a constrained
+                # request retired at the row budget finishes "length" in
+                # a non-accepting state — keep the prompt TAIL (recency
+                # matters for agent turns) and reserve room for the call
+                if len(ids) > max_seq_len - 96:
+                    ids = ids[-(max_seq_len - 96):]
             req = GenRequest(
                 prompt_ids=ids, max_tokens=mt,
                 temperature=float(rec.get("temp", 0.0)),
                 top_k=int(rec.get("top_k", 0)),
                 top_p=float(rec.get("top_p", 1.0)),
+                constraint=constraint,
             )
             rid = str(rec.get("rid") or req.request_id)
             eng.submit(req)
@@ -2132,6 +2160,19 @@ def trace_replay_metrics(
                 (ws.get("stages") or {}).get(stage, {}).get("p95_ms", 0.0)
             )
         out["waterfall_total_p95_ms"] = ws.get("total_p95_ms", 0.0)
+        # constrained-decoding line of record: only when the replay actually
+        # carried constrained traffic — unconstrained runs keep these keys
+        # absent so perf_gate reports [SKIP], never a vacuous 1.0 pass
+        cs = getattr(eng, "constrain_stats", None)
+        cs = cs() if cs is not None else {}
+        if cs.get("requests", 0.0) > 0:
+            out["constrain_requests"] = cs["requests"]
+            out["constrain_mask_us_per_tok"] = round(cs["mask_us_per_tok"], 2)
+            out["schema_valid_rate"] = cs["schema_valid_rate"]
+            if cs.get("spec_drafted", 0.0) > 0:
+                out["constrain_spec_accept_rate"] = round(
+                    cs["spec_accept_rate"], 4
+                )
         h = hashlib.sha256()
         for rid in sorted(results):
             h.update(f"{rid}\x00{results[rid]}\x01".encode())

@@ -60,10 +60,12 @@ DEFAULT_CONFIG: dict = {
     # stops emitting them (tests/test_perf.py pinned these; wl/wf are the
     # workload-capture and latency-waterfall marks from telemetry/workload;
     # zoo/swap_in/swap_out are the model-zoo residency trail from
-    # executor/zoo.py)
+    # executor/zoo.py; cn_cmp/cnstep/cn_spec are the grammar-constrained
+    # decoding trail from llm_mcp_tpu/constrain + the engine cn rounds)
     "required_etypes": (
         "pf_rag", "fused_rag", "perf", "wl", "wf",
         "zoo", "swap_in", "swap_out",
+        "cn_cmp", "cnstep", "cn_spec",
     ),
 }
 

@@ -204,6 +204,48 @@ PURITY_MANIFEST: tuple[PurityEntry, ...] = (
         why="runs on the engine host thread and in follower processes",
     ),
     PurityEntry(
+        key="cn-grammar",
+        path="llm_mcp_tpu/constrain/grammar.py",
+        stubs=("llm_mcp_tpu", "llm_mcp_tpu.constrain"),
+        forbidden=("jax", "numpy"),
+        exercise=textwrap.dedent(
+            """
+            rules, start = mod.regex_to_grammar("a(b|c){{2}}")
+            a = mod.ByteAutomaton(rules, start)
+            s = a.step_bytes(a.start_state, b"abc")
+            assert s >= 0 and a.accepting(s)
+            assert a.step(a.start_state, ord("z")) == -1
+            """
+        ),
+        why="constraint compilation runs on API + engine host threads",
+    ),
+    PurityEntry(
+        key="cn-masks",
+        path="llm_mcp_tpu/constrain/masks.py",
+        allow=("numpy", "llm_mcp_tpu.constrain.grammar",
+               "llm_mcp_tpu.constrain.schema"),
+        stubs=("llm_mcp_tpu", "llm_mcp_tpu.constrain"),
+        deps=("llm_mcp_tpu/constrain/grammar.py",
+              "llm_mcp_tpu/constrain/schema.py"),
+        forbidden=("jax", "llm_mcp_tpu.executor", "llm_mcp_tpu.api"),
+        exercise=textwrap.dedent(
+            """
+            class Tok:
+                vocab_size = 259
+                pad_id, bos_id, eos_id = 0, 1, 2
+                OFFSET = 3
+            cc = mod.ConstraintCompiler(Tok(), 259, cache_size=2)
+            sa = cc.make({{"type": "choice", "choices": ["ab", "cd"]}})
+            legal = [t for t in range(259) if sa.allows(t)]
+            assert legal == [3 + ord("a"), 3 + ord("c")], legal
+            assert sa.advance(3 + ord("a")) and sa.advance(3 + ord("b"))
+            assert sa.accepting and sa.allows(2)
+            assert cc.stats()["misses"] == 1
+            """
+        ),
+        why="mask lift is host-only; the device sees packed words alone",
+    ),
+    PurityEntry(
         key="locks",
         path="llm_mcp_tpu/utils/locks.py",
         stubs=("llm_mcp_tpu", "llm_mcp_tpu.utils"),

@@ -943,6 +943,7 @@ class CoreServer:
         r("GET", "/v1/debug/perf", self.handle_debug_perf)
         r("GET", "/v1/debug/zoo", self.handle_debug_zoo)
         r("GET", "/v1/debug/workload", self.handle_debug_workload)
+        r("GET", "/v1/debug/constrain", self.handle_debug_constrain)
         r("GET", "/v1/debug/latency", self.handle_debug_latency)
         r("GET", "/v1/debug/prefix", self.handle_debug_prefix)
         r("GET", "/v1/debug/profile", self.handle_debug_profile)
@@ -1157,6 +1158,27 @@ class CoreServer:
                 resp.write_error(f"dump failed: {e}", 400)
                 return
         resp.write_json(out)
+
+    def handle_debug_constrain(self, req: Request, resp: Response) -> None:
+        """Grammar-constrained decoding (llm_mcp_tpu/constrain) per engine:
+        kill-switch state (TPU_CONSTRAIN), request/token/illegal counters,
+        schema validity, host mask cost per token, the spec-composition
+        accept rate, and the compile cache's hit/miss/eviction + mask-memo
+        stats (TPU_CONSTRAIN_CACHE)."""
+        engines = dict(self.gen_engines)
+        if self.zoo is not None:
+            for name in self.zoo.resident_models():
+                try:
+                    engines.setdefault(name, self.zoo.get(name))
+                except (KeyError, RuntimeError):
+                    pass
+        resp.write_json(
+            {
+                name: e.constrain_stats()
+                for name, e in engines.items()
+                if getattr(e, "constrain_stats", None) is not None
+            }
+        )
 
     def handle_debug_latency(self, req: Request, resp: Response) -> None:
         """Latency waterfall per engine: the per-stage decomposition of

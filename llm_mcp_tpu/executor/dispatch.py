@@ -70,6 +70,7 @@ DISPATCH_OPS = (
     "bsample",  # boundary sample off a parked group's logits + row writes
     "decode",   # decode round (plain / fused-chunk / fused-ragged)
     "verify",   # speculative verify round
+    "cnstep",   # grammar-constrained single-step decode (masked sample)
     "samprow",  # set one slot's sampling row (temp/top-k/top-p/last)
     "snap",     # replicate+fetch KV rows (preempt snapshot, migration)
     "pfxput",   # slice live rows into the device prefix cache

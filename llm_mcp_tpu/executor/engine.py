@@ -66,7 +66,8 @@ from ..models.llama import (
     llama_decode_step,
     quantize_kv,
 )
-from ..ops.sampling import sample_tokens, spec_verify
+from .. import constrain
+from ..ops.sampling import apply_token_mask, sample_tokens, spec_verify
 from ..parallel.sharding import (
     llama_param_specs, kv_cache_specs, kv_pool_specs, shard_pytree,
     supports_ragged_prefill,
@@ -250,6 +251,17 @@ class GenRequest:
     # ledgers, and SLO-debt preemption all key off a non-empty value, so
     # single-tenant serving never touches any of that machinery.
     tenant: str = ""
+    # Grammar-constrained decoding (constrain/): the constraint spec dict
+    # ({"type": "json_schema"|"json_object"|"regex"|"choice", ...}) and the
+    # parsed logit_bias pairs [(token_id, bias), ...]. None/None means
+    # unconstrained — the request never touches the constrain subsystem.
+    constraint: dict | None = None
+    logit_bias: list | None = None
+    # engine-filled: the compiled per-request SlotAutomaton, attached when
+    # the loop pops the request (so the FIRST sampled token is already
+    # masked) and handed to the slot at activation. Never set when
+    # TPU_CONSTRAIN=0.
+    cn: Any = None
 
 
 @dataclass
@@ -269,6 +281,11 @@ class _Slot:
     # self-speculative decoding: the slot's n-gram index over its own token
     # history (drafter.py), fed by _process_token; None when TPU_SPEC=0
     spec: Any = None
+    # constrained decoding: the request's SlotAutomaton cursor (constrain/
+    # masks.py), advanced by _process_token on every emitted token. None for
+    # unconstrained requests and always None when TPU_CONSTRAIN=0 — the
+    # loop's cn_active/active split keys off this field.
+    cn: Any = None
     spec_drafted: int = 0  # draft tokens proposed for this request
     spec_accepted: int = 0  # draft tokens accepted by verify
     # KV pool: last emission wall time, the "idle" preemption policy's
@@ -685,6 +702,26 @@ class GenerationEngine:
 
         self._sample1 = sample1
 
+        # constrained sibling of _sample1: same engine mask, then the
+        # automaton mask + logit_bias, then EXACT sampling (approx top-k
+        # could miss a tiny legal set entirely). Built lazily here but only
+        # ever TRACED when a constrained batch reaches bsample — under
+        # TPU_CONSTRAIN=0 no request carries cn, so this executable never
+        # exists and the kill switch stays a zero-trace no-op.
+        sample1_cn = jax.jit(
+            lambda logits, counter, temp, topk, topp, masks, bids, bvals: sample_tokens(
+                apply_token_mask(
+                    jnp.where(mask, logits, -jnp.inf) if mask is not None else logits,
+                    masks, bids, bvals,
+                ),
+                jax.random.fold_in(skey_base, counter), temp, topk, topp,
+                exact=True,
+            ),
+            **self._shard_out(["repl"]),
+        )
+
+        self._sample1_cn = sample1_cn
+
         impl = self.attn_impl
 
         # Long-context path: with an sp axis in the mesh, prefill runs
@@ -902,7 +939,7 @@ class GenerationEngine:
                  **self._shard_out(["k", "v", "repl", "repl", "repl", "repl",
                                    "repl"]))
         def admit_fn(params, ck, cv, d_temp, d_topk, d_topp, d_last, tokens,
-                     ipack, fpack):
+                     ipack, fpack, cn=None):
             """Fused admission: prefill + cache insert + sampling-param
             update + first-token sample in ONE dispatch.
 
@@ -956,12 +993,19 @@ class GenerationEngine:
             d_topp = d_topp.at[row].set(topps)
             if mask_ is not None:
                 logits = jnp.where(mask_, logits, -jnp.inf)
+            # constrained admission: automaton masks + logit_bias for the
+            # FIRST sampled token. cn rides at the END defaulting to None
+            # (the paged=None pattern) so unconstrained admissions keep the
+            # exact executable traced before this subsystem existed.
+            if cn is not None:
+                logits = apply_token_mask(logits, cn[0], cn[1], cn[2])
             key = jax.random.fold_in(base_key_, counter)
             # pad rows duplicate garbage prompts/params — keep them out of
             # the sampler's homogeneity reductions (fast-path selection)
             toks0 = sample_tokens(
                 logits, key, temps, topks, topps,
                 active=jnp.arange(Ab) < live_n,
+                exact=cn is not None,
             )
             d_last = d_last.at[row].set(toks0)
             return ck, cv, d_temp, d_topk, d_topp, d_last, toks0
@@ -1179,6 +1223,40 @@ class GenerationEngine:
         # vs decode_chunk) — back off for a while after a low-acceptance call
         self._spec_cooldown = 0
         self._verify_fn = self._build_verify() if self.spec_enabled else None
+
+        # Grammar-constrained decoding (constrain/): schema/regex/choice
+        # specs compile to byte automata lifted to packed token bitmasks,
+        # one SlotAutomaton cursor per constrained slot, masks fused into
+        # sampling (admit / cnstep / bsample / verify). TPU_CONSTRAIN=0 is
+        # a hard kill switch mirroring TPU_SPEC=0: the compiler is never
+        # constructed, no request ever carries `cn`, every jitted path
+        # keeps its cn=None trailing operand — zero new executables traced
+        # and token-identical greedy output.
+        self.constrain_enabled = constrain.constrain_enabled()
+        self.cn_bias_max = max(
+            1, int(os.environ.get("LLM_MCP_TPU_CN_BIAS_MAX", "") or 64)
+        )
+        self._constrain = (
+            constrain.ConstraintCompiler(
+                self.tokenizer, self.cfg.vocab_size,
+                cache_size=int(os.environ.get("TPU_CONSTRAIN_CACHE", "") or 64),
+            )
+            if self.constrain_enabled
+            else None
+        )
+        # constrained-traffic counters (constrain_stats; engine-thread
+        # writers, lock-free like the spec counters)
+        self.cn_requests = 0
+        self.cn_tokens = 0
+        self.cn_illegal = 0  # automaton-illegal emissions — must stay 0
+        self.cn_finished = 0
+        self.cn_finished_accepting = 0
+        self.cn_spec_drafted = 0
+        self.cn_spec_accepted = 0
+        self.cn_mask_s = 0.0  # host wall building/gathering mask rows
+        # masked single-step decode for constrained slots (built lazily on
+        # first constrained traffic — never traced otherwise)
+        self._cn_step_fn = None
 
         # HBM-aware KV pool (memory.py): admission watermark + slot
         # preemption with host offload. TPU_KV_HOST_OFFLOAD=0 (default)
@@ -1619,12 +1697,12 @@ class GenerationEngine:
         and the engine's _dx call sites both ways."""
         ops: dict[str, Any] = {}
 
-        def op_admit(tokens, ipack, fpack):
+        def op_admit(tokens, ipack, fpack, cn=None):
             # jits read via self._admit_fn at call time (tests monkeypatch it)
             (self._ck, self._cv, self._d_temp, self._d_topk, self._d_topp,
              self._d_last_tok, toks0) = self._admit_fn(
                 self.params, self._ck, self._cv, self._d_temp, self._d_topk,
-                self._d_topp, self._d_last_tok, tokens, ipack, fpack,
+                self._d_topp, self._d_last_tok, tokens, ipack, fpack, cn=cn,
             )
             return toks0
 
@@ -1675,15 +1753,25 @@ class GenerationEngine:
 
         ops["ragged"] = op_ragged
 
-        def op_bsample(gid, rows, slots_fin, temps, topks, topps, counter):
+        def op_bsample(gid, rows, slots_fin, temps, topks, topps, counter,
+                       cn=None):
             # activation sample off a parked chunk group's boundary logits +
             # the sampling-param/token-ring writes for the finishing slots
             logits = self._x_logits.pop(gid, None)
             if logits is None or len(rows) == 0:
                 return None
-            toks0 = self._sample1(
-                logits[rows], np.int32(counter), temps, topks, topps
-            )
+            if cn is not None:
+                # constrained activation (chunked-prefill and prefix-hit
+                # admissions): the masked sibling jit — only ever traced
+                # when constrained traffic reaches this path
+                toks0 = self._sample1_cn(
+                    logits[rows], np.int32(counter), temps, topks, topps,
+                    cn[0], cn[1], cn[2],
+                )
+            else:
+                toks0 = self._sample1(
+                    logits[rows], np.int32(counter), temps, topks, topps
+                )
             self._d_temp = self._d_temp.at[slots_fin].set(temps)
             self._d_topk = self._d_topk.at[slots_fin].set(topks)
             self._d_topp = self._d_topp.at[slots_fin].set(topps)
@@ -1713,17 +1801,33 @@ class GenerationEngine:
         ops["decode"] = op_decode
 
         def op_verify(tokens, slots, starts, nvalid, drafts, ndraft,
-                      counter, skey, tbl):
+                      counter, skey, tbl, cn=None):
             (n_acc, final, self._ck, self._cv,
              self._d_last_tok) = self._verify_fn(
                 self.params, self._ck, self._cv, self._d_last_tok,
                 self._d_temp, self._d_topk, self._d_topp, tokens, slots,
                 starts, nvalid, drafts, ndraft, np.int32(counter),
-                skey=skey, paged=self._paged_from(tbl),
+                skey=skey, paged=self._paged_from(tbl), cn=cn,
             )
             return n_acc, final
 
         ops["verify"] = op_verify
+
+        def op_cnstep(packed, masks, bids, bvals, tbl):
+            # masked single-step decode for constrained slots. The jit is
+            # built on first use — leader and follower alike only ever
+            # trace it when constrained traffic actually dispatches here,
+            # which is what keeps TPU_CONSTRAIN=0 a zero-trace no-op.
+            if self._cn_step_fn is None:
+                self._cn_step_fn = self._build_cn_step()
+            out, self._ck, self._cv, self._d_last_tok = self._cn_step_fn(
+                self.params, self._ck, self._cv, packed, self._d_temp,
+                self._d_topk, self._d_topp, self._d_last_tok, masks, bids,
+                bvals, paged=self._paged_from(tbl),
+            )
+            return out
+
+        ops["cnstep"] = op_cnstep
 
         def op_samprow(b, temp, topk, topp, last):
             # single-slot sampling-state restore (preempt-restore path)
@@ -1998,20 +2102,29 @@ class GenerationEngine:
                  **self._shard_out(["repl", "repl", "k", "v", "repl"]))
         def verify_fn(params, ck, cv, d_last, d_temp, d_topk, d_topp,
                       tokens, slots, starts, nvalid, drafts, ndraft,
-                      counter, skey, paged=None):
+                      counter, skey, paged=None, cn=None):
             logits, ck, cv = llama_prefill_chunk_batch(
                 cfg, params, ck, cv, tokens, slots, starts, nvalid,
                 skey=skey, all_logits=True, paged=paged,
             )  # [A, C, V]
             if mask is not None:
                 logits = jnp.where(mask, logits, -jnp.inf)
+            # constrained verify rounds: per-POSITION automaton masks
+            # ([A, C, W] — row j constrains the token at draft offset j)
+            # applied BEFORE accept/reject, so the draft acceptance test
+            # and the rejection-resampling residual both see the
+            # renormalized masked target — distribution-exact under the
+            # constraint. cn=None (unconstrained rounds) keeps the
+            # pre-existing executable (the paged=None trailing pattern).
+            if cn is not None:
+                logits = apply_token_mask(logits, cn[0], cn[1], cn[2])
             temp = d_temp[slots]
             topk = d_topk[slots]
             topp = d_topp[slots]
             rng = jax.random.fold_in(base_key, counter)
             n_acc, final = spec_verify(
                 logits, drafts, ndraft, rng, temp, topk, topp,
-                active=slots < B,
+                active=slots < B, exact=cn is not None,
             )
             # the round's final token into the device ring: the next decode
             # round reads its input from d_last without host staging
@@ -2019,6 +2132,51 @@ class GenerationEngine:
             return n_acc, final, ck, cv, d_last
 
         return verify_fn
+
+    def _build_cn_step(self):
+        """Masked SINGLE-step decode for constrained slots (op "cnstep").
+
+        Constrained slots cannot ride the K-step pipelined scan: the mask
+        for step j+1 depends on the token sampled at step j, which only the
+        host-side automaton can produce. So constrained traffic decodes one
+        committed-exact masked step per loop iteration — compact packed
+        [lengths | slot_ids | counter] exactly like decode_body's compact
+        path, plus the packed [Ba, W] mask rows and [Ba, NB] bias arrays.
+        Built lazily on the first constrained dispatch; under
+        TPU_CONSTRAIN=0 it never exists (zero-trace kill switch)."""
+        cfg = self.cfg
+        mask = self._allowed_mask
+        impl = self.decode_impl
+        base_key = self._base_key
+
+        @partial(jax.jit, donate_argnums=(1, 2, 7),
+                 **self._shard_out(["repl", "k", "v", "repl"]))
+        def cn_step_fn(params, ck, cv, packed, d_temp, d_topk, d_topp,
+                       d_last, masks, bids, bvals, paged=None):
+            Ba = (packed.shape[0] - 1) // 2
+            lengths = packed[:Ba]
+            slot_ids = packed[Ba : 2 * Ba]
+            tokens = d_last[slot_ids]
+            temp = d_temp[slot_ids]
+            topk = d_topk[slot_ids]
+            topp = d_topp[slot_ids]
+            rng = jax.random.fold_in(base_key, packed[-1])
+            logits, ck, cv = llama_decode_step(
+                cfg, params, ck, cv, tokens, lengths, attn_impl=impl,
+                slot_ids=slot_ids, paged=paged,
+            )
+            if mask is not None:
+                logits = jnp.where(mask, logits, -jnp.inf)
+            logits = apply_token_mask(logits, masks, bids, bvals)
+            S_cache = (ck["q"] if isinstance(ck, dict) else ck).shape[3]
+            new = sample_tokens(
+                logits, rng, temp, topk, topp, active=lengths < S_cache,
+                exact=True,
+            )
+            d_last = d_last.at[slot_ids].set(new)
+            return new, ck, cv, d_last
+
+        return cn_step_fn
 
     def stall_seconds(self) -> float:
         """Age of the engine loop's last progress stamp. Large values with
@@ -2445,6 +2603,8 @@ class GenerationEngine:
         stop: list[str] | None = None,
         priority: int = 0,
         tenant: str = "",
+        constraint: dict | None = None,
+        logit_bias: list | None = None,
     ) -> Iterator[dict[str, Any]]:
         """Yield {"type":"token","text":...} events then a final
         {"type":"done", "usage":..., "finish_reason":...}."""
@@ -2459,6 +2619,8 @@ class GenerationEngine:
             priority=priority,
             trace_ctx=tracing.current_traceparent(),
             tenant=tenant,
+            constraint=constraint,
+            logit_bias=logit_bias,
         )
         self.submit(req)
         while True:
@@ -2549,6 +2711,39 @@ class GenerationEngine:
             "accept_rate": (self.spec_accepted / drafted) if drafted else 0.0,
             "tok_per_call": (self.spec_emitted / calls) if calls else 0.0,
         }
+
+    def constrain_stats(self) -> dict[str, Any]:
+        """Constrained-decoding observability (/v1/debug/constrain + the
+        bench line of record): traffic counters, the token-level validity
+        proof (illegal_tokens must be 0 — the mask makes illegal emission
+        impossible by construction; the counter is the check), per-token
+        host mask cost, spec-composition acceptance, and the schema
+        compile-cache economics."""
+        toks = float(self.cn_tokens)
+        fin = float(self.cn_finished)
+        drafted = float(self.cn_spec_drafted)
+        out: dict[str, Any] = {
+            "enabled": 1.0 if self._constrain is not None else 0.0,
+            "requests": float(self.cn_requests),
+            "tokens": toks,
+            "illegal_tokens": float(self.cn_illegal),
+            "finished": fin,
+            "finished_accepting": float(self.cn_finished_accepting),
+            # token-level validity: every constrained token was automaton-
+            # legal AND every finished constrained request ended accepting
+            "schema_valid_rate": (
+                (self.cn_finished_accepting / fin) if fin else 1.0
+            ) if self.cn_illegal == 0 else 0.0,
+            "mask_us_per_tok": (self.cn_mask_s * 1e6 / toks) if toks else 0.0,
+            "spec_drafted": drafted,
+            "spec_accepted": float(self.cn_spec_accepted),
+            "spec_accept_rate": (
+                (self.cn_spec_accepted / drafted) if drafted else 0.0
+            ),
+        }
+        if self._constrain is not None:
+            out["cache"] = self._constrain.stats()
+        return out
 
     def _offered_load(self) -> float:
         """Offered load the admission watermark compares against, in
@@ -3645,6 +3840,8 @@ class GenerationEngine:
             created_at=float(header.get("created_at") or time.time()),
             trace_ctx=header.get("trace_ctx") or "",
             migrations=int(header.get("migrations") or 0) + 1,
+            constraint=header.get("constraint"),
+            logit_bias=header.get("logit_bias"),
         )
         if out is not None:
             req.out = out
@@ -3716,6 +3913,28 @@ class GenerationEngine:
                         s.req.out.put({"type": "error", "error": str(e)})
                         s.req.out.put(_DONE)
                         continue
+            if self._constrain is not None and (
+                header.get("constraint") or header.get("logit_bias")
+            ):
+                # rebuild the automaton cursor HERE (engine thread — the
+                # compile cache is not locked) and replay the consumed ids
+                # so masking resumes mid-constraint on this host
+                try:
+                    s.req.cn = self._constrain.make(
+                        header.get("constraint"), header.get("logit_bias")
+                    )
+                except constrain.GrammarError as e:
+                    self._count_error()
+                    s.req.out.put(
+                        {"type": "error", "error": f"constraint: {e}"}
+                    )
+                    s.req.out.put(_DONE)
+                    continue
+                self.cn_requests += 1
+                s.req.cn.replay(
+                    [int(t) for t in header.get("cn_tokens") or []]
+                )
+                s.cn = s.req.cn
             try:
                 self._restore_snapshot(slot, snap)
             except Exception as e:
@@ -3852,6 +4071,24 @@ class GenerationEngine:
                 i for i, s in enumerate(self._slots)
                 if s is not None and self._lengths[i] + K <= S
             ]
+            # Constrained slots leave the pipelined path entirely: their
+            # next mask depends on their previous token, so each round is
+            # synchronous and committed-exact (_cn_round — masked verify
+            # when drafts compose, masked single step otherwise). They are
+            # never in `inflight`, so no drain is needed here, and they
+            # must never leak into the UNMASKED spec rounds below.
+            cn_active = [i for i in active if self._slots[i].cn is not None]
+            active = [i for i in active if self._slots[i].cn is None]
+            if cn_active:
+                try:
+                    timed("dispatch", self._cn_round, cn_active)
+                except Exception as e:
+                    # cn jits donate the cache chain like decode rounds: a
+                    # poisoned dispatch invalidates in-flight rounds too
+                    if pending is not None:
+                        self._emit_round(pending)
+                        pending = None
+                    drain_failed(e, also=cn_active)
             if self._verify_fn is not None and active:
                 if self._spec_cooldown > 0:
                     self._spec_cooldown -= 1
@@ -3879,10 +4116,13 @@ class GenerationEngine:
                         timed("emit", self._emit_round, fetched)
                     if ok:
                         # re-draft against the post-drain history (slots may
-                        # have finished; tokens arrived)
+                        # have finished; tokens arrived). Constrained slots
+                        # stay filtered out — they already ran their masked
+                        # round above and must not join an unmasked verify.
                         active = [
                             i for i, s in enumerate(self._slots)
                             if s is not None and self._lengths[i] + K <= S
+                            and s.cn is None
                         ]
                         entries = self._stage_spec(active) if active else None
                         if entries is not None:
@@ -3960,7 +4200,8 @@ class GenerationEngine:
                 except Exception as e:  # poisoned execution surfaces at fetch
                     inflight.appendleft(disp)  # drain fails its slots too
                     drain_failed(e)
-            elif not (active or admitted or group is not None or inflight):
+            elif not (active or cn_active or admitted or group is not None
+                      or inflight):
                 t_idle = time.perf_counter()
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
@@ -4008,6 +4249,58 @@ class GenerationEngine:
         if self._recover_cache():
             # mid-prefill KV lives in the same buffers
             self._abort_all("kv cache lost in failed decode round")
+
+    def _cn_attach(self, req: GenRequest) -> bool:
+        """Compile the request's constraint (and/or logit_bias) into the
+        per-slot automaton cursor on ``req.cn``. Compilation is host-only
+        and LRU-cached by schema hash; a bad spec errors the request here
+        (the API already 400s well-formed-but-unsupported specs, this is
+        the engine-side backstop). Returns False when the request died."""
+        if self._constrain is None or not (req.constraint or req.logit_bias):
+            return True
+        before = self._constrain.stats_d["misses"]
+        t0 = time.perf_counter()
+        try:
+            req.cn = self._constrain.make(req.constraint, req.logit_bias)
+        except constrain.GrammarError as e:
+            self._count_error()
+            req.out.put({"type": "error", "error": f"constraint: {e}"})
+            req.out.put(_DONE)
+            return False
+        self.cn_requests += 1
+        self._flight.event(
+            "cn_cmp",
+            miss=self._constrain.stats_d["misses"] > before,
+            states=req.cn.cc.n_states() if req.cn.cc is not None else 0,
+            us=int((time.perf_counter() - t0) * 1e6),
+        )
+        return True
+
+    def _cn_payload(self, cns: list, n_rows: int):
+        """Pack (masks, bias_ids, bias_vals) dispatch operands for a round
+        of ``n_rows`` rows where row i serves cursor ``cns[i]`` (None =
+        unconstrained). Returns None when nothing is constrained — the op
+        closures then call the unmasked executable, so plain traffic never
+        traces a masked variant. Pad/unconstrained rows get all-ones masks
+        and empty bias (mask-add of 0 over everything = identity)."""
+        if not any(cn is not None for cn in cns):
+            return None
+        t0 = time.perf_counter()
+        W = constrain.mask_words(self.cfg.vocab_size)
+        NB = self.cn_bias_max
+        masks = np.full((n_rows, W), 0xFFFFFFFF, dtype=np.uint32)
+        bids = np.full((n_rows, NB), -1, dtype=np.int32)
+        bvals = np.zeros((n_rows, NB), dtype=np.float32)
+        for i, cn in enumerate(cns):
+            if cn is None:
+                continue
+            masks[i] = cn.mask_row()
+            nb = min(len(cn.bias_ids), NB)
+            if nb:
+                bids[i, :nb] = cn.bias_ids[:nb]
+                bvals[i, :nb] = cn.bias_vals[:nb]
+        self.cn_mask_s += time.perf_counter() - t0
+        return masks, bids, bvals
 
     def _admit_pending(self) -> bool:
         admitted = False
@@ -4061,6 +4354,8 @@ class GenerationEngine:
                     req.out.put(_DONE)
                     continue
                 admitted = True
+                if not self._cn_attach(req):
+                    continue  # bad constraint spec: request already errored
                 ent = self._match_prefix(ids)
                 if ent is not None:
                     # cached prefix: copy its KV rows, chunk-prefill only
@@ -4676,11 +4971,14 @@ class GenerationEngine:
             fpack[Ab + i] = req.top_p
         ipack[3 * Ab] = A
         ipack[3 * Ab + 1] = self._next_counter()
+        # constrained admissions: the first sampled token rides the same
+        # fused dispatch, so its mask (start-state row) and bias must too
+        cn_payload = self._cn_payload([req.cn for _, req, _ in batch], Ab)
         # ONE fused dispatch: prefill + cache inserts + device sampling-param
         # rows + first-token sample (see admit_fn)
-        first = self._note_exec_shape("admit", Ab, bucket)
+        first = self._note_exec_shape("admit", Ab, bucket, cn_payload is not None)
         t0c = time.perf_counter()
-        toks0 = self._dx("admit", tokens, ipack, fpack)
+        toks0 = self._dx("admit", tokens, ipack, fpack, cn_payload)
         t_call = time.perf_counter()  # jit returned; device running
         toks0 = np.asarray(toks0)  # host sync: first-call wall ≈ compile time
         if first:
@@ -4709,6 +5007,9 @@ class GenerationEngine:
         self._maybe_store_prefix(slot, ids)
         self._recent_prompts.append(tuple(ids))
         s = _Slot(req=req, prompt_len=P, first_token_at=time.time())
+        # the automaton cursor moves onto the slot BEFORE tok0 is emitted:
+        # _process_token advances it for every token including the first
+        s.cn = req.cn
         # prefix-hit provenance rides the _PrefillState onto the live slot
         # (still present here — _finish_prefill_group deletes it after);
         # preemption uses it to snapshot only the private rows
@@ -5113,9 +5414,14 @@ class GenerationEngine:
             temps = np.asarray([st.req.temperature for _, _, st in fin], np.float32)
             topks = np.asarray([st.req.top_k for _, _, st in fin], np.int32)
             topps = np.asarray([st.req.top_p for _, _, st in fin], np.float32)
+            # constrained slots finishing their chunked prefill sample
+            # tok0 here: their start-state masks ride the same dispatch
+            cn_payload = self._cn_payload(
+                [st.req.cn for _, _, st in fin], len(fin)
+            )
             toks0 = self._dx(
                 "bsample", group.gid, rows, slots_fin, temps, topks, topps,
-                self._next_counter(),
+                self._next_counter(), cn_payload,
             )
             if fin:
                 toks0 = np.asarray(toks0)
@@ -5185,6 +5491,13 @@ class GenerationEngine:
             if int(self._lengths[b]) + C > S:
                 return None
             d = s.spec.draft(self.spec_k)
+            if d and s.cn is not None:
+                # spec × constraint composition: truncate the draft to its
+                # longest automaton-legal prefix, so every draft position
+                # verify scores is constraint-legal BY CONSTRUCTION and a
+                # masked target can never be asked to accept an illegal
+                # token (it would always reject — wasted verify width)
+                d = s.cn.filter_draft(d)
             if d:
                 n_drafting += 1
             entries.append((b, d))
@@ -5230,18 +5543,48 @@ class GenerationEngine:
             pow2_bucket(int(starts_arr[:n].max()), self.max_seq_len),
             self.max_seq_len,
         )
+        # constrained verify rounds (reached only via _cn_round, so the
+        # round is HOMOGENEOUS — every live row carries an automaton):
+        # per-position packed masks + the per-request bias arrays ride the
+        # payload; pad rows/positions stay all-ones (spec_verify never
+        # reads past each row's valid draft span)
+        cn_objs = [self._slots[b].cn for b, _ in entries]
+        constrained = any(c is not None for c in cn_objs)
+        cn_payload = None
+        if constrained:
+            t_m = time.perf_counter()
+            W = constrain.mask_words(self.cfg.vocab_size)
+            NB = self.cn_bias_max
+            masks = np.full((A, C, W), 0xFFFFFFFF, dtype=np.uint32)
+            bids = np.full((A, NB), -1, dtype=np.int32)
+            bvals = np.zeros((A, NB), dtype=np.float32)
+            for i, (b, d) in enumerate(entries):
+                cn = cn_objs[i]
+                if cn is None:
+                    continue
+                rows = cn.masks_for_draft(d)
+                masks[i, : rows.shape[0]] = rows
+                nb = min(len(cn.bias_ids), NB)
+                if nb:
+                    bids[i, :nb] = cn.bias_ids[:nb]
+                    bvals[i, :nb] = cn.bias_vals[:nb]
+            self.cn_mask_s += time.perf_counter() - t_m
+            cn_payload = (masks, bids, bvals)
         first = self._note_exec_shape("verify", A, C, skey,
-                                      self._phys is not None)
+                                      self._phys is not None, constrained)
         n_acc, final = self._dx(
             "verify", tokens, slots_arr, starts_arr, nv_arr, drafts_arr,
             nd_arr, self._next_counter(), skey, self._paged_payload(),
+            cn_payload,
         )
         t_call = time.perf_counter()  # jit returned (dispatch is async)
         n_acc = np.asarray(n_acc)  # the round's host sync point
         final = np.asarray(final)
         if first:
-            self._compile_obs("verify", (A, C, skey, self._phys is not None),
-                              time.perf_counter() - t0)
+            self._compile_obs(
+                "verify", (A, C, skey, self._phys is not None, constrained),
+                time.perf_counter() - t0,
+            )
         elif self._perf.should_sample("verify"):
             # verify is synchronous, so the asarray fetch IS the device wall
             t_done = time.perf_counter()
@@ -5311,6 +5654,15 @@ class GenerationEngine:
         self._flight.event(
             "verify", rows=n, drafted=drafted_round, accepted=accepted_round,
         )
+        if constrained:
+            # spec × constraint composition telemetry: how much of the
+            # filtered draft stream survives the masked target
+            self.cn_spec_drafted += drafted_round
+            self.cn_spec_accepted += accepted_round
+            self._flight.event(
+                "cn_spec", rows=n, drafted=drafted_round,
+                accepted=accepted_round,
+            )
         self._anomaly.signal(
             "spec_collapse", drafted=drafted_round, accepted=accepted_round
         )
@@ -5319,6 +5671,115 @@ class GenerationEngine:
             # history): a verify round still emits >=1 token per slot, but a
             # decode round emits K — back off before re-probing
             self._spec_cooldown = 50
+        with self.stats_lock:
+            self._window.append((time.time(), self.total_tokens - before))
+
+    def _cn_round(self, cn_active: list[int]) -> None:
+        """One synchronous round for the constrained slots. Constrained
+        traffic composes with speculation first: when the n-gram drafters
+        have automaton-filtered drafts for a majority of constrained slots,
+        the round IS a masked verify (_spec_round with the cn payload —
+        per-position masks applied before accept/reject, so the committed
+        tokens follow the renormalized masked target exactly). Otherwise
+        one masked single decode step (op "cnstep"). Either way the round
+        commits before returning: constrained slots are never pipelined,
+        because the mask for token t+1 only exists after the host automaton
+        consumed token t."""
+        if self._verify_fn is not None and self._spec_cooldown <= 0:
+            entries = self._stage_spec(cn_active)
+            if entries is not None:
+                self._spec_round(entries)
+                return
+        self._cn_step_round(cn_active)
+
+    def _cn_step_round(self, cn_active: list[int]) -> None:
+        """Masked single-step decode round: gather each slot automaton's
+        current packed mask row + bias arrays, dispatch op "cnstep", and
+        commit the sampled token through _process_token (which advances
+        the automaton for the NEXT round's masks)."""
+        maybe_fail("engine.cnstep", f"slots={cn_active}")
+        t0 = time.perf_counter()
+        B = self.max_slots
+        S = self.max_seq_len
+        n = len(cn_active)
+        Ba = pow2_bucket(n, B, floor=min(8, B))
+        act = np.asarray(cn_active, dtype=np.int32)
+        if Ba > n:
+            # pad rows must target an inactive cache row (the same append-
+            # tile safety rule as _dispatch_decode's compact path)
+            in_round = set(cn_active)
+            free = next(
+                (i for i in range(B)
+                 if self._slots[i] is None and i not in self._prefills),
+                next(
+                    (i for i in range(B) if self._slots[i] is None),
+                    next(i for i in range(B) if i not in in_round),
+                ),
+            )
+        else:
+            free = 0  # Ba == n: no pad rows exist
+        ids = np.full(Ba, free, dtype=np.int32)
+        ids[:n] = act
+        lens_in = np.full(Ba, S, dtype=np.int32)
+        lens_in[:n] = self._lengths[act]
+        packed = np.concatenate(
+            [lens_in, ids, [self._next_counter()]]
+        ).astype(np.int32)
+        # host mask gather: memoized per automaton state, so steady-state
+        # cost is a dict hit + row copy per slot (cn_mask_s / cn_tokens is
+        # the published mask_us_per_tok)
+        masks, bids, bvals = self._cn_payload(
+            [self._slots[b].cn for b in cn_active], Ba
+        )
+        first = self._note_exec_shape("cnstep", Ba, self._phys is not None)
+        toks = self._dx(
+            "cnstep", packed, masks, bids, bvals, self._paged_payload()
+        )
+        t_call = time.perf_counter()
+        toks = np.asarray(toks)  # synchronous round: this is the device wall
+        if first:
+            self._compile_obs("cnstep", (Ba, self._phys is not None),
+                              time.perf_counter() - t0)
+        elif self._perf.should_sample("cnstep"):
+            t_done = time.perf_counter()
+            wait_s = max(0.0, t0 - self._perf_mark)
+            self._perf.observe_phase(
+                "cnstep", t_call - t0, t_done - t_call, wait_s,
+                tokens=n, rows=n,
+                ctx_mean=float(lens_in[:n].mean()) if n else 0.0,
+            )
+            self._flight.event(
+                "perf", phase="cnstep",
+                host_ms=round((t_call - t0) * 1e3, 3),
+                device_ms=round((t_done - t_call) * 1e3, 3),
+                wait_ms=round(wait_s * 1e3, 3),
+                rows=n,
+            )
+        before = self.total_tokens
+        blk_wants: dict[int, int] = {}
+        for i, b in enumerate(cn_active):
+            s = self._slots[b]
+            if s is None or s.done:
+                continue
+            if s.aborted:
+                self._free_now(b)
+                continue
+            pos = int(self._lengths[b])
+            gen_before = s.generated
+            emit, finish = self._process_token(s, int(toks[i]), pos)
+            self._observe_itl(s, s.generated - gen_before)
+            if emit:
+                s.req.out.put({"type": "token", "text": emit})
+            if finish is not None:
+                self._finish_slot(b, s, finish)
+            else:
+                self._lengths[b] = pos + 1
+                self._last_tok[b] = int(toks[i])
+                blk_wants[b] = pos + 1
+        if blk_wants:
+            self._paging.extend_many(blk_wants)
+        self._last_round_ts = time.time()  # cn rounds are decode cadence too
+        self._flight.event("cnstep", rows=n)
         with self.stats_lock:
             self._window.append((time.time(), self.total_tokens - before))
 
@@ -5713,6 +6174,14 @@ class GenerationEngine:
         finish = None
         emit = ""
         cut = -1
+        if s.cn is not None:
+            # the single automaton hook for every emission path (admit tok0,
+            # decode rounds, verify commits, cn steps): consume the token so
+            # the next mask reflects it. The mask made an illegal token
+            # impossible — cn_illegal is the live proof (must stay 0).
+            self.cn_tokens += 1
+            if not s.cn.advance(tok):
+                self.cn_illegal += 1
         if tok == self.tokenizer.eos_id:
             finish = "stop"
         else:
@@ -5766,6 +6235,13 @@ class GenerationEngine:
         with self.stats_lock:
             self.finished_requests += 1
             self.finished_tokens += s.generated
+        if s.cn is not None and s.cn.constrained:
+            # schema validity at the REQUEST level: a constrained stream
+            # that ends anywhere but an accepting automaton state produced
+            # a syntactically incomplete document (e.g. cut by max_tokens)
+            self.cn_finished += 1
+            if s.cn.accepting:
+                self.cn_finished_accepting += 1
         ttft_ms = (s.first_token_at - req.created_at) * 1000.0
         itl_mean_ms = (
             s.itl_s_total / s.itl_samples * 1e3 if s.itl_samples else 0.0
@@ -5798,6 +6274,8 @@ class GenerationEngine:
                 # accepted counts explain the tok_per_s figure
                 attrs["spec_drafted"] = s.spec_drafted
                 attrs["spec_accepted"] = s.spec_accepted
+            if s.cn is not None and s.cn.constrained:
+                attrs["cn_accepting"] = bool(s.cn.accepting)
             tracing.get_tracer().record(
                 "engine.decode", s.first_token_at, now,
                 parent=req.trace_ctx, attrs=attrs,

@@ -183,6 +183,17 @@ def snapshot_header(snap: KVSnapshot, req: Any, slot: Any) -> dict[str, Any]:
         "text": slot.text,
         "pending_b64": base64.b64encode(slot.pending).decode("ascii"),
         "prompt_len": int(slot.prompt_len),
+        # grammar-constrained decoding: ship the raw spec + the ids the
+        # automaton has consumed; the destination recompiles against its
+        # own cache and replays to the same state (automaton internals
+        # never cross the wire — they are engine-local memo tables)
+        "constraint": getattr(req, "constraint", None),
+        "logit_bias": getattr(req, "logit_bias", None),
+        "cn_tokens": (
+            [int(t) for t in slot.cn.consumed]
+            if getattr(slot, "cn", None) is not None
+            else None
+        ),
     }
 
 

@@ -19,6 +19,45 @@ import jax.numpy as jnp
 _CANDIDATES = 64
 
 
+def expand_mask(packed: jnp.ndarray, V: int) -> jnp.ndarray:
+    """Unpack a `[..., ceil(V/32)] uint32` token bitmask to `[..., V]` bool.
+
+    Bit layout matches the host-side constrain/masks.py packer: token id
+    ``t`` lives at bit ``t & 31`` of word ``t >> 5``. The gather+shift
+    compiles to a handful of vector ops — no host round-trip, so the
+    packed words are all that crosses PCIe per constrained row."""
+    ids = jnp.arange(V, dtype=jnp.uint32)
+    word = packed[..., (ids >> 5).astype(jnp.int32)]
+    return ((word >> (ids & jnp.uint32(31))) & jnp.uint32(1)).astype(jnp.bool_)
+
+
+def apply_token_mask(
+    logits: jnp.ndarray,  # [B, V] or [A, C, V]
+    packed: jnp.ndarray | None,  # [B, W] / [A, C, W] uint32, or None
+    bias_ids: jnp.ndarray | None = None,  # [B, NB] int32, -1 = pad
+    bias_vals: jnp.ndarray | None = None,  # [B, NB] float32
+) -> jnp.ndarray:
+    """Constraint mask + `logit_bias` on one static-shape path.
+
+    Bias is scattered densely FIRST (so a bias can reweight within the
+    legal set), then illegal tokens go to -inf — a bias can never
+    resurrect a token the automaton forbids. Bias rows are per-request
+    ([B, NB]) and broadcast across chunk positions for 3-D verify
+    logits; pad entries use id -1 (add 0 at column 0, harmless)."""
+    V = logits.shape[-1]
+    out = logits
+    if bias_ids is not None and bias_vals is not None:
+        B = bias_ids.shape[0]
+        safe = jnp.maximum(bias_ids, 0)
+        vals = jnp.where(bias_ids >= 0, bias_vals, 0.0).astype(logits.dtype)
+        dense = jnp.zeros((B, V), dtype=logits.dtype)
+        dense = dense.at[jnp.arange(B)[:, None], safe].add(vals)
+        out = out + (dense[:, None, :] if logits.ndim == 3 else dense)
+    if packed is not None:
+        out = jnp.where(expand_mask(packed, V), out, -jnp.inf)
+    return out
+
+
 def sample_tokens(
     logits: jnp.ndarray,  # [B, V] float32
     rng: jax.Array,
@@ -26,6 +65,7 @@ def sample_tokens(
     top_k: jnp.ndarray,  # [B] int32 (0 = disabled)
     top_p: jnp.ndarray,  # [B] float32 (1.0 = disabled)
     active: jnp.ndarray | None = None,  # [B] bool — rows whose sample matters
+    exact: bool = False,  # static: force exact top-k windows (constrained rows)
 ) -> jnp.ndarray:
     """Sample one token per row. temperature<=0 → greedy argmax.
 
@@ -56,7 +96,9 @@ def sample_tokens(
         return jnp.argmax(logits / temp + g, axis=-1).astype(jnp.int32)
 
     def _windowed(_):
-        return _sample_windowed(logits, rng, temperature, top_k, top_p, n_cand)
+        return _sample_windowed(
+            logits, rng, temperature, top_k, top_p, n_cand, exact=exact
+        )
 
     plain = _pred((top_k <= 0) & (top_p >= 1.0) & (temperature > 0.0))
     return jax.lax.cond(
@@ -74,6 +116,7 @@ def _sample_windowed(
     top_k: jnp.ndarray,
     top_p: jnp.ndarray,
     n_cand: int,
+    exact: bool = False,
 ) -> jnp.ndarray:
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -82,8 +125,10 @@ def _sample_windowed(
     # approx_max_k uses the TPU-native approximate top-k (recall ~0.95 within
     # the window) — exact lax.top_k over a 128k vocab costs ~1.5 ms/step at
     # B=64, several times the logits head itself. Results come back sorted
-    # descending, which the top-p prefix logic below relies on.
-    if V > 4 * n_cand:
+    # descending, which the top-p prefix logic below relies on. Constrained
+    # rows force `exact`: with a tiny automaton-legal set a 0.95-recall
+    # window could miss EVERY legal token and sample from a -inf row.
+    if V > 4 * n_cand and not exact:
         cand_logits, cand_idx = jax.lax.approx_max_k(
             logits, n_cand, recall_target=0.95, aggregate_to_topk=True
         )
@@ -120,6 +165,7 @@ def spec_verify(
     top_k: jnp.ndarray,  # [A] int32 (0 = disabled)
     top_p: jnp.ndarray,  # [A] float32 (1.0 = disabled)
     active: jnp.ndarray | None = None,  # [A] bool — rows whose result matters
+    exact: bool = False,  # static: force exact top-k windows (constrained rows)
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Accept/reject a deterministic draft against the target logits and
     sample the one token that always follows.
@@ -206,7 +252,7 @@ def spec_verify(
         # the same candidate-window distribution _sample_windowed draws
         # from, applied per chunk position
         flat = logits.reshape(A * C, V)
-        if V > 4 * n_cand:
+        if V > 4 * n_cand and not exact:
             cand_logits, cand_idx = jax.lax.approx_max_k(
                 flat, n_cand, recall_target=0.95, aggregate_to_topk=True
             )

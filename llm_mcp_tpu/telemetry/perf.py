@@ -73,7 +73,8 @@ __all__ = [
 # Steady-state dispatch phases: every one has a CompileLedger phase string,
 # a flight-recorder etype, and a cost model in PHASE_COSTS (lint-enforced).
 DISPATCH_PHASES = (
-    "admit", "chunk", "decode", "fused", "fused_rag", "pf_rag", "verify",
+    "admit", "chunk", "cnstep", "decode", "fused", "fused_rag", "pf_rag",
+    "verify",
 )
 # Compile-ledger-only phases: rare, data-dependent dispatches (COW block
 # copies, pool offload staging, host-payload pool puts on the fleet
@@ -256,6 +257,7 @@ PHASE_COSTS = {
     "admit": _prefill_cost,
     "chunk": _prefill_cost,
     "pf_rag": _prefill_cost,
+    "cnstep": _decode_cost,  # one masked decode step: decode-shaped
     "decode": _decode_cost,
     "fused": _decode_cost,
     "fused_rag": _decode_cost,

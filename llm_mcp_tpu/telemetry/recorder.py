@@ -45,7 +45,12 @@ An *event* is a tuple ``(seq, ts, etype, trace_id, fields)``:
             registration with residency — executor/zoo.py) / swap_in /
             swap_out (zoo residency moves, with byte counts and wall
             seconds: page parked host weights into HBM / park a resident
-            engine's tree back to host RAM)
+            engine's tree back to host RAM) / cn_cmp (one constraint
+            compile at admission: cache miss flag, automaton states,
+            wall — llm_mcp_tpu/constrain) / cnstep (one grammar-masked
+            single-step decode round, with row count) / cn_spec (one
+            constrained speculative verify round: drafted vs accepted
+            token counts under per-position masks)
   trace_id  the request's 32-hex trace id ("" for engine-global events) —
             a dump stitches directly into /v1/traces
   fields    flat dict of scalars (or None)

@@ -66,6 +66,7 @@ from collections import deque
 from typing import Any, Iterable
 
 __all__ = [
+    "AGENT_TOOL_SCHEMAS",
     "LatencyWaterfall",
     "SCHEMA_VERSION",
     "STAGES",
@@ -84,6 +85,27 @@ DEFAULT_RING = 8192
 # head hashes only: enough chain entries to see prefix-sharing structure
 # without shipping the whole boundary list for an 8k prompt
 CHAIN_HEAD = 8
+
+# Canned tool-call schemas for the synthetic agent workload. These are
+# CLOSED schemas — every field is an enum or boolean, so the constraint
+# automaton's accepting state has no outgoing transitions and the mask
+# forces EOS there. A grammar-constrained replay therefore terminates
+# with valid JSON on ANY model, which is what lets bench.py's
+# schema_valid_rate gate demand exactly 1.0 (scripts/perf_gate.py).
+AGENT_TOOL_SCHEMAS: tuple = (
+    {"type": "object", "properties": {
+        "tool": {"enum": ["search", "fetch", "calc"]},
+        "urgent": {"type": "boolean"},
+    }},
+    {"type": "object", "properties": {
+        "action": {"enum": ["read", "write", "list"]},
+        "confirm": {"enum": ["yes", "no"]},
+    }},
+    {"type": "object", "properties": {
+        "op": {"enum": ["add", "mul", "div"]},
+        "commit": {"type": "boolean"},
+    }},
+)
 
 STAGES = (
     "admit_wait",
@@ -384,7 +406,11 @@ def synth_trace(kind: str, n: int, seed: int = 0, start_ts: float = 0.0) -> list
               shaped traffic: all prefill, no decode)
       longctx sparse arrivals, 1k-8k prompts, short outputs
       agent   bursty tool-call loops: 3-8 requests per burst sharing one
-              prefix chain (the conversation so far), think-time between
+              prefix chain (the conversation so far), think-time between.
+              Each burst is one tool loop, so its records carry the SAME
+              tool-call JSON schema under ``rec["schema"]`` (drawn from
+              AGENT_TOOL_SCHEMAS) — bench.py's constrained sweep wraps it
+              as a json_schema constraint for grammar-constrained replay
     """
     rng = random.Random((seed << 8) ^ len(kind))
     ts = float(start_ts)
@@ -421,6 +447,9 @@ def synth_trace(kind: str, n: int, seed: int = 0, start_ts: float = 0.0) -> list
         while i < n:
             ts += rng.uniform(2.0, 8.0)  # think-time between tool loops
             shared = f"agent{seed}:burst{burst}"
+            # one tool per loop: every request in the burst emits a call
+            # shaped by the same (closed) JSON schema
+            sch = AGENT_TOOL_SCHEMAS[rng.randrange(len(AGENT_TOOL_SCHEMAS))]
             grow = 0
             for _ in range(min(rng.randint(3, 8), n - i)):
                 ts += rng.uniform(0.05, 0.4)  # tool round-trip
@@ -430,6 +459,7 @@ def synth_trace(kind: str, n: int, seed: int = 0, start_ts: float = 0.0) -> list
                           mt=rng.randint(16, 96),
                           temp=0.0,
                           chain_seed=shared)
+                rec["schema"] = sch
                 out.append(rec)
                 i += 1
             burst += 1

@@ -103,6 +103,22 @@ Checks, in order of authority:
      HBM through the warmup path. Hosts that skip the zoo sweep omit
      both keys and [SKIP].
 
+  11. Constrained-decoding checks, when the record carries them (ISSUE
+     20, the BENCH_CONSTRAIN=1 agent-trace replay): schema_valid_rate
+     is an exact check — it must be EXACTLY 1.0, no baseline leniency
+     and no tolerance band. The bench agent schemas are closed (every
+     field enum/boolean), so the automaton's accepting state has no
+     outgoing transitions and the mask forces EOS: a finished request
+     that is not valid JSON matching its schema, or any single
+     automaton-illegal token, is a masking bug, not model weakness.
+     constrain_mask_us_per_tok <= 500 ceilings the host-side mask
+     fuse/lift cost per constrained token (past it the automaton walk
+     is recompiling masks instead of hitting the per-state memo);
+     constrain_spec_accept_rate >= 0.05 mirrors the spec_accept_rate
+     floor — constraint-filtered drafts accepted below that rate mean
+     the masked verify is rejecting legal drafts and TPU_SPEC=0 beats
+     composing them. Unconstrained runs omit all three keys and [SKIP].
+
 Missing metrics are reported as [SKIP] with a stderr warning but never
 fail the gate (older records predate newer fields — a KeyError here
 would make every old BENCH_*.json ungateable); a metric PRESENT and
@@ -145,6 +161,7 @@ HIGHER_BETTER = (
     "goodput_ratio",
     "decode_mbu",
     "tenant_isolation",
+    "constrain_spec_accept_rate",
 )
 LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms", "cow_copies_per_req",
                 "attn_us_per_cell", "attn_us_per_cell_paged",
@@ -152,7 +169,8 @@ LOWER_BETTER = ("p50_ttft_ms", "p95_ttft_ms", "cow_copies_per_req",
                 "itl_p95_ms", "waterfall_stall_p95_ms",
                 "waterfall_total_p95_ms",
                 "coldstart_first_token_s", "coldstart_first_token_cold_s",
-                "coldstart_fully_warm_s", "zoo_swap_in_s")
+                "coldstart_fully_warm_s", "zoo_swap_in_s",
+                "constrain_mask_us_per_tok")
 
 # absolute floors/ceilings applied regardless of baseline coverage (only
 # ever read with .get(): a floor for a metric the record lacks must skip,
@@ -165,6 +183,12 @@ ABS_MIN = {
     # pass is pure overhead over plain decode
     "spec_accept_rate": 0.05,
     "spec_tok_per_call": 1.0,
+    # constrained spec composition (ISSUE 20): drafts are automaton-
+    # filtered before staging, so they are constraint-legal by
+    # construction — the masked verify rejecting nearly all of them means
+    # the per-position masks disagree with the filter that built the
+    # drafts, and the composition is overhead, not speedup
+    "constrain_spec_accept_rate": 0.05,
     # embedding throughput drifted down unnoticed across rounds (nomic b1
     # 9.3 → 7.9 /s, qwen3-8b-int8 b64 98 → 90.5 /s between r4 and r5);
     # these floors are well under the worst observed value — they catch a
@@ -283,6 +307,13 @@ ABS_MAX = {
     # the persistent cache + priors should have amortized. Hosts that skip
     # the zoo sweep omit the key → [SKIP]+warning.
     "zoo_swap_in_s": 60.0,
+    # constrained decoding (ISSUE 20): amortized host-side cost of
+    # building/fusing the per-slot token mask, per constrained token.
+    # The per-state mask memo makes steady state a dict hit plus a
+    # [W] uint32 row copy; past 500 µs/tok the automaton walk is
+    # rebuilding masks (memo misses — state explosion or a cache bug)
+    # and the constrain path is throttling decode
+    "constrain_mask_us_per_tok": 500.0,
 }
 
 
@@ -407,6 +438,19 @@ def check(cand: dict, base: dict) -> list[tuple[str, str, str]]:
             )
         else:
             results.append((name, "absent from candidate", "skip"))
+    # exact check, no baseline leniency and no tolerance band: the closed
+    # agent schemas force EOS at the accepting state, so every finished
+    # constrained request IS schema-valid by construction — any fraction
+    # under 1.0 means an automaton-illegal token got sampled (a masking
+    # bug), never that the model was too weak to follow the schema
+    c = metric(cand, "schema_valid_rate")
+    if c is not None:
+        results.append(
+            ("schema_valid_rate", f"{c:.4f} (must be exactly 1.0)",
+             "pass" if c >= 1.0 else "fail")
+        )
+    else:
+        results.append(("schema_valid_rate", "absent from candidate", "skip"))
     # the waterfall stage partition is exact by construction: coverage
     # (sum of stage seconds / measured wall) drifting past 5% of 1.0 means
     # a stage fell out of the ledger, not that requests got slower

@@ -219,7 +219,7 @@ def test_census_flags_unregistered_kernel(tmp_path):
         "pkg/engine.py": "\n",
         "pkg/recorder.py": (
             '"""etypes: pf_rag fused_rag perf wl wf zoo swap_in '
-            'swap_out."""\n'
+            'swap_out cn_cmp cnstep cn_spec."""\n'
         ),
     })
     found = RegistryCensusPass().run(RepoIndex(root, {
